@@ -1,0 +1,92 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on JAX/XLA/Pallas/pjit.
+
+Public surface mirrors `import paddle` (reference:
+python/paddle/__init__.py): tensor ops at top level, plus nn / optimizer /
+autograd / amp / io / jit / static / distributed / vision / ... subpackages.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# dtypes (paddle.float32 etc.)
+from .framework.dtype import (  # noqa: F401
+    bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2,
+    int8, int16, int32, int64, uint8, bool_ as bool8, complex64, complex128,
+)
+from .framework.dtype import bool_  # noqa: F401
+uint16 = __import__("numpy").dtype("uint16")
+
+from .framework import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, set_default_dtype, get_default_dtype,
+    set_flags, get_flags, iinfo, finfo,
+)
+from .core import (  # noqa: F401
+    Tensor, Parameter, to_tensor, no_grad, enable_grad, set_grad_enabled,
+    grad_enabled,
+)
+
+# every tensor op into the top-level namespace (paddle.add, paddle.matmul, ...)
+from .ops import *  # noqa: F401,F403
+from .ops import __all__ as _ops_all
+from . import ops  # noqa: F401
+
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import device  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import framework  # noqa: F401
+from . import linalg_ns as linalg  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .device import set_device, get_device, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu  # noqa: F401
+from .jit.api import to_static  # noqa: F401
+
+import sys as _sys
+
+
+def in_dynamic_mode() -> bool:
+    """Always true: the framework is eager-first; `to_static` jits functions
+    without a global static mode (reference: paddle.in_dynamic_mode)."""
+    return not static._static_mode[0]
+
+
+def enable_static():
+    static._static_mode[0] = True
+
+
+def disable_static():
+    static._static_mode[0] = False
+
+
+def is_grad_enabled():
+    return grad_enabled()
+
+
+def disable_signal_handler():  # paddle API parity; no-op
+    return None
+
+
+def CUDAPinnedPlace(*a, **k):  # compat shims: places are strings on TPU
+    return "cpu"
+
+
+def CPUPlace(*a, **k):
+    return "cpu"
+
+
+def TPUPlace(idx=0):
+    return f"tpu:{idx}"
+
+
+CUDAPlace = TPUPlace
+
+__all__ = (
+    ["Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad", "seed",
+     "save", "load", "set_device", "get_device", "to_static",
+     "in_dynamic_mode", "enable_static", "disable_static"]
+    + list(_ops_all)
+)

@@ -1,0 +1,222 @@
+"""AMP: automatic mixed precision (reference: python/paddle/amp).
+
+On TPU, bf16 is the native mixed-precision dtype and needs no loss scaling —
+`GradScaler` is a functional no-op kept for API parity (enabled scaling still
+works for fp16 parity testing). `auto_cast` (ref: amp/auto_cast.py:1018)
+installs a dtype-cast policy consulted by `dispatch` via an op allow/deny
+list mirroring amp_lists (ref: python/paddle/amp/amp_lists.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from ..framework import dtype as dtypes
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate", "is_float16_supported",
+           "is_bfloat16_supported", "white_list", "black_list"]
+
+_state = threading.local()
+
+# reference: python/paddle/amp/amp_lists.py FP16_WHITE_LIST / FP16_BLACK_LIST
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "mv",
+    "einsum", "flash_attn", "flash_attn_ref", "sdpa",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "reciprocal",
+    "softmax", "log_softmax", "cross_entropy", "bce_with_logits",
+    "binary_cross_entropy", "layer_norm", "rms_norm", "batch_norm",
+    "instance_norm", "group_norm", "mean", "sum", "cumsum", "logsumexp",
+    "softmax_with_cross_entropy", "nll_loss", "kl_div", "cosine_similarity",
+}
+
+
+def white_list():
+    return WHITE_LIST
+
+
+def black_list():
+    return BLACK_LIST
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast (ref: python/paddle/amp/auto_cast.py:1018)."""
+    prev = amp_state()
+    if enable:
+        wl = set(WHITE_LIST)
+        bl = set(BLACK_LIST)
+        if custom_white_list:
+            wl |= set(custom_white_list)
+            bl -= set(custom_white_list)
+        if custom_black_list:
+            bl |= set(custom_black_list)
+            wl -= set(custom_black_list)
+        _state.amp = {
+            "dtype": dtypes.convert_dtype(dtype),
+            "level": level,
+            "white": wl,
+            "black": bl,
+        }
+    else:
+        _state.amp = None
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name: str, arrays):
+    """Called from core dispatch: cast inputs per active AMP policy.
+
+    O1: white-list ops run in low precision, black-list in fp32, others
+    follow inputs. O2: everything except black-list runs in low precision.
+    """
+    st = amp_state()
+    if st is None:
+        return arrays
+    low = st["dtype"]
+    if op_name in st["black"]:
+        tgt = jnp.float32
+    elif op_name in st["white"] or st["level"] == "O2":
+        tgt = low
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != tgt:
+            out.append(a.astype(tgt))
+        else:
+            out.append(a)
+    return out
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate (ref: auto_cast.py). O2 casts parameters to the
+    low-precision dtype (norm layers excluded, matching the reference)."""
+    from ..nn.layer.norm import _BatchNormBase, LayerNorm, GroupNorm, RMSNorm
+
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        skip = (_BatchNormBase, LayerNorm, GroupNorm, RMSNorm)
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, skip) or (excluded_layers and isinstance(layer, tuple(excluded_layers))):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(p._array.dtype, jnp.floating):
+                        p._array = p._array.astype(dtypes.convert_dtype(dtype))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """paddle.amp.GradScaler (ref: python/paddle/amp/grad_scaler.py:645).
+
+    bf16-on-TPU needs no scaling: with default args this is pass-through, but
+    dynamic loss scaling is fully implemented for fp16 parity.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for group in optimizer._param_groups:
+            for p in group["params"]:
+                if p._grad is not None:
+                    g = p._grad * inv
+                    found = found or bool(jnp.any(~jnp.isfinite(g)))
+                    p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,251 @@
+"""paddle.autograd equivalent.
+
+Reference: python/paddle/autograd (backward, PyLayer at py_layer.py:270,
+functional jvp/vjp/jacobian/hessian in autograd.py). The tape lives in
+core/tape.py; PyLayer maps to a custom-vjp dispatch record; the functional
+transforms delegate to jax.jvp/jax.vjp/jax.jacobian on the unwrapped pure
+function.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tape import backward, no_grad, enable_grad, set_grad_enabled, grad_enabled
+from ..core import tape as _tape
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = [
+    "backward", "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+    "grad", "PyLayer", "PyLayerContext", "jvp", "vjp", "jacobian", "hessian",
+]
+
+
+def is_grad_enabled():
+    return grad_enabled()
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad (reference: python/paddle/base/dygraph/base.py `grad`).
+
+    Runs the tape backward but collects cotangents for `inputs` instead of
+    writing `.grad`.
+    """
+    outs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    # snapshot + restore .grad around a tape sweep
+    saved = [t._grad for t in ins]
+    for t in ins:
+        t._grad = None
+    # ensure inputs are treated as leaves for accumulation: temporarily mark
+    prev_nodes = [t._node for t in ins]
+    stops = [t.stop_gradient for t in ins]
+    for t in ins:
+        t.stop_gradient = False
+    _tape.backward(outs, grad_outputs, retain_graph=bool(retain_graph or create_graph))
+    result = []
+    for t, s, pn, sv in zip(ins, stops, prev_nodes, saved):
+        g = t._grad
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(t._array)
+        result.append(Tensor(g) if g is not None else None)
+        t._grad = sv
+        t.stop_gradient = s
+    return result
+
+
+class PyLayerContext:
+    """ctx object passed to PyLayer.forward/backward
+    (ref: python/paddle/autograd/py_layer.py)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable = tensors
+
+
+class PyLayer:
+    """Custom autograd op via subclassing (reference:
+    python/paddle/autograd/py_layer.py:270). forward/backward receive a ctx;
+    apply() records a TapeNode whose vjp calls the user backward."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+
+        diff_inputs = [
+            a for a in args if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if _tape.grad_enabled() and diff_inputs:
+            tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+            def vjp_fn(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                ct_tensors = tuple(Tensor(c) for c in cts)
+                with no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                # map returned grads to the diff inputs (paddle: one grad per
+                # forward tensor input, in order)
+                grads_arr = []
+                gi = list(gin)
+                for a in tensor_args:
+                    g = gi.pop(0) if gi else None
+                    if a in diff_inputs:
+                        grads_arr.append(unwrap(g) if g is not None else None)
+                return tuple(
+                    g if g is not None else jnp.zeros_like(t._array)
+                    for g, t in zip(grads_arr, diff_inputs)
+                )
+
+            node = _tape.TapeNode(cls.__name__, vjp_fn, diff_inputs, len(outs))
+            wrapped = []
+            nd_set = {id(t) for t in ctx.non_differentiable}
+            node._out_shapes = [
+                (tuple(o.shape), o.dtype) for o in outs
+            ]
+            for i, o in enumerate(outs):
+                t = o if isinstance(o, Tensor) else Tensor(o)
+                if id(t) not in nd_set:
+                    t.stop_gradient = False
+                    t._node = node
+                    t._out_idx = i
+                    node.register_output(i, t)
+                wrapped.append(t)
+            return wrapped[0] if single else tuple(wrapped)
+        return out
+
+
+# ------------------------- functional transforms -------------------------
+
+
+def _functionalize(func):
+    def fn(*arrs):
+        outs = func(*[Tensor(a) for a in arrs])
+        if isinstance(outs, (tuple, list)):
+            return tuple(unwrap(o) for o in outs)
+        return unwrap(outs)
+
+    return fn
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode JVP (ref: python/paddle/autograd/autograd.py)."""
+    xs_t = (xs,) if isinstance(xs, Tensor) else tuple(xs)
+    arrs = tuple(unwrap(x) for x in xs_t)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        v_t = (v,) if isinstance(v, Tensor) else tuple(v)
+        tangents = tuple(unwrap(t) for t in v_t)
+    out, tangent_out = jax.jvp(_functionalize(func), arrs, tangents)
+    w = lambda o: Tensor(o)
+    if isinstance(out, tuple):
+        return tuple(map(w, out)), tuple(map(w, tangent_out))
+    return w(out), w(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    xs_t = (xs,) if isinstance(xs, Tensor) else tuple(xs)
+    arrs = tuple(unwrap(x) for x in xs_t)
+    out, vjp_fn = jax.vjp(_functionalize(func), *arrs)
+    if v is None:
+        cots = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(jnp.ones_like(o) for o in out)
+    else:
+        v_t = v if isinstance(v, Tensor) else v
+        cots = unwrap(v_t) if isinstance(v_t, Tensor) else tuple(unwrap(t) for t in v_t)
+    grads = vjp_fn(cots)
+    w = lambda o: Tensor(o)
+    out_w = tuple(map(w, out)) if isinstance(out, tuple) else w(out)
+    grads_w = tuple(map(w, grads))
+    return out_w, grads_w[0] if len(grads_w) == 1 and isinstance(xs, Tensor) else grads_w
+
+
+class Jacobian:
+    """Lazy Jacobian (ref: autograd.autograd.Jacobian)."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __getitem__(self, idx):
+        return Tensor(self._arr[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._arr)
+
+    @property
+    def shape(self):
+        return list(self._arr.shape)
+
+
+def jacobian(func, xs, is_batched=False):
+    xs_t = (xs,) if isinstance(xs, Tensor) else tuple(xs)
+    arrs = tuple(unwrap(x) for x in xs_t)
+    jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(arrs))))(*arrs)
+    if isinstance(xs, Tensor):
+        j = jac[0] if isinstance(jac, tuple) else jac
+        return Jacobian(j)
+    return tuple(Jacobian(j) for j in jac)
+
+
+def hessian(func, xs, is_batched=False):
+    xs_t = (xs,) if isinstance(xs, Tensor) else tuple(xs)
+    arrs = tuple(unwrap(x) for x in xs_t)
+    h = jax.hessian(_functionalize(func), argnums=tuple(range(len(arrs))))(*arrs)
+    if isinstance(xs, Tensor):
+        hh = h[0][0] if isinstance(h, tuple) else h
+        return Jacobian(hh)
+    return tuple(tuple(Jacobian(hj) for hj in hrow) for hrow in h)
+
+
+class saved_tensors_hooks:
+    """paddle.autograd.saved_tensors_hooks compatibility (used by recompute)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,7 @@
+from .tensor import Tensor, Parameter, to_tensor, dispatch, unwrap
+from .tape import backward, no_grad, enable_grad, grad_enabled, set_grad_enabled
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "dispatch", "unwrap",
+    "backward", "no_grad", "enable_grad", "grad_enabled", "set_grad_enabled",
+]

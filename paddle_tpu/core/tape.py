@@ -1,0 +1,254 @@
+"""Eager autograd tape.
+
+TPU-native replacement for the reference's eager autograd engine
+(paddle/fluid/eager/backward.cc:105 `RunBackward`,
+paddle/fluid/eager/grad_node_info.h:197 `GradNodeBase`): instead of codegen'd
+GradNode classes per op, every dispatched op records a `TapeNode` holding the
+`jax.vjp` residual closure. `backward()` runs a reference-counted reverse
+topological sweep over the node DAG — the same algorithm as RunBackward — and
+accumulates cotangents into leaf ``Tensor.grad``.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    prev = grad_enabled()
+    _state.grad_enabled = bool(mode)
+    return prev
+
+
+class no_grad:
+    """paddle.no_grad (context manager + decorator)."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class TapeNode:
+    """One recorded op: vjp closure + graph edges.
+
+    Reference analog: a generated `MatmulGradNode` etc. holding TensorWrappers
+    (paddle/fluid/eager/grad_node_info.h, tensor_wrapper.h). Here the vjp
+    closure owns the residuals.
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "out_refs",
+        "n_outs",
+        "name",
+        "_out_shapes",
+        "__weakref__",
+    )
+
+    def __init__(self, name: str, vjp_fn, inputs: List[Any], n_outs: int):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # Tensors that were differentiable inputs
+        self.out_refs: List[Optional[weakref.ref]] = [None] * n_outs
+        self.n_outs = n_outs
+        self._out_shapes: List[Any] = [None] * n_outs  # (shape, dtype) pairs
+
+    def register_output(self, idx: int, tensor):
+        self.out_refs[idx] = weakref.ref(tensor)
+
+
+def _topo_order(root_node) -> List[TapeNode]:
+    """Iterative post-order DFS over the node DAG (backward.cc:23 builds the
+    same in-degree structure; we produce a reverse-topological list)."""
+    order: List[TapeNode] = []
+    visited = set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prev = t._node
+            if prev is not None and id(prev) not in visited:
+                stack.append((prev, False))
+    order.reverse()  # roots first -> we iterate in this order (outputs first)
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """paddle.autograd.backward / Tensor.backward.
+
+    Reference: egr::Backward (paddle/fluid/eager/backward.cc:439).
+    """
+    from .tensor import Tensor  # cycle-free at call time
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # cotangent store keyed by id(tensor); holds jax arrays
+    cotangents = {}
+    keepalive = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True and no "
+                "grad graph"
+            )
+        if g is None:
+            if t._array.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t.shape)}"
+                )
+            g_arr = jnp.ones_like(t._array)
+        else:
+            g_arr = g._array if isinstance(g, Tensor) else jnp.asarray(g)
+        _accum(cotangents, keepalive, t, g_arr)
+        if t._node is not None:
+            roots.append(t._node)
+
+    if not roots:
+        _write_leaf_grads(cotangents, keepalive)
+        return
+
+    # merge DAGs from all roots
+    seen = set()
+    order: List[TapeNode] = []
+    for r in roots:
+        for n in _topo_order(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+    # true global reverse-topo: sort by dependency — _topo_order already gives
+    # outputs-before-inputs per root; merging preserves correctness because we
+    # only run a node when pulled, and cotangents accumulate before use if we
+    # process in a correct global order. Build in-degree based ordering:
+    order = _global_order(order)
+
+    for node in order:
+        outs = []
+        any_ct = False
+        for ref in node.out_refs:
+            t = ref() if ref is not None else None
+            if t is not None and id(t) in cotangents:
+                outs.append(cotangents.pop(id(t)))
+                keepalive.pop(id(t), None)
+                any_ct = True
+            else:
+                outs.append(None)
+        if not any_ct or node.vjp_fn is None:
+            continue
+        # materialise zeros for missing output cotangents
+        shapes = node._out_shapes
+        outs = [
+            o if o is not None else jnp.zeros(s, d)
+            for o, (s, d) in zip(outs, shapes)
+        ]
+        cts = node.vjp_fn(tuple(outs) if node.n_outs > 1 else outs[0])
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+        for inp, ct in zip(node.inputs, cts):
+            _accum(cotangents, keepalive, inp, ct)
+
+    _write_leaf_grads(cotangents, keepalive)
+
+
+def _global_order(nodes: List[TapeNode]) -> List[TapeNode]:
+    """Kahn's algorithm over the sub-DAG: a node runs only after every node
+    that consumes one of its outputs has run (the reference keeps the same
+    invariant with an in-degree map, backward.cc:23)."""
+    node_set = {id(n) for n in nodes}
+    adj = {id(n): [] for n in nodes}  # node -> producers of its inputs
+    cons_count = {id(n): 0 for n in nodes}  # how many in-set consumers
+    for n in nodes:
+        for t in n.inputs:
+            p = t._node
+            if p is not None and id(p) in node_set:
+                adj[id(n)].append(p)
+                cons_count[id(p)] += 1
+    ready = [n for n in nodes if cons_count[id(n)] == 0]
+    out = []
+    while ready:
+        n = ready.pop()
+        out.append(n)
+        for p in adj[id(n)]:
+            cons_count[id(p)] -= 1
+            if cons_count[id(p)] == 0:
+                ready.append(p)
+    return out
+
+
+def _accum(cotangents, keepalive, tensor, ct):
+    if ct is None:
+        return
+    if isinstance(ct, jax.custom_derivatives.SymbolicZero):
+        return
+    tid = id(tensor)
+    if tid in cotangents:
+        cotangents[tid] = cotangents[tid] + ct
+    else:
+        cotangents[tid] = ct
+        keepalive[tid] = tensor  # keep tensor alive while ct pending
+
+
+def _write_leaf_grads(cotangents, keepalive):
+    from .tensor import Tensor
+
+    for tid, ct in cotangents.items():
+        t = keepalive.get(tid)
+        if t is None:
+            continue
+        if t.stop_gradient:
+            continue
+        if t._node is not None and not t.is_leaf:
+            continue  # non-leaf grads not retained by default (paddle parity)
+        if t._grad is None:
+            t._grad = ct
+        else:
+            t._grad = t._grad + ct

@@ -1,0 +1,152 @@
+"""Device management (reference: python/paddle/device/__init__.py).
+
+On TPU there is one accelerator platform; device selection maps to
+`jax.devices()` entries. CUDA-specific APIs (streams/events) are represented
+as no-op compatibility shims because XLA owns scheduling — documented
+divergences, not missing features.
+"""
+from __future__ import annotations
+
+import jax
+
+_current = ["auto"]
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        return "cpu"
+
+
+def set_device(device: str):
+    """paddle.set_device. Accepts 'tpu', 'tpu:0', 'cpu', 'gpu' (alias of the
+    accelerator on this build)."""
+    _current[0] = device
+    return device
+
+
+def get_device() -> str:
+    if _current[0] == "auto":
+        p = _platform()
+        return f"{p}:0"
+    return _current[0]
+
+
+def get_all_custom_device_type():
+    return ["tpu"] if _platform() not in ("cpu",) else []
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _platform() not in ("cpu",)
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type == "tpu"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def cuda_device_count() -> int:
+    return 0
+
+
+class Stream:
+    """Compatibility shim: XLA streams are implicit (the reference's
+    paddle.device.Stream wraps CUDA streams; TPU execution is in-order per
+    device)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def synchronize(device=None):
+    """Block until all queued work finishes (ref: paddle.device.synchronize)."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _noop():
+        yield
+
+    return _noop()
+
+
+class cuda:
+    """paddle.device.cuda compatibility namespace (empty on TPU)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    Stream = Stream
+    Event = Event

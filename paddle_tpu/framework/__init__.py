@@ -1,0 +1,13 @@
+from . import dtype as _dtype_mod
+from .dtype import (
+    convert_dtype, set_default_dtype, get_default_dtype, promote_types,
+    iinfo, finfo,
+)
+from .flags import set_flags, get_flags, define_flag, flag
+from .random import seed, get_rng_state, set_rng_state, default_generator, rng_scope, next_key
+
+__all__ = [
+    "convert_dtype", "set_default_dtype", "get_default_dtype", "promote_types",
+    "iinfo", "finfo", "set_flags", "get_flags", "define_flag", "flag", "seed",
+    "get_rng_state", "set_rng_state", "default_generator", "rng_scope", "next_key",
+]

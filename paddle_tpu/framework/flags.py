@@ -1,0 +1,73 @@
+"""Global runtime flag registry.
+
+TPU-native equivalent of the reference's exported-flag registry
+(paddle/common/flags.h:336 `ExportedFlagInfoMap`, paddle/common/flags.cc which
+defines ~176 FLAGS_*). Flags are plain Python values, overridable from the
+environment (``FLAGS_check_nan_inf=1 python ...``) and via
+``paddle_tpu.set_flags``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def define_flag(name: str, default, doc: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    _REGISTRY[name] = _coerce(default, env) if env is not None else default
+    return _REGISTRY[name]
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags equivalent (python/paddle/base/framework.py)."""
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k}; known: {sorted(_REGISTRY)}")
+        _REGISTRY[k] = v
+
+
+def get_flags(flags=None) -> Dict[str, Any]:
+    if flags is None:
+        return dict(_REGISTRY)
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        out[k] = _REGISTRY[k]
+    return out
+
+
+def flag(name: str):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _REGISTRY[name]
+
+
+# --- core flags (subset of paddle/common/flags.cc, TPU-relevant) ---
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf (reference: flags.cc:72)")
+define_flag("check_nan_inf_level", 0, "0: raise on NaN/Inf, >0: log only")
+define_flag("benchmark", False, "synchronous op execution for timing")
+define_flag("use_deterministic_ops", False, "prefer deterministic lowering")
+define_flag("eager_delete_tensor_gb", 0.0, "no-op on TPU (XLA owns buffers)")
+define_flag("allocator_strategy", "xla", "allocation is owned by the XLA runtime")
+define_flag("tpu_matmul_precision", "default", "jax default_matmul_precision for fp32 matmuls")
+define_flag("enable_pallas_kernels", True, "use Pallas kernels for fused ops when on TPU")
+define_flag("log_level", 0, "VLOG-style verbosity")

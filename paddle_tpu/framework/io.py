@@ -1,0 +1,61 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:740 (save) / :982 (load) — pickle
+protocol with tensors stored as numpy payloads. We keep the same user model
+(nested state_dict of Tensors <-> file) with a numpy-npz-in-pickle format.
+Distributed sharded checkpointing lives in distributed/checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+
+def _pack(obj):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._array), str(obj.dtype), not obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__tensor__":
+        _, arr, dtype, trainable = obj
+        if return_numpy:
+            return arr
+        return Tensor(jnp.asarray(arr), stop_gradient=not trainable)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """paddle.save (ref: python/paddle/framework/io.py:740)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    """paddle.load (ref: python/paddle/framework/io.py:982)."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,99 @@
+"""RNG state: keys-as-generator.
+
+The reference carries a per-device `phi::Generator` (paddle/phi/core/generator.h)
+with a seed + offset counter. The TPU-native design keeps a global splittable
+JAX PRNG key; every random op folds in a fresh subkey. A scoped key can be
+installed (``rng_scope``) so that jitted functional code receives randomness as
+a traced argument — the idiomatic JAX pattern — while eager code keeps
+paddle-style implicit state.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+class Generator:
+    """Splittable-key generator (reference: paddle/phi/core/generator.h)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self):
+        """Return a fresh subkey, advancing internal state."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int):
+    """paddle.seed (python/paddle/framework/random.py)."""
+    _default_generator.manual_seed(int(value))
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Install a (possibly traced) PRNG key for random ops in this scope.
+
+    Inside `jax.jit`-traced code, random ops must derive from a traced key to
+    vary between steps; the functional trainer wraps model application in
+    ``rng_scope(step_key)``.
+    """
+    prev = getattr(_state, "scope_key", None)
+    prev_n = getattr(_state, "scope_n", 0)
+    _state.scope_key = key
+    _state.scope_n = 0
+    try:
+        yield
+    finally:
+        _state.scope_key = prev
+        _state.scope_n = prev_n
+
+
+def next_key():
+    """Fresh subkey: from the active rng_scope if present, else the global
+    generator."""
+    key = getattr(_state, "scope_key", None)
+    if key is not None:
+        n = getattr(_state, "scope_n", 0)
+        _state.scope_n = n + 1
+        return jax.random.fold_in(key, n)
+    return _default_generator.split()
+
+
+def in_rng_scope() -> bool:
+    return getattr(_state, "scope_key", None) is not None

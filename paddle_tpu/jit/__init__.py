@@ -1,0 +1,3 @@
+from .api import to_static, not_to_static, ignore_module, save, load, TranslatedLayer
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load", "TranslatedLayer"]

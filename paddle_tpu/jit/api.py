@@ -1,0 +1,280 @@
+"""paddle.jit.to_static — the TPU-native jit story.
+
+Reference: python/paddle/jit/api.py:182 (`to_static`) with two front-ends:
+AST transform (dy2static/program_translator.py:783) and the SOT bytecode
+tracer (jit/sot/). On TPU neither is needed: because *every* op funnels
+through the pure-jnp dispatch layer, plain `jax.jit` tracing of the user
+function is the graph capture. What we keep from SOT is its *contract* —
+guard-based re-specialisation and a compiled-program cache
+(jit/sot/opcode_translator/executor/guard.py, executor_cache.py): the cache
+key ("guard") is the treedef + shape/dtype of tensor args plus the values of
+plain-Python args, and a miss re-traces instead of graph-breaking.
+
+Training is supported: the traced callable is routed through core dispatch,
+so `jax.vjp` of the jitted function records on the eager tape and
+`loss.backward()` works across a to_static boundary. Layer buffers (e.g.
+BatchNorm running stats) are threaded as extra outputs and written back.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..core import tape as _tape
+from ..framework import random as _random
+
+
+def _guard_key(args, kwargs):
+    """Build the specialisation key (SOT guard analog)."""
+
+    def leaf_key(x):
+        if isinstance(x, Tensor):
+            return ("T", tuple(x.shape), str(x.dtype), x.stop_gradient)
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return ("A", tuple(x.shape), str(x.dtype))
+        if isinstance(x, (int, float, bool, str, bytes, type(None))):
+            return ("P", x)
+        if isinstance(x, (list, tuple)):
+            return (type(x).__name__, tuple(leaf_key(i) for i in x))
+        if isinstance(x, dict):
+            return ("D", tuple(sorted((k, leaf_key(v)) for k, v in x.items())))
+        return ("O", id(type(x)))
+
+    return (tuple(leaf_key(a) for a in args), leaf_key(kwargs))
+
+
+class StaticFunction:
+    """Compiled-function wrapper (reference:
+    python/paddle/jit/dy2static/program_translator.py:711
+    `SymbolicStaticFunction.__call__`)."""
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None, full_graph=True, layer=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}  # guard key -> (jitted, n_params, n_buffers, out_treedef)
+        functools.update_wrapper(self, fn)
+
+    @property
+    def layer(self):
+        if self._layer is not None:
+            return self._layer
+        # bound method of a Layer?
+        self_obj = getattr(self._fn, "__self__", None)
+        from ..nn.layer.layers import Layer
+
+        if isinstance(self_obj, Layer):
+            return self_obj
+        return None
+
+    def _collect_state(self):
+        layer = self.layer
+        if layer is None:
+            return [], []
+        params = list(layer.parameters(include_sublayers=True))
+        buffers = [b for _, b in layer.named_buffers()]
+        return params, buffers
+
+    def __call__(self, *args, **kwargs):
+        params, buffers = self._collect_state()
+        key = _guard_key(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._trace(params, buffers, args, kwargs)
+            self._cache[key] = entry
+        jitted, out_treedef, n_out = entry
+
+        flat_args, _ = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        tensor_args = [a for a in flat_args if isinstance(a, Tensor)]
+
+        # thread a fresh PRNG key so dropout etc. varies between calls without
+        # retracing (keys-as-generator; see framework/random.py)
+        all_inputs = [_random.next_key()] + params + tensor_args + buffers
+
+        outs = dispatch(f"to_static:{self._fn.__name__}", jitted, tuple(all_inputs))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        # write back updated buffers
+        new_buf = outs[n_out:]
+        for b, nb in zip(buffers, new_buf):
+            b._replace(nb._array)
+        result = jax.tree.unflatten(out_treedef, list(outs[:n_out]))
+        return result
+
+    def _trace(self, params, buffers, args, kwargs):
+        fn = self._fn
+        n_p, n_b = len(params), len(buffers)
+        flat_args, args_treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        tensor_pos = [i for i, a in enumerate(flat_args) if isinstance(a, Tensor)]
+        const_args = [a if not isinstance(a, Tensor) else None for a in flat_args]
+
+        out_info = {}
+
+        def pure(key, *arrays):
+            p_arr = arrays[:n_p]
+            t_arr = arrays[n_p : n_p + len(tensor_pos)]
+            b_arr = arrays[n_p + len(tensor_pos) :]
+            # bind state
+            saved_p = [p._array for p in params]
+            saved_b = [b._array for b in buffers]
+            for p, a in zip(params, p_arr):
+                p._array = a
+            for b, a in zip(buffers, b_arr):
+                b._array = a
+            flat = list(const_args)
+            for pos, a in zip(tensor_pos, t_arr):
+                t = Tensor(a)
+                t.stop_gradient = flat_args[pos].stop_gradient
+                flat[pos] = t
+            call_args, call_kwargs = jax.tree.unflatten(args_treedef, flat)
+            try:
+                with _tape.no_grad(), _random.rng_scope(key):
+                    out = fn(*call_args, **call_kwargs)
+            finally:
+                new_b = [b._array for b in buffers]
+                for p, a in zip(params, saved_p):
+                    p._array = a
+                for b, a in zip(buffers, saved_b):
+                    b._array = a
+            out_leaves, out_treedef = jax.tree.flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            out_info["treedef"] = out_treedef
+            out_info["n"] = len(out_leaves)
+            return tuple(unwrap(o) for o in out_leaves) + tuple(new_b)
+
+        jitted = jax.jit(pure)
+        # prime: trace once at aval level (no execution) to learn out structure
+        jax.eval_shape(
+            pure,
+            _random.next_key(),
+            *[unwrap(p) for p in params],
+            *[unwrap(flat_args[i]) for i in tensor_pos],
+            *[unwrap(b) for b in buffers],
+        )
+        return jitted, out_info["treedef"], out_info["n"]
+
+    # paddle parity helpers
+    @property
+    def code(self):
+        return inspect.getsource(self._fn)
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """paddle.jit.to_static (ref: python/paddle/jit/api.py:182)."""
+
+    def decorate(fn):
+        from ..nn.layer.layers import Layer
+
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec=input_spec, layer=layer)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+# ---------------------------------------------------------------------------
+# jit.save / jit.load — deployment artifacts via StableHLO export
+# ---------------------------------------------------------------------------
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save (ref: python/paddle/jit/api.py, TranslatedLayer
+    artifacts). Serialises params (pickle) + a StableHLO export of the
+    forward function when input_spec is given."""
+    import pickle
+    from ..framework.io import save as fsave
+
+    fsave(layer.state_dict(), path + ".pdiparams")
+    meta = {"class": type(layer).__name__}
+    if input_spec:
+        try:
+            from jax import export as jexport
+
+            params = [unwrap(p) for p in layer.parameters()]
+
+            def pure(params_arr, *xs):
+                saved = [p._array for p in layer.parameters()]
+                for p, a in zip(layer.parameters(), params_arr):
+                    p._array = a
+                try:
+                    with _tape.no_grad():
+                        out = layer(*[Tensor(x) for x in xs])
+                finally:
+                    for p, a in zip(layer.parameters(), saved):
+                        p._array = a
+                return unwrap(out)
+
+            specs = [
+                jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in input_spec
+            ]
+            exported = jexport.export(jax.jit(pure))(
+                [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params], *specs
+            )
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+            meta["stablehlo"] = True
+        except Exception as e:  # pragma: no cover
+            meta["stablehlo"] = False
+            meta["export_error"] = repr(e)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (ref: python/paddle/jit/translated_layer.py)."""
+
+    def __init__(self, exported, state_dict):
+        self._exported = exported
+        self._state = state_dict
+
+    def __call__(self, *xs):
+        params = [unwrap(v) for v in self._state.values()]
+        out = self._exported.call(params, *[unwrap(x) for x in xs])
+        return Tensor(out) if not isinstance(out, (tuple, list)) else tuple(Tensor(o) for o in out)
+
+    def state_dict(self):
+        return self._state
+
+
+def load(path, **configs):
+    import pickle
+    from ..framework.io import load as fload
+
+    state = fload(path + ".pdiparams")
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    if meta.get("stablehlo"):
+        from jax import export as jexport
+
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(f.read())
+        return TranslatedLayer(exported, state)
+    raise ValueError(f"no serialized program at {path}.pdmodel; re-save with input_spec")

@@ -1,0 +1,4 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports)."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__  # noqa: F401
+from .ops.math import trace  # noqa: F401

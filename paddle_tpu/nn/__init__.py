@@ -1,0 +1,31 @@
+"""paddle.nn namespace (reference: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+
+from .layer import common as _common
+from .layer import activation as _activation
+from .layer import conv as _conv
+from .layer import norm as _norm
+from .layer import pooling as _pooling
+from .layer import loss as _loss
+from .layer import transformer as _transformer
+from .layer import rnn as _rnn
+
+__all__ = (
+    ["Layer", "Sequential", "LayerList", "ParameterList", "LayerDict",
+     "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue", "ParamAttr"]
+    + _common.__all__ + _activation.__all__ + _conv.__all__ + _norm.__all__
+    + _pooling.__all__ + _loss.__all__ + _transformer.__all__ + _rnn.__all__
+)

@@ -1,0 +1,19 @@
+"""paddle.nn.functional namespace (reference:
+python/paddle/nn/functional/__init__.py)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from .activation import __all__ as _a
+from .common import __all__ as _c
+from .conv import __all__ as _cv
+from .pooling import __all__ as _p
+from .norm import __all__ as _n
+from .loss import __all__ as _l
+from .attention import __all__ as _at
+
+__all__ = list(_a) + list(_c) + list(_cv) + list(_p) + list(_n) + list(_l) + list(_at)

@@ -1,0 +1,225 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...framework.random import next_key
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "swish", "mish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "leaky_relu", "log_sigmoid",
+    "log_softmax", "softmax", "softmax_", "softplus", "softsign", "tanh",
+    "tanh_", "thresholded_relu", "maxout", "glu", "swiglu", "prelu", "rrelu",
+    "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return dispatch("relu", jax.nn.relu, (x,))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def relu6(x, name=None):
+    return dispatch("relu6", jax.nn.relu6, (x,))
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", lambda a: jax.nn.elu(a, alpha), (x,))
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,))
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch("celu", lambda a: jax.nn.celu(a, alpha), (x,))
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,))
+
+
+def silu(x, name=None):
+    return dispatch("silu", jax.nn.silu, (x,))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return dispatch("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), (x,))
+
+
+def sigmoid(x, name=None):
+    return dispatch("sigmoid", jax.nn.sigmoid, (x,))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (x,))
+
+
+def hardswish(x, name=None):
+    return dispatch("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch("hardtanh", lambda a: jnp.clip(a, min, max), (x,))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        (x,),
+    )
+
+
+def tanhshrink(x, name=None):
+    return dispatch("tanhshrink", lambda a: a - jnp.tanh(a), (x,))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), (x,))
+
+
+def log_sigmoid(x, name=None):
+    return dispatch("log_sigmoid", jax.nn.log_sigmoid, (x,))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return dispatch("log_softmax", impl, (x,))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+
+    return dispatch("softmax", impl, (x,))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return dispatch(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta),
+        (x,),
+    )
+
+
+def softsign(x, name=None):
+    return dispatch("softsign", jax.nn.soft_sign, (x,))
+
+
+def tanh(x, name=None):
+    return dispatch("tanh", jnp.tanh, (x,))
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), (x,))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return dispatch("maxout", impl, (x,))
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch("glu", lambda a: jax.nn.glu(a, axis=axis), (x,))
+
+
+def swiglu(x, y=None, name=None):
+    """ref: python/paddle/incubate/nn/functional/swiglu (fused op in
+    reference paddle/phi/kernels/fusion); here: silu(x) * y."""
+    if y is None:
+        def impl(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return dispatch("swiglu", impl, (x,))
+    return dispatch("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return dispatch("prelu", impl, (x, weight))
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    if not training:
+        return dispatch("rrelu", lambda a: jnp.where(a > 0, a, (lower + upper) / 2 * a), (x,))
+    key = next_key()
+
+    def impl(a):
+        slope = jax.random.uniform(key, a.shape, minval=lower, maxval=upper).astype(a.dtype)
+        return jnp.where(a > 0, a, slope * a)
+
+    return dispatch("rrelu", impl, (x,))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = next_key()
+
+    def impl(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, a.shape) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return dispatch("gumbel_softmax", impl, (x,))

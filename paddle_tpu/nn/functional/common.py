@@ -1,0 +1,434 @@
+"""Common functionals: linear, embedding, dropout, pad, interpolate, one_hot...
+
+Reference: python/paddle/nn/functional/{common,input,extension}.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...framework.random import next_key
+from ...framework import dtype as dtypes
+
+__all__ = [
+    "linear", "embedding", "one_hot", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "pad", "zeropad2d", "cosine_similarity", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "interpolate", "upsample", "unfold",
+    "fold", "label_smooth", "sequence_mask", "normalize", "bilinear",
+    "class_center_sample", "grid_sample", "affine_grid", "temporal_shift",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W layout [in, out] (reference:
+    python/paddle/nn/functional/common.py `linear` -> matmul kernel). Kept as
+    a bare jnp.matmul so XLA maps it onto the MXU and fuses the bias add."""
+    if bias is None:
+        return dispatch("linear", jnp.matmul, (x, weight))
+    return dispatch("linear", lambda a, w, b: jnp.matmul(a, w) + b, (x, weight, bias))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight (reference: nn/functional/input.py embedding).
+
+    `sparse` is accepted for API parity; on TPU gather is already the
+    efficient lowering (no SelectedRows analog needed).
+    """
+
+    def impl(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return dispatch("embedding", impl, (x, weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return dispatch("one_hot", lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), (x,))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Reference: nn/functional/common.py dropout; keys-as-generator RNG."""
+    if not training or (isinstance(p, (int, float)) and p == 0):
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+    p_val = float(unwrap(p)) if not isinstance(p, (int, float)) else float(p)
+
+    def impl(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p_val, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p_val), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return dispatch("dropout", impl, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return dispatch("alpha_dropout", impl, (x,))
+
+
+def _pad_nd(a, pad_list, mode, value, data_format):
+    nd = a.ndim
+    if len(pad_list) == 2 * nd:
+        # paddle full-form: [[before,after] per dim] flattened low-dim-first?
+        pairs = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form pads the last spatial dims; respect data_format
+        k = len(pad_list) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        spatial = spatial[-k:] if k <= len(spatial) else spatial
+        # paddle pad order: last-dim pads first in the list? It's
+        # [left, right, top, bottom, front, back] => reversed spatial order
+        dims = list(reversed(spatial))[:k]
+        for i, d in enumerate(dims):
+            pairs[d] = (pad_list[2 * i], pad_list[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(a, pairs, mode="constant", constant_values=value)
+    return jnp.pad(a, pairs, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    pl = [int(unwrap(p)) for p in (pad.tolist() if isinstance(pad, Tensor) else pad)]
+    return dispatch("pad", lambda a: _pad_nd(a, pl, mode, value, data_format), (x,))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def impl(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return dispatch("cosine_similarity", impl, (x1, x2))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return dispatch("pixel_shuffle", impl, (x,))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return dispatch("pixel_unshuffle", impl, (x,))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = jnp.swapaxes(a, 1, 2)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = jnp.swapaxes(a, 3, 4)
+        return a.reshape(n, h, w, c)
+
+    return dispatch("channel_shuffle", impl, (x,))
+
+
+def interpolate(
+    x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+    align_mode=0, data_format="NCHW", name=None,
+):
+    """Reference: nn/functional/common.py interpolate → jax.image.resize."""
+    mode = mode.lower()
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "linear": "linear",
+        "area": "linear",
+    }[mode]
+
+    def impl(a):
+        nd = a.ndim
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        if size is not None:
+            tgt = [int(unwrap(s)) for s in (size.tolist() if isinstance(size, Tensor) else (size if isinstance(size, (list, tuple)) else [size]))]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            tgt = [int(a.shape[d] * f) for d, f in zip(spatial, sf)]
+        out_shape = list(a.shape)
+        for d, s in zip(spatial, tgt):
+            out_shape[d] = s
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+        # align_corners path: gather with linspace indices
+        out = a
+        for d, s in zip(spatial, tgt):
+            n_in = out.shape[d]
+            if s == n_in:
+                continue
+            idx = jnp.linspace(0.0, n_in - 1, s)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.clip(lo + 1, 0, n_in - 1)
+            w = (idx - lo).astype(out.dtype)
+            shape_w = [1] * out.ndim
+            shape_w[d] = s
+            w = w.reshape(shape_w)
+            out = jnp.take(out, lo, axis=d) * (1 - w) + jnp.take(out, hi, axis=d) * w
+        return out.astype(a.dtype)
+
+    return dispatch("interpolate", impl, (x,))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: phi unfold kernel). Output [N, C*kh*kw, L]."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def impl(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a[:, :, i * dl[0] : i * dl[0] + oh * st[0] : st[0], j * dl[1] : j * dl[1] + ow * st[1] : st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return dispatch("unfold", impl, (x,))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def impl(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0] : i * dl[0] + oh * st[0] : st[0], j * dl[1] : j * dl[1] + ow * st[1] : st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0] : ph - pd[2], pd[1] : pw - pd[3]]
+
+    return dispatch("fold", impl, (x,))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return dispatch(
+            "label_smooth",
+            lambda l, p: (1 - epsilon) * l + epsilon * p,
+            (label, prior_dist),
+        )
+    return dispatch(
+        "label_smooth", lambda l: (1 - epsilon) * l + epsilon / l.shape[-1], (label,)
+    )
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(a):
+        m = maxlen if maxlen is not None else int(jnp.max(a)) if not isinstance(a, jax.core.Tracer) else None
+        if m is None:
+            raise ValueError("sequence_mask requires static maxlen under jit")
+        r = jnp.arange(m)
+        return (r[None, :] < a[..., None]).astype(d)
+
+    return dispatch("sequence_mask", impl, (x,))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return dispatch("normalize", impl, (x,))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def impl(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return dispatch("bilinear", impl, args)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    # simplified host-side sampling (reference: phi class_center_sample kernel)
+    lab = np.asarray(unwrap(label))
+    pos = np.unique(lab)
+    extra = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng(0)
+    n_extra = max(0, num_samples - len(pos))
+    sampled = np.concatenate([pos, rng.choice(extra, size=n_extra, replace=False)]) if n_extra else pos
+    sampled.sort()
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lab = np.array([remap[int(v)] for v in lab], dtype=np.int64)
+    return Tensor(jnp.asarray(new_lab)), Tensor(jnp.asarray(sampled.astype(np.int64)))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def impl(t):
+        n, _, _ = t.shape
+        h, w = int(out_shape[2]), int(out_shape[3])
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+        out = jnp.einsum("nij,pj->npi", t, base)  # [n, h*w, 2]
+        return out.reshape(n, h, w, 2)
+
+    return dispatch("affine_grid", impl, (theta,))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def impl(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            # img [c,h,w]; yy/xx [oh,ow]
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = xx - x0
+            wy = yy - y0
+
+            def get(yi, xi):
+                valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yi_c = jnp.clip(yi, 0, h - 1)
+                xi_c = jnp.clip(xi, 0, w - 1)
+                v = img[:, yi_c, xi_c]
+                if padding_mode == "zeros":
+                    v = jnp.where(valid[None], v, 0.0)
+                return v
+
+            if mode == "nearest":
+                return get(jnp.round(yy).astype(jnp.int32), jnp.round(xx).astype(jnp.int32))
+            return (
+                get(y0, x0) * ((1 - wx) * (1 - wy))[None]
+                + get(y0, x1) * (wx * (1 - wy))[None]
+                + get(y1, x0) * ((1 - wx) * wy)[None]
+                + get(y1, x1) * (wx * wy)[None]
+            )
+
+        return jax.vmap(sample)(a, fy, fx)
+
+    return dispatch("grid_sample", impl, (x, grid))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def impl(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold_c], jnp.zeros_like(a[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold_c : 2 * fold_c]), a[:, :-1, fold_c : 2 * fold_c]], axis=1)
+        out = jnp.concatenate([left, right, a[:, :, 2 * fold_c :]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return dispatch("temporal_shift", impl, (x,))

@@ -1,0 +1,163 @@
+"""Convolution functionals via lax.conv_general_dilated.
+
+Reference: python/paddle/nn/functional/conv.py → phi conv kernels (cudnn).
+On TPU the conv maps to the MXU through XLA's convolution HLO; weight layout
+follows paddle ([out_c, in_c/groups, *k]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import dispatch
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        out = list(v)
+        if len(out) == 1:
+            out = out * n
+        return tuple(int(i) for i in out)
+    return (int(v),) * n
+
+
+def _padding(padding, n, stride, dilation, ksize):
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n:
+            return [(int(i), int(i)) for i in p]
+        if len(p) == 2 * n:
+            return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+        if all(isinstance(i, (list, tuple)) for i in p):
+            # NCHW-style 4-elem list incl batch/channel dims
+            sp = [i for i in p if list(i) != [0, 0]] or [(0, 0)] * n
+            return [tuple(int(j) for j in i) for i in sp[-n:]]
+    return [(int(padding), int(padding))] * n
+
+
+def _conv(a, w, bias, stride, padding, dilation, groups, n, data_format):
+    chars = "DHW"[-n:]
+    if data_format in (f"NC{chars}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + chars
+    else:
+        lhs_spec = "N" + chars + "C"
+    rhs_spec = "OI" + chars
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        a, w,
+        window_strides=_norm_tuple(stride, n),
+        padding=padding,
+        rhs_dilation=_norm_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        if lhs_spec.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * n)
+        else:
+            out = out + bias
+    return out
+
+
+def _make_conv(n, name):
+    def conv(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format=None, name_=None, **kw):
+        df = data_format or ("NCL" if n == 1 else "NCHW" if n == 2 else "NCDHW")
+        ks = None
+        pad = padding
+
+        def impl(a, w, *rest):
+            b = rest[0] if rest else None
+            p = _padding(pad, n, stride, dilation, w.shape[2:])
+            return _conv(a, w, b, stride, p, dilation, groups, n, df)
+
+        args = (x, weight) + ((bias,) if bias is not None else ())
+        return dispatch(name, impl, args)
+
+    conv.__name__ = name
+    return conv
+
+
+conv1d = _make_conv(1, "conv1d")
+conv2d = _make_conv(2, "conv2d")
+conv3d = _make_conv(3, "conv3d")
+
+
+def _conv_transpose(a, w, bias, stride, padding, output_padding, dilation, groups, n, data_format):
+    chars = "DHW"[-n:]
+    if data_format.startswith("NC"):
+        lhs_spec = "NC" + chars
+    else:
+        lhs_spec = "N" + chars + "C"
+    # paddle conv_transpose weight layout: [in_c, out_c/groups, *k]
+    rhs_spec = "IO" + chars
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _padding(padding, n, stride, dilation, w.shape[2:])
+    out = jax.lax.conv_transpose(
+        a, w,
+        strides=strides,
+        padding=pad,
+        rhs_dilation=dil,
+        dimension_numbers=dn,
+        transpose_kernel=True,
+    )
+    op = _norm_tuple(output_padding, n)
+    if any(op):
+        pads = [(0, 0)] * out.ndim
+        spatial = range(2, 2 + n) if lhs_spec.startswith("NC") else range(1, 1 + n)
+        for i, d in enumerate(spatial):
+            pads[d] = (0, op[i])
+        out = jnp.pad(out, pads)
+    if bias is not None:
+        if lhs_spec.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * n)
+        else:
+            out = out + bias
+    return out
+
+
+def _make_conv_transpose(n, name):
+    def convt(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format=None, output_size=None, name_=None, **kw):
+        df = data_format or ("NCL" if n == 1 else "NCHW" if n == 2 else "NCDHW")
+
+        def impl(a, w, *rest):
+            b = rest[0] if rest else None
+            if groups > 1:
+                # split groups manually (lax.conv_transpose lacks group support)
+                in_per = a.shape[1] // groups if df.startswith("NC") else a.shape[-1] // groups
+                outs = []
+                for g in range(groups):
+                    if df.startswith("NC"):
+                        ag = a[:, g * in_per : (g + 1) * in_per]
+                    else:
+                        ag = a[..., g * in_per : (g + 1) * in_per]
+                    wg = w[g * in_per : (g + 1) * in_per]
+                    outs.append(
+                        _conv_transpose(ag, wg, None, stride, padding, output_padding, dilation, 1, n, df)
+                    )
+                o = jnp.concatenate(outs, axis=1 if df.startswith("NC") else -1)
+                if b is not None:
+                    o = o + (b.reshape((1, -1) + (1,) * n) if df.startswith("NC") else b)
+                return o
+            return _conv_transpose(a, w, b, stride, padding, output_padding, dilation, groups, n, df)
+
+        args = (x, weight) + ((bias,) if bias is not None else ())
+        return dispatch(name, impl, args)
+
+    convt.__name__ = name
+    return convt
+
+
+conv1d_transpose = _make_conv_transpose(1, "conv1d_transpose")
+conv2d_transpose = _make_conv_transpose(2, "conv2d_transpose")
+conv3d_transpose = _make_conv_transpose(3, "conv3d_transpose")

@@ -1,0 +1,444 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "kl_div", "smooth_l1_loss", "huber_loss", "margin_ranking_loss",
+    "cosine_embedding_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "sigmoid_focal_loss", "square_error_cost",
+    "log_loss", "ctc_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "multi_margin_loss", "rnnt_loss", "dice_loss", "npair_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    """paddle.nn.functional.cross_entropy (ref: nn/functional/loss.py).
+
+    Computed in fp32 with log-softmax for numerical stability (same contract
+    as phi softmax_with_cross_entropy kernels).
+    """
+    w = unwrap(weight) if weight is not None else None
+
+    def impl(logits, lab, *rest):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax else jnp.log(
+            jnp.clip(logits.astype(jnp.float32), 1e-30, None)
+        )
+        n_cls = logits.shape[axis]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_cls
+            loss = -jnp.sum(soft * lp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == lp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            lp_m = jnp.moveaxis(lp, axis, -1)
+            picked = jnp.take_along_axis(lp_m, safe[..., None], axis=-1)[..., 0]
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(lp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            loss = jnp.where(valid, loss, 0.0)
+            if w is not None:
+                wv = jnp.take(w, safe)
+                loss = loss * jnp.where(valid, wv, 0.0)
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, wv, 0.0))
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(jnp.float32))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+
+    return dispatch("cross_entropy", impl, (input, label))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def impl(p, lab, *rest):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
+        out = -(lab * jnp.log(p32) + (1 - lab) * jnp.log1p(-p32))
+        if rest:
+            out = out * rest[0]
+        return _reduce(out, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("binary_cross_entropy", impl, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def impl(z, lab, *rest):
+        z32 = z.astype(jnp.float32)
+        lab32 = lab.astype(jnp.float32)
+        # log(1+exp(-|z|)) formulation
+        max_val = jnp.clip(-z32, 0, None)
+        if pos_weight is not None:
+            pw_t = rest[len(rest) - 1]
+            log_weight = (pw_t - 1) * lab32 + 1
+            loss = (1 - lab32) * z32 + log_weight * (jnp.log1p(jnp.exp(-jnp.abs(z32))) + max_val)
+        else:
+            loss = (1 - lab32) * z32 + max_val + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        if weight is not None:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return dispatch("bce_with_logits", impl, args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch("mse_loss", lambda a, b: _reduce((a - b) ** 2, reduction), (input, label))
+
+
+def square_error_cost(input, label):
+    return dispatch("square_error_cost", lambda a, b: (a - b) ** 2, (input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), (input, label))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    w = weight
+
+    def impl(lp, lab, *rest):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        if lp.ndim > 2:  # N,C,d1.. -> move C last
+            lpm = jnp.moveaxis(lp, 1, -1)
+        else:
+            lpm = lp
+        picked = jnp.take_along_axis(lpm, safe[..., None], axis=-1)[..., 0]
+        loss = -jnp.where(valid, picked, 0.0)
+        if rest:
+            wv = jnp.take(rest[0], safe)
+            loss = loss * jnp.where(valid, wv, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((w,) if w is not None else ())
+    return dispatch("nll_loss", impl, args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def impl(lp, t):
+        if log_target:
+            out = jnp.exp(t) * (t - lp)
+        else:
+            out = t * (jnp.log(jnp.clip(t, 1e-12, None)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / lp.shape[0]
+        return _reduce(out, reduction)
+
+    return dispatch("kl_div", impl, (input, label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(out, reduction)
+
+    return dispatch("smooth_l1_loss", impl, (input, label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(out, reduction)
+
+    return dispatch("huber_loss", impl, (input, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return dispatch(
+        "margin_ranking_loss",
+        lambda a, b, l: _reduce(jnp.clip(-l * (a - b) + margin, 0, None), reduction),
+        (input, other, label),
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def impl(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        out = jnp.where(l == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+        return _reduce(out, reduction)
+
+    return dispatch("cosine_embedding_loss", impl, (input1, input2, label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def impl(a, l):
+        out = jnp.where(l == 1, a, jnp.clip(margin - a, 0, None))
+        return _reduce(out, reduction)
+
+    return dispatch("hinge_embedding_loss", impl, (input, label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-06, swap=False, reduction="mean", name=None):
+    def impl(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dsn = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.clip(dp - dn + margin, 0, None), reduction)
+
+    return dispatch("triplet_margin_loss", impl, (input, positive, negative))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None, margin=1.0, swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin, swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dsn = distance_function(positive, negative)
+        from ...ops import minimum
+
+        dn = minimum(dn, dsn)
+    from ...ops import clip, mean as _mean, sum as _sum
+
+    out = clip(dp - dn + margin, min=0)
+    if reduction == "mean":
+        return _mean(out)
+    if reduction == "sum":
+        return _sum(out)
+    return out
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def impl(z, y, *rest):
+        out = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if rest:
+            out = out * rest[0]
+        return _reduce(jnp.mean(out, axis=-1), reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("multi_label_soft_margin_loss", impl, args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return dispatch(
+        "soft_margin_loss",
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+        (input, label),
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def impl(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = (1 - y) * z + jnp.clip(-z, 0, None) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return dispatch("sigmoid_focal_loss", impl, args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return dispatch(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        (input, label),
+    )
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def impl(p, y):
+        y1 = jax.nn.one_hot(y[..., 0], p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return dispatch("dice_loss", impl, (input, label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def impl(a, p, l):
+        sim = a @ p.T
+        lab = l.reshape(-1)
+        target = (lab[:, None] == lab[None, :]).astype(jnp.float32)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        ce = -jnp.sum(target * jax.nn.log_softmax(sim, axis=1), axis=1)
+        l2 = jnp.mean(jnp.sum(a * a, axis=1) + jnp.sum(p * p, axis=1))
+        return jnp.mean(ce) + l2_reg * l2 * 0.25
+
+    return dispatch("npair_loss", impl, (anchor, positive, labels))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):
+    def impl(z, y):
+        if log_input:
+            out = jnp.exp(z) - y * z
+        else:
+            out = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(y + (y == 0)) - y + 0.5 * jnp.log(2 * jnp.pi * (y + (y == 0)))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+
+    return dispatch("poisson_nll_loss", impl, (input, label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):
+    def impl(mu, y, v):
+        v = jnp.clip(v, epsilon, None)
+        out = 0.5 * (jnp.log(v) + (y - mu) ** 2 / v)
+        if full:
+            out = out + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi))
+        return _reduce(out, reduction)
+
+    return dispatch("gaussian_nll_loss", impl, (input, label, variance))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+    def impl(z, y, *rest):
+        n, c = z.shape
+        correct = jnp.take_along_axis(z, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.clip(margin - correct + z, 0, None) ** p
+        if rest:
+            m = m * jnp.take(rest[0], y)[:, None]
+        mask = 1 - jax.nn.one_hot(y, c, dtype=z.dtype)
+        out = jnp.sum(m * mask, axis=1) / c
+        return _reduce(out, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("multi_margin_loss", impl, args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via dynamic-programming in log space (reference: phi
+    warpctc_kernel). log_probs: [T, N, C] (paddle layout)."""
+
+    def impl(lp, lab):
+        T, N, C = lp.shape
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        il = unwrap(input_lengths)
+        ll = unwrap(label_lengths)
+        S = lab.shape[1]
+        # extended label seq with blanks: length 2S+1
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        def step(alpha, lp_t):
+            # alpha [N, 2S+1]
+            shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            ext_prev2 = jnp.concatenate([jnp.full((N, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+            allow_skip = (ext != blank) & (ext != ext_prev2)
+            merged = jnp.logaddexp(alpha, shift1)
+            merged = jnp.where(allow_skip, jnp.logaddexp(merged, shift2), merged)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze past input_lengths
+            new_alpha = jnp.where((t < il)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        # gather final positions: 2*label_len and 2*label_len-1
+        idx_last = (2 * ll).astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, jnp.clip(idx_last - 1, 0, None)[:, None], axis=1)[:, 0]
+        ll_total = jnp.logaddexp(a_last, a_prev)
+        loss = -ll_total
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(ll.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return dispatch("ctc_loss", impl, (log_probs, labels))
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T loss via alpha-recursion (reference: phi warprnnt kernel)."""
+
+    def impl(logits, lab):
+        B, T, U1, C = logits.shape
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        il = unwrap(input_lengths)
+        ul = unwrap(label_lengths)
+        neg_inf = -1e30
+
+        def one(lp_b, lab_b, T_b, U_b):
+            U = U1 - 1
+            # alpha [T, U+1]
+            blank_lp = lp_b[:, :, blank]  # [T, U+1]
+            lab_idx = jnp.concatenate([lab_b.astype(jnp.int32), jnp.zeros((1,), jnp.int32)])
+            emit_lp = jnp.take_along_axis(lp_b, jnp.broadcast_to(lab_idx[None, :, None], (T, U1, 1)), axis=2)[:, :, 0]
+
+            def row(carry, t):
+                prev = carry  # alpha[t-1, :]
+                def col(c2, u):
+                    cur = c2
+                    from_left = jnp.where(u > 0, cur[u - 1] + emit_lp[t, u - 1], neg_inf)
+                    from_down = jnp.where(t > 0, prev[u] + blank_lp[t - 1, u], neg_inf)
+                    init = jnp.where((t == 0) & (u == 0), 0.0, neg_inf)
+                    val = jnp.logaddexp(jnp.logaddexp(from_left, from_down), init)
+                    return cur.at[u].set(val), None
+
+                cur0 = jnp.full((U1,), neg_inf)
+                cur, _ = jax.lax.scan(col, cur0, jnp.arange(U1))
+                return cur, cur
+
+            _, alphas = jax.lax.scan(row, jnp.full((U1,), neg_inf), jnp.arange(T))
+            final = alphas[T_b - 1, U_b] + blank_lp[T_b - 1, U_b]
+            return -final
+
+        loss = jax.vmap(one)(lp, lab, il.astype(jnp.int32), ul.astype(jnp.int32))
+        return _reduce(loss, reduction)
+
+    return dispatch("rnnt_loss", impl, (input, label))

@@ -1,0 +1,179 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+`rms_norm` / fused variants route to Pallas kernels on TPU when
+FLAGS_enable_pallas_kernels is set (paddle_tpu/kernels/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm", "local_response_norm", "rms_norm"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    n_axes = len(ns)
+
+    def impl(a, *rest):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i]
+            i += 1
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("layer_norm", impl, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference fused op: paddle/phi/kernels/fusion rms_norm,
+    python/paddle/incubate/nn/functional/fused_rms_norm)."""
+
+    def impl(a, *rest):
+        a32 = a.astype(jnp.float32)
+        var = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+
+    args = (x,) + ((weight,) if weight is not None else ())
+    return dispatch("rms_norm", impl, args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+    """Reference: nn/functional/norm.py batch_norm. Running stats are updated
+    in-place on the passed tensors when training (matching paddle)."""
+    ch_axis = 1 if data_format.startswith("NC") and unwrap(x).ndim > 1 else -1
+    use_batch_stats = training and not use_global_stats
+
+    xa = unwrap(x)
+    reduce_axes = tuple(i for i in range(xa.ndim) if i != (ch_axis % xa.ndim))
+
+    if use_batch_stats:
+        def impl(a, *rest):
+            a32 = a.astype(jnp.float32)
+            mean = jnp.mean(a32, axis=reduce_axes)
+            var = jnp.var(a32, axis=reduce_axes)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (a32 - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            out = out.astype(a.dtype)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + rest[i].reshape(shape)
+            return out, mean, var
+
+        args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+        out, mean_t, var_t = dispatch("batch_norm", impl, args)
+        # update running stats in place (no_grad semantics)
+        m = float(momentum)
+        rm, rv = unwrap(running_mean), unwrap(running_var)
+        running_mean._replace((m * rm + (1 - m) * mean_t._array).astype(rm.dtype))
+        running_var._replace((m * rv + (1 - m) * var_t._array).astype(rv.dtype))
+        return out
+
+    def impl_eval(a, rm, rv, *rest):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a.astype(jnp.float32) - rm.reshape(shape)) * jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    args = (x, running_mean, running_var) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("batch_norm", impl_eval, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    def impl(a, *rest):
+        # normalize over spatial dims per (n, c)
+        nc_first = data_format.startswith("NC")
+        axes = tuple(range(2, a.ndim)) if nc_first else tuple(range(1, a.ndim - 1))
+        a32 = a.astype(jnp.float32)
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        i = 0
+        ch_axis = 1 if nc_first else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("instance_norm", impl, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    def impl(a, *rest):
+        nc_first = data_format.startswith("NC")
+        if not nc_first:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[:2]
+        g = num_groups
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        a32 = grouped.astype(jnp.float32)
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype).reshape(a_t.shape)
+        shape = [1] * a_t.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        if not nc_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("group_norm", impl, args)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def impl(a):
+        nc_first = data_format.startswith("NC")
+        ch = 1 if nc_first else a.ndim - 1
+        sq = a * a
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * a.ndim
+        pads[ch] = (pad_lo, pad_hi)
+        sq = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[ch] = size
+        summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window), (1,) * a.ndim, "valid")
+        div = (k + alpha * summed / size) ** beta
+        return a / div
+
+    return dispatch("local_response_norm", impl, (x,))

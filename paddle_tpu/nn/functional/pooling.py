@@ -1,0 +1,234 @@
+"""Pooling functionals via lax.reduce_window.
+
+Reference: python/paddle/nn/functional/pooling.py → phi pool kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import dispatch
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        t = list(v)
+        if len(t) == 1:
+            t = t * n
+        return tuple(int(i) for i in t)
+    return (int(v),) * n
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n:
+            return [(int(i), int(i)) for i in p]
+        if len(p) == 2 * n:
+            return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _pool(a, n, ksize, stride, padding, kind, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    k = _tup(ksize, n)
+    s = _tup(stride if stride is not None else ksize, n)
+    p = _pads(padding, n)
+    nc_first = data_format.startswith("NC")
+    if nc_first:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_full = [(0, 0), (0, 0)] + (p if not isinstance(p, str) else p)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_full = [(0, 0)] + (p if not isinstance(p, str) else p) + [(0, 0)]
+    if isinstance(p, str):
+        pad_full = p
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pad_full)
+    # avg
+    summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_full)
+    if exclusive and not isinstance(pad_full, str):
+        ones = jnp.ones_like(a)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_full)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+def _make_pool(n, kind, name):
+    def pool(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+             divisor_override=None, data_format=None, return_mask=False, name_=None, **kw):
+        df = data_format or ("NCL" if n == 1 else "NCHW" if n == 2 else "NCDHW")
+
+        def impl(a):
+            out = _pool(a, n, kernel_size, stride, padding, kind, ceil_mode, exclusive, df)
+            return out.astype(a.dtype)
+
+        out = dispatch(name, impl, (x,))
+        if return_mask and kind == "max":
+            idx = _max_pool_indices(x, n, kernel_size, stride, padding, df)
+            return out, idx
+        return out
+
+    pool.__name__ = name
+    return pool
+
+
+def _max_pool_indices(x, n, ksize, stride, padding, df):
+    """Flat indices of max elements (paddle return_mask contract)."""
+
+    def impl(a):
+        nc_first = df.startswith("NC")
+        spatial_shape = a.shape[2:] if nc_first else a.shape[1:-1]
+        flat_idx = jnp.arange(int(np.prod(spatial_shape))).reshape(spatial_shape)
+        # reduce_window over (value, index) pairs
+        k = _tup(ksize, n)
+        s = _tup(stride if stride is not None else ksize, n)
+        p = _pads(padding, n)
+        if nc_first:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            pad_full = [(0, 0), (0, 0)] + p
+            idx_map = jnp.broadcast_to(flat_idx[None, None], a.shape)
+        else:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pad_full = [(0, 0)] + p + [(0, 0)]
+            idx_map = jnp.broadcast_to(flat_idx[None, ..., None], a.shape)
+
+        def reducer(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take = cv > av
+            return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+        init_v = jnp.asarray(-jnp.inf, a.dtype)
+        init_i = jnp.asarray(-1, jnp.int64)
+        _, idx = jax.lax.reduce_window(
+            (a, idx_map.astype(jnp.int64)),
+            (init_v, init_i),
+            reducer,
+            window, strides, pad_full,
+        )
+        return idx
+
+    return dispatch("max_pool_indices", impl, (x,))
+
+
+max_pool1d = _make_pool(1, "max", "max_pool1d")
+max_pool2d = _make_pool(2, "max", "max_pool2d")
+max_pool3d = _make_pool(3, "max", "max_pool3d")
+avg_pool1d = _make_pool(1, "avg", "avg_pool1d")
+avg_pool2d = _make_pool(2, "avg", "avg_pool2d")
+avg_pool3d = _make_pool(3, "avg", "avg_pool3d")
+
+
+def _adaptive(a, n, out_size, kind, df):
+    nc_first = df.startswith("NC")
+    spatial = list(range(2, 2 + n)) if nc_first else list(range(1, 1 + n))
+    tgt = _tup(out_size, n)
+    out = a
+    for d, t in zip(spatial, tgt):
+        if t is None:
+            continue
+        n_in = out.shape[d]
+        # split into t nearly-even bins (paddle adaptive semantics)
+        starts = (np.arange(t) * n_in) // t
+        ends = ((np.arange(t) + 1) * n_in + t - 1) // t  # ceil
+        slices = []
+        for st, en in zip(starts, ends):
+            seg = jax.lax.slice_in_dim(out, int(st), int(en), axis=d)
+            red = jnp.max(seg, axis=d, keepdims=True) if kind == "max" else jnp.mean(seg, axis=d, keepdims=True)
+            slices.append(red)
+        out = jnp.concatenate(slices, axis=d)
+    return out
+
+
+def _make_adaptive(n, kind, name):
+    def pool(x, output_size, data_format=None, return_mask=False, name_=None, **kw):
+        df = data_format or ("NCL" if n == 1 else "NCHW" if n == 2 else "NCDHW")
+        out = dispatch(name, lambda a: _adaptive(a, n, output_size, kind, df), (x,))
+        if return_mask:
+            # indices of max within each bin — host-computed fallback
+            raise NotImplementedError("adaptive pool return_mask: use max_pool with return_mask")
+        return out
+
+    pool.__name__ = name
+    return pool
+
+
+adaptive_avg_pool1d = _make_adaptive(1, "avg", "adaptive_avg_pool1d")
+adaptive_avg_pool2d = _make_adaptive(2, "avg", "adaptive_avg_pool2d")
+adaptive_avg_pool3d = _make_adaptive(3, "avg", "adaptive_avg_pool3d")
+adaptive_max_pool1d = _make_adaptive(1, "max", "adaptive_max_pool1d")
+adaptive_max_pool2d = _make_adaptive(2, "max", "adaptive_max_pool2d")
+adaptive_max_pool3d = _make_adaptive(3, "max", "adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+
+    def impl(a):
+        powed = jnp.abs(a) ** p
+        pooled = _pool(powed, 1, kernel_size, stride, padding, "avg", ceil_mode, False, data_format)
+        k = _tup(kernel_size, 1)
+        return (pooled * float(np.prod(k))) ** (1.0 / p)
+
+    return dispatch("lp_pool1d", impl, (x,))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+
+    def impl(a):
+        powed = jnp.abs(a) ** p
+        pooled = _pool(powed, 2, kernel_size, stride, padding, "avg", ceil_mode, False, data_format)
+        k = _tup(kernel_size, 2)
+        return (pooled * float(np.prod(k))) ** (1.0 / p)
+
+    return dispatch("lp_pool2d", impl, (x,))
+
+
+def _make_unpool(n, name):
+    def unpool(x, indices, kernel_size, stride=None, padding=0, data_format=None, output_size=None, name_=None, **kw):
+        df = data_format or ("NCL" if n == 1 else "NCHW" if n == 2 else "NCDHW")
+        k = _tup(kernel_size, n)
+        s = _tup(stride if stride is not None else kernel_size, n)
+
+        def impl(a, idx):
+            nc_first = df.startswith("NC")
+            in_spatial = a.shape[2:] if nc_first else a.shape[1:-1]
+            if output_size is not None:
+                out_spatial = tuple(int(i) for i in output_size)[-n:]
+            else:
+                out_spatial = tuple((isz - 1) * st + kk for isz, st, kk in zip(in_spatial, s, k))
+            lead = a.shape[:2] if nc_first else (a.shape[0], a.shape[-1])
+            flat = a.reshape(lead + (-1,)) if nc_first else jnp.moveaxis(a, -1, 1).reshape((a.shape[0], a.shape[-1], -1))
+            fidx = idx.reshape(lead + (-1,)) if nc_first else jnp.moveaxis(idx, -1, 1).reshape((idx.shape[0], idx.shape[-1], -1))
+            out_flat = jnp.zeros(lead + (int(np.prod(out_spatial)),), a.dtype)
+            out_flat = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out_flat, fidx, flat)
+            out = out_flat.reshape(lead + out_spatial)
+            if not nc_first:
+                out = jnp.moveaxis(out, 1, -1)
+            return out
+
+        return dispatch(name, impl, (x, indices))
+
+    unpool.__name__ = name
+    return unpool
+
+
+max_unpool1d = _make_unpool(1, "max_unpool1d")
+max_unpool2d = _make_unpool(2, "max_unpool2d")
+max_unpool3d = _make_unpool(3, "max_unpool3d")

@@ -1,0 +1,97 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish", "Mish",
+    "Sigmoid", "Hardsigmoid", "Hardswish", "Hardtanh", "Hardshrink",
+    "Softshrink", "Tanhshrink", "LeakyReLU", "LogSigmoid", "LogSoftmax",
+    "Softmax", "Softmax2D", "Softplus", "Softsign", "Tanh", "ThresholdedReLU",
+    "Maxout", "GLU", "PReLU", "RReLU",
+]
+
+
+def _simple(name, fn_name, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, name=None, **kwargs):
+            super().__init__()
+            params = dict(defaults)
+            keys = list(defaults.keys())
+            for i, a in enumerate(args):
+                params[keys[i]] = a
+            params.update({k: v for k, v in kwargs.items() if k in params})
+            self._params = params
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._params)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+ELU = _simple("ELU", "elu", alpha=1.0)
+SELU = _simple("SELU", "selu", scale=1.0507009873554805, alpha=1.6732632423543772)
+CELU = _simple("CELU", "celu", alpha=1.0)
+GELU = _simple("GELU", "gelu", approximate=False)
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+Mish = _simple("Mish", "mish")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+LogSoftmax = _simple("LogSoftmax", "log_softmax", axis=-1)
+Softmax = _simple("Softmax", "softmax", axis=-1)
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _simple("Softsign", "softsign")
+Tanh = _simple("Tanh", "tanh")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu", threshold=1.0, value=0.0)
+GLU = _simple("GLU", "glu", axis=-1)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr, default_initializer=Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

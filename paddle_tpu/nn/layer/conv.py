@@ -1,0 +1,86 @@
+"""Conv layers (reference: python/paddle/nn/layer/conv.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import functional as F
+from ..initializer import XavierNormal
+from .layers import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose"]
+
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, n, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None, transposed=False, output_padding=0):
+        super().__init__()
+        self._n = n
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _tup(kernel_size, n)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._padding_mode = padding_mode
+        self._data_format = data_format or ("NCL" if n == 1 else "NCHW" if n == 2 else "NCDHW")
+        self._transposed = transposed
+        self._output_padding = output_padding
+        if transposed:
+            w_shape = (in_channels, out_channels // groups) + self._kernel_size
+        else:
+            w_shape = (out_channels, in_channels // groups) + self._kernel_size
+        self.weight = self.create_parameter(w_shape, attr=weight_attr, default_initializer=XavierNormal())
+        if bias_attr is False:
+            self.bias = None
+            self.add_parameter("bias", None)
+        else:
+            self.bias = self.create_parameter((out_channels,), attr=bias_attr, is_bias=True)
+
+    def extra_repr(self):
+        return (
+            f"{self._in_channels}, {self._out_channels}, kernel_size={self._kernel_size}, "
+            f"stride={self._stride}, padding={self._padding}"
+        )
+
+
+def _make_conv_layer(n, name, transposed):
+    fns = {
+        (1, False): F.conv1d, (2, False): F.conv2d, (3, False): F.conv3d,
+        (1, True): F.conv1d_transpose, (2, True): F.conv2d_transpose, (3, True): F.conv3d_transpose,
+    }
+    fn = fns[(n, transposed)]
+
+    class _Conv(_ConvNd):
+        def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, padding_mode="zeros",
+                     weight_attr=None, bias_attr=None, data_format=None):
+            super().__init__(n, in_channels, out_channels, kernel_size, stride, padding,
+                             dilation, groups, padding_mode, weight_attr, bias_attr,
+                             data_format, transposed, output_padding)
+
+        def forward(self, x, output_size=None):
+            if self._transposed:
+                return fn(x, self.weight, self.bias, stride=self._stride, padding=self._padding,
+                          output_padding=self._output_padding, groups=self._groups,
+                          dilation=self._dilation, data_format=self._data_format,
+                          output_size=output_size)
+            return fn(x, self.weight, self.bias, stride=self._stride, padding=self._padding,
+                      dilation=self._dilation, groups=self._groups, data_format=self._data_format)
+
+    _Conv.__name__ = name
+    _Conv.__qualname__ = name
+    return _Conv
+
+
+Conv1D = _make_conv_layer(1, "Conv1D", False)
+Conv2D = _make_conv_layer(2, "Conv2D", False)
+Conv3D = _make_conv_layer(3, "Conv3D", False)
+Conv1DTranspose = _make_conv_layer(1, "Conv1DTranspose", True)
+Conv2DTranspose = _make_conv_layer(2, "Conv2DTranspose", True)
+Conv3DTranspose = _make_conv_layer(3, "Conv3DTranspose", True)

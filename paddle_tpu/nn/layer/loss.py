@@ -1,0 +1,93 @@
+"""Loss layers (reference: python/paddle/nn/layer/loss.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
+    "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "HuberLoss",
+    "MarginRankingLoss", "CosineEmbeddingLoss", "HingeEmbeddingLoss",
+    "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+    "MultiLabelSoftMarginLoss", "SoftMarginLoss", "CTCLoss", "RNNTLoss",
+    "PoissonNLLLoss", "GaussianNLLLoss", "MultiMarginLoss",
+]
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean", soft_label=False,
+                 axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+        super().__init__()
+        self.kw = dict(weight=weight, ignore_index=ignore_index, reduction=reduction,
+                       soft_label=soft_label, axis=axis, use_softmax=use_softmax,
+                       label_smoothing=label_smoothing)
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, **self.kw)
+
+
+def _wrap(name, fn, **defaults):
+    class _Loss(Layer):
+        def __init__(self, **kwargs):
+            super().__init__()
+            kwargs.pop("name", None)
+            params = dict(defaults)
+            params.update({k: v for k, v in kwargs.items() if k in params})
+            self.kw = params
+
+        def forward(self, *args):
+            return fn(*args, **self.kw)
+
+    _Loss.__name__ = name
+    _Loss.__qualname__ = name
+    return _Loss
+
+
+MSELoss = _wrap("MSELoss", F.mse_loss, reduction="mean")
+L1Loss = _wrap("L1Loss", F.l1_loss, reduction="mean")
+NLLLoss = _wrap("NLLLoss", F.nll_loss, weight=None, ignore_index=-100, reduction="mean")
+BCELoss = _wrap("BCELoss", F.binary_cross_entropy, weight=None, reduction="mean")
+BCEWithLogitsLoss = _wrap("BCEWithLogitsLoss", F.binary_cross_entropy_with_logits,
+                          weight=None, reduction="mean", pos_weight=None)
+KLDivLoss = _wrap("KLDivLoss", F.kl_div, reduction="mean", log_target=False)
+SmoothL1Loss = _wrap("SmoothL1Loss", F.smooth_l1_loss, reduction="mean", delta=1.0)
+HuberLoss = _wrap("HuberLoss", F.huber_loss, delta=1.0, reduction="mean")
+MarginRankingLoss = _wrap("MarginRankingLoss", F.margin_ranking_loss, margin=0.0, reduction="mean")
+CosineEmbeddingLoss = _wrap("CosineEmbeddingLoss", F.cosine_embedding_loss, margin=0, reduction="mean")
+HingeEmbeddingLoss = _wrap("HingeEmbeddingLoss", F.hinge_embedding_loss, margin=1.0, reduction="mean")
+TripletMarginLoss = _wrap("TripletMarginLoss", F.triplet_margin_loss, margin=1.0, p=2.0,
+                          epsilon=1e-06, swap=False, reduction="mean")
+TripletMarginWithDistanceLoss = _wrap("TripletMarginWithDistanceLoss",
+                                      F.triplet_margin_with_distance_loss,
+                                      distance_function=None, margin=1.0, swap=False, reduction="mean")
+MultiLabelSoftMarginLoss = _wrap("MultiLabelSoftMarginLoss", F.multi_label_soft_margin_loss,
+                                 weight=None, reduction="mean")
+SoftMarginLoss = _wrap("SoftMarginLoss", F.soft_margin_loss, reduction="mean")
+PoissonNLLLoss = _wrap("PoissonNLLLoss", F.poisson_nll_loss, log_input=True, full=False,
+                       epsilon=1e-8, reduction="mean")
+GaussianNLLLoss = _wrap("GaussianNLLLoss", F.gaussian_nll_loss, full=False, epsilon=1e-6, reduction="mean")
+MultiMarginLoss = _wrap("MultiMarginLoss", F.multi_margin_loss, p=1, margin=1.0, weight=None, reduction="mean")
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction, norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank, fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

@@ -1,0 +1,106 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "LPPool1D", "LPPool2D",
+]
+
+
+def _make_pool_layer(name, fn, has_mask=False):
+    class _Pool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                     exclusive=True, divisor_override=None, return_mask=False,
+                     data_format=None, name=None):
+            super().__init__()
+            self.kw = dict(stride=stride, padding=padding, ceil_mode=ceil_mode,
+                           data_format=data_format)
+            self.kernel_size = kernel_size
+            self.return_mask = return_mask
+            self.exclusive = exclusive
+
+        def forward(self, x):
+            kw = dict(self.kw)
+            if has_mask:
+                kw["return_mask"] = self.return_mask
+            else:
+                kw["exclusive"] = self.exclusive
+            return fn(x, self.kernel_size, **kw)
+
+    _Pool.__name__ = name
+    _Pool.__qualname__ = name
+    return _Pool
+
+
+AvgPool1D = _make_pool_layer("AvgPool1D", F.avg_pool1d)
+AvgPool2D = _make_pool_layer("AvgPool2D", F.avg_pool2d)
+AvgPool3D = _make_pool_layer("AvgPool3D", F.avg_pool3d)
+MaxPool1D = _make_pool_layer("MaxPool1D", F.max_pool1d, has_mask=True)
+MaxPool2D = _make_pool_layer("MaxPool2D", F.max_pool2d, has_mask=True)
+MaxPool3D = _make_pool_layer("MaxPool3D", F.max_pool3d, has_mask=True)
+
+
+def _make_adaptive_layer(name, fn):
+    class _Pool(Layer):
+        def __init__(self, output_size, data_format=None, return_mask=False, name=None):
+            super().__init__()
+            self.output_size = output_size
+            self.data_format = data_format
+
+        def forward(self, x):
+            return fn(x, self.output_size, data_format=self.data_format)
+
+    _Pool.__name__ = name
+    _Pool.__qualname__ = name
+    return _Pool
+
+
+AdaptiveAvgPool1D = _make_adaptive_layer("AdaptiveAvgPool1D", F.adaptive_avg_pool1d)
+AdaptiveAvgPool2D = _make_adaptive_layer("AdaptiveAvgPool2D", F.adaptive_avg_pool2d)
+AdaptiveAvgPool3D = _make_adaptive_layer("AdaptiveAvgPool3D", F.adaptive_avg_pool3d)
+AdaptiveMaxPool1D = _make_adaptive_layer("AdaptiveMaxPool1D", F.adaptive_max_pool1d)
+AdaptiveMaxPool2D = _make_adaptive_layer("AdaptiveMaxPool2D", F.adaptive_max_pool2d)
+AdaptiveMaxPool3D = _make_adaptive_layer("AdaptiveMaxPool3D", F.adaptive_max_pool3d)
+
+
+def _make_unpool_layer(name, fn):
+    class _Unpool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0, data_format=None, output_size=None, name=None):
+            super().__init__()
+            self.kw = dict(stride=stride, padding=padding, data_format=data_format, output_size=output_size)
+            self.kernel_size = kernel_size
+
+        def forward(self, x, indices):
+            return fn(x, indices, self.kernel_size, **self.kw)
+
+    _Unpool.__name__ = name
+    _Unpool.__qualname__ = name
+    return _Unpool
+
+
+MaxUnPool1D = _make_unpool_layer("MaxUnPool1D", F.max_unpool1d)
+MaxUnPool2D = _make_unpool_layer("MaxUnPool2D", F.max_unpool2d)
+MaxUnPool3D = _make_unpool_layer("MaxUnPool3D", F.max_unpool3d)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)

@@ -1,0 +1,364 @@
+"""RNN layers via lax.scan (reference: python/paddle/nn/layer/rnn.py).
+
+The reference lowers RNNs to cudnn kernels; on TPU the idiomatic form is a
+`lax.scan` over time — XLA pipelines the per-step matmuls onto the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        sizes = self.state_shape
+        if isinstance(sizes, (list, tuple)) and isinstance(sizes[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((b,) + tuple(s), init_value, jnp.float32)) for s in sizes)
+        return Tensor(jnp.full((b,) + tuple(sizes), init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter((hidden_size, input_size), weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size), weight_hh_attr, default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter((hidden_size,), bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter((hidden_size,), bias_hh_attr, is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def impl(x, h, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = dispatch("simple_rnn_cell", impl, tuple(args))
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size), weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size), weight_hh_attr, default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter((4 * hidden_size,), bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter((4 * hidden_size,), bias_hh_attr, is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def impl(x, h, c, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h, c = dispatch("lstm_cell", impl, tuple(args))
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size), weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size), weight_hh_attr, default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter((3 * hidden_size,), bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter((3 * hidden_size,), bias_hh_attr, is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        has_bi = self.bias_ih is not None
+        has_bh = self.bias_hh is not None
+
+        def impl(x, h, wi, wh, *biases):
+            gi = x @ wi.T
+            gh = h @ wh.T
+            i = 0
+            if has_bi:
+                gi = gi + biases[i]
+                i += 1
+            if has_bh:
+                gh = gh + biases[i]
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = dispatch("gru_cell", impl, tuple(args))
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over time (ref: nn/layer/rnn.py:RNN). Python loop keeps
+    per-step hooks usable; under to_static, XLA unrolls/pipelines it."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        from ...ops import stack
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        states = initial_states if initial_states is not None else self.cell.get_initial_states(inputs, batch_dim_idx=1 if self.time_major else 0)
+        outs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            xt = inputs[:, t] if not self.time_major else inputs[t]
+            out, states = self.cell(xt, states, **kwargs)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        from ...ops import concat
+
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length, **kwargs)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length, **kwargs)
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net with scan-based time loop."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell, "LSTM": LSTMCell, "GRU": GRUCell}[mode]
+        kwargs = {}
+        if mode == "RNN_RELU":
+            kwargs["activation"] = "relu"
+        elif mode == "RNN_TANH":
+            kwargs["activation"] = "tanh"
+        from .container import LayerList
+
+        self.cells = LayerList()
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * num_dir
+            for _ in range(num_dir):
+                self.cells.append(cell_cls(in_size, hidden_size,
+                                           weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                                           bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr, **kwargs))
+
+    def _scan_layer(self, cell, x, h0, reverse):
+        """x: [B, T, I] (batch-first internal). Uses lax.scan through dispatch
+        so autograd works."""
+        is_lstm = self.mode == "LSTM"
+        has_bi = cell.bias_ih is not None
+        has_bh = cell.bias_hh is not None
+
+        params = [cell.weight_ih, cell.weight_hh]
+        params += [b for b in (cell.bias_ih, cell.bias_hh) if b is not None]
+
+        def impl(xa, h_init_0, h_init_1, wi, wh, *biases):
+            bias_sum = 0.0
+            i = 0
+            if has_bi:
+                bias_sum = bias_sum + biases[i]
+                i += 1
+            if has_bh:
+                bias_sum = bias_sum + biases[i]
+
+            xs = jnp.swapaxes(xa, 0, 1)  # [T, B, I]
+            if reverse:
+                xs = jnp.flip(xs, 0)
+
+            if self.mode in ("RNN_TANH", "RNN_RELU"):
+                act = jnp.tanh if self.mode == "RNN_TANH" else jax.nn.relu
+
+                def step(h, xt):
+                    hn = act(xt @ wi.T + h @ wh.T + bias_sum)
+                    return hn, hn
+
+                hT, ys = jax.lax.scan(step, h_init_0, xs)
+                state = (hT,)
+            elif self.mode == "GRU":
+                bi = biases[0] if has_bi else 0.0
+                bh = biases[1 if has_bi else 0] if has_bh else 0.0
+
+                def step(h, xt):
+                    gi = xt @ wi.T + bi
+                    gh = h @ wh.T + bh
+                    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                    r = jax.nn.sigmoid(ir + hr)
+                    z = jax.nn.sigmoid(iz + hz)
+                    c = jnp.tanh(ic + r * hc)
+                    hn = (1 - z) * c + z * h
+                    return hn, hn
+
+                hT, ys = jax.lax.scan(step, h_init_0, xs)
+                state = (hT,)
+            else:  # LSTM
+
+                def step(carry, xt):
+                    h, c = carry
+                    z = xt @ wi.T + h @ wh.T + bias_sum
+                    ii, ff, gg, oo = jnp.split(z, 4, axis=-1)
+                    ii, ff, oo = jax.nn.sigmoid(ii), jax.nn.sigmoid(ff), jax.nn.sigmoid(oo)
+                    gg = jnp.tanh(gg)
+                    cn = ff * c + ii * gg
+                    hn = oo * jnp.tanh(cn)
+                    return (hn, cn), hn
+
+                (hT, cT), ys = jax.lax.scan(step, (h_init_0, h_init_1), xs)
+                state = (hT, cT)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            return (jnp.swapaxes(ys, 0, 1),) + state
+
+        h0_0 = h0[0] if is_lstm else h0
+        h0_1 = h0[1] if is_lstm else h0  # dummy for non-lstm
+        out = dispatch("rnn_scan", impl, tuple([x, h0_0, h0_1] + params))
+        if is_lstm:
+            y, hT, cT = out
+            return y, (hT, cT)
+        y, hT = out[0], out[1]
+        return y, hT
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import concat, stack
+
+        x = inputs
+        if self.time_major:
+            from ...ops import transpose
+
+            x = transpose(x, [1, 0, 2])
+        b = x.shape[0]
+        nd = self.num_directions
+        is_lstm = self.mode == "LSTM"
+        if initial_states is None:
+            z = Tensor(jnp.zeros((self.num_layers * nd, b, self.hidden_size), jnp.float32))
+            initial_states = (z, z.clone()) if is_lstm else z
+        final_h, final_c = [], []
+        for layer_i in range(self.num_layers):
+            outs = []
+            for d in range(nd):
+                idx = layer_i * nd + d
+                cell = self.cells[idx]
+                if is_lstm:
+                    h0 = (initial_states[0][idx], initial_states[1][idx])
+                else:
+                    h0 = initial_states[idx]
+                y, st = self._scan_layer(cell, x, h0, reverse=(d == 1))
+                outs.append(y)
+                if is_lstm:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            x = outs[0] if nd == 1 else concat(outs, axis=-1)
+            if self.dropout > 0 and layer_i < self.num_layers - 1 and self.training:
+                from .. import functional as F
+
+                x = F.dropout(x, self.dropout, training=True)
+        out = x
+        if self.time_major:
+            from ...ops import transpose
+
+            out = transpose(out, [1, 0, 2])
+        h_stack = stack(final_h, axis=0)
+        if is_lstm:
+            c_stack = stack(final_c, axis=0)
+            return out, (h_stack, c_stack)
+        return out, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, proj_size=None, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)

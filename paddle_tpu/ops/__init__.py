@@ -1,0 +1,182 @@
+"""Op namespace assembly + Tensor method patching.
+
+Reference analog: python/paddle/tensor/__init__.py exports every op into the
+`paddle` namespace, and `math_op_patch.py` / `tensor_patch_methods.py`
+monkey-patch them onto Tensor. We do the same mechanically from the op
+modules' __all__ lists.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from . import creation, linalg, logic, manipulation, math, search
+from .registry import OPS, OpDef, get_op, register_op
+
+_MODULES = (math, manipulation, creation, linalg, logic, search)
+
+# hoist all ops into this namespace
+for _mod in _MODULES:
+    for _name in _mod.__all__:
+        globals()[_name] = getattr(_mod, _name)
+
+__all__ = sorted({n for m in _MODULES for n in m.__all__})
+
+
+# ---------------------------------------------------------------------------
+# Tensor method patching (math_op_patch analog)
+# ---------------------------------------------------------------------------
+
+_METHOD_NAMES = [
+    # math
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "sin",
+    "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
+    "acosh", "atanh", "abs", "ceil", "floor", "round", "trunc", "frac", "sign",
+    "neg", "reciprocal", "square", "erf", "erfinv", "sigmoid", "digamma",
+    "lgamma", "angle", "conj", "deg2rad", "rad2deg", "isfinite", "isinf",
+    "isnan", "bitwise_not",
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "floor_mod", "pow", "maximum", "minimum", "fmax", "fmin",
+    "atan2", "logaddexp", "heaviside", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "gcd", "lcm",
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "all", "any",
+    "nansum", "nanmean", "logsumexp", "median", "nanmedian", "std", "var",
+    "quantile", "nanquantile", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp", "scale", "clip", "lerp", "stanh", "trace", "diagonal",
+    "kron", "inner", "outer", "cross", "dot", "addmm", "nan_to_num",
+    "count_nonzero", "diff", "rot90", "histogram", "bincount",
+    # manipulation
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+    "split", "chunk", "unbind", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "tile", "expand", "expand_as", "broadcast_to", "flip",
+    "roll", "cast", "cast_", "astype", "clone", "gather", "gather_nd",
+    "take_along_axis", "put_along_axis", "scatter", "scatter_",
+    "scatter_nd_add", "index_select", "index_add", "index_add_", "index_fill",
+    "index_put", "index_put_", "masked_select", "masked_fill", "masked_fill_",
+    "masked_scatter", "fill_diagonal_", "strided_slice", "tril", "triu",
+    "diag", "diagflat", "diag_embed", "repeat_interleave", "unique",
+    "unique_consecutive", "numel", "view", "view_as", "unfold",
+    # linalg
+    "matmul", "bmm", "mm", "mv", "t", "dist", "norm", "cond", "solve",
+    "cholesky", "cholesky_solve", "inverse", "slogdet", "qr", "svd",
+    "eig", "eigvals", "lu", "matrix_power", "pinv", "lstsq",
+    "triangular_solve", "tensordot", "corrcoef", "cov",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "equal_all", "allclose", "isclose", "isin",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "index_sample", "kthvalue", "mode", "searchsorted", "bucketize",
+]
+
+
+def _patch_methods():
+    ns = globals()
+    for name in _METHOD_NAMES:
+        fn = ns.get(name)
+        if fn is None or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    # determinant lives at paddle.linalg.det but Tensor.det exists too
+    Tensor.det = ns["det"]
+
+    # ---- dunder operators ----
+    def _binop(opname, swap=False):
+        base = ns[opname]
+
+        def fwd(self, other):
+            return base(self, other)
+
+        def rev(self, other):
+            return base(other if isinstance(other, Tensor) else Tensor(other), self)
+
+        return rev if swap else fwd
+
+    Tensor.__add__ = _binop("add")
+    Tensor.__radd__ = _binop("add", swap=True)
+    Tensor.__sub__ = _binop("subtract")
+    Tensor.__rsub__ = _binop("subtract", swap=True)
+    Tensor.__mul__ = _binop("multiply")
+    Tensor.__rmul__ = _binop("multiply", swap=True)
+    Tensor.__truediv__ = _binop("divide")
+    Tensor.__rtruediv__ = _binop("divide", swap=True)
+    Tensor.__floordiv__ = _binop("floor_divide")
+    Tensor.__rfloordiv__ = _binop("floor_divide", swap=True)
+    Tensor.__mod__ = _binop("mod")
+    Tensor.__rmod__ = _binop("mod", swap=True)
+    Tensor.__pow__ = _binop("pow")
+    Tensor.__rpow__ = _binop("pow", swap=True)
+    Tensor.__matmul__ = _binop("matmul")
+    Tensor.__rmatmul__ = _binop("matmul", swap=True)
+    Tensor.__and__ = _binop("bitwise_and")
+    Tensor.__or__ = _binop("bitwise_or")
+    Tensor.__xor__ = _binop("bitwise_xor")
+    Tensor.__invert__ = lambda self: ns["bitwise_not"](self)
+    Tensor.__neg__ = lambda self: ns["neg"](self)
+    Tensor.__abs__ = lambda self: ns["abs"](self)
+    Tensor.__eq__ = lambda self, o: ns["equal"](self, o)
+    Tensor.__ne__ = lambda self, o: ns["not_equal"](self, o)
+    Tensor.__lt__ = lambda self, o: ns["less_than"](self, o)
+    Tensor.__le__ = lambda self, o: ns["less_equal"](self, o)
+    Tensor.__gt__ = lambda self, o: ns["greater_than"](self, o)
+    Tensor.__ge__ = lambda self, o: ns["greater_equal"](self, o)
+
+    # in-place arithmetic (paddle add_/subtract_/... semantics)
+    def _make_inplace(base_name):
+        base = ns[base_name]
+
+        def inplace(self, *args, **kwargs):
+            out = base(self, *args, **kwargs)
+            return self._replace(out._array, out._node, out._out_idx)
+
+        return inplace
+
+    for nm in ("add", "subtract", "multiply", "divide", "clip", "scale",
+               "floor_divide", "mod", "remainder", "pow", "exp", "sqrt",
+               "rsqrt", "abs", "ceil", "floor", "round", "trunc", "sigmoid",
+               "tanh", "reciprocal", "neg", "lerp", "pow"):
+        setattr(Tensor, nm + "_", _make_inplace(nm))
+
+    Tensor.zero_ = lambda self: self._replace(jnp.zeros_like(self._array))
+    Tensor.fill_ = lambda self, v: self._replace(jnp.full_like(self._array, unwrap(v)))
+
+    # indexing
+    def _getitem(self, idx):
+        idx = _unwrap_index(idx)
+        return dispatch("getitem", lambda a: a[idx], (self,))
+
+    def _setitem(self, idx, value):
+        idx = _unwrap_index(idx)
+        out = dispatch(
+            "setitem",
+            (lambda a, v: a.at[idx].set(v.astype(a.dtype)))
+            if isinstance(value, Tensor)
+            else (lambda a: a.at[idx].set(value)),
+            (self, value) if isinstance(value, Tensor) else (self,),
+        )
+        self._replace(out._array, out._node, out._out_idx)
+
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        arr = idx._array
+        return arr
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [(_unwrap_index(i)) for i in idx]
+    if isinstance(idx, slice):
+        return slice(
+            int(idx.start.item()) if isinstance(idx.start, Tensor) else idx.start,
+            int(idx.stop.item()) if isinstance(idx.stop, Tensor) else idx.stop,
+            int(idx.step.item()) if isinstance(idx.step, Tensor) else idx.step,
+        )
+    return idx
+
+
+_patch_methods()

@@ -1,0 +1,258 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap, to_tensor
+from ..framework import dtype as dtypes
+from ..framework.random import next_key
+
+__all__ = [
+    "to_tensor", "zeros", "zeros_like", "ones", "ones_like", "full",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "tril_indices", "triu_indices", "clone_detached",
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "bernoulli", "multinomial", "poisson",
+    "exponential_", "uniform_", "normal_", "gaussian", "complex", "polar",
+    "cauchy_", "geometric_", "log_normal_", "binomial", "standard_gamma",
+]
+
+
+def _d(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(i) for i in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = unwrap(fill_value)
+    if dtype is None and isinstance(fv, (bool, int, float)):
+        dtype = (
+            dtypes.bool_
+            if isinstance(fv, bool)
+            else dtypes.int64 if isinstance(fv, int) else dtypes.get_default_dtype()
+        )
+    return Tensor(jnp.full(_shape(shape), fv, _d(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return dispatch("zeros_like", lambda a: jnp.zeros_like(a, dtype=dtypes.convert_dtype(dtype)), (x,))
+
+
+def ones_like(x, dtype=None, name=None):
+    return dispatch("ones_like", lambda a: jnp.ones_like(a, dtype=dtypes.convert_dtype(dtype)), (x,))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return dispatch(
+        "full_like",
+        lambda a: jnp.full_like(a, unwrap(fill_value), dtype=dtypes.convert_dtype(dtype)),
+        (x,),
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = unwrap(start)
+    end = unwrap(end)
+    step = unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = (start, end, step)
+        dtype = (
+            dtypes.int64
+            if all(isinstance(v, (int, np.integer)) or (hasattr(v, "dtype") and jnp.issubdtype(np.dtype(v.dtype), jnp.integer)) for v in vals)
+            else dtypes.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=unwrap(base), dtype=_d(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_d(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = dispatch("meshgrid", lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")), args)
+    return list(outs)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def clone_detached(x):
+    return Tensor(x._array)
+
+
+def complex(real, imag, name=None):
+    return dispatch("complex", jax.lax.complex, (real, imag))
+
+
+def polar(abs, angle, name=None):
+    return dispatch("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)), (abs, angle))
+
+
+# ------------------------- random -------------------------
+# RNG design: keys-as-generator (framework/random.py). Reference analog:
+# phi::Generator seeds curand (paddle/phi/core/generator.h).
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _d(dtype)))
+
+
+standard_normal = randn
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype), minval=unwrap(min), maxval=unwrap(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean)
+        s = unwrap(std)
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), shp, dtypes.get_default_dtype()))
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape), dtypes.get_default_dtype()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), _d(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high, dtype=dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    return dispatch("bernoulli", lambda a: jax.random.bernoulli(next_key(), a).astype(a.dtype), (x,))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    arr = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x._array.dtype)
+    return x._replace(arr)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def impl(a):
+        if a.ndim == 1:
+            p = a / a.sum()
+            return jax.random.choice(
+                next_key(), a.shape[0], shape=(num_samples,), replace=replacement, p=p
+            ).astype(jnp.int64)
+        keys = jax.random.split(next_key(), a.shape[0])
+        p = a / a.sum(axis=-1, keepdims=True)
+        sample = lambda k, pi: jax.random.choice(
+            k, a.shape[1], shape=(num_samples,), replace=replacement, p=pi
+        )
+        return jax.vmap(sample)(keys, p).astype(jnp.int64)
+
+    return dispatch("multinomial", impl, (x,))
+
+
+def poisson(x, name=None):
+    return dispatch("poisson", lambda a: jax.random.poisson(next_key(), a).astype(a.dtype), (x,))
+
+
+def binomial(count, prob, name=None):
+    return dispatch(
+        "binomial",
+        lambda n, p: jax.random.binomial(next_key(), n.astype(jnp.float32), p).astype(jnp.int64),
+        (count, prob),
+    )
+
+
+def standard_gamma(x, name=None):
+    return dispatch("standard_gamma", lambda a: jax.random.gamma(next_key(), a).astype(a.dtype), (x,))
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    return x._replace(jax.random.uniform(next_key(), tuple(x.shape), x._array.dtype, min, max))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x._replace((mean + std * jax.random.normal(next_key(), tuple(x.shape))).astype(x._array.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    return x._replace((jax.random.exponential(next_key(), tuple(x.shape)) / lam).astype(x._array.dtype))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    return x._replace((loc + scale * jax.random.cauchy(next_key(), tuple(x.shape))).astype(x._array.dtype))
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(next_key(), tuple(x.shape))
+    return x._replace((jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs))).astype(x._array.dtype))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    return x._replace(jnp.exp(mean + std * jax.random.normal(next_key(), tuple(x.shape))).astype(x._array.dtype))

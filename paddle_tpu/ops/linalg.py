@@ -1,0 +1,349 @@
+"""Linear algebra ops.
+
+Reference surface: python/paddle/tensor/linalg.py (matmul at linalg.py:189 →
+_C_ops.matmul) over phi kernels backed by cuBLAS/cuSOLVER
+(paddle/phi/kernels/funcs/blas). On TPU, matmul lowers straight to the MXU;
+decompositions route through jnp.linalg (XLA custom calls / QR-based paths).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from .registry import register_op
+
+__all__ = [
+    "matmul", "bmm", "mm", "mv", "t", "dist", "norm", "vector_norm", "matrix_norm",
+    "cond", "solve", "cholesky", "cholesky_solve", "cholesky_inverse", "inverse", "det", "slogdet",
+    "qr", "svd", "svd_lowrank", "svdvals", "eig", "eigh", "eigvals", "eigvalsh", "lu", "lu_unpack",
+    "matrix_rank", "matrix_power", "multi_dot", "pinv", "lstsq", "triangular_solve",
+    "einsum", "tensordot", "corrcoef", "cov", "householder_product", "matrix_exp",
+    "pca_lowrank", "ormqr", "histogramdd",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """paddle.matmul (ref: python/paddle/tensor/linalg.py:189). The single
+    most important op on TPU — keep it a bare dot_general so XLA tiles it
+    onto the MXU."""
+
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return dispatch("matmul", impl, (x, y))
+
+
+register_op("matmul", jnp.matmul)
+
+
+def bmm(x, y, name=None):
+    return dispatch("bmm", jnp.matmul, (x, y))
+
+
+def mm(input, mat2, name=None):
+    return dispatch("mm", jnp.matmul, (input, mat2))
+
+
+def mv(x, vec, name=None):
+    return dispatch("mv", jnp.matmul, (x, vec))
+
+
+def t(input, name=None):
+    def impl(a):
+        return a if a.ndim < 2 else jnp.swapaxes(a, -1, -2)
+
+    return dispatch("t", impl, (input,))
+
+
+def dist(x, y, p=2, name=None):
+    def impl(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if np.isinf(p):
+            return jnp.max(jnp.abs(d)) if p > 0 else jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return dispatch("dist", impl, (x, y))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def impl(a):
+        if axis is None and p is None:
+            return jnp.linalg.norm(a.reshape(-1))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None:
+            return jnp.linalg.norm(a, axis=ax, keepdims=keepdim)
+        if p == "fro":
+            return jnp.linalg.norm(a if ax is not None else a.reshape(-1), ord="fro" if isinstance(ax, tuple) else None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if ax is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=p, keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return dispatch("norm", impl, (x,))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def impl(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.linalg.vector_norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return dispatch("vector_norm", impl, (x,))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return dispatch(
+        "matrix_norm", lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim), (x,)
+    )
+
+
+def cond(x, p=None, name=None):
+    return dispatch("cond", lambda a: jnp.linalg.cond(a, p=p), (x,))
+
+
+def solve(x, y, name=None):
+    def impl(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return dispatch("solve", impl, (x, y))
+
+
+def cholesky(x, upper=False, name=None):
+    return dispatch("cholesky", lambda a: jnp.linalg.cholesky(a, upper=upper), (x,))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, L):
+        return jax.scipy.linalg.cho_solve((L, not bool(upper)), b)
+
+    return dispatch("cholesky_solve", impl, (x, y))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def impl(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        return jax.scipy.linalg.cho_solve((L, bool(upper)), eye)
+
+    return dispatch("cholesky_inverse", impl, (x,))
+
+
+def inverse(x, name=None):
+    return dispatch("inverse", jnp.linalg.inv, (x,))
+
+
+def det(x, name=None):
+    return dispatch("det", jnp.linalg.det, (x,))
+
+
+def slogdet(x, name=None):
+    def impl(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return dispatch("slogdet", impl, (x,))
+
+
+def qr(x, mode="reduced", name=None):
+    out = dispatch("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)) if mode != "r" else (jnp.linalg.qr(a, mode="r"),), (x,))
+    return out if isinstance(out, tuple) and len(out) > 1 else out[0]
+
+
+def svd(x, full_matrices=False, name=None):
+    return dispatch("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), (x,))
+
+
+def svdvals(x, name=None):
+    return dispatch("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), (x,))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def impl(a):
+        u, s, vt = jnp.linalg.svd(a if M is None else a - unwrap(M), full_matrices=False)
+        k = min(q, s.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+    return dispatch("svd_lowrank", impl, (x,))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def impl(a):
+        k = q if q is not None else min(6, *a.shape[-2:])
+        b = a - a.mean(axis=-2, keepdims=True) if center else a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+    return dispatch("pca_lowrank", impl, (x,))
+
+
+def eig(x, name=None):
+    # TPU/XLA nonsymmetric eig runs on host (same as reference routing eig to
+    # CPU solver when unavailable on device)
+    a = np.asarray(unwrap(x))
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    a = np.asarray(unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return dispatch("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def impl(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)  # paddle returns 1-based pivots
+
+    out = dispatch("lu", impl, (x,))
+    if get_infos:
+        return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
+    return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def impl(lu_, piv):
+        n = lu_.shape[-2]
+        L = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
+        L = L[..., :, : min(lu_.shape[-2:])]
+        U = jnp.triu(lu_)[..., : min(lu_.shape[-2:]), :]
+        # pivots (1-based sequential transpositions) -> permutation matrix
+        perm = jnp.arange(n)
+        piv0 = piv - 1
+
+        def body(i, p):
+            j = piv0[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv0.shape[-1], body, perm)
+        P = jnp.zeros((n, n), lu_.dtype).at[perm, jnp.arange(n)].set(1.0)
+        return P, L, U
+
+    return dispatch("lu_unpack", impl, (x, y))
+
+
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None, name=None):
+    return dispatch(
+        "matrix_rank", lambda a: jnp.linalg.matrix_rank(a, rtol=tol if tol is not None else rtol), (x,)
+    )
+
+
+def matrix_power(x, n, name=None):
+    return dispatch("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,))
+
+
+def matrix_exp(x, name=None):
+    return dispatch("matrix_exp", jax.scipy.linalg.expm, (x,))
+
+
+def multi_dot(x, name=None):
+    return dispatch("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_, sv
+
+    return dispatch("lstsq", impl, (x, y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return dispatch("triangular_solve", impl, (x, y))
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return dispatch("einsum", lambda *arrs: jnp.einsum(equation, *arrs), operands)
+
+
+def tensordot(x, y, axes=2, name=None):
+    def impl(a, b):
+        ax = axes
+        if isinstance(ax, (list, tuple)):
+            ax = tuple(tuple(t) if isinstance(t, (list, tuple)) else t for t in ax)
+        return jnp.tensordot(a, b, axes=ax)
+
+    return dispatch("tensordot", impl, (x, y))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return dispatch(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+        (x,),
+    )
+
+
+def householder_product(x, tau, name=None):
+    def impl(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+
+        def one(mat, tv):
+            q = jnp.eye(m, dtype=mat.dtype)
+            for i in range(n):
+                v = jnp.concatenate([jnp.zeros(i, mat.dtype), jnp.ones(1, mat.dtype), mat[i + 1 :, i]])
+                q = q - tv[i] * (q @ jnp.outer(v, v))
+            return q[:, :n]
+
+        if a.ndim == 2:
+            return one(a, t_)
+        flat_a = a.reshape((-1, m, n))
+        flat_t = t_.reshape((-1, t_.shape[-1]))
+        outs = jnp.stack([one(flat_a[i], flat_t[i]) for i in range(flat_a.shape[0])])
+        return outs.reshape(a.shape[:-2] + (m, n))
+
+    return dispatch("householder_product", impl, (x, tau))
+
+
+def ormqr(input, tau, other, left=True, transpose=False, name=None):
+    def impl(a, t_, c):
+        q = householder_product(Tensor(a), Tensor(t_))._array
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return jnp.matmul(qm, c) if left else jnp.matmul(c, qm)
+
+    return dispatch("ormqr", impl, (input, tau, other))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    h, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]

@@ -1,0 +1,80 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = []
+
+
+def _export(n):
+    __all__.append(n)
+
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+
+for _name, _fn in _CMP.items():
+    def _op(x, y, name=None, _f=_fn, _n=_name):
+        return dispatch(_n, _f, (x, y))
+
+    _op.__name__ = _name
+    globals()[_name] = _op
+    _export(_name)
+
+
+def logical_not(x, name=None):
+    return dispatch("logical_not", jnp.logical_not, (x,))
+
+
+def equal_all(x, y, name=None):
+    return dispatch("equal_all", lambda a, b: jnp.array_equal(a, b), (x, y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=float(unwrap(rtol)), atol=float(unwrap(atol)), equal_nan=equal_nan),
+        (x, y),
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=float(unwrap(rtol)), atol=float(unwrap(atol)), equal_nan=equal_nan),
+        (x, y),
+    )
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return dispatch("isin", lambda a, b: jnp.isin(a, b, invert=invert), (x, test_x))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+for _n in (
+    "logical_not", "equal_all", "allclose", "isclose", "isin",
+    "is_complex", "is_floating_point", "is_integer",
+):
+    _export(_n)

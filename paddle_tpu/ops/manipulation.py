@@ -1,0 +1,674 @@
+"""Tensor manipulation ops (reshape/concat/gather/scatter/...).
+
+Reference surface: python/paddle/tensor/manipulation.py over phi kernels
+(paddle/phi/kernels/*). Gather/scatter map to jnp indexed updates (XLA
+scatter/gather HLOs).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..framework import dtype as dtypes
+from .registry import register_op
+
+__all__ = []
+
+
+def _export(n):
+    __all__.append(n)
+
+
+def _static_ints(v):
+    """Resolve a shape-like arg that may be list/tuple/Tensor of ints."""
+    if isinstance(v, Tensor):
+        return [int(i) for i in v.tolist()]
+    if isinstance(v, (list, tuple)):
+        return [int(i) if not isinstance(i, Tensor) else int(i.item()) for i in v]
+    return int(v)
+
+
+def reshape(x, shape, name=None):
+    s = _static_ints(shape)
+    return dispatch("reshape", lambda a: jnp.reshape(a, s), (x,))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(a):
+        nd = a.ndim
+        st = start_axis % nd if nd else 0
+        sp = stop_axis % nd if nd else 0
+        new_shape = list(a.shape[:st]) + [-1] + list(a.shape[sp + 1 :])
+        return jnp.reshape(a, new_shape)
+
+    return dispatch("flatten", impl, (x,))
+
+
+def transpose(x, perm, name=None):
+    p = _static_ints(perm)
+    return dispatch("transpose", lambda a: jnp.transpose(a, p), (x,))
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch("moveaxis", lambda a: jnp.moveaxis(a, source, destination), (x,))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return dispatch("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), (x,))
+
+
+transpose_ = reshape_  # placeholder overwritten below
+
+
+def concat(x, axis=0, name=None):
+    ts = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return dispatch("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), tuple(ts))
+
+
+def stack(x, axis=0, name=None):
+    ts = list(x)
+    return dispatch("stack", lambda *arrs: jnp.stack(arrs, axis=axis), tuple(ts))
+
+
+def hstack(x, name=None):
+    return dispatch("hstack", lambda *arrs: jnp.hstack(arrs), tuple(x))
+
+
+def vstack(x, name=None):
+    return dispatch("vstack", lambda *arrs: jnp.vstack(arrs), tuple(x))
+
+
+def dstack(x, name=None):
+    return dispatch("dstack", lambda *arrs: jnp.dstack(arrs), tuple(x))
+
+
+def column_stack(x, name=None):
+    return dispatch("column_stack", lambda *arrs: jnp.column_stack(arrs), tuple(x))
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        def impl(a):
+            return tuple(jnp.split(a, n, axis=ax))
+    else:
+        secs = _static_ints(num_or_sections)
+        dim = None
+
+        def impl(a):
+            sizes = list(secs)
+            total = a.shape[ax]
+            if any(s in (-1,) for s in sizes):
+                known = sum(s for s in sizes if s != -1)
+                sizes = [total - known if s == -1 else s for s in sizes]
+            idx = np.cumsum(sizes)[:-1].tolist()
+            return tuple(jnp.split(a, idx, axis=ax))
+
+    out = dispatch("split", impl, (x,))
+    return list(out)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(
+        dispatch(
+            "tensor_split",
+            lambda a: tuple(jnp.array_split(a, num_or_indices, axis=axis))
+            if isinstance(num_or_indices, int)
+            else tuple(jnp.split(a, _static_ints(num_or_indices), axis=axis)),
+            (x,),
+        )
+    )
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+
+    def impl(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(dispatch("unbind", impl, (input,)))
+
+
+def squeeze(x, axis=None, name=None):
+    def impl(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(i % a.ndim for i in ax if a.shape[i % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return dispatch("squeeze", impl, (x,))
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def unsqueeze(x, axis, name=None):
+    def impl(a):
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = [int(i.item()) if isinstance(i, Tensor) else int(i) for i in ax]
+        out = a
+        for i in sorted(ax):
+            out = jnp.expand_dims(out, i if i >= 0 else i + out.ndim + 1)
+        return out
+
+    return dispatch("unsqueeze", impl, (x,))
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def tile(x, repeat_times, name=None):
+    r = _static_ints(repeat_times)
+    return dispatch("tile", lambda a: jnp.tile(a, r), (x,))
+
+
+def expand(x, shape, name=None):
+    s = _static_ints(shape)
+
+    def impl(a):
+        tgt = list(s)
+        # paddle: -1 means keep dim
+        offset = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - offset] if i >= offset else 1
+        return jnp.broadcast_to(a, tgt)
+
+    return dispatch("expand", impl, (x,))
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(y.shape)
+    return dispatch("expand_as", lambda a: jnp.broadcast_to(a, tgt), (x,))
+
+
+def broadcast_to(x, shape, name=None):
+    s = tuple(_static_ints(shape))
+    return dispatch("broadcast_to", lambda a: jnp.broadcast_to(a, s), (x,))
+
+
+def broadcast_tensors(input, name=None):
+    return list(dispatch("broadcast_tensors", lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), tuple(input)))
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return dispatch("flip", lambda a: jnp.flip(a, axis=tuple(ax)), (x,))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return dispatch("roll", lambda a: jnp.roll(a, shifts, axis=axis), (x,))
+
+
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    return dispatch("cast", lambda a: a.astype(d), (x,))
+
+
+def cast_(x, dtype):
+    out = cast(x, dtype)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+astype = cast
+
+
+def clone(x, name=None):
+    return dispatch("clone", lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.copy(a), (x,))
+
+
+def assign(x, output=None):
+    arr = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return Tensor(jnp.copy(arr) if not isinstance(arr, jax.core.Tracer) else arr)
+    output.set_value(arr)
+    return output
+
+
+# ------------------------- gather / scatter family -------------------------
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return dispatch("gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=ax), (x, index))
+
+
+def gather_nd(x, index, name=None):
+    def impl(a, idx):
+        # idx [..., k] indexes first k dims of a
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return dispatch("gather_nd", impl, (x, index))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def impl(a, i):
+        if broadcast:
+            tgt = list(a.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(a, i, axis=axis)
+
+    return dispatch("take_along_axis", impl, (arr, indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def impl(a, i, v):
+        if broadcast:
+            tgt = list(a.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        v = jnp.broadcast_to(v, i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        idx_tuple = []
+        for d in range(a.ndim):
+            if d == axis:
+                idx_tuple.append(i)
+            else:
+                sh = [1] * a.ndim
+                sh[d] = a.shape[d]
+                idx_tuple.append(jnp.broadcast_to(jnp.arange(a.shape[d]).reshape(sh), i.shape))
+        at = a.at[tuple(idx_tuple)]
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        if reduce == "amax":
+            return at.max(v)
+        if reduce == "amin":
+            return at.min(v)
+        if reduce == "mean":
+            ones = jnp.ones_like(v)
+            cnt = jnp.zeros(a.shape, v.dtype).at[tuple(idx_tuple)].add(ones)
+            summed = a.at[tuple(idx_tuple)].add(v)
+            return jnp.where(cnt > 0, summed / (cnt + (cnt == 0)), summed)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return dispatch("put_along_axis", impl, (arr, indices, values))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def impl(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle: overwrite=False sums contributions, zeroing first
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return dispatch("scatter", impl, (x, index, updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(a, i, u):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return dispatch("scatter_nd_add", impl, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = tuple(_static_ints(shape))
+
+    def impl(i, u):
+        return jnp.zeros(s, u.dtype).at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return dispatch("scatter_nd", impl, (index, updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return dispatch("index_select", lambda a, i: jnp.take(a, i, axis=axis), (x, index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def impl(a, i, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].add(v)
+
+    return dispatch("index_add", impl, (x, index, value))
+
+
+def index_add_(x, index, axis, value, name=None):
+    out = index_add(x, index, axis, value)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def impl(a, i):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].set(unwrap(value))
+
+    return dispatch("index_fill", impl, (x, index))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(unwrap(i) for i in indices)
+
+    def impl(a, v):
+        return a.at[idxs].add(v) if accumulate else a.at[idxs].set(v)
+
+    return dispatch("index_put", impl, (x, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    out = index_put(x, indices, value, accumulate)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: materialise on host (documented non-jittable,
+    # same caveat as reference's dynamic-shape ops under to_static)
+    a = unwrap(x)
+    m = np.asarray(unwrap(mask))
+    return dispatch("masked_select", lambda arr: arr[jnp.asarray(np.nonzero(m.reshape(-1))[0])], (reshape(x, [-1]),))
+
+
+def masked_fill(x, mask, value, name=None):
+    return dispatch("masked_fill", lambda a, m: jnp.where(m, unwrap(value), a), (x, mask))
+
+
+def masked_fill_(x, mask, value, name=None):
+    out = masked_fill(x, mask, value)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def masked_scatter(x, mask, value, name=None):
+    def impl(a, m, v):
+        flat_m = m.reshape(-1)
+        order = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        picked = jnp.take(v.reshape(-1), jnp.clip(order, 0, v.size - 1))
+        return jnp.where(flat_m, picked, a.reshape(-1)).reshape(a.shape)
+
+    return dispatch("masked_scatter", impl, (x, mask, value))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def impl(a):
+        n = min(a.shape[-2:]) if a.ndim >= 2 else 0
+        i = jnp.arange(n - abs(offset))
+        if offset >= 0:
+            return a.at[..., i, i + offset].set(value)
+        return a.at[..., i - offset, i].set(value)
+
+    out = dispatch("fill_diagonal_", impl, (x,))
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+# ------------------------- slicing -------------------------
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+
+    def impl2(a):
+        import builtins
+
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(st, en)
+        return a[tuple(idx)]
+
+    return dispatch("slice", impl2, (input,))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+    strides = _static_ints(strides)
+
+    def impl(a):
+        import builtins
+
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return dispatch("strided_slice", impl, (x,))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _static_ints(shape)
+    o = _static_ints(offsets) if offsets is not None else [0] * len(s)
+
+    def impl(a):
+        import builtins
+
+        idx = tuple(
+            builtins.slice(off, off + (dim if dim != -1 else a.shape[i] - off))
+            for i, (off, dim) in enumerate(zip(o, s))
+        )
+        return a[idx]
+
+    return dispatch("crop", impl, (x,))
+
+
+# ------------------------- structure -------------------------
+
+
+def tril(x, diagonal=0, name=None):
+    return dispatch("tril", lambda a: jnp.tril(a, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch("triu", lambda a: jnp.triu(a, k=diagonal), (x,))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, a.dtype))
+            return out
+        return jnp.diag(a, k=offset)
+
+    return dispatch("diag", impl, (x,))
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch("diagflat", lambda a: jnp.diagflat(a, k=offset), (x,))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def impl(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        if offset >= 0:
+            base = base.at[..., i, i + offset].set(a)
+        else:
+            base = base.at[..., i - offset, i].set(a)
+        # move diagonal dims to dim1/dim2
+        nd = base.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # insert
+        order = []
+        src = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(src))
+        return jnp.transpose(base, order)
+
+    return dispatch("diag_embed", impl, (input,))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return dispatch(
+            "repeat_interleave",
+            lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=int(np.asarray(unwrap(repeats)).sum())),
+            (x, repeats),
+        )
+    return dispatch("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), (x,))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if a.size == 0:
+        outs = [Tensor(jnp.asarray(a))]
+    else:
+        take = np.ones(a.shape[ax], dtype=bool)
+        sl = np.moveaxis(a, ax, 0)
+        take[1:] = np.any((sl[1:] != sl[:-1]).reshape(a.shape[ax] - 1, -1), axis=1)
+        vals = np.compress(take, a, axis=ax)
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            inv = np.cumsum(take) - 1
+            outs.append(Tensor(jnp.asarray(inv)))
+        if return_counts:
+            idx = np.nonzero(take)[0]
+            counts = np.diff(np.append(idx, a.shape[ax]))
+            outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def impl(i):
+        shard = i // size
+        return jnp.where(shard == shard_id, i % size, ignore_value)
+
+    return dispatch("shard_index", impl, (input,))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim if isinstance(input, Tensor) else jnp.ndim(input)))
+
+
+def shape(input):
+    return Tensor(jnp.asarray(input.shape, dtype=jnp.int32))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [dispatch("atleast_1d", jnp.atleast_1d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [dispatch("atleast_2d", jnp.atleast_2d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [dispatch("atleast_3d", jnp.atleast_3d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def as_complex(x, name=None):
+    return dispatch("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,))
+
+
+def as_real(x, name=None):
+    return dispatch("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = dtypes.convert_dtype(shape_or_dtype)
+    return dispatch("view_dtype", lambda a: a.view(d), (x,))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    def impl(a):
+        n = (a.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        out = jnp.moveaxis(a, axis, 0)[idx]  # [n, size, ...rest]
+        out = jnp.moveaxis(out, (0, 1), (axis, a.ndim))
+        return out
+
+    return dispatch("unfold", impl, (x,))
+
+
+for _n in (
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+    "concat", "stack", "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "split", "tensor_split", "chunk", "unbind", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "flip", "roll", "cast", "cast_", "astype", "clone",
+    "assign", "gather", "gather_nd", "take_along_axis", "put_along_axis",
+    "scatter", "scatter_", "scatter_nd_add", "scatter_nd", "index_select",
+    "index_add", "index_add_", "index_fill", "index_put", "index_put_",
+    "masked_select", "masked_fill", "masked_fill_", "masked_scatter",
+    "fill_diagonal_", "slice", "strided_slice", "crop", "tril", "triu", "diag",
+    "diagflat", "diag_embed", "repeat_interleave", "unique", "unique_consecutive",
+    "shard_index", "rank", "shape", "numel", "is_empty", "is_tensor",
+    "atleast_1d", "atleast_2d", "atleast_3d", "as_complex", "as_real", "view",
+    "view_as", "unfold",
+):
+    _export(_n)
+
+register_op("reshape", jnp.reshape)
+register_op("transpose", jnp.transpose)
+register_op("concat", jnp.concatenate)

@@ -1,0 +1,572 @@
+"""Elementwise + reduction math ops.
+
+TPU-native analog of the reference op library's math section
+(paddle/phi/kernels/{cpu,gpu}/*_kernel.* registered from
+paddle/phi/ops/yaml/ops.yaml; python surface python/paddle/tensor/math.py).
+Every op is a pure jnp function routed through `core.tensor.dispatch`, so XLA
+owns fusion/codegen (the role CINN + phi kernels play in the reference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..framework import dtype as dtypes
+from .registry import register_op
+
+__all__ = []
+
+
+def _export(name):
+    __all__.append(name)
+
+
+# ---------------------------------------------------------------------------
+# table-driven simple unary ops
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x),
+    "sign": jnp.sign,
+    "neg": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "square": jnp.square,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "sigmoid": jax.nn.sigmoid,
+    "logit": jax.scipy.special.logit,
+    "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln,
+    "gammaln": jax.scipy.special.gammaln,
+    "i0": jax.scipy.special.i0,
+    "i0e": jax.scipy.special.i0e,
+    "i1": jax.scipy.special.i1,
+    "i1e": jax.scipy.special.i1e,
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "isfinite": jnp.isfinite,
+    "isinf": jnp.isinf,
+    "isnan": jnp.isnan,
+    "isneginf": jnp.isneginf,
+    "isposinf": jnp.isposinf,
+    "isreal": jnp.isreal,
+    "bitwise_not": jnp.bitwise_not,
+    "bitwise_invert": jnp.bitwise_not,
+}
+
+
+def _make_unary(name, fn):
+    def op(x, name=None, _f=fn, _n=name):
+        return dispatch(_n, _f, (x,))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"paddle.{name} — elementwise {name} (ref: python/paddle/tensor/math.py)."
+    register_op(name, fn)
+    return op
+
+
+for _name, _fn in _UNARY.items():
+    globals()[_name] = _make_unary(_name, _fn)
+    _export(_name)
+
+# ---------------------------------------------------------------------------
+# table-driven binary ops
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "floor_divide": jnp.floor_divide,
+    "mod": lambda x, y: jnp.mod(x, y),
+    "remainder": jnp.mod,
+    "floor_mod": jnp.mod,
+    "fmod": jnp.fmod,
+    "pow": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp,
+    "heaviside": jnp.heaviside,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "hypot": jnp.hypot,
+    "ldexp": jnp.ldexp,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift,
+    "bitwise_right_shift": jnp.right_shift,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+}
+
+
+def _make_binary(name, fn):
+    def op(x, y, name=None, _f=fn, _n=name):
+        return dispatch(_n, _f, (x, y))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"paddle.{name} — broadcasting elementwise {name}."
+    register_op(name, fn)
+    return op
+
+
+for _name, _fn in _BINARY.items():
+    globals()[_name] = _make_binary(_name, _fn)
+    _export(_name)
+
+
+def divide_no_nan(x, y, name=None):
+    return dispatch("divide_no_nan", lambda a, b: jnp.where(b == 0, 0.0, a / b), (x, y))
+
+
+_export("divide_no_nan")
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, fn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, _f=fn, _n=name):
+        ax = _norm_axis(axis)
+
+        def impl(a):
+            if int_promote and jnp.issubdtype(a.dtype, jnp.integer):
+                a = a.astype(jnp.int64 if a.dtype != jnp.bool_ else jnp.int64)
+            return _f(a, axis=ax, keepdims=keepdim)
+
+        return dispatch(_n, impl, (x,))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"paddle.{name} reduction (ref: python/paddle/tensor/math.py)."
+    register_op(name, fn)
+    return op
+
+
+_REDUCE = {
+    "sum": (jnp.sum, True),
+    "mean": (jnp.mean, False),
+    "prod": (jnp.prod, True),
+    "max": (jnp.max, False),
+    "min": (jnp.min, False),
+    "amax": (jnp.amax, False),
+    "amin": (jnp.amin, False),
+    "all": (jnp.all, False),
+    "any": (jnp.any, False),
+    "nansum": (jnp.nansum, True),
+    "nanmean": (jnp.nanmean, False),
+    "logsumexp": (jax.scipy.special.logsumexp, False),
+    "median": (lambda a, axis, keepdims: jnp.median(a, axis=axis, keepdims=keepdims), False),
+    "nanmedian": (lambda a, axis, keepdims: jnp.nanmedian(a, axis=axis, keepdims=keepdims), False),
+}
+
+for _name, (_fn, _p) in _REDUCE.items():
+    globals()[_name] = _make_reduce(_name, _fn, _p)
+    _export(_name)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return dispatch(
+        "std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), (x,)
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return dispatch(
+        "var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), (x,)
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    return dispatch(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim, method=interpolation),
+        (x,),
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    return dispatch(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim, method=interpolation),
+        (x,),
+    )
+
+
+for _n in ("std", "var", "quantile", "nanquantile"):
+    _export(_n)
+
+# ---------------------------------------------------------------------------
+# cumulative ops
+# ---------------------------------------------------------------------------
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return dispatch("cumsum", impl, (x,))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=d)
+        return jnp.cumprod(a, axis=int(dim), dtype=d)
+
+    return dispatch("cumprod", impl, (x,))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(a):
+        ax = axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.cummax(a, axis=int(ax))
+        eq = a == vals
+        idx = jnp.arange(a.shape[ax], dtype=d)
+        idx = idx.reshape([-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+        inds = jax.lax.cummax(jnp.where(eq, idx, jnp.asarray(-1, d)), axis=int(ax))
+        return vals, inds
+
+    return dispatch("cummax", impl, (x,))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+
+    def impl(a):
+        ax = axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.cummin(a, axis=int(ax))
+        eq = a == vals
+        idx = jnp.arange(a.shape[ax], dtype=d)
+        idx = idx.reshape([-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+        inds = jax.lax.cummax(jnp.where(eq, idx, jnp.asarray(-1, d)), axis=int(ax))
+        return vals, inds
+
+    return dispatch("cummin", impl, (x,))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def impl(a):
+        ax = axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=int(ax))
+
+    return dispatch("logcumsumexp", impl, (x,))
+
+
+for _n in ("cumsum", "cumprod", "cummax", "cummin", "logcumsumexp"):
+    _export(_n)
+
+# ---------------------------------------------------------------------------
+# misc math
+# ---------------------------------------------------------------------------
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale (ref: ops.yaml `scale`)."""
+
+    def impl(a, s=scale, b=bias):
+        s = unwrap(s)
+        b = unwrap(b)
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out.astype(a.dtype)
+
+    return dispatch("scale", impl, (x,))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return dispatch("clip", lambda a: jnp.clip(a, lo, hi), (x,))
+
+
+def lerp(x, y, weight, name=None):
+    return dispatch("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,))
+
+
+def multiplex(inputs, index, name=None):
+    def impl(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)  # [n, batch, ...]
+        return jnp.take_along_axis(
+            stacked, idx.reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0
+        )[0]
+
+    return dispatch("multiplex", impl, (index, *inputs))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), (x,))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(
+        "diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), (x,)
+    )
+
+
+def kron(x, y, name=None):
+    return dispatch("kron", jnp.kron, (x, y))
+
+
+def inner(x, y, name=None):
+    return dispatch("inner", jnp.inner, (x, y))
+
+
+def outer(x, y, name=None):
+    return dispatch("outer", lambda a, b: jnp.outer(a, b), (x, y))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+
+    def impl(a, b):
+        if ax is None:
+            # find first axis with dim 3 (paddle semantics)
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    return jnp.cross(a, b, axis=i)
+            raise ValueError("cross: no axis of size 3")
+        return jnp.cross(a, b, axis=ax)
+
+    return dispatch("cross", impl, (x, y))
+
+
+def dot(x, y, name=None):
+    def impl(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return dispatch("dot", impl, (x, y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), (input, x, y)
+    )
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch(
+        "nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), (x,)
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return dispatch(
+        "count_nonzero", lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), (x,)
+    )
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        args.append(prepend)
+    if has_app:
+        args.append(append)
+
+    def impl(a, *rest):
+        pre = rest[0] if has_pre else None
+        app = rest[1 if has_pre else 0] if has_app else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return dispatch("diff", impl, tuple(args))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def impl(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+
+    return dispatch("histogram", impl, (input,))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return dispatch("bincount", lambda a: jnp.bincount(a, minlength=minlength), (x,))
+    return dispatch(
+        "bincount", lambda a, w: jnp.bincount(a, weights=w, minlength=minlength), (x, weights)
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def log_normalize(x, axis=-1):
+    return dispatch("log_normalize", lambda a: a - jax.scipy.special.logsumexp(a, axis=axis, keepdims=True), (x,))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def impl(a):
+        dims = [i for i in range(a.ndim) if i != axis % a.ndim]
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return dispatch("renorm", impl, (x,))
+
+
+def gammainc(x, y, name=None):
+    return dispatch("gammainc", lambda a, b: jax.scipy.special.gammainc(a, b), (x, y))
+
+
+def gammaincc(x, y, name=None):
+    return dispatch("gammaincc", lambda a, b: jax.scipy.special.gammaincc(a, b), (x, y))
+
+
+def polygamma(x, n, name=None):
+    return dispatch("polygamma", lambda a: jax.scipy.special.polygamma(n, a), (x,))
+
+
+def sinc(x, name=None):
+    return dispatch("sinc", jnp.sinc, (x,))
+
+
+def signbit(x, name=None):
+    return dispatch("signbit", jnp.signbit, (x,))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    def impl(a):
+        n = a.shape[0]
+        combo = (
+            itertools.combinations_with_replacement(range(n), r)
+            if with_replacement
+            else itertools.combinations(range(n), r)
+        )
+        idx = jnp.asarray(list(combo), dtype=jnp.int32)
+        if idx.size == 0:
+            return jnp.zeros((0, r), a.dtype)
+        return a[idx]
+
+    return dispatch("combinations", impl, (x,))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return dispatch("vander", lambda a: jnp.vander(a, N=n, increasing=increasing), (x,))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return dispatch("trapezoid", lambda a, b: jnp.trapezoid(a, x=b, axis=axis), (y, x))
+    return dispatch(
+        "trapezoid", lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx, axis=axis), (y,)
+    )
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import jax.scipy.integrate as _integrate  # noqa: F401
+
+    def _cumtrap(a, b=None):
+        d = dx if dx is not None else 1.0
+        sl1 = [slice(None)] * a.ndim
+        sl2 = [slice(None)] * a.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        if b is not None:
+            db = jnp.diff(b, axis=axis) if b.ndim == a.ndim else jnp.diff(b)
+            if b.ndim != a.ndim:
+                shape = [1] * a.ndim
+                shape[axis] = -1
+                db = db.reshape(shape)
+            avg = db * (a[tuple(sl1)] + a[tuple(sl2)]) / 2.0
+        else:
+            avg = d * (a[tuple(sl1)] + a[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg, axis=axis)
+
+    if x is not None:
+        return dispatch("cumulative_trapezoid", _cumtrap, (y, x))
+    return dispatch("cumulative_trapezoid", _cumtrap, (y,))
+
+
+for _n in (
+    "scale", "clip", "lerp", "stanh", "multiplex", "trace", "diagonal", "kron",
+    "inner", "outer", "cross", "dot", "addmm", "nan_to_num", "count_nonzero",
+    "diff", "rot90", "histogram", "bincount", "broadcast_shape", "renorm",
+    "gammainc", "gammaincc", "polygamma", "sinc", "signbit", "combinations",
+    "vander", "trapezoid", "cumulative_trapezoid", "log_normalize",
+):
+    _export(_n)

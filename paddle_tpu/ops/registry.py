@@ -1,0 +1,59 @@
+"""Declarative op registry.
+
+TPU-native analog of the reference's YAML op system
+(paddle/phi/ops/yaml/ops.yaml + paddle/phi/api/generator/api_gen.py +
+phi::KernelFactory, paddle/phi/core/kernel_factory.h:240). On TPU the
+"kernel" is a pure jax function and backend/dtype dispatch belongs to XLA, so
+an OpDef only needs: the impl, an optional infer_meta (defaults to
+`jax.eval_shape`), an optional SPMD rule for the semi-auto parallel API, and
+an optional custom VJP (defaults to `jax.vjp` of the impl).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable  # pure function on jax arrays
+    infer_meta: Optional[Callable] = None  # (*ShapeDtypeStruct) -> ShapeDtypeStruct
+    spmd_rule: Optional[Callable] = None  # see parallel/spmd_rules.py
+    vjp: Optional[Callable] = None  # custom vjp (already applied via jax.custom_vjp)
+    doc: str = ""
+
+    def eval_shape(self, *args, **kwargs):
+        if self.infer_meta is not None:
+            return self.infer_meta(*args, **kwargs)
+        return jax.eval_shape(self.fn, *args, **kwargs)
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(
+    name: str,
+    fn: Callable,
+    *,
+    infer_meta: Optional[Callable] = None,
+    spmd_rule: Optional[Callable] = None,
+    vjp: Optional[Callable] = None,
+    doc: str = "",
+) -> OpDef:
+    op = OpDef(name, fn, infer_meta, spmd_rule, vjp, doc)
+    OPS[name] = op
+    return op
+
+
+def get_op(name: str) -> OpDef:
+    return OPS[name]
+
+
+def set_spmd_rule(name: str, rule: Callable):
+    """Attach a sharding-propagation rule (reference:
+    paddle/phi/infermeta/spmd_rules/*.cc) to a registered op."""
+    if name in OPS:
+        OPS[name].spmd_rule = rule

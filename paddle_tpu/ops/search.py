@@ -1,0 +1,187 @@
+"""Search / sort / indexing ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "where_",
+    "nonzero", "index_sample", "masked_select_idx", "kthvalue", "mode",
+    "searchsorted", "bucketize", "top_p_sampling",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmax(a, axis=int(axis), keepdims=keepdim).astype(d)
+
+    return dispatch("argmax", impl, (x,))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def impl(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmin(a, axis=int(axis), keepdims=keepdim).astype(d)
+
+    return dispatch("argmin", impl, (x,))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def impl(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable or descending, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return dispatch("argsort", impl, (x,))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return dispatch(
+        "sort", lambda a: jnp.sort(a, axis=axis, stable=stable or descending, descending=descending), (x,)
+    )
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(unwrap(k)) if not isinstance(k, int) else k
+
+    def impl(a):
+        ax = axis if axis is not None else a.ndim - 1
+        ax = ax % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return dispatch("topk", impl, (x,))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return dispatch("where", lambda c, a, b: jnp.where(c, a, b), (condition, x, y))
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape -> host fallback (reference kernels also produce dynamic
+    # outputs that break static graphs; documented non-jittable)
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def index_sample(x, index):
+    return dispatch(
+        "index_sample", lambda a, i: jnp.take_along_axis(a, i, axis=1), (x, index)
+    )
+
+
+def masked_select_idx(x, mask):
+    from .manipulation import masked_select
+
+    return masked_select(x, mask)
+
+
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
+    def impl(a):
+        ax = axis if axis is not None else a.ndim - 1
+        ax = ax % a.ndim
+        vals = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax).astype(jnp.int64)
+        sl = [slice(None)] * a.ndim
+        sl[ax] = slice(k - 1, k)
+        v, i = vals[tuple(sl)], idxs[tuple(sl)]
+        if not keepdim:
+            v, i = jnp.squeeze(v, ax), jnp.squeeze(i, ax)
+        return v, i
+
+    return dispatch("kthvalue", impl, (x,))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        srt = jnp.sort(moved, axis=-1)
+        n = srt.shape[-1]
+        runs = jnp.concatenate(
+            [jnp.ones(srt.shape[:-1] + (1,), bool), srt[..., 1:] != srt[..., :-1]], axis=-1
+        )
+        run_id = jnp.cumsum(runs, axis=-1)
+        counts = jax.vmap(lambda rid: jnp.bincount(rid.reshape(-1), length=n + 1))(
+            run_id.reshape((-1, n))
+        ).reshape(run_id.shape[:-1] + (n + 1,))
+        cnt_per_elem = jnp.take_along_axis(counts, run_id, axis=-1)
+        best = jnp.argmax(cnt_per_elem, axis=-1, keepdims=True)
+        val = jnp.take_along_axis(srt, best, axis=-1)
+        # last index of val in original order (paddle returns an index)
+        eq = moved == val
+        idx = jnp.max(jnp.where(eq, jnp.arange(n), -1), axis=-1, keepdims=True)
+        val = jnp.moveaxis(val, -1, ax)
+        idx = jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+        if not keepdim:
+            val, idx = jnp.squeeze(val, ax), jnp.squeeze(idx, ax)
+        return val, idx
+
+    return dispatch("mode", impl, (x,))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def impl(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            flat_s = s.reshape((-1, s.shape[-1]))
+            flat_v = v.reshape((-1, v.shape[-1]))
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(flat_s, flat_v)
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return dispatch("searchsorted", impl, (sorted_sequence, values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (ref: paddle/phi/kernels/gpu/top_p_sampling_kernel.cu)."""
+    from ..framework.random import next_key
+
+    def impl(probs, p):
+        srt_idx = jnp.argsort(-probs, axis=-1)
+        srt = jnp.take_along_axis(probs, srt_idx, axis=-1)
+        csum = jnp.cumsum(srt, axis=-1)
+        keep = csum - srt < p[..., None]
+        filtered = jnp.where(keep, srt, 0.0)
+        filtered = filtered / filtered.sum(axis=-1, keepdims=True)
+        k = jax.random.categorical(next_key(), jnp.log(jnp.clip(filtered, 1e-30, None)), axis=-1)
+        ids = jnp.take_along_axis(srt_idx, k[..., None], axis=-1)
+        scores = jnp.take_along_axis(probs, ids, axis=-1)
+        return scores, ids.astype(jnp.int64)
+
+    return dispatch("top_p_sampling", impl, (x, ps))

@@ -1,0 +1,12 @@
+"""paddle.optimizer namespace (reference: python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
+    NAdam, RAdam, ASGD, Rprop, LBFGS,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop", "LBFGS", "lr",
+]

@@ -1,0 +1,194 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:125).
+
+TPU-native design: each optimizer defines a pure `_update(param, grad,
+*state, lr)` rule; `step()` applies it through a single jitted, buffer-donating
+function per parameter group so the whole update runs fused on device (the
+role of the reference's fused Adam/merged_adam kernels).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor, unwrap
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode, ref optimizer.py:125)")
+        self._parameter_list = list(parameters)
+        self._param_groups = []
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            groups = self._parameter_list
+            self._parameter_list = []
+            for g in groups:
+                ps = list(g["params"])
+                self._param_groups.append({**g, "params": ps})
+                self._parameter_list.extend(ps)
+        else:
+            self._param_groups.append({"params": self._parameter_list})
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # state: param id -> dict of jax arrays
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = defaultdict(dict)
+        self._global_step = 0
+        self._jitted_update = None
+
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _param_dicts(self):
+        return self._param_groups
+
+    # ------------------------------------------------------------------
+    def _create_accumulators(self, p: Parameter) -> Dict[str, jax.Array]:
+        """Override: return initial state arrays for one param."""
+        return {}
+
+    def _update_rule(self, param, grad, state: Dict[str, jax.Array], lr, wd):
+        """Override: pure function -> (new_param, new_state). All jnp."""
+        raise NotImplementedError
+
+    def _weight_decay_value(self, group) -> float:
+        wd = group.get("weight_decay", self._weight_decay)
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "__float__"):
+            return float(wd)
+        return float(wd)
+
+    # ------------------------------------------------------------------
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        """Apply one update (reference Optimizer.step / _apply_optimize).
+
+        Builds (once) a jitted update over the flat list of (param, grad,
+        state) and donates old buffers.
+        """
+        self._global_step += 1
+        params: List[Parameter] = []
+        grads = []
+        for group in self._param_groups:
+            for p in group["params"]:
+                if p._grad is None or p.stop_gradient:
+                    continue
+                params.append(p)
+                grads.append(p._grad)
+        if not params:
+            return
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply(grads)
+        lr = self.get_lr()
+        step_count = self._global_step
+
+        # lazily ensure state exists
+        for p in params:
+            if not self._accumulators.get(id(p)):
+                self._accumulators[id(p)] = self._create_accumulators(p)
+
+        wd_flags = []
+        for group in self._param_groups:
+            wd = self._weight_decay_value(group)
+            for p in group["params"]:
+                if p._grad is None or p.stop_gradient:
+                    continue
+                wd_flags.append(wd if self._apply_decay(p) else 0.0)
+
+        def update_all(param_arrs, grad_arrs, state_list, lr_, step_):
+            new_params, new_states = [], []
+            for pa, ga, st, wd in zip(param_arrs, grad_arrs, state_list, wd_flags):
+                np_, ns = self._update_rule_arr(pa, ga, st, lr_, wd, step_)
+                new_params.append(np_)
+                new_states.append(ns)
+            return new_params, new_states
+
+        if self._jitted_update is None:
+            self._jitted_update = jax.jit(update_all, donate_argnums=(0, 2))
+
+        param_arrs = [p._array for p in params]
+        state_list = [self._accumulators[id(p)] for p in params]
+        try:
+            new_params, new_states = self._jitted_update(
+                param_arrs, grads, state_list, jnp.asarray(lr, jnp.float32), jnp.asarray(step_count, jnp.float32)
+            )
+        except TypeError:
+            # structure changed (e.g. new params added) -> rebuild
+            self._jitted_update = jax.jit(update_all, donate_argnums=(0, 2))
+            new_params, new_states = self._jitted_update(
+                param_arrs, grads, state_list, jnp.asarray(lr, jnp.float32), jnp.asarray(step_count, jnp.float32)
+            )
+        for p, na, ns in zip(params, new_params, new_states):
+            p._array = na
+            self._accumulators[id(p)] = ns
+
+    def _apply_decay(self, p: Parameter) -> bool:
+        return True
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def clear_grad(self, set_to_zero=False):
+        for group in self._param_groups:
+            for p in group["params"]:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        i = 0
+        for group in self._param_groups:
+            for p in group["params"]:
+                st = self._accumulators.get(id(p), {})
+                for k, v in st.items():
+                    name = (p.name or f"param_{i}") + "_" + k
+                    out[name] = Tensor(v)
+                i += 1
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        i = 0
+        for group in self._param_groups:
+            for p in group["params"]:
+                if not self._accumulators.get(id(p)):
+                    self._accumulators[id(p)] = self._create_accumulators(p)
+                st = self._accumulators[id(p)]
+                for k in list(st.keys()):
+                    name = (p.name or f"param_{i}") + "_" + k
+                    if name in state_dict:
+                        st[k] = unwrap(state_dict[name])
+                i += 1
+
+    load_state_dict = set_state_dict

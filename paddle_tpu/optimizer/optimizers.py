@@ -1,0 +1,386 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,lamb,rmsprop,adagrad,adadelta,adamax}.py). Update math matches the
+reference kernels (paddle/phi/kernels/gpu/adam_kernel.cu etc.); master-weight
+(multi_precision) semantics fall out of keeping state in fp32.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta",
+           "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD", "Rprop", "LBFGS"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+
+    def _create_accumulators(self, p):
+        return {}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        if wd:
+            g = g + wd * pa.astype(jnp.float32)
+        return (pa.astype(jnp.float32) - lr * g).astype(pa.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, p):
+        return {"velocity": jnp.zeros(p._array.shape, jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        if wd:
+            g = g + wd * pa.astype(jnp.float32)
+        v = self._momentum * state["velocity"] + g
+        if self._use_nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return (pa.astype(jnp.float32) - lr * upd).astype(pa.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _create_accumulators(self, p):
+        st = {
+            "moment1": jnp.zeros(p._array.shape, jnp.float32),
+            "moment2": jnp.zeros(p._array.shape, jnp.float32),
+        }
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p._array.shape, jnp.float32)
+        if self._multi_precision and p._array.dtype != jnp.float32:
+            st["master"] = p._array.astype(jnp.float32)
+        return st
+
+    def _decoupled(self):
+        return False  # Adam applies L2 as grad decay; AdamW decouples
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        master = state.get("master", None)
+        p32 = master if master is not None else pa.astype(jnp.float32)
+        if wd and not self._decoupled():
+            g = g + wd * p32
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1**step)
+        v_use = v
+        new_state = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], v)
+            v_use = vmax
+            new_state["moment2_max"] = vmax
+        vhat = v_use / (1 - self._beta2**step)
+        upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if wd and self._decoupled():
+            upd = upd + wd * p32
+        new_p32 = p32 - lr * upd
+        if master is not None:
+            new_state["master"] = new_p32
+        return new_p32.astype(pa.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py).
+    Default weight_decay=0.01; `apply_decay_param_fun` filters params."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay,
+                         grad_clip, lazy_mode, multi_precision, name=name, amsgrad=amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def _apply_decay(self, p: Parameter) -> bool:
+        if self._apply_decay_param_fun is not None:
+            return bool(self._apply_decay_param_fun(p.name or ""))
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.zeros(p._array.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._array.shape, jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g) + self._epsilon)
+        new_p = p32 - (lr / (1 - self._beta1**step)) * m / u
+        return new_p.astype(pa.dtype), {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, initial_accumulator_value=0.0, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.full(p._array.shape, self._init_acc, jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        acc = state["moment"] + g * g
+        new_p = p32 - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return new_p.astype(pa.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._array.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._array.shape, jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        eg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = jnp.sqrt(state["avg_squared_update"] + self._epsilon) / jnp.sqrt(eg + self._epsilon) * g
+        eu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (p32 - lr * upd).astype(pa.dtype), {"avg_squared_grad": eg, "avg_squared_update": eu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, p):
+        st = {"mean_square": jnp.zeros(p._array.shape, jnp.float32),
+              "momentum": jnp.zeros(p._array.shape, jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(p._array.shape, jnp.float32)
+        return st
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return (p32 - mom).astype(pa.dtype), new_state
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: python/paddle/optimizer/lamb.py; fused C++ analog
+    incubate DistributedFusedLamb)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_decay(self, p):
+        if self._exclude_fn is not None:
+            return not self._exclude_fn(p)
+        return True
+
+    def _create_accumulators(self, p):
+        return {"moment1": jnp.zeros(p._array.shape, jnp.float32),
+                "moment2": jnp.zeros(p._array.shape, jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1**step)
+        vhat = v / (1 - self._beta2**step)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(pa.dtype), {"moment1": m, "moment2": v}
+
+
+class NAdam(Adam):
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = b1 * m / (1 - b1 ** (step + 1)) + (1 - b1) * g / (1 - b1**step)
+        vhat = v / (1 - b2**step)
+        new_p = p32 - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p.astype(pa.dtype), {"moment1": m, "moment2": v}
+
+
+class RAdam(Adam):
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1**step)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * step * (b2**step) / (1 - b2**step)
+        vhat = jnp.sqrt(v / (1 - b2**step))
+        r_t = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8))
+        use_adapt = rho_t > 5.0
+        upd = jnp.where(use_adapt, r_t * mhat / (vhat + self._epsilon), mhat)
+        return (p32 - lr * upd).astype(pa.dtype), {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
+        self._batch_num = batch_num
+
+    def _create_accumulators(self, p):
+        return {"d": jnp.zeros(p._array.shape, jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + tuple(p._array.shape), jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        idx = (step.astype(jnp.int32) - 1) % self._batch_num
+        y_old = state["ys"][idx]
+        d = state["d"] - y_old + g
+        ys = state["ys"].at[idx].set(g)
+        n = jnp.minimum(step, float(self._batch_num))
+        return (p32 - lr * d / n).astype(pa.dtype), {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name, multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _create_accumulators(self, p):
+        return {"prev_grad": jnp.zeros(p._array.shape, jnp.float32),
+                "lrs": jnp.full(p._array.shape, float(self._learning_rate) if not callable(self._learning_rate) else 1e-3, jnp.float32)}
+
+    def _update_rule_arr(self, pa, ga, state, lr, wd, step):
+        g = ga.astype(jnp.float32)
+        p32 = pa.astype(jnp.float32)
+        sign = jnp.sign(g * state["prev_grad"])
+        etan, etap = self._etas
+        lrs = jnp.clip(
+            jnp.where(sign > 0, state["lrs"] * etap, jnp.where(sign < 0, state["lrs"] * etan, state["lrs"])),
+            self._lr_range[0], self._lr_range[1],
+        )
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p32 - lrs * jnp.sign(g_eff)
+        return new_p.astype(pa.dtype), {"prev_grad": g_eff, "lrs": lrs}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with closure (reference: python/paddle/optimizer/lbfgs.py).
+    Keeps history on host; suitable for small problems (parity feature)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-07,
+                 tolerance_change=1e-09, history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history = []
+        self._prev_flat_grad = None
+        self._prev_flat_w = None
+
+    def _flat(self, arrays):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32) for a in arrays])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        loss = closure()
+        params = [p for g in self._param_groups for p in g["params"] if not p.stop_gradient]
+        grads = [p._grad for p in params]
+        if any(g is None for g in grads):
+            return loss
+        flat_g = self._flat(grads)
+        flat_w = self._flat([p._array for p in params])
+        if self._prev_flat_grad is not None:
+            s = flat_w - self._prev_flat_w
+            y = flat_g - self._prev_flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._history.append((s, y))
+                if len(self._history) > 100:
+                    self._history.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y in reversed(self._history):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho))
+        if self._history:
+            s, y = self._history[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for (a, rho), (s, y) in zip(reversed(alphas), self._history):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        d = -q
+        lr = self.get_lr()
+        self._prev_flat_grad = flat_g
+        self._prev_flat_w = flat_w
+        offset = 0
+        for p in params:
+            n = p._array.size
+            upd = d[offset : offset + n].reshape(p._array.shape)
+            p._array = (p._array.astype(jnp.float32) + lr * upd).astype(p._array.dtype)
+            offset += n
+        return loss

@@ -1,0 +1,3 @@
+"""paddle_tpu.parallel: the distributed stack (reference:
+python/paddle/distributed). Aliased as `paddle_tpu.distributed`."""
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

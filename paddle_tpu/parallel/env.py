@@ -1,0 +1,56 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+ParallelEnv). Under single-controller JAX, `rank` is the process index
+(jax.process_index) and world_size the process count; per-device data
+parallelism inside one process is handled by sharding, not ranks.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank(group=None) -> int:
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size(group=None) -> int:
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")

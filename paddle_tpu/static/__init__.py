@@ -1,0 +1,92 @@
+"""Static-graph compatibility layer.
+
+The reference's static graph (python/paddle/static: Program/Executor,
+paddle.enable_static) is subsumed on TPU by jax.jit tracing: `to_static`
+produces a compiled, cached callable, and `InputSpec` describes traced
+arguments. We keep a thin `Program`/`Executor` facade so code written against
+the static API keeps running (it executes eagerly under the hood, with jit
+around user `main_program` bodies left to `to_static`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+_static_mode = [False]
+
+
+class InputSpec:
+    """paddle.static.InputSpec (reference:
+    python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        from ..framework.dtype import convert_dtype
+
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+
+class Program:
+    """Minimal Program facade (reference: python/paddle/base/framework.py:5840)."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return _MAIN
+
+
+def default_startup_program():
+    return _STARTUP
+
+
+_MAIN = Program()
+_STARTUP = Program()
+
+
+class Executor:
+    """Eager-executing stand-in for paddle.static.Executor
+    (python/paddle/base/executor.py:1172)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        outs = []
+        for f in fetch_list or []:
+            if callable(f):
+                outs.append(np.asarray(f(**(feed or {}))))
+            else:
+                outs.append(f)
+        return outs
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        yield
+
+    return _scope()

@@ -1,0 +1,24 @@
+"""Test config: force an 8-device CPU mesh BEFORE jax initialises.
+
+Mirrors the reference's strategy of testing distributed logic on small local
+worlds (SURVEY.md §4): SPMD tests run against a virtual 8-device CPU mesh via
+--xla_force_host_platform_device_count (no TPU needed).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

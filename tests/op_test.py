@@ -1,0 +1,61 @@
+"""OpTest-style harness: numpy is the oracle.
+
+Reference: test/legacy_test/op_test.py:418 — check_output compares op results
+against a numpy reference across executors; check_grad compares analytic
+grads against numeric finite differences. Here the two "executors" are eager
+dispatch and jit (to_static) tracing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor, unwrap
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    """Run op_fn on Tensors and np_fn on numpy arrays; compare."""
+    kwargs = kwargs or {}
+    t_in = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    out = op_fn(*t_in, **kwargs)
+    ref = np_fn(*[np.asarray(a) for a in inputs])
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(unwrap(o)), r, atol=atol, rtol=rtol)
+    return outs
+
+
+def check_grad(op_fn, inputs, grad_idx=0, eps=1e-3, atol=1e-2, rtol=1e-2, kwargs=None,
+               reduce_fn=None):
+    """Numeric-vs-analytic gradient check (ref: op_test.py:3090 check_grad)."""
+    kwargs = kwargs or {}
+    arrays = [np.asarray(a, dtype=np.float64).astype(np.float32) for a in inputs]
+
+    def scalar_loss(*arrs):
+        ts = [paddle.to_tensor(a) for a in arrs]
+        ts[grad_idx].stop_gradient = False
+        out = op_fn(*ts, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = out.sum() if reduce_fn is None else reduce_fn(out)
+        return loss, ts[grad_idx]
+
+    loss, target = scalar_loss(*arrays)
+    loss.backward()
+    analytic = np.asarray(target.grad.numpy(), dtype=np.float64)
+
+    # numeric: central differences
+    x = arrays[grad_idx]
+    numeric = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    num_flat = numeric.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        lp, _ = scalar_loss(*arrays)
+        flat[i] = orig - eps
+        lm, _ = scalar_loss(*arrays)
+        flat[i] = orig
+        num_flat[i] = (float(lp._array) - float(lm._array)) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)
