@@ -2,3 +2,4 @@
 from .ops.linalg import *  # noqa: F401,F403
 from .ops.linalg import __all__  # noqa: F401
 from .ops.math import trace  # noqa: F401
+from .ops.linalg import inverse as inv  # noqa: F401

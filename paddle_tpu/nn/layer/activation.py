@@ -6,7 +6,7 @@ from ..initializer import Constant
 from .layers import Layer
 
 __all__ = [
-    "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish", "Mish",
+    "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "SiLU", "Swish", "Mish",
     "Sigmoid", "Hardsigmoid", "Hardswish", "Hardtanh", "Hardshrink",
     "Softshrink", "Tanhshrink", "LeakyReLU", "LogSigmoid", "LogSoftmax",
     "Softmax", "Softmax2D", "Softplus", "Softsign", "Tanh", "ThresholdedReLU",
@@ -40,6 +40,7 @@ SELU = _simple("SELU", "selu", scale=1.0507009873554805, alpha=1.673263242354377
 CELU = _simple("CELU", "celu", alpha=1.0)
 GELU = _simple("GELU", "gelu", approximate=False)
 Silu = _simple("Silu", "silu")
+SiLU = Silu
 Swish = _simple("Swish", "swish")
 Mish = _simple("Mish", "mish")
 Sigmoid = _simple("Sigmoid", "sigmoid")

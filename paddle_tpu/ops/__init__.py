@@ -7,6 +7,8 @@ modules' __all__ lists.
 """
 from __future__ import annotations
 
+import builtins
+
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, dispatch, unwrap
@@ -170,8 +172,8 @@ def _unwrap_index(idx):
         return tuple(_unwrap_index(i) for i in idx)
     if isinstance(idx, list):
         return [(_unwrap_index(i)) for i in idx]
-    if isinstance(idx, slice):
-        return slice(
+    if isinstance(idx, builtins.slice):
+        return builtins.slice(
             int(idx.start.item()) if isinstance(idx.start, Tensor) else idx.start,
             int(idx.stop.item()) if isinstance(idx.stop, Tensor) else idx.stop,
             int(idx.step.item()) if isinstance(idx.step, Tensor) else idx.step,

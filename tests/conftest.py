@@ -6,10 +6,17 @@ worlds (SURVEY.md §4): SPMD tests run against a virtual 8-device CPU mesh via
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-override: the driver environment pre-sets JAX_PLATFORMS to the real
+# TPU tunnel (and /root/.axon_site re-asserts it), so the env var alone does
+# not stick — use jax.config, which wins over the site hook.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

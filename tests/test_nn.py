@@ -1,0 +1,227 @@
+"""nn.Layer / layers / functional tests (reference: test/legacy_test API tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameters_and_state_dict(self):
+        l = nn.Linear(4, 3)
+        params = l.parameters()
+        assert len(params) == 2
+        sd = l.state_dict()
+        assert set(sd.keys()) == {"weight", "bias"}
+        assert sd["weight"].shape == [4, 3]
+
+    def test_nested_state_dict(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        assert len(m.parameters()) == 4
+
+    def test_set_state_dict(self):
+        l1, l2 = nn.Linear(4, 3), nn.Linear(4, 3)
+        l2.set_state_dict(l1.state_dict())
+        np.testing.assert_allclose(l1.weight.numpy(), l2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        d.train()
+        assert (d(x).numpy() == 0).mean() > 0.3
+
+    def test_sublayers_named(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        assert len(list(m.sublayers())) >= 2
+        names = [n for n, _ in m.named_parameters()]
+        assert "0.weight" in names
+
+    def test_apply_and_to(self):
+        m = nn.Linear(3, 3)
+        m.apply(lambda l: None)
+        m.float()  # dtype cast API exists
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        l(paddle.ones([1, 2]))
+        assert calls == [1]
+
+
+class TestLayers:
+    def test_linear(self):
+        l = nn.Linear(4, 3)
+        x = rand(2, 4)
+        ref = x @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(l(paddle.to_tensor(x)).numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([1, 5, 9]))
+        out = emb(idx)
+        np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[[1, 5, 9]])
+
+    def test_conv2d(self):
+        c = nn.Conv2D(3, 8, 3, padding=1)
+        out = c(paddle.to_tensor(rand(2, 3, 16, 16)))
+        assert out.shape == [2, 8, 16, 16]
+
+    def test_conv2d_stride(self):
+        c = nn.Conv2D(3, 8, 3, stride=2)
+        assert c(paddle.to_tensor(rand(2, 3, 16, 16))).shape == [2, 8, 7, 7]
+
+    def test_maxpool_avgpool(self):
+        x = paddle.to_tensor(rand(2, 3, 8, 8))
+        assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+
+    def test_batchnorm(self):
+        bn = nn.BatchNorm2D(3)
+        x = rand(4, 3, 5, 5)
+        bn.train()
+        y = bn(paddle.to_tensor(x))
+        m = y.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-4)
+        # running stats updated
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        y2 = bn(paddle.to_tensor(x))
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = rand(2, 4, 8)
+        y = ln(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y.mean(-1), np.zeros((2, 4)), atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), np.ones((2, 4)), atol=1e-2)
+
+    def test_rmsnorm_vs_ref(self):
+        rms = nn.RMSNorm(8)
+        x = rand(2, 8)
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(rms(paddle.to_tensor(x)).numpy(), ref, rtol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.to_tensor(rand(2, 4, 5, 5))).shape == [2, 4, 5, 5]
+
+    def test_activations(self):
+        x = rand(3, 4)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(nn.ReLU()(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(nn.GELU()(t).numpy(),
+                                   0.5 * x * (1 + np.vectorize(__import__("math").erf)(x / np.sqrt(2))),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(nn.Sigmoid()(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            nn.Softmax()(t).numpy(),
+            np.exp(x) / np.exp(x).sum(-1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(nn.SiLU()(t).numpy(), x / (1 + np.exp(-x)), rtol=1e-5)
+
+    def test_rnn_lstm_gru(self):
+        x = paddle.to_tensor(rand(2, 5, 4))  # [batch, time, feat]
+        lstm = nn.LSTM(4, 8)
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8] and h.shape == [1, 2, 8]
+        gru = nn.GRU(4, 8)
+        out, h = gru(x)
+        assert out.shape == [2, 5, 8]
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        x = paddle.to_tensor(rand(2, 5, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, num_layers=2)
+        out = enc(paddle.to_tensor(rand(2, 5, 16)))
+        assert out.shape == [2, 5, 16]
+
+
+class TestFunctional:
+    def test_softmax_logsoftmax(self):
+        x = rand(3, 5)
+        t = paddle.to_tensor(x)
+        s = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+        np.testing.assert_allclose(F.softmax(t).numpy(), s, rtol=1e-5)
+        np.testing.assert_allclose(F.log_softmax(t).numpy(), np.log(s), rtol=1e-4)
+
+    def test_cross_entropy(self):
+        logits = rand(4, 10)
+        labels = np.array([1, 3, 5, 7])
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        s = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        ref = -np.log(s[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = rand(4, 10)
+        soft = np.abs(rand(4, 10)); soft = soft / soft.sum(-1, keepdims=True)
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                              soft_label=True)
+        lsm = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        ref = -(soft * lsm).sum(-1).mean()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a, b = rand(3, 4), rand(3, 4)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_nll_binary_ce(self):
+        x = np.abs(rand(4, 3)) + 0.1
+        p = x / x.sum(-1, keepdims=True)
+        lbl = np.array([0, 1, 2, 1])
+        out = F.nll_loss(paddle.to_tensor(np.log(p)), paddle.to_tensor(lbl))
+        np.testing.assert_allclose(out.numpy(), -np.log(p[np.arange(4), lbl]).mean(),
+                                   rtol=1e-5)
+
+    def test_scaled_dot_product_attention(self):
+        q = rand(2, 5, 4, 8)  # [b, seq, heads, dim]
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q))
+        assert out.shape == [2, 5, 4, 8]
+
+    def test_one_hot(self):
+        idx = paddle.to_tensor(np.array([0, 2, 1]))
+        oh = F.one_hot(idx, num_classes=3).numpy()
+        np.testing.assert_allclose(oh, np.eye(3)[[0, 2, 1]])
+
+    def test_pad(self):
+        x = rand(1, 2, 3, 3)
+        out = F.pad(paddle.to_tensor(x), [1, 1, 1, 1])
+        assert out.shape == [1, 2, 5, 5]
+
+    def test_interpolate(self):
+        x = rand(1, 3, 4, 4)
+        out = F.interpolate(paddle.to_tensor(x), scale_factor=2, mode="nearest")
+        assert out.shape == [1, 3, 8, 8]
+
+    def test_grad_flows_through_layers(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        x = paddle.to_tensor(rand(3, 4))
+        loss = m(x).sum()
+        loss.backward()
+        for p in m.parameters():
+            assert p.grad is not None, p.name
+            assert p.grad.shape == p.shape

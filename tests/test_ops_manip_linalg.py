@@ -1,0 +1,227 @@
+"""Manipulation + linalg + creation + logic + search op tests (numpy oracle)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestManipulation:
+    def test_reshape_flatten(self):
+        x = rand(2, 3, 4)
+        t = paddle.to_tensor(x)
+        assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+        assert paddle.reshape(t, [-1, 4]).shape == [6, 4]
+        assert paddle.flatten(t).shape == [24]
+        assert paddle.flatten(t, start_axis=1).shape == [2, 12]
+
+    def test_transpose(self):
+        x = rand(2, 3, 4)
+        check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                     lambda a: a.transpose(2, 0, 1), [x])
+
+    def test_concat_stack(self):
+        a, b = rand(2, 3), rand(2, 3)
+        np.testing.assert_allclose(
+            paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0).numpy(),
+            np.concatenate([a, b], 0))
+        np.testing.assert_allclose(
+            paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1).numpy(),
+            np.stack([a, b], 1))
+
+    def test_concat_grad(self):
+        a, b = rand(2, 3), rand(2, 3)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        ta.stop_gradient = False
+        tb.stop_gradient = False
+        out = paddle.concat([ta, tb], axis=0)
+        (out * out).sum().backward()
+        np.testing.assert_allclose(ta.grad.numpy(), 2 * a, rtol=1e-5)
+        np.testing.assert_allclose(tb.grad.numpy(), 2 * b, rtol=1e-5)
+
+    def test_split_chunk(self):
+        x = rand(6, 4)
+        parts = paddle.split(paddle.to_tensor(x), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 4]
+        parts = paddle.split(paddle.to_tensor(x), [2, 4], axis=0)
+        assert parts[1].shape == [4, 4]
+
+    def test_squeeze_unsqueeze(self):
+        x = rand(2, 1, 3)
+        t = paddle.to_tensor(x)
+        assert paddle.squeeze(t, axis=1).shape == [2, 3]
+        assert paddle.unsqueeze(t, axis=0).shape == [1, 2, 1, 3]
+
+    def test_tile_expand(self):
+        x = rand(1, 3)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.tile(t, [2, 2]).numpy(), np.tile(x, (2, 2)))
+        assert paddle.expand(t, [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = rand(5, 3)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(
+            paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(), x[idx])
+
+    def test_index_select(self):
+        x = rand(4, 5)
+        idx = np.array([1, 3])
+        np.testing.assert_allclose(
+            paddle.index_select(paddle.to_tensor(x), paddle.to_tensor(idx), axis=1).numpy(),
+            x[:, idx])
+
+    def test_slicing(self):
+        x = rand(4, 5, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[:, 2:4].numpy(), x[:, 2:4])
+        np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+        np.testing.assert_allclose(t[1, 2, 3].numpy(), x[1, 2, 3])
+
+    def test_setitem(self):
+        x = rand(4, 5)
+        t = paddle.to_tensor(x)
+        t[1] = 0.0
+        x2 = x.copy(); x2[1] = 0.0
+        np.testing.assert_allclose(t.numpy(), x2)
+
+    def test_slice_grad(self):
+        x = rand(4, 5)
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        t[1:3].sum().backward()
+        expect = np.zeros_like(x); expect[1:3] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), expect)
+
+    def test_tril_triu(self):
+        x = rand(4, 4)
+        check_output(paddle.tril, np.tril, [x])
+        check_output(paddle.triu, np.triu, [x])
+
+    def test_cast(self):
+        t = paddle.to_tensor(rand(3))
+        assert paddle.cast(t, "int32").dtype == np.int32
+        assert t.astype("float16").dtype == np.float16
+
+    def test_flip_roll(self):
+        x = rand(3, 4)
+        check_output(lambda t: paddle.flip(t, axis=[0]), lambda a: np.flip(a, 0), [x])
+        check_output(lambda t: paddle.roll(t, shifts=1, axis=0),
+                     lambda a: np.roll(a, 1, 0), [x])
+
+    def test_where(self):
+        c = np.array([[True, False], [False, True]])
+        a, b = rand(2, 2), rand(2, 2)
+        np.testing.assert_allclose(
+            paddle.where(paddle.to_tensor(c), paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.where(c, a, b))
+
+
+class TestLinalg:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [rand(3, 4), rand(4, 5)])
+        check_grad(paddle.matmul, [rand(3, 4), rand(4, 5)], grad_idx=0)
+        check_grad(paddle.matmul, [rand(3, 4), rand(4, 5)], grad_idx=1)
+
+    def test_matmul_transpose(self):
+        a, b = rand(3, 4), rand(5, 4)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_y=True).numpy(),
+            a @ b.T, rtol=1e-5)
+
+    def test_batched_matmul(self):
+        check_output(paddle.matmul, np.matmul, [rand(2, 3, 4), rand(2, 4, 5)])
+
+    def test_dot(self):
+        check_output(paddle.dot, np.dot, [rand(5), rand(5)])
+
+    def test_norm(self):
+        x = rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x)).numpy(), np.linalg.norm(x), rtol=1e-5)
+
+    def test_einsum(self):
+        a, b = rand(3, 4), rand(4, 5)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.einsum("ij,jk->ik", a, b), rtol=1e-5)
+
+    def test_solve_inv(self):
+        a = rand(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        b = rand(4, 2)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(), np.linalg.inv(a),
+            rtol=1e-4, atol=1e-5)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 3.5).numpy(), [3.5, 3.5])
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        assert paddle.eye(3).numpy().trace() == 3
+
+    def test_like(self):
+        t = paddle.to_tensor(rand(2, 3))
+        assert paddle.zeros_like(t).shape == [2, 3]
+        assert paddle.ones_like(t).numpy().sum() == 6
+        assert paddle.full_like(t, 2.0).numpy()[0, 0] == 2.0
+
+    def test_random(self):
+        r = paddle.rand([100])
+        assert 0 <= r.numpy().min() and r.numpy().max() <= 1
+        n = paddle.randn([1000])
+        assert abs(n.numpy().mean()) < 0.2
+        ri = paddle.randint(0, 10, [100])
+        assert ri.numpy().min() >= 0 and ri.numpy().max() < 10
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        a, b = rand(3, 4), rand(3, 4)
+        for op, ref in [("equal", np.equal), ("greater_than", np.greater),
+                        ("less_than", np.less), ("not_equal", np.not_equal)]:
+            check_output(getattr(paddle, op), ref, [a, b])
+
+    def test_argmax_argmin(self):
+        x = rand(3, 4)
+        t = paddle.to_tensor(x)
+        assert int(paddle.argmax(t)) == int(x.argmax())
+        np.testing.assert_allclose(paddle.argmax(t, axis=1).numpy(), x.argmax(1))
+        np.testing.assert_allclose(paddle.argmin(t, axis=0).numpy(), x.argmin(0))
+
+    def test_topk(self):
+        x = rand(3, 10)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+        ref = np.sort(x, 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_sort_argsort(self):
+        x = rand(4, 5)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.sort(x, 1))
+        np.testing.assert_allclose(paddle.argsort(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.argsort(x, 1))
+
+    def test_nonzero(self):
+        x = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(nz, np.stack(np.nonzero(x), -1))

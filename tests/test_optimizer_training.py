@@ -1,0 +1,161 @@
+"""Optimizer + end-to-end training convergence tests.
+
+Reference strategy: test/legacy_test optimizer tests + loss-goes-down e2e
+checks (SURVEY.md §4.3: parallel-vs-serial loss alignment uses the same idea).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def _train_quadratic(optimizer_cls, steps=150, **kw):
+    """Minimise ||w - c||^2; returns final distance."""
+    paddle.seed(0)
+    w = paddle.core.Parameter(np.zeros(4, np.float32))
+    c = paddle.to_tensor(np.array([1.0, -2.0, 3.0, 0.5], np.float32))
+    o = optimizer_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - c) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return float(((w - c) ** 2).sum().numpy())
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        assert _train_quadratic(opt.SGD, learning_rate=0.1) < 1e-3
+
+    def test_momentum(self):
+        assert _train_quadratic(opt.Momentum, learning_rate=0.05, momentum=0.9) < 1e-3
+
+    def test_adam(self):
+        assert _train_quadratic(opt.Adam, learning_rate=0.2) < 1e-2
+
+    def test_adamw(self):
+        assert _train_quadratic(opt.AdamW, learning_rate=0.2, weight_decay=0.0) < 1e-2
+
+    def test_adagrad_rmsprop(self):
+        assert _train_quadratic(opt.Adagrad, learning_rate=0.5) < 0.5
+        assert _train_quadratic(opt.RMSProp, learning_rate=0.1) < 1e-2
+
+    def test_adam_matches_reference_formula(self):
+        """One Adam step vs hand-computed update."""
+        w = paddle.core.Parameter(np.array([1.0, 2.0], np.float32))
+        o = opt.Adam(learning_rate=0.1, parameters=[w], beta1=0.9, beta2=0.99,
+                     epsilon=1e-8)
+        (w * paddle.to_tensor(np.array([1.0, 2.0], np.float32))).sum().backward()
+        g = np.array([1.0, 2.0], np.float32)
+        o.step()
+        m = 0.1 * g
+        v = 0.01 * g * g
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.99)
+        ref = np.array([1.0, 2.0]) - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(w.numpy(), ref, rtol=1e-5)
+
+    def test_weight_decay_decoupled(self):
+        w = paddle.core.Parameter(np.array([10.0], np.float32))
+        o = opt.AdamW(learning_rate=0.0, parameters=[w], weight_decay=0.1)
+        w.sum().backward()
+        o.step()
+        # lr=0 -> only decoupled decay applies... paddle couples decay*lr, so w unchanged
+        assert w.numpy()[0] <= 10.0
+
+    def test_grad_clip_global_norm(self):
+        w = paddle.core.Parameter(np.array([1.0, 1.0], np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        o = opt.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+        (w * paddle.to_tensor(np.array([3.0, 4.0], np.float32))).sum().backward()
+        o.step()
+        # grad (3,4) has norm 5 -> clipped to (0.6, 0.8)
+        np.testing.assert_allclose(w.numpy(), [1 - 0.6, 1 - 0.8], rtol=1e-5)
+
+    def test_get_lr_and_set_lr(self):
+        o = opt.SGD(learning_rate=0.5, parameters=[paddle.core.Parameter(np.zeros(1, np.float32))])
+        assert o.get_lr() == 0.5
+        o.set_lr(0.1)
+        assert o.get_lr() == 0.1
+
+
+class TestLRSchedulers:
+    def _run(self, sched, n=5):
+        lrs = []
+        for _ in range(n):
+            lrs.append(sched.get_lr())
+            sched.step()
+        return lrs
+
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        lrs = self._run(s, 6)
+        np.testing.assert_allclose(lrs, [1, 1, 0.5, 0.5, 0.25, 0.25])
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        lrs = self._run(s, 11)
+        assert lrs[0] == 1.0 and lrs[10] < 1e-6
+
+    def test_warmup(self):
+        s = opt.lr.LinearWarmup(learning_rate=1.0, warmup_steps=4, start_lr=0.0,
+                                end_lr=1.0)
+        lrs = self._run(s, 5)
+        np.testing.assert_allclose(lrs[:4], [0.0, 0.25, 0.5, 0.75])
+
+    def test_optimizer_uses_scheduler(self):
+        w = paddle.core.Parameter(np.array([1.0], np.float32))
+        sched = opt.lr.StepDecay(learning_rate=1.0, step_size=1, gamma=0.1)
+        o = opt.SGD(learning_rate=sched, parameters=[w])
+        w.sum().backward()
+        o.step(); o.clear_grad(); sched.step()
+        w.sum().backward()
+        o.step()
+        # step1 at lr=1.0: 1->0 ; step2 at lr=0.1: 0->-0.1
+        np.testing.assert_allclose(w.numpy(), [-0.1], rtol=1e-5)
+
+
+class TestEndToEnd:
+    def test_mlp_classification_converges(self):
+        """SURVEY.md §7.2 phase-1 target: an MLP trains."""
+        paddle.seed(42)
+        n = 256
+        x = np.random.randn(n, 10).astype(np.float32)
+        w_true = np.random.randn(10, 3).astype(np.float32)
+        y = (x @ w_true).argmax(-1)
+
+        model = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 3))
+        o = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+        losses = []
+        for epoch in range(30):
+            logits = model(paddle.to_tensor(x))
+            loss = F.cross_entropy(logits, paddle.to_tensor(y))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.3 * losses[0]
+        acc = (model(paddle.to_tensor(x)).numpy().argmax(-1) == y).mean()
+        assert acc > 0.9
+
+    def test_conv_net_step(self):
+        model = nn.Sequential(
+            nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+            nn.Flatten(), nn.Linear(4 * 14 * 14, 10))
+        o = opt.SGD(learning_rate=0.01, parameters=model.parameters())
+        x = paddle.to_tensor(rand(2, 1, 28, 28))
+        y = paddle.to_tensor(np.array([3, 7]))
+        l0 = None
+        for _ in range(5):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            o.step(); o.clear_grad()
+            l0 = l0 or float(loss.numpy())
+        assert float(loss.numpy()) < l0
