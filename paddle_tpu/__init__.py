@@ -40,6 +40,15 @@ from . import device  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import framework  # noqa: F401
+from . import parallel  # noqa: F401
+from . import parallel as distributed  # noqa: F401
+import sys as _sys0
+# alias paddle_tpu.distributed (and every submodule) to paddle_tpu.parallel
+# so both import paths resolve to the SAME module objects
+for _k in [k for k in list(_sys0.modules) if k.startswith(__name__ + ".parallel")]:
+    _sys0.modules[_k.replace(".parallel", ".distributed", 1)] = _sys0.modules[_k]
+_sys0.modules[__name__ + ".distributed"] = distributed
+from .parallel.data_parallel import DataParallel  # noqa: F401
 from . import linalg_ns as linalg  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .device import set_device, get_device, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu  # noqa: F401

@@ -1,0 +1,347 @@
+"""Collective communication facade: paddle.distributed.* over XLA collectives.
+
+Reference: python/paddle/distributed/communication/{all_reduce,all_gather,
+all_to_all,reduce_scatter,broadcast,...}.py over ProcessGroupNCCL
+(paddle/fluid/distributed/collective/process_group_nccl.h:37).
+
+TPU-native design: a `Group` IS a mesh axis (or tuple of axes). Collectives
+called under `shard_map`/`pjit` tracing lower to XLA collectives over ICI
+(`lax.psum`, `lax.all_gather`, ...). Called eagerly on global (already
+replicated/sharded) arrays they are the corresponding no-op/layout change —
+single-controller JAX has no per-rank eager tensors, so eager collectives
+exist for API parity and intra-process semantics only.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from . import mesh as mesh_mod
+
+__all__ = [
+    "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "all_to_all", "all_to_all_single", "reduce_scatter",
+    "broadcast", "reduce", "scatter", "gather", "barrier", "send", "recv",
+    "isend", "irecv", "ReduceOp", "stream",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = one or more mesh axes.
+
+    Reference: python/paddle/distributed/communication/group.py Group (ranks +
+    backend pg). Here the membership is implicit in the mesh topology.
+    """
+
+    def __init__(self, axis: Union[str, Sequence[str]], mesh=None, id: int = 0):
+        self.axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        self._mesh = mesh
+        self.id = id
+
+    @property
+    def mesh(self):
+        return self._mesh or mesh_mod.get_global_mesh()
+
+    @property
+    def nranks(self) -> int:
+        m = self.mesh
+        if m is None:
+            return 1
+        size = 1
+        for a in self.axes:
+            size *= int(m.shape[a])
+        return size
+
+    world_size = nranks
+
+    @property
+    def rank(self):
+        try:
+            return lax.axis_index(self.axes[0])
+        except Exception:
+            return 0
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+_groups: List[Group] = []
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis=None) -> Group:
+    """Create a group. With `axis`, binds to that mesh axis; the rank-list
+    form (reference collective.py:186) has no TPU meaning — it returns the
+    world group for API compatibility."""
+    g = Group(axis or (mesh_mod.get_global_mesh().axis_names
+                       if mesh_mod.get_global_mesh() else "dp"),
+              id=len(_groups) + 1)
+    _groups.append(g)
+    return g
+
+
+def get_group(id: int = 0) -> Optional[Group]:
+    for g in _groups:
+        if g.id == id:
+            return g
+    return _groups[-1] if _groups else None
+
+
+def _axes_of(group) -> Optional[Sequence[str]]:
+    if group is None:
+        m = mesh_mod.get_global_mesh()
+        return None if m is None else tuple(m.axis_names)
+    if isinstance(group, Group):
+        return group.axes
+    if isinstance(group, str):
+        return (group,)
+    return tuple(group)
+
+
+def _bound(axes) -> bool:
+    """True iff the axis names are bound in the current trace (inside
+    shard_map over those axes)."""
+    if axes is None:
+        return False
+    try:
+        lax.axis_index(axes[0])
+        return True
+    except Exception:
+        return False
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In shard_map: lax.psum/pmax/... over the group's axes (XLA all-reduce
+    over ICI). Eagerly: identity (a global array is already the reduced
+    value across the single controller)."""
+    axes = _axes_of(group)
+    if not _bound(axes):
+        return tensor
+
+    def impl(x):
+        if op in (ReduceOp.SUM, "sum"):
+            return lax.psum(x, axes)
+        if op in (ReduceOp.AVG, "avg"):
+            return lax.pmean(x, axes)
+        if op in (ReduceOp.MAX, "max"):
+            return lax.pmax(x, axes)
+        if op in (ReduceOp.MIN, "min"):
+            return lax.pmin(x, axes)
+        if op in (ReduceOp.PROD, "prod"):
+            return jnp.exp(lax.psum(jnp.log(x), axes))
+        raise ValueError(f"unknown reduce op {op}")
+
+    out = dispatch("all_reduce", impl, (tensor,))
+    if isinstance(tensor, Tensor):
+        tensor._replace(out._array, out._node, out._out_idx)
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """paddle signature: all_gather(list, tensor). Functional form: pass
+    tensor only -> returns gathered Tensor (stacked on a new leading dim)."""
+    if tensor is None and not isinstance(tensor_list, list):
+        tensor, tensor_list = tensor_list, None
+    axes = _axes_of(group)
+    if not _bound(axes):
+        out = tensor
+        n = 1
+    else:
+        out = dispatch(
+            "all_gather",
+            lambda x: lax.all_gather(x, axes[0], tiled=False), (tensor,))
+        n = Group(axes).nranks
+    if tensor_list is not None:
+        if n == 1:
+            tensor_list.append(out if isinstance(out, Tensor) else Tensor(out))
+        else:
+            for i in range(n):
+                tensor_list.append(out[i])
+        return None
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """psum_scatter over the group axis (XLA reduce-scatter)."""
+    axes = _axes_of(group)
+    src = tensor_list if tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        stacked = jnp.concatenate([unwrap(t) for t in src], axis=0)
+        src_t = Tensor(stacked)
+    else:
+        src_t = src
+    if not _bound(axes):
+        out = src_t
+    else:
+        out = dispatch(
+            "reduce_scatter",
+            lambda x: lax.psum_scatter(x, axes[0], scatter_dimension=0,
+                                       tiled=True), (src_t,))
+    if tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._replace(out._array, out._node, out._out_idx)
+        return tensor
+    return out
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    """List-form all_to_all (reference: communication/all_to_all.py). Inside
+    shard_map use `all_to_all_single` (the XLA-native form)."""
+    if in_tensor_list is None:
+        in_tensor_list = out_tensor_list
+        out_tensor_list = None
+    axes = _axes_of(group)
+    if not _bound(axes):
+        res = list(in_tensor_list)
+    else:
+        x = jnp.stack([unwrap(t) for t in in_tensor_list], axis=0)
+        swapped = lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0,
+                                 tiled=False)
+        res = [Tensor(swapped[i]) for i in range(swapped.shape[0])]
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(res)
+        return None
+    return res
+
+
+def all_to_all_single(out_tensor, in_tensor=None, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True,
+                      split_axis=0, concat_axis=0):
+    """XLA-native all-to-all: split in_tensor along split_axis across the
+    group, concat received chunks along concat_axis. This is the Ulysses /
+    MoE-dispatch primitive (reference: alltoall op +
+    distributed/utils/moe_utils.py global_scatter)."""
+    if in_tensor is None:
+        in_tensor, out_tensor = out_tensor, None
+    axes = _axes_of(group)
+    if not _bound(axes):
+        out = in_tensor if isinstance(in_tensor, Tensor) else Tensor(in_tensor)
+    else:
+        out = dispatch(
+            "all_to_all",
+            lambda x: lax.all_to_all(x, axes[0], split_axis=split_axis,
+                                     concat_axis=concat_axis, tiled=True),
+            (in_tensor,))
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._replace(out._array, out._node, out._out_idx)
+        return out_tensor
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Within a mesh axis every shard computes the same program — broadcast
+    from rank `src` is realized by selecting src's value via ppermute when
+    values may diverge; under SPMD they cannot, so this is identity inside
+    traces and eagerly."""
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axes = _axes_of(group)
+    if not _bound(axes):
+        if tensor_list:
+            t0 = tensor_list[0]
+            tensor._replace(unwrap(t0) if not isinstance(t0, Tensor) else t0._array)
+        return tensor
+    stacked = jnp.stack([unwrap(t) for t in tensor_list], axis=0)
+    idx = lax.axis_index(axes[0])
+    out = lax.dynamic_index_in_dim(stacked, idx, axis=0, keepdims=False)
+    tensor._replace(out)
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    return all_gather(gather_list, tensor, group=group)
+
+
+def barrier(group=None):
+    """Device-sync barrier; eager = block_until_ready on a trivial psum."""
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P over a mesh axis = lax.ppermute (used by pipeline parallel;
+    reference: pp_utils/p2p_communication.py)."""
+    axes = _axes_of(group)
+    if not _bound(axes):
+        _p2p_buf.append(unwrap(tensor))
+        return tensor
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    axes = _axes_of(group)
+    if not _bound(axes):
+        if _p2p_buf:
+            tensor._replace(jnp.asarray(_p2p_buf.pop(0)))
+        return tensor
+    return tensor
+
+
+_p2p_buf: list = []
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _DoneTask()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _DoneTask()
+
+
+class _DoneTask:
+    def wait(self):
+        return None
+
+    def is_completed(self):
+        return True
+
+
+class _StreamNS:
+    """paddle.distributed.stream.* variants (reference: communication/stream/);
+    on TPU streams are XLA's concern — same impls."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    all_to_all_single = staticmethod(all_to_all_single)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    gather = staticmethod(gather)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

@@ -1,0 +1,77 @@
+"""DataParallel wrapper + gradient sync semantics.
+
+Reference: python/paddle/distributed/parallel.py:207 (paddle.DataParallel)
+backed by the C++ EagerReducer (fluid/distributed/collective/reducer.h:88):
+bucketed grad fusion + async allreduce overlapped with backward, `no_sync`
+to skip sync during gradient accumulation.
+
+TPU-native: data parallelism is batch sharding over the `dp` mesh axis.
+Params are replicated; XLA emits one fused reduce for the gradient of each
+replicated param automatically during the backward of a pjit'd step — the
+EagerReducer's bucketing/overlap is exactly what the XLA scheduler does with
+collective-matmul overlap. The wrapper's job reduces to (a) laying out
+inputs over `dp`, (b) API parity (`no_sync`, `scale_loss`)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import mesh as mesh_mod
+from .api import shard_constraint
+from .placement import Replicate, Shard
+
+__all__ = ["DataParallel", "scale_batch"]
+
+
+def scale_batch(x, axis_name: str = "dp"):
+    """Annotate a batch tensor as sharded on dim 0 over `dp`."""
+    mesh = mesh_mod.get_global_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        return x
+    pl = [Shard(0) if a == axis_name else Replicate() for a in mesh.axis_names]
+    return shard_constraint(x, pl, mesh)
+
+
+class DataParallel(Layer):
+    """reference: paddle.DataParallel(layers, strategy=None, comm_buffer_size,
+    last_comm_buffer_size, find_unused_parameters)."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._sync = True
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            scale_batch(i) if isinstance(i, Tensor) and i.ndim > 0 else i
+            for i in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Gradient-accumulation window (reference: parallel.py no_sync).
+        Under single-controller SPMD grads are only materialized at step
+        boundaries, so nothing to suppress — parity no-op."""
+        self._sync = False
+        try:
+            yield
+        finally:
+            self._sync = True
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

@@ -54,3 +54,22 @@ class ParallelEnv:
     @property
     def trainer_endpoints(self):
         return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host bootstrap (reference: init_parallel_env's TCPStore/NCCL-id
+    exchange, parallel.py:957) -> jax.distributed.initialize, which speaks
+    to the TPU coordination service over DCN."""
+    try:
+        from jax._src import distributed as _jd
+
+        if _jd.global_state.client is not None:
+            return  # already initialized
+    except Exception:
+        pass
+    if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address
+            or os.environ.get("COORDINATOR_ADDRESS"),
+            num_processes=num_processes, process_id=process_id)

@@ -1,0 +1,138 @@
+"""fleet facade: init / distributed_model / distributed_optimizer.
+
+Reference: python/paddle/distributed/fleet/fleet.py:166 (fleet.init),
+fleet/model.py:32 (distributed_model wraps per active axes),
+fleet/base/distributed_strategy.py (proto-backed DistributedStrategy,
+distributed_strategy.proto:359).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import mesh as mesh_mod
+from .data_parallel import DataParallel
+from .mesh import HybridCommunicateGroup, auto_mesh
+from .sharding import group_sharded_parallel, shard_accumulators
+
+__all__ = ["DistributedStrategy", "init", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "fleet"]
+
+
+class _HybridConfigs(dict):
+    __getattr__ = dict.get
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    """Knob container (reference: distributed_strategy.proto — amp/recompute/
+    sharding/pipeline/mp knobs). Only the hybrid degrees drive behavior on
+    TPU; the rest are stored for API parity and surfaced to passes."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+
+class _Fleet:
+    def __init__(self):
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective: bool = True, strategy=None,
+             log_level="INFO"):
+        """Build the hybrid mesh from strategy.hybrid_configs
+        (reference: fleet.py:166 + HybridCommunicateGroup ctor)."""
+        strategy = strategy or DistributedStrategy()
+        hc = strategy.hybrid_configs
+        degrees = {}
+        for axis, key in (("dp", "dp_degree"), ("pp", "pp_degree"),
+                          ("sharding", "sharding_degree"),
+                          ("sep", "sep_degree"), ("mp", "mp_degree")):
+            d = int(hc.get(key, 1) or 1)
+            if axis != "dp":
+                degrees[axis] = d
+        import jax
+
+        if int(hc.get("dp_degree", 1) or 1) > 0 and "dp_degree" in hc:
+            # dp inferred when product of others < device count
+            pass
+        mesh = auto_mesh(**degrees)
+        self._hcg = HybridCommunicateGroup(mesh)
+        self._strategy = strategy
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        if self._hcg is None:
+            self.init()
+        return self._hcg
+
+    def distributed_model(self, model):
+        """Wrap per active axes (reference: fleet/model.py:32,141-160)."""
+        hcg = self.get_hybrid_communicate_group()
+        if hcg.get_pipe_parallel_world_size() > 1:
+            from .pipeline import PipelineParallel
+
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            from .sharding import shard_params_stage3  # stage set at optimizer
+
+        if hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference: HybridParallelOptimizer
+        (fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:255)."""
+        hcg = self.get_hybrid_communicate_group()
+        if hcg.get_sharding_parallel_world_size() > 1:
+            optimizer = shard_accumulators(optimizer)
+        return optimizer
+
+    # role info
+    def worker_index(self):
+        from .env import get_rank
+
+        return get_rank()
+
+    def worker_num(self):
+        from .env import get_world_size
+
+        return get_world_size()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from .collective import barrier
+
+        barrier()
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+
+fleet = _Fleet()
+init = fleet.init
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer

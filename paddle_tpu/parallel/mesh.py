@@ -1,0 +1,116 @@
+"""Device-mesh topology: the TPU-native HybridCommunicateGroup.
+
+Reference: python/paddle/distributed/fleet/base/topology.py:68
+(CommunicateTopology / HybridCommunicateGroup) builds a 5-D cartesian
+process topology [data, pipe, sharding, sep, model] and one NCCL group per
+axis. On TPU the entire topology is ONE `jax.sharding.Mesh` whose named axes
+are the parallelism axes; XLA inserts the collectives (psum/all_gather/...)
+over ICI when a computation is pjit'd/shard_map'd over the mesh. No
+per-group communicator bootstrap (NCCL id exchange, TCPStore) is needed —
+`jax.distributed.initialize` handles multi-host rendezvous.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical axis order mirrors the reference's
+# ["data", "pipe", "sharding", "sep", "model"] (topology.py:188)
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_mesh: Optional[Mesh] = None
+
+
+def build_mesh(
+    shape: Dict[str, int] | Sequence[int],
+    axis_names: Optional[Sequence[str]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Create a Mesh from {axis: size}. Axes of size 1 are kept so sharding
+    specs can always reference every hybrid axis."""
+    if isinstance(shape, dict):
+        axis_names = tuple(shape.keys())
+        sizes = tuple(shape.values())
+    else:
+        sizes = tuple(shape)
+        axis_names = tuple(axis_names or HYBRID_AXES[: len(sizes)])
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh shape {dict(zip(axis_names, sizes))} needs {n} devices, "
+            f"got {len(devices)}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names)
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def auto_mesh(**degrees: int) -> Mesh:
+    """Build + install a hybrid mesh, inferring the dp degree from the device
+    count (reference: HybridCommunicateGroup checks
+    np.prod(dims) == world_size, topology.py:178)."""
+    n = jax.device_count()
+    known = int(np.prod([d for d in degrees.values()]))
+    shape = dict(degrees)
+    if n % known != 0:
+        raise ValueError(f"{degrees} does not divide device count {n}")
+    if "dp" not in shape:
+        shape = {"dp": n // known, **shape}
+    mesh = build_mesh(shape)
+    set_global_mesh(mesh)
+    return mesh
+
+
+@dataclasses.dataclass
+class HybridParallelInfo:
+    """Per-axis degree/rank view (reference: HybridCommunicateGroup's
+    get_*_parallel_world_size/rank accessors, topology.py:224-344)."""
+
+    mesh: Mesh
+
+    def degree(self, axis: str) -> int:
+        return int(self.mesh.shape[axis]) if axis in self.mesh.axis_names else 1
+
+    # paddle-named accessors
+    def get_data_parallel_world_size(self):
+        return self.degree("dp")
+
+    def get_model_parallel_world_size(self):
+        return self.degree("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self.degree("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self.degree("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self.degree("sep")
+
+
+class HybridCommunicateGroup(HybridParallelInfo):
+    """API-parity facade over the mesh (reference: topology.py:178)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, **degrees: int):
+        if mesh is None:
+            mesh = get_global_mesh() or auto_mesh(**degrees)
+        super().__init__(mesh)
+
+    @property
+    def nranks(self) -> int:
+        return self.mesh.size
+
+    def topology(self) -> List[int]:
+        return [self.degree(a) for a in self.mesh.axis_names]

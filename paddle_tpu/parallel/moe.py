@@ -1,0 +1,168 @@
+"""Expert parallelism: MoE layer with all-to-all dispatch over the `ep` axis.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(MoELayer with global_scatter/global_gather all-to-all ops), gates in
+moe/gate/{gshard,switch,naive}_gate.py, helpers
+python/paddle/distributed/utils/moe_utils.py:20,153.
+
+TPU-native: experts are stacked into one weight tensor with the expert dim
+sharded over `ep` (aliasing `mp` or `dp` when no dedicated axis exists);
+tokens are routed with a capacity-bounded one-hot dispatch einsum
+(GShard-style — compiler-friendly static shapes, no dynamic gather), and
+XLA lowers the dispatch/combine einsums against expert-sharded weights to
+the same all-to-all pattern as global_scatter/global_gather.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..nn.layer.layers import Layer
+from . import mesh as mesh_mod
+from .api import shard_constraint
+from .placement import Replicate, Shard
+
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "MoELayer", "moe_dispatch"]
+
+
+class NaiveGate(Layer):
+    """reference: moe/gate/naive_gate.py — linear router, top-k softmax."""
+
+    def __init__(self, d_model, num_experts, topk=2):
+        super().__init__()
+        self.num_experts = num_experts
+        self.topk = topk
+        self.gate_weight = self.create_parameter([d_model, num_experts])
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        return F.softmax(x @ self.gate_weight, axis=-1)
+
+
+class SwitchGate(NaiveGate):
+    """reference: moe/gate/switch_gate.py — top-1 routing."""
+
+    def __init__(self, d_model, num_experts, topk=1, **kw):
+        super().__init__(d_model, num_experts, topk=1)
+
+
+class GShardGate(NaiveGate):
+    """reference: moe/gate/gshard_gate.py — top-2 + capacity + aux loss."""
+
+    def __init__(self, d_model, num_experts, topk=2, capacity_factor=1.25, **kw):
+        super().__init__(d_model, num_experts, topk=topk)
+        self.capacity_factor = capacity_factor
+
+
+def moe_dispatch(x, gate_probs, num_experts: int, topk: int,
+                 capacity_factor: float = 1.25):
+    """Capacity-bounded top-k dispatch (GShard). Returns (dispatch_mask
+    [tokens, experts, capacity], combine_weights same shape, aux_loss).
+
+    Static-shape re-expression of global_scatter (moe_utils.py:20): instead
+    of variable-length token lists per expert, a fixed `capacity` slot
+    matrix — the XLA-friendly form."""
+    tokens = x.shape[0]
+    capacity = max(1, int(capacity_factor * tokens * topk / num_experts))
+
+    def impl(probs):
+        topv, topi = jax.lax.top_k(probs, topk)  # [tokens, topk]
+        mask = jax.nn.one_hot(topi, num_experts, dtype=probs.dtype)  # [t,k,e]
+        # positions within each expert queue
+        flat = mask.reshape(tokens * topk, num_experts)
+        pos = jnp.cumsum(flat, axis=0) - 1.0  # [t*k, e]
+        pos = pos.reshape(tokens, topk, num_experts)
+        keep = pos < capacity
+        mask = mask * keep
+        # aux load-balance loss (gshard eq.)
+        density = mask.sum(axis=(0, 1)) / tokens
+        density_proxy = probs.mean(axis=0)
+        aux = (density * density_proxy).sum() * num_experts
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=probs.dtype)  # [t,k,e,c]
+        disp = (mask[..., None] * slot).sum(1)  # [t,e,c]
+        combine = disp * topv.sum(-1, keepdims=True)[..., None]
+        weights = (mask * topv[..., None]).sum(1)  # [t,e]
+        combine = disp * weights[..., None]
+        return disp, combine, aux
+
+    return dispatch("moe_dispatch", impl, (gate_probs,), n_outs=3)
+
+
+class MoELayer(Layer):
+    """reference: moe_layer.py:263 MoELayer(d_model, experts, gate, ...).
+
+    forward: gate -> dispatch all-to-all -> expert MLPs -> combine."""
+
+    def __init__(self, d_model: int, experts: Optional[List[Layer]] = None,
+                 gate=None, moe_group=None, mp_group=None,
+                 num_experts: Optional[int] = None, d_hidden: Optional[int] = None,
+                 topk: int = 2, capacity_factor: float = 1.25, **kw):
+        super().__init__()
+        if experts is not None:
+            num_experts = len(experts)
+            from ..nn.layer.container import LayerList
+
+            self.experts = LayerList(experts)
+            self._stacked = False
+        else:
+            assert num_experts and d_hidden
+            # stacked expert weights [E, d, h] / [E, h, d]: expert dim
+            # sharded over the ep axis
+            self.w1 = self.create_parameter([num_experts, d_model, d_hidden])
+            self.w2 = self.create_parameter([num_experts, d_hidden, d_model])
+            self._stacked = True
+            mesh = mesh_mod.get_global_mesh()
+            ep_axis = next((a for a in ("ep", "mp", "sharding")
+                            if mesh is not None and a in mesh.axis_names
+                            and num_experts % int(mesh.shape[a]) == 0), None)
+            if ep_axis is not None:
+                sh = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(ep_axis))
+                self.w1._array = jax.device_put(self.w1._array, sh)
+                self.w2._array = jax.device_put(self.w2._array, sh)
+        self.num_experts = num_experts
+        self.topk = topk
+        self.capacity_factor = capacity_factor
+        self.gate = gate or NaiveGate(d_model, num_experts, topk=topk)
+        self.aux_loss = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        h = x.reshape([-1, orig_shape[-1]])
+        probs = self.gate(h)
+        disp, combine, aux = moe_dispatch(
+            h, probs, self.num_experts, self.topk, self.capacity_factor)
+        self.aux_loss = aux
+
+        if self._stacked:
+            def expert_impl(d, hh, w1, w2):
+                # d: [t,e,c]; expert inputs [e,c,dm]
+                ein = jnp.einsum("tec,td->ecd", d, hh)
+                act = jax.nn.gelu(jnp.einsum("ecd,edh->ech", ein, w1))
+                out = jnp.einsum("ech,ehd->ecd", act, w2)
+                return out
+
+            out_ecd = dispatch("moe_experts", expert_impl,
+                               (disp, h, self.w1, self.w2))
+            y = dispatch("moe_combine",
+                         lambda c, o: jnp.einsum("tec,ecd->td", c, o),
+                         (combine, out_ecd))
+        else:
+            ein = dispatch("moe_dispatch_einsum",
+                           lambda d, hh: jnp.einsum("tec,td->ecd", d, hh),
+                           (disp, h))
+            outs = []
+            for e, expert in enumerate(self.experts):
+                outs.append(expert(ein[e]))
+            from .. import ops
+
+            stacked = ops.stack(outs, axis=0)
+            y = dispatch("moe_combine",
+                         lambda c, o: jnp.einsum("tec,ecd->td", c, o),
+                         (combine, stacked))
+        return y.reshape(orig_shape)

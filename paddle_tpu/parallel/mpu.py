@@ -1,0 +1,194 @@
+"""Tensor-parallel (Megatron-style) layers over the `mp` mesh axis.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding:47, ColumnParallelLinear:334, RowParallelLinear:541,
+ParallelCrossEntropy:742) and mp_ops.py (_c_identity/_c_concat/_c_split/
+_mp_allreduce autograd-aware collectives).
+
+TPU-native: instead of per-rank local weight shards + explicit NCCL calls,
+each layer holds the GLOBAL weight annotated with a NamedSharding that
+splits it over the `mp` axis; XLA's SPMD partitioner inserts the identical
+collectives (all-gather for column-parallel output gather, reduce for
+row-parallel partial sums) over ICI. The math and the communication pattern
+match the reference exactly — only who inserts the collective differs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from .. import ops
+from ..nn.initializer import XavierNormal
+from . import mesh as mesh_mod
+from .api import shard_constraint, shard_tensor
+from .placement import Replicate, Shard
+
+__all__ = [
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy", "get_rng_state_tracker", "RNGStatesTracker",
+]
+
+
+def _mp_axis(mesh=None) -> Optional[str]:
+    m = mesh or mesh_mod.get_global_mesh()
+    if m is None:
+        return None
+    return "mp" if "mp" in m.axis_names else None
+
+
+class RNGStatesTracker:
+    """Per-group RNG offsetting for dropout inside/outside TP regions
+    (reference: mpu/random.py:34 RNGStatesTracker). On TPU, per-shard
+    randomness is derived by folding the mp axis index into the key, so no
+    state juggling is needed — kept for API parity."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        self.states_[name] = seed
+
+    def rng_state(self, name="global_seed"):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+class ColumnParallelLinear(Layer):
+    """Y = X @ W, W [in, out] sharded on out (column) over `mp`.
+
+    Reference: mp_layers.py:334 — per-rank W shard [in, out/mp], optional
+    gather_output via c_concat. Here W carries Shard(1) over mp; when
+    gather_output the output constraint is Replicate (XLA all-gathers),
+    otherwise the activation stays Shard(-1)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self._name = name
+        init = XavierNormal()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=init)
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        axis = _mp_axis()
+        if axis is not None:
+            mesh = mesh_mod.get_global_mesh()
+            w_pl = [Shard(1) if a == axis else Replicate() for a in mesh.axis_names]
+            self.weight._array = shard_tensor(self.weight, mesh, w_pl)._array
+            if self.bias is not None:
+                b_pl = [Shard(0) if a == axis else Replicate() for a in mesh.axis_names]
+                self.bias._array = shard_tensor(self.bias, mesh, b_pl)._array
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        axis = _mp_axis()
+        if axis is None:
+            return out
+        mesh = mesh_mod.get_global_mesh()
+        if self.gather_output:
+            pl = [Replicate()] * len(mesh.axis_names)
+        else:
+            pl = [Shard(out.ndim - 1) if a == axis else Replicate()
+                  for a in mesh.axis_names]
+        return shard_constraint(out, pl, mesh)
+
+
+class RowParallelLinear(Layer):
+    """Y = X @ W, W [in, out] sharded on in (row) over `mp`; partial outputs
+    are summed (reference: mp_layers.py:541 — mp_allreduce after the local
+    matmul; input optionally split via c_split when not parallel yet)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        init = XavierNormal()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=init)
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        axis = _mp_axis()
+        if axis is not None:
+            mesh = mesh_mod.get_global_mesh()
+            w_pl = [Shard(0) if a == axis else Replicate() for a in mesh.axis_names]
+            self.weight._array = shard_tensor(self.weight, mesh, w_pl)._array
+
+    def forward(self, x):
+        axis = _mp_axis()
+        if axis is not None and self.input_is_parallel:
+            mesh = mesh_mod.get_global_mesh()
+            pl = [Shard(x.ndim - 1) if a == axis else Replicate()
+                  for a in mesh.axis_names]
+            x = shard_constraint(x, pl, mesh)
+        out = F.linear(x, self.weight, self.bias)
+        if axis is not None:
+            mesh = mesh_mod.get_global_mesh()
+            out = shard_constraint(out, [Replicate()] * len(mesh.axis_names), mesh)
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over `mp` (reference:
+    mp_layers.py:47 — per-rank vocab range + masked lookup + allreduce).
+    XLA partitions the gather the same way from Shard(0) on the table."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierNormal())
+        axis = _mp_axis()
+        if axis is not None:
+            mesh = mesh_mod.get_global_mesh()
+            pl = [Shard(0) if a == axis else Replicate() for a in mesh.axis_names]
+            self.weight._array = shard_tensor(self.weight, mesh, pl)._array
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross-entropy over mp-sharded logits (reference: mp_layers.py:742 —
+    c_softmax_with_cross_entropy op computing with only local vocab logits
+    + two allreduces). With XLA the same reduction structure falls out of
+    the sharded logsumexp."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        def impl(logits, lbl):
+            lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+            logp = logits - lse
+            lbl_ = lbl.astype(jnp.int32)
+            picked = jnp.take_along_axis(logp, lbl_[..., None], axis=-1)[..., 0]
+            loss = -picked
+            if self.ignore_index >= 0:
+                loss = jnp.where(lbl_ == self.ignore_index, 0.0, loss)
+            return loss[..., None]
+
+        return dispatch("parallel_cross_entropy", impl, (input, label))

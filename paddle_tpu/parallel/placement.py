@@ -1,0 +1,172 @@
+"""Placements + ProcessMesh + DistTensor attributes for the semi-auto API.
+
+Reference: paddle/phi/core/distributed/auto_parallel/placement_types.h:36
+(Placement/Shard/Replicate/Partial) and process_mesh.h (ProcessMesh);
+python surface python/paddle/distributed/auto_parallel/api.py.
+
+TPU-native mapping: a placement list [p_0 .. p_{k-1}] over a k-axis mesh
+translates directly to a `jax.sharding.NamedSharding` PartitionSpec: mesh
+axis i whose placement is Shard(j) contributes its name to spec dim j.
+Partial has no first-class jax.Array representation — we track it as
+metadata and materialize (all-reduce) on read, same as the reference's
+reshard p→r rule (p_to_r_reshard_function.cc).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import mesh as mesh_mod
+
+
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("r")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("s", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("p", self.reduce_type))
+
+
+class ProcessMesh:
+    """Reference: ProcessMesh (process_mesh.h; python auto_parallel
+    process_mesh.py). Wraps (or builds) a jax Mesh over the same device ids."""
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = list(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            return
+        if mesh is None and shape is not None:
+            mesh = np.arange(int(np.prod(shape))).reshape(shape)
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        devs = jax.devices()
+        dev_arr = np.empty(arr.shape, dtype=object)
+        for idx, pid in np.ndenumerate(arr):
+            dev_arr[idx] = devs[int(pid) % len(devs)]
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(range(int(np.prod(self._shape))))
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def __eq__(self, o):
+        return (isinstance(o, ProcessMesh) and o._shape == self._shape
+                and o._dim_names == self._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: Mesh,
+                       ndim: int) -> PartitionSpec:
+    """[p_axis0, p_axis1, ...] -> PartitionSpec over tensor dims.
+
+    Reference analog: TensorDistAttr dims_mapping (dist_attr.h) — here
+    inverted into jax's dim-major PartitionSpec."""
+    per_dim: List[List[str]] = [[] for _ in range(ndim)]
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            per_dim[p.dim].append(mesh.axis_names[axis_idx])
+    return PartitionSpec(*[
+        (tuple(names) if len(names) > 1 else names[0]) if names else None
+        for names in per_dim
+    ])
+
+
+def spec_to_placements(spec: PartitionSpec, mesh: Mesh) -> List[Placement]:
+    """Inverse of placements_to_spec (best-effort; Partial not expressible)."""
+    placements: List[Placement] = [Replicate() for _ in mesh.axis_names]
+    for dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for n in names:
+            placements[mesh.axis_names.index(n)] = Shard(dim)
+    return placements
+
+
+def named_sharding(mesh, placements: Sequence[Placement], ndim: int) -> NamedSharding:
+    jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else (
+        mesh or mesh_mod.get_global_mesh())
+    return NamedSharding(jmesh, placements_to_spec(placements, jmesh, ndim))

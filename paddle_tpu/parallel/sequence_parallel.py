@@ -1,0 +1,144 @@
+"""Sequence / context parallelism for long sequences.
+
+Reference (SURVEY.md §5.7):
+1. Megatron-style SP tied to TP: fleet/utils/sequence_parallel_utils.py
+   (ScatterOp:85, GatherOp, AllGatherOp, ReduceScatterOp PyLayers;
+   ColumnSequenceParallelLinear:427, RowSequenceParallelLinear:562).
+2. SEP axis (Ulysses-class): fleet/base/topology.py:224-244 5th axis `sep`;
+   all-to-all head/seq swap.
+The reference has NO ring-attention kernel; here we leapfrog (SURVEY.md
+§5.7 TPU equivalent): `sep` is a mesh axis; Ulysses = `lax.all_to_all`
+swapping the sharded dim between sequence and heads around attention; ring
+attention is provided in ops/pallas (see paddle_tpu.incubate ring_attention)
+for the blockwise path.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, dispatch
+from ..nn.layer.layers import Layer
+from . import mesh as mesh_mod
+from .api import shard_constraint
+from .placement import Replicate, Shard
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "mark_as_sequence_parallel_parameter", "split_seq", "gather_seq",
+    "ulysses_alltoall", "sep_attention_context",
+]
+
+
+def _seq_axis(mesh=None) -> Optional[str]:
+    m = mesh or mesh_mod.get_global_mesh()
+    if m is None:
+        return None
+    for cand in ("sep", "mp"):
+        if cand in m.axis_names and int(m.shape[cand]) > 1:
+            return cand
+    return None
+
+
+def split_seq(x, seq_dim: int = 1):
+    """Shard the sequence dim (reference: ScatterOp — split seq across the
+    mp group). Sharding annotation; XLA scatters."""
+    mesh = mesh_mod.get_global_mesh()
+    axis = _seq_axis(mesh)
+    if axis is None:
+        return x
+    pl = [Shard(seq_dim) if a == axis else Replicate() for a in mesh.axis_names]
+    return shard_constraint(x, pl, mesh)
+
+
+def gather_seq(x, seq_dim: int = 1):
+    """Re-replicate the sequence dim (reference: GatherOp / AllGatherOp)."""
+    mesh = mesh_mod.get_global_mesh()
+    axis = _seq_axis(mesh)
+    if axis is None:
+        return x
+    pl = [Replicate() for _ in mesh.axis_names]
+    return shard_constraint(x, pl, mesh)
+
+
+# PyLayer-shaped aliases (reference classes are autograd PyLayers; with XLA
+# the transpose of a sharding constraint is the reverse movement, so plain
+# functions differentiate correctly).
+class ScatterOp:
+    apply = staticmethod(split_seq)
+
+
+class GatherOp:
+    apply = staticmethod(gather_seq)
+
+
+class AllGatherOp:
+    apply = staticmethod(gather_seq)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(split_seq)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """reference: sequence_parallel_utils.py — tags params whose grads need
+    allreduce over the sp group; XLA derives this from shardings."""
+    param.is_sequence_parallel = True
+    return param
+
+
+def ulysses_alltoall(x, scatter_dim: int, gather_dim: int, axis: str = "sep"):
+    """DeepSpeed-Ulysses all-to-all: swap which of (heads, seq) is sharded.
+
+    x inside shard_map: local [.., seq_local, heads, ..]; all_to_all over
+    `axis` re-shards from gather_dim to scatter_dim. Outside a trace this is
+    a sharding re-annotation (XLA emits the all-to-all).
+    Reference analog: the `sep` topology axis + alltoall in
+    distributed/utils/moe_utils.py / segment_parallel.py."""
+    mesh = mesh_mod.get_global_mesh()
+    if mesh is None or axis not in mesh.axis_names or int(mesh.shape[axis]) == 1:
+        return x
+
+    def impl(a):
+        try:
+            return lax.all_to_all(a, axis, split_axis=scatter_dim,
+                                  concat_axis=gather_dim, tiled=True)
+        except NameError:
+            return a
+
+    if isinstance(x, Tensor) and isinstance(x._array, jax.core.Tracer):
+        try:
+            return dispatch("ulysses_alltoall", impl, (x,))
+        except Exception:
+            pass
+    # global view: re-annotate shardings
+    pl = [Shard(scatter_dim) if a == axis else Replicate()
+          for a in mesh.axis_names]
+    return shard_constraint(x, pl, mesh)
+
+
+def sep_attention_context(q, k, v, seq_dim: int = 1, head_dim: int = 2):
+    """Shard q/k/v over heads (instead of seq) for the attention block —
+    the Ulysses pattern: seq-sharded activations enter, head-sharded
+    attention runs, seq-sharded activations leave."""
+    return (ulysses_alltoall(q, head_dim, seq_dim),
+            ulysses_alltoall(k, head_dim, seq_dim),
+            ulysses_alltoall(v, head_dim, seq_dim))
+
+
+class SegmentParallel(Layer):
+    """reference: fleet/meta_parallel/segment_parallel.py:26 — broadcasts
+    params across sep group at init; on TPU params are replicated by
+    construction, so the wrapper only annotates inputs."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        args = tuple(split_seq(a) if isinstance(a, Tensor) and a.ndim >= 2
+                     else a for a in args)
+        return self._layers(*args, **kwargs)
