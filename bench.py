@@ -1,0 +1,91 @@
+"""Benchmark: Llama pretrain step throughput on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/sec for a full train step (fwd + bwd + AdamW) of a ~1B-param
+Llama (bf16 weights, fp32 optimizer states, per-layer remat), the BASELINE.md
+config-3 analog sized for one chip. vs_baseline is measured MFU vs the 45%
+MFU north-star from BASELINE.json (no published reference numbers exist).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion, shard_llama)
+    from paddle_tpu.parallel import make_train_step
+    from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_dev = jax.device_count()
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=True,
+                                   max_position_embeddings=2048)
+        batch, seq, iters = 8, 2048, 10
+    else:  # CPU smoke config so the harness always yields a number
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters = 4, 64, 3
+
+    mesh = None
+    if n_dev > 1:
+        mesh = build_mesh({"dp": 1, "sharding": n_dev, "mp": 1, "sep": 1})
+        set_global_mesh(mesh)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if mesh is not None:
+        model = shard_llama(model, mesh)
+    crit = LlamaPretrainingCriterion(cfg)
+    step, params, opt = make_train_step(
+        model, lambda lg, lb: crit(lg, lb), mesh, lr=1e-4)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
+
+    # warmup / compile; sync via device_get (block_until_ready is not a
+    # reliable barrier on tunneled device platforms)
+    loss, params, opt = step(params, opt, x, y)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt = step(params, opt, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tok_per_s = tokens / dt
+    if os.environ.get("BENCH_DEBUG"):
+        import sys
+        print(f"debug: dt={dt:.4f} iters={iters} batch={batch} seq={seq} "
+              f"n_dev={n_dev} loss={float(loss):.4f}", file=sys.stderr)
+
+    # parameter count & model FLOPs (6 * N * tokens for fwd+bwd; +33% remat)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params
+    achieved = tok_per_s * flops_per_token
+    # per-chip peak: v5e 197 TFLOPs bf16, v6e 918; detect via device kind
+    kind = jax.devices()[0].device_kind.lower()
+    peak = 918e12 if "v6" in kind else 197e12
+    mfu = achieved / (peak * n_dev) if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec",
+        "value": round(tok_per_s, 2),
+        "unit": f"tokens/s ({'1B-class llama, bf16, 1 chip' if on_tpu else 'tiny cpu smoke'}; loss={float(loss):.3f}; mfu={mfu:.3f})",
+        "vs_baseline": round(mfu / 0.45, 3) if on_tpu else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

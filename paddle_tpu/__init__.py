@@ -42,6 +42,8 @@ from . import static  # noqa: F401
 from . import framework  # noqa: F401
 from . import parallel  # noqa: F401
 from . import parallel as distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import kernels  # noqa: F401
 import sys as _sys0
 # alias paddle_tpu.distributed (and every submodule) to paddle_tpu.parallel
 # so both import paths resolve to the SAME module objects

@@ -1,0 +1,7 @@
+"""paddle.incubate equivalent: staging ground for fused / experimental ops.
+
+Reference: python/paddle/incubate (41.2k LoC) — the parts that matter on TPU
+are the fused LLM ops (nn/functional), which here ride the Pallas kernel
+pack instead of hand-written CUDA.
+"""
+from . import nn  # noqa: F401

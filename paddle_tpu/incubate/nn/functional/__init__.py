@@ -1,0 +1,167 @@
+"""Fused LLM ops (paddle.incubate.nn.functional parity).
+
+Reference surface: python/paddle/incubate/nn/functional/
+  fused_rotary_position_embedding.py, swiglu (fused_swiglu op),
+  fused_rms_norm.py, fused_layer_norm.py, variable_length_memory_efficient
+  attention / block_multihead_attention (inference family).
+
+On TPU these map to the Pallas kernel pack (paddle_tpu/kernels) or to jnp
+forms XLA fuses natively; all are differentiable and Tensor-in/Tensor-out.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, dispatch
+from ....kernels.rms_norm import rms_norm as _k_rms
+from ....kernels.rope import apply_rotary_emb as _k_rope
+from ....nn.functional.activation import swiglu  # fused op already  # noqa: F401
+
+__all__ = [
+    "fused_rotary_position_embedding", "fused_rms_norm", "fused_layer_norm",
+    "swiglu", "fused_bias_act", "fused_linear", "fused_linear_activation",
+]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """reference: incubate/nn/functional/fused_rotary_position_embedding.py
+    (CUDA kernel paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu).
+    q/k/v: [B, S, H, D]; returns rotated (q, k, v) — v untouched."""
+    args = [a for a in (q, k, v, sin, cos, position_ids) if a is not None]
+    n_qkv = sum(a is not None for a in (q, k, v))
+
+    def impl(*arrs):
+        it = iter(arrs)
+        qa = next(it)
+        ka = next(it) if k is not None else None
+        va = next(it) if v is not None else None
+        sa = next(it) if sin is not None else None
+        ca = next(it) if cos is not None else None
+        pa = next(it) if position_ids is not None else None
+        out = _k_rope(qa, ka, va, sin=sa, cos=ca, position_ids=pa,
+                      use_neox_rotary_style=use_neox_rotary_style,
+                      base=rotary_emb_base)
+        return out if isinstance(out, tuple) else (out,)
+
+    outs = dispatch("fused_rope", impl, args)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    # pad to 3-tuple like paddle (None for absent inputs)
+    res = list(outs) + [None] * (3 - len(outs))
+    return tuple(res[:3]) if n_qkv > 1 else res[0]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
+                   begin_norm_axis: int = -1, bias=None, residual=None,
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """reference: incubate/nn/functional/fused_rms_norm.py — optional
+    bias/residual add fused before the norm; returns (out, residual_out)."""
+    args = [a for a in (x, norm_weight, norm_bias, bias, residual)
+            if a is not None]
+
+    def impl(*arrs):
+        it = iter(arrs)
+        xa = next(it)
+        wa = next(it)
+        ba = next(it) if norm_bias is not None else None
+        bias_a = next(it) if bias is not None else None
+        res_a = next(it) if residual is not None else None
+        if bias_a is not None:
+            xa = xa + bias_a
+        if res_a is not None:
+            xa = xa + res_a
+        y = _k_rms(xa, wa, epsilon)
+        if ba is not None:
+            y = y + ba.astype(y.dtype)
+        return y, xa
+
+    out, residual_out = dispatch("fused_rms_norm", impl, args)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
+                     begin_norm_axis: int = -1, bias=None, residual=None,
+                     quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                     quant_min_bound=0):
+    """reference: incubate/nn/functional/fused_layer_norm.py."""
+    args = [a for a in (x, norm_weight, norm_bias, bias, residual)
+            if a is not None]
+
+    def impl(*arrs):
+        it = iter(arrs)
+        xa = next(it)
+        wa = next(it) if norm_weight is not None else None
+        ba = next(it) if norm_bias is not None else None
+        bias_a = next(it) if bias is not None else None
+        res_a = next(it) if residual is not None else None
+        if bias_a is not None:
+            xa = xa + bias_a
+        if res_a is not None:
+            xa = xa + res_a
+        x32 = xa.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + epsilon)
+        if wa is not None:
+            y = y * wa.astype(jnp.float32)
+        if ba is not None:
+            y = y + ba.astype(jnp.float32)
+        return y.astype(xa.dtype), xa
+
+    out, residual_out = dispatch("fused_layer_norm", impl, args)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method: str = "gelu", compute_dtype: str = "default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """reference: incubate/nn/functional/fused_bias_act — bias + activation in
+    one pass (XLA fuses this natively)."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+            "swiglu": lambda a: jax.nn.silu(a[..., : a.shape[-1] // 2])
+            * a[..., a.shape[-1] // 2:],
+            "geglu": lambda a: jax.nn.gelu(a[..., : a.shape[-1] // 2])
+            * a[..., a.shape[-1] // 2:]}
+    fn = acts[act_method]
+    if bias is None:
+        return dispatch("fused_bias_act", lambda a: fn(a), (x,))
+    return dispatch("fused_bias_act", lambda a, b: fn(a + b), (x, bias))
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference: incubate/nn/functional/fused_linear (fused_gemm_epilogue).
+    One MXU matmul with the bias epilogue fused by XLA."""
+    def impl(xa, wa, *rest):
+        w = wa.T if transpose_weight else wa
+        y = jnp.matmul(xa, w)
+        if rest:
+            y = y + rest[0]
+        return y
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch("fused_linear", impl, args)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """reference: incubate/nn/functional/fused_linear_activation."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "none": lambda a: a}
+    fn = acts[activation]
+
+    def impl(xa, wa, ba):
+        xa = xa.T if trans_x else xa
+        wa = wa.T if trans_y else wa
+        return fn(jnp.matmul(xa, wa) + ba)
+
+    return dispatch("fused_linear_activation", impl, (x, y, bias))

@@ -1,0 +1,11 @@
+"""Pallas TPU kernel pack.
+
+TPU-native counterpart of the reference's hand-written fused CUDA kernels
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu, fusion/ cutlass kernels,
+incubate fused op family). Each kernel ships:
+  - a Pallas TPU implementation (MXU/VMEM-tiled), used on TPU backends;
+  - a jnp reference path (XLA-fusable) used on CPU and as the numerics oracle.
+"""
+from .flash_attention import flash_attention_fwd, flash_attention  # noqa: F401
+from .rms_norm import rms_norm as fused_rms_norm  # noqa: F401
+from .rope import apply_rotary_emb  # noqa: F401

@@ -1,0 +1,229 @@
+"""Flash attention as a Pallas TPU kernel.
+
+TPU-native counterpart of the reference's flash_attn op family
+(paddle/phi/ops/yaml/ops.yaml:1765-1777, kernel
+paddle/phi/kernels/gpu/flash_attn_kernel.cu): online-softmax tiled attention
+that never materialises the [S, S] score matrix. The forward runs on the MXU
+with fp32 accumulators in VMEM scratch; the backward recomputes scores and
+softmax statistics from q/k/v (flash-attention-2 recompute strategy).
+
+Public layout matches paddle: [batch, seqlen, num_heads, head_dim]; GQA/MQA
+(fewer kv heads) is supported by routing each query head to its kv head in
+the BlockSpec index maps (no materialised repeat in the forward).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (batch*q_heads, num_q_blocks, num_k_blocks)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
+                block_q: int, block_k: int, q_offset: int):
+    """q_offset = sk - sq aligns the causal diagonal to the END of the kv
+    sequence (paddle/flash-attn convention: the last q row sees all keys)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: skip k blocks strictly above the diagonal band
+    run = ((qi * block_q + block_q - 1 + q_offset >= ki * block_k)
+           if causal else True)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                      # [block_q, d]
+        k = k_ref[0]                      # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_scr[...]               # [block_q, 128] (row stat replicated)
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = m_new
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _fwd_pallas(q, k, v, causal: bool, scale: float,
+                block_q: int = 128, block_k: int = 128):
+    """q: [BH, Sq, D]; k/v: [BKVH, Sk, D]. Returns out [BH, Sq, D].
+    Softmax stats are NOT saved: the FA2-style backward recomputes them,
+    which keeps the forward output layout trivially tileable."""
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    rep = bh // bkv                      # q heads per kv head (GQA)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) not divisible by blocks "
+                         f"({block_q},{block_k})")
+    grid = (bh, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, q_offset=sk - sq)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, rep=rep: (b // rep, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, rep=rep: (b // rep, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(q, k, v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jnp reference core (oracle + odd-shape fallback), layout [BH, S, D]
+# ---------------------------------------------------------------------------
+
+def _fwd_ref(q, k, v, causal: bool, scale: float):
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    if bkv != bh:
+        rep = bh // bkv
+        k = jnp.repeat(k, rep, axis=0)
+        v = jnp.repeat(v, rep, axis=0)
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bqk,bkd->bqd", (p / l).astype(q.dtype), v)
+    return out
+
+
+def _fwd_core(q, k, v, causal, scale):
+    if (q.shape[1] % min(128, q.shape[1]) == 0
+            and k.shape[1] % min(128, k.shape[1]) == 0
+            and q.shape[0] % k.shape[0] == 0):
+        try:
+            return _fwd_pallas(q, k, v, causal, scale)
+        except Exception:
+            pass
+    return _fwd_ref(q, k, v, causal, scale)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp over [BH, S, D] core
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, causal: bool, scale: float):
+    return _fwd_core(q, k, v, causal, scale)
+
+
+def _flash_core_fwd(q, k, v, causal, scale):
+    out = _fwd_core(q, k, v, causal, scale)
+    return out, (q, k, v, out)
+
+
+def _flash_core_bwd(causal, scale, res, do):
+    """FA2-style recompute backward: recompute scores + LSE, then
+      dv = P^T dO ; dS = P * (dO V^T - rowsum(dO*O)) * scale ;
+      dq = dS K ; dk = dS^T Q.
+    (reference math: paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu via
+    the flashattn library)."""
+    q, k, v, out = res
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    rep = bh // bkv
+    kr = jnp.repeat(k, rep, axis=0) if rep > 1 else k
+    vr = jnp.repeat(v, rep, axis=0) if rep > 1 else v
+    s = jnp.einsum("bqd,bkd->bqk", q, kr).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])                       # [BH, Sq, Sk] fp32
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, vr.astype(jnp.float32))
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kr.astype(jnp.float32))
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    if rep > 1:
+        dk = dk.reshape(bkv, rep, sk, d).sum(1)
+        dv = dv.reshape(bkv, rep, sk, d).sum(1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API, paddle layout [B, S, H, D]
+# ---------------------------------------------------------------------------
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Differentiable flash attention; layout [B, S, H, D] (paddle
+    flash_attn layout, ops.yaml:1765). kv heads may divide q heads (GQA)."""
+    b, sq, hq, dh = q.shape
+    hk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    qc = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, dh)
+    kc = jnp.swapaxes(k, 1, 2).reshape(b * hk, k.shape[1], dh)
+    vc = jnp.swapaxes(v, 1, 2).reshape(b * hk, v.shape[1], dh)
+    out = _flash_core(qc, kc, vc, causal, scale)
+    return jnp.swapaxes(out.reshape(b, hq, sq, dh), 1, 2)
+
+
+flash_attention_fwd = flash_attention

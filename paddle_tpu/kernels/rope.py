@@ -1,0 +1,88 @@
+"""Rotary position embedding (RoPE), fused.
+
+TPU-native counterpart of fused_rotary_position_embedding
+(paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu; python surface
+python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py).
+Pure jnp: the rotate+multiply is bandwidth-bound elementwise work that XLA
+fuses into neighbouring ops on TPU — a dedicated Pallas kernel buys nothing
+here (the reference needed CUDA fusion because its eager mode launches one
+kernel per op; XLA does not).
+
+Uses the paddle/neox "rotate_half" convention: pairs are (x[..., :d/2],
+x[..., d/2:]) when use_neox_rotary_style else interleaved even/odd lanes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rope_freqs(seq_len: int, head_dim: int, base: float = 10000.0,
+               position_ids=None, dtype=jnp.float32):
+    """cos/sin tables [S, D/2] (fp32 for accuracy, cast at apply)."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                          / head_dim))
+    pos = (jnp.arange(seq_len, dtype=jnp.float32)
+           if position_ids is None else position_ids.astype(jnp.float32))
+    freqs = jnp.einsum("...s,d->...sd", pos, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def _rotate_neox(x, cos, sin):
+    # x: [..., S, H, D]; cos/sin: [S, D/2] or [..., S, D/2]
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = jnp.expand_dims(cos, -2)  # broadcast over heads
+    sin = jnp.expand_dims(sin, -2)
+    while cos.ndim < x.ndim:
+        cos = cos[None]
+        sin = sin[None]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.concatenate([o1, o2], axis=-1)
+
+
+def _rotate_interleaved(x, cos, sin):
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    cos = jnp.expand_dims(cos, -2)
+    sin = jnp.expand_dims(sin, -2)
+    while cos.ndim < x.ndim:
+        cos = cos[None]
+        sin = sin[None]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
+def apply_rotary_emb(q, k=None, v=None, sin=None, cos=None,
+                     position_ids=None, use_neox_rotary_style: bool = True,
+                     base: float = 10000.0):
+    """Apply RoPE to q (and k) in paddle layout [B, S, H, D].
+
+    Mirrors fused_rotary_position_embedding(q, k, v, sin, cos, position_ids,
+    use_neox_rotary_style): v passes through untouched (kept for signature
+    parity). Returns the same number of tensors it was given.
+    """
+    seq = q.shape[1]
+    dh = q.shape[-1]
+    if cos is None or sin is None:
+        cos, sin = rope_freqs(seq, dh, base=base, position_ids=position_ids)
+    else:
+        # paddle passes [1, S, 1, D] tables with values duplicated over the
+        # two halves; reduce to [S, D/2]
+        cos = jnp.squeeze(cos)
+        sin = jnp.squeeze(sin)
+        if cos.shape[-1] == dh:
+            cos = cos[..., : dh // 2]
+            sin = sin[..., : dh // 2]
+    rot = _rotate_neox if use_neox_rotary_style else _rotate_interleaved
+    cos = cos.astype(q.dtype)
+    sin = sin.astype(q.dtype)
+    outs: Tuple = (rot(q, cos, sin),)
+    if k is not None:
+        outs += (rot(k, cos, sin),)
+    if v is not None:
+        outs += (v,)
+    return outs if len(outs) > 1 else outs[0]

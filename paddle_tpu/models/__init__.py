@@ -1,0 +1,12 @@
+"""Model zoo.
+
+Reference scope: the reference frameworks' flagship model families live in
+PaddleNLP/PaddleClas etc., but the in-repo anchor is the auto-parallel Llama
+decoder used by its hybrid-strategy tests
+(test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py).
+Here the zoo is first-class: Llama is the flagship for benchmarks.
+"""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
+    llama_sharding_rules, shard_llama,
+)

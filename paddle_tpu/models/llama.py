@@ -1,0 +1,381 @@
+"""Llama decoder family — the flagship benchmark model.
+
+Reference anchor: test/auto_parallel/hybrid_strategy/
+semi_auto_parallel_llama_model.py (the reference's own Llama used for hybrid
+dp/mp/pp accuracy tests) and the fused-op family it rides
+(fused_rotary_position_embedding, swiglu, rms_norm).
+
+TPU-first design:
+- weights are plain Layer parameters annotated with NamedSharding via
+  logical-axis rules (`shard_llama`) — TP (mp), FSDP (sharding), and
+  sequence/context parallel (sep) all come from ONE mesh; XLA SPMD inserts
+  the collectives.
+- attention runs the Pallas flash-attention kernel; norm runs the fused
+  RMSNorm kernel; RoPE/swiglu are XLA-fused elementwise ops.
+- optional per-layer rematerialisation (jax.checkpoint) trades FLOPs for
+  HBM, replacing the reference's RecomputeFunction PyLayer
+  (fleet/recompute/recompute.py:109).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..core import tape as _tape
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding
+from ..kernels.rms_norm import rms_norm as _k_rms
+from ..kernels.rope import rope_freqs, apply_rotary_emb
+from ..parallel import mesh as mesh_mod
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    recompute: bool = False          # per-layer remat
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    # ------ stock sizes ------
+    @staticmethod
+    def llama2_7b(**over) -> "LlamaConfig":
+        return LlamaConfig(hidden_size=4096, intermediate_size=11008,
+                           num_hidden_layers=32, num_attention_heads=32,
+                           **over)
+
+    @staticmethod
+    def llama_1b(**over) -> "LlamaConfig":
+        return LlamaConfig(hidden_size=2048, intermediate_size=5504,
+                           num_hidden_layers=16, num_attention_heads=16,
+                           **over)
+
+    @staticmethod
+    def tiny(**over) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=64, **over)
+
+
+# ---------------------------------------------------------------------------
+# activation sharding helper
+# ---------------------------------------------------------------------------
+
+def _act_spec(mesh: Optional[Mesh], shape, *dims) -> Optional[NamedSharding]:
+    """Build a NamedSharding keeping only axes present in the mesh whose size
+    divides the tensor dim. Each dim is None, an axis name, or a tuple of
+    axis names."""
+    if mesh is None:
+        return None
+    out = []
+    for i, d in enumerate(dims):
+        if d is None:
+            out.append(None)
+            continue
+        names = (d,) if isinstance(d, str) else d
+        names = tuple(n for n in names if n in mesh.axis_names)
+        size = 1
+        for n in names:
+            size *= int(mesh.shape[n])
+        if not names or shape[i] % size != 0:
+            names = ()
+        out.append(names if names else None)
+    return NamedSharding(mesh, P(*out))
+
+
+def _constrain(x, mesh, *dims):
+    sh = _act_spec(mesh, list(x.shape), *dims)
+    if sh is None:
+        return x
+    return dispatch("shard_constraint",
+                    lambda a: jax.lax.with_sharding_constraint(a, sh), (x,))
+
+
+# batch dim is data-parallel over both dp and the ZeRO axis; seq dim is
+# context-parallel over sep (reference: 5-D topo [data,pipe,sharding,sep,model],
+# fleet/base/topology.py:188)
+BATCH_AXES = ("dp", "sharding")
+SEQ_AXIS = "sep"
+MP_AXIS = "mp"
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.hidden_size = config.hidden_size
+        self.variance_epsilon = config.rms_norm_eps
+        from ..nn.initializer import Constant
+
+        self.weight = self.create_parameter(
+            [config.hidden_size], default_initializer=Constant(1.0),
+            dtype=config.dtype)
+
+    def forward(self, x):
+        return dispatch(
+            "rms_norm",
+            lambda a, w: _k_rms(a, w, self.variance_epsilon), (x, self.weight))
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        nh, nkv, dh = (config.num_attention_heads, config.num_key_value_heads,
+                       config.head_dim)
+        self.num_heads, self.num_kv_heads, self.head_dim = nh, nkv, dh
+        self.q_proj = Linear(h, nh * dh, bias_attr=False)
+        self.k_proj = Linear(h, nkv * dh, bias_attr=False)
+        self.v_proj = Linear(h, nkv * dh, bias_attr=False)
+        self.o_proj = Linear(nh * dh, h, bias_attr=False)
+
+    def forward(self, hidden, cos, sin, cache: Optional[Tuple] = None,
+                mesh=None):
+        b, s, _ = hidden.shape
+        q = self.q_proj(hidden).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = dispatch(
+            "fused_rope",
+            lambda qa, ka: apply_rotary_emb(qa, ka, cos=cos, sin=sin), (q, k))
+        new_cache = None
+        if cache is not None:
+            pk, pv = cache
+            if pk is not None:
+                k = Tensor(jnp.concatenate([unwrap(pk), unwrap(k)], axis=1))
+                v = Tensor(jnp.concatenate([unwrap(pv), unwrap(v)], axis=1))
+            new_cache = (k, v)
+        causal = cache is None or k.shape[1] == s
+        # heads sharded over mp; batch over dp+sharding
+        q = _constrain(q, mesh, BATCH_AXES, None, MP_AXIS, None)
+        out, _ = F.flash_attention(q, k, v, causal=causal)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = Linear(h, i, bias_attr=False)
+        self.up_proj = Linear(h, i, bias_attr=False)
+        self.down_proj = Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, hidden, cos, sin, cache=None, mesh=None):
+        residual = hidden
+        h = self.input_layernorm(hidden)
+        if cache is not None:
+            attn, new_cache = self.self_attn(h, cos, sin, cache=cache, mesh=mesh)
+        else:
+            attn = self.self_attn(h, cos, sin, mesh=mesh)
+            new_cache = None
+        hidden = residual + attn
+        residual = hidden
+        h = self.post_attention_layernorm(hidden)
+        hidden = residual + self.mlp(h)
+        hidden = _constrain(hidden, mesh, BATCH_AXES, SEQ_AXIS, None)
+        if cache is not None:
+            return hidden, new_cache
+        return hidden
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        from ..nn.layer.container import LayerList
+
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, caches=None, position_offset: int = 0):
+        mesh = mesh_mod.get_global_mesh()
+        s = input_ids.shape[1]
+        pos = jnp.arange(position_offset, position_offset + s)
+        cos, sin = rope_freqs(s, self.config.head_dim,
+                              base=self.config.rope_theta, position_ids=pos)
+        hidden = self.embed_tokens(input_ids)
+        hidden = _constrain(hidden, mesh, BATCH_AXES, SEQ_AXIS, None)
+        use_ckpt = (self.config.recompute and not _tape.grad_enabled()
+                    and caches is None)
+        new_caches = [] if caches is not None else None
+        for li, layer in enumerate(self.layers):
+            if caches is not None:
+                hidden, c = layer(hidden, cos, sin, cache=caches[li], mesh=mesh)
+                new_caches.append(c)
+            elif use_ckpt:
+                def run(h, l=layer):
+                    return unwrap(l(Tensor(h), cos, sin, mesh=mesh))
+
+                hidden = Tensor(jax.checkpoint(run)(unwrap(hidden)))
+            else:
+                hidden = layer(hidden, cos, sin, mesh=mesh)
+        hidden = self.norm(hidden)
+        if caches is not None:
+            return hidden, new_caches
+        return hidden
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+            if config.dtype != "float32":
+                self.lm_head.to(dtype=config.dtype)
+
+    def forward(self, input_ids, caches=None, position_offset: int = 0):
+        out = self.llama(input_ids, caches=caches,
+                         position_offset=position_offset)
+        hidden = out[0] if caches is not None else out
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens.weight
+            logits = dispatch("tied_lm_head",
+                              lambda h, e: jnp.matmul(h, e.T), (hidden, w))
+        else:
+            logits = self.lm_head(hidden)
+        if caches is not None:
+            return logits, out[1]
+        return logits
+
+    # --------------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None):
+        """Greedy decode with a KV cache (reference analog: PaddleNLP
+        generation; kernel family masked_multihead_attention)."""
+        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)
+        caches = [(None, None)] * self.config.num_hidden_layers
+        logits, caches = self(ids, caches=caches)
+        out = [ids]
+        last = Tensor(jnp.argmax(unwrap(logits)[:, -1:], axis=-1))
+        offset = ids.shape[1]
+        for _ in range(max_new_tokens):
+            out.append(last)
+            logits, caches = self(last, caches=caches, position_offset=offset)
+            offset += 1
+            last = Tensor(jnp.argmax(unwrap(logits)[:, -1:], axis=-1))
+        return Tensor(jnp.concatenate([unwrap(t) for t in out], axis=1))
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shifted-token cross entropy (reference:
+    semi_auto_parallel_llama_model.py LlamaPretrainingCriterion)."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        def impl(lg, lb):
+            lg32 = lg.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(lg32, axis=-1)
+            picked = jnp.take_along_axis(
+                lg32, lb.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+            return jnp.mean(lse - picked)
+
+        return dispatch("llama_ce", impl, (logits, labels))
+
+
+# ---------------------------------------------------------------------------
+# sharding rules (logical-axis table; reference analog: per-op spmd_rules +
+# the mp/sharding placements the fleet wrappers assign)
+# ---------------------------------------------------------------------------
+
+def llama_sharding_rules():
+    """(param-name-suffix, partition dims) table. Weight layout is
+    [in, out] (nn.Linear convention)."""
+    return [
+        ("embed_tokens.weight", (MP_AXIS, "sharding")),     # [vocab, h]
+        ("q_proj.weight", ("sharding", MP_AXIS)),           # [h, nh*dh]
+        ("k_proj.weight", ("sharding", MP_AXIS)),
+        ("v_proj.weight", ("sharding", MP_AXIS)),
+        ("o_proj.weight", (MP_AXIS, "sharding")),           # [nh*dh, h]
+        ("gate_proj.weight", ("sharding", MP_AXIS)),
+        ("up_proj.weight", ("sharding", MP_AXIS)),
+        ("down_proj.weight", (MP_AXIS, "sharding")),
+        ("lm_head.weight", ("sharding", MP_AXIS)),          # [h, vocab]
+        ("layernorm.weight", (None,)),
+        ("norm.weight", (None,)),
+    ]
+
+
+def _param_sharding(mesh: Mesh, name: str, ndim: int,
+                    shape) -> NamedSharding:
+    for suffix, dims in llama_sharding_rules():
+        if name.endswith(suffix):
+            spec = []
+            for i in range(ndim):
+                d = dims[i] if i < len(dims) else None
+                if d is not None and d in mesh.axis_names \
+                        and shape[i] % int(mesh.shape[d]) == 0:
+                    spec.append(d)
+                else:
+                    spec.append(None)
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P(*([None] * ndim)))
+
+
+def shard_llama(model: Layer, mesh: Optional[Mesh] = None) -> Layer:
+    """Lay every parameter out per the logical-axis rules: TP over `mp`,
+    ZeRO-3/FSDP over `sharding` — one device_put per param, then XLA SPMD
+    owns all collectives."""
+    mesh = mesh or mesh_mod.get_global_mesh()
+    if mesh is None:
+        return model
+    for name, p in model.named_parameters():
+        sh = _param_sharding(mesh, name, p.ndim, p.shape)
+        if isinstance(p._array, jax.core.Tracer):
+            p._array = jax.lax.with_sharding_constraint(p._array, sh)
+        else:
+            p._array = jax.device_put(p._array, sh)
+    return model

@@ -46,6 +46,10 @@ from .sequence_parallel import (  # noqa: F401
 )
 from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate, moe_dispatch  # noqa: F401
 from .fleet import DistributedStrategy, fleet  # noqa: F401
+from .trainer import (  # noqa: F401
+    AdamWState, adamw_update, init_adamw_state, make_eval_step,
+    make_train_step,
+)
 from . import mpu  # noqa: F401
 from . import collective as communication  # noqa: F401
 

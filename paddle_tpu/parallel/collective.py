@@ -69,7 +69,7 @@ class Group:
     @property
     def rank(self):
         try:
-            return lax.axis_index(self.axes[0])
+            return lax.axis_index(tuple(self.axes))
         except Exception:
             return 0
 
@@ -122,7 +122,7 @@ def _bound(axes) -> bool:
     if axes is None:
         return False
     try:
-        lax.axis_index(axes[0])
+        lax.axis_index(tuple(axes))
         return True
     except Exception:
         return False
@@ -146,7 +146,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if op in (ReduceOp.MIN, "min"):
             return lax.pmin(x, axes)
         if op in (ReduceOp.PROD, "prod"):
-            return jnp.exp(lax.psum(jnp.log(x), axes))
+            # gather + prod: exact for zeros/negatives (log-space psum is not)
+            g = lax.all_gather(x, axes, tiled=False)
+            extra = g.ndim - x.ndim
+            return jnp.prod(g, axis=tuple(range(extra)))
         raise ValueError(f"unknown reduce op {op}")
 
     out = dispatch("all_reduce", impl, (tensor,))
@@ -168,7 +171,8 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     else:
         out = dispatch(
             "all_gather",
-            lambda x: lax.all_gather(x, axes[0], tiled=False), (tensor,))
+            lambda x: lax.all_gather(x, tuple(axes), tiled=False),
+            (tensor,))
         n = Group(axes).nranks
     if tensor_list is not None:
         if n == 1:
@@ -199,7 +203,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     else:
         out = dispatch(
             "reduce_scatter",
-            lambda x: lax.psum_scatter(x, axes[0], scatter_dimension=0,
+            lambda x: lax.psum_scatter(x, tuple(axes), scatter_dimension=0,
                                        tiled=True), (src_t,))
     if tensor_list is not None and isinstance(tensor, Tensor):
         tensor._replace(out._array, out._node, out._out_idx)
@@ -218,7 +222,7 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
         res = list(in_tensor_list)
     else:
         x = jnp.stack([unwrap(t) for t in in_tensor_list], axis=0)
-        swapped = lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0,
+        swapped = lax.all_to_all(x, tuple(axes), split_axis=0, concat_axis=0,
                                  tiled=False)
         res = [Tensor(swapped[i]) for i in range(swapped.shape[0])]
     if out_tensor_list is not None:
@@ -243,7 +247,7 @@ def all_to_all_single(out_tensor, in_tensor=None, out_split_sizes=None,
     else:
         out = dispatch(
             "all_to_all",
-            lambda x: lax.all_to_all(x, axes[0], split_axis=split_axis,
+            lambda x: lax.all_to_all(x, tuple(axes), split_axis=split_axis,
                                      concat_axis=concat_axis, tiled=True),
             (in_tensor,))
     if out_tensor is not None and isinstance(out_tensor, Tensor):
@@ -272,7 +276,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             tensor._replace(unwrap(t0) if not isinstance(t0, Tensor) else t0._array)
         return tensor
     stacked = jnp.stack([unwrap(t) for t in tensor_list], axis=0)
-    idx = lax.axis_index(axes[0])
+    idx = lax.axis_index(tuple(axes))
     out = lax.dynamic_index_in_dim(stacked, idx, axis=0, keepdims=False)
     tensor._replace(out)
     return tensor

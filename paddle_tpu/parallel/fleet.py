@@ -69,12 +69,12 @@ class _Fleet:
             d = int(hc.get(key, 1) or 1)
             if axis != "dp":
                 degrees[axis] = d
-        import jax
-
-        if int(hc.get("dp_degree", 1) or 1) > 0 and "dp_degree" in hc:
-            # dp inferred when product of others < device count
-            pass
         mesh = auto_mesh(**degrees)
+        cfg_dp = int(hc.get("dp_degree", 0) or 0)
+        if cfg_dp and cfg_dp != int(mesh.shape["dp"]):
+            raise ValueError(
+                f"dp_degree={cfg_dp} inconsistent with device count: "
+                f"inferred dp={int(mesh.shape['dp'])}")
         self._hcg = HybridCommunicateGroup(mesh)
         self._strategy = strategy
         self._is_initialized = True
@@ -93,8 +93,9 @@ class _Fleet:
 
             return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_sharding_parallel_world_size() > 1:
-            from .sharding import shard_params_stage3  # stage set at optimizer
+            from .sharding import shard_params_stage3
 
+            model = shard_params_stage3(model, hcg.mesh)
         if hcg.get_data_parallel_world_size() > 1:
             return DataParallel(model)
         return model

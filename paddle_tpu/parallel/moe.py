@@ -85,7 +85,6 @@ def moe_dispatch(x, gate_probs, num_experts: int, topk: int,
         slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
                               dtype=probs.dtype)  # [t,k,e,c]
         disp = (mask[..., None] * slot).sum(1)  # [t,e,c]
-        combine = disp * topv.sum(-1, keepdims=True)[..., None]
         weights = (mask * topv[..., None]).sum(1)  # [t,e]
         combine = disp * weights[..., None]
         return disp, combine, aux

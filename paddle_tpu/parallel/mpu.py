@@ -185,10 +185,10 @@ class ParallelCrossEntropy(Layer):
             lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
             logp = logits - lse
             lbl_ = lbl.astype(jnp.int32)
-            picked = jnp.take_along_axis(logp, lbl_[..., None], axis=-1)[..., 0]
-            loss = -picked
-            if self.ignore_index >= 0:
-                loss = jnp.where(lbl_ == self.ignore_index, 0.0, loss)
+            ignored = lbl_ == self.ignore_index
+            safe = jnp.where(ignored, 0, lbl_)  # avoid negative wrap-indexing
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            loss = jnp.where(ignored, 0.0, -picked)
             return loss[..., None]
 
         return dispatch("parallel_cross_entropy", impl, (input, label))

@@ -192,7 +192,11 @@ class PipelineParallel(Layer):
         n_micro = max(1, self.accumulate_steps)
         xs = x if not isinstance(x, Tensor) else x
         bsz = xs.shape[0]
-        mb = max(1, bsz // n_micro)
+        if bsz % n_micro != 0:
+            raise ValueError(
+                f"batch size {bsz} must be divisible by accumulate_steps "
+                f"{n_micro} (reference: PipelineParallel micro-batching)")
+        mb = bsz // n_micro
         total = None
         loss_fn = loss_fn or getattr(self._layers, "_loss_fn", None)
         for i in range(n_micro):

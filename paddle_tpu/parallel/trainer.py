@@ -1,0 +1,144 @@
+"""Functional hybrid-parallel train step.
+
+Reference analog: the fleet dygraph train loop
+(fleet/meta_parallel/pipeline_parallel.py train_batch + HybridParallelOptimizer
+step) and the semi-auto static Engine (auto_parallel/static/engine.py). On
+TPU both collapse into ONE jitted pure function over the mesh:
+
+    (params, opt_state, batch) -> (loss, params', opt_state')
+
+Params carry NamedShardings (TP over `mp`, ZeRO over `sharding`); the batch
+is constrained over (dp, sharding); XLA SPMD emits all collectives
+(grad psum ≙ EagerReducer allreduce; Shard(0) states ≙ sharding stage 1/2;
+Shard params ≙ stage 3 gather/release with async prefetch). Buffer donation
+makes the update in-place in HBM.
+
+The optimizer update is a pure fused AdamW over the whole pytree — the role
+of the reference's multi_tensor / fused adam kernels
+(paddle/phi/kernels/fusion/gpu/fused_adam_kernel.cu).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, unwrap
+from ..core import tape as _tape
+from ..nn.layer.layers import Layer
+from . import mesh as mesh_mod
+
+
+class AdamWState(NamedTuple):
+    m: Any
+    v: Any
+    step: Any
+
+
+def init_adamw_state(params: Dict[str, jax.Array]) -> AdamWState:
+    """Moments inherit each param's NamedSharding via zeros_like — this IS
+    sharding stage 1/2 when params are FSDP-sharded (states follow params)."""
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return AdamWState(m=zeros, v=jax.tree.map(jnp.zeros_like, params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def adamw_update(params, grads, state: AdamWState, lr, *, beta1=0.9,
+                 beta2=0.999, eps=1e-8, weight_decay=0.01,
+                 grad_clip_norm: Optional[float] = 1.0):
+    """Pure AdamW with global-norm clipping (ClipGradByGlobalNorm analog)."""
+    step = state.step + 1
+    if grad_clip_norm is not None:
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(grads)))
+        scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+    c1 = 1.0 - beta1 ** step.astype(jnp.float32)
+    c2 = 1.0 - beta2 ** step.astype(jnp.float32)
+
+    def upd(p, g, m, v):
+        g32 = g.astype(jnp.float32)
+        m_ = beta1 * m + (1 - beta1) * g32
+        v_ = beta2 * v + (1 - beta2) * jnp.square(g32)
+        mhat = m_ / c1
+        vhat = v_ / c2
+        p32 = p.astype(jnp.float32)
+        p_ = p32 - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p32)
+        return p_.astype(p.dtype), m_.astype(m.dtype), v_.astype(v.dtype)
+
+    out = jax.tree.map(upd, params, grads, state.m, state.v)
+    new_p = jax.tree.map(lambda t: t[0], out,
+                         is_leaf=lambda t: isinstance(t, tuple))
+    new_m = jax.tree.map(lambda t: t[1], out,
+                         is_leaf=lambda t: isinstance(t, tuple))
+    new_v = jax.tree.map(lambda t: t[2], out,
+                         is_leaf=lambda t: isinstance(t, tuple))
+    return new_p, AdamWState(m=new_m, v=new_v, step=step)
+
+
+def make_train_step(model: Layer, loss_fn: Callable, mesh: Optional[Mesh] = None,
+                    lr: float = 1e-4, weight_decay: float = 0.01,
+                    grad_clip_norm: Optional[float] = 1.0,
+                    batch_spec: Optional[Tuple] = None,
+                    donate: bool = True):
+    """Build (step_fn, params, opt_state) for `model`.
+
+    `loss_fn(logits_or_output, *batch_rest) -> scalar Tensor`; batch is
+    (input, *rest). The returned step_fn is jitted with buffer donation;
+    call it as `loss, params, opt_state = step_fn(params, opt_state, *batch)`.
+    """
+    mesh = mesh or mesh_mod.get_global_mesh()
+    params = dict(model.raw_state())
+    opt_state = init_adamw_state(params)
+
+    def batch_constraint(x):
+        if mesh is None:
+            return x
+        dims = batch_spec or (("dp", "sharding"), "sep")
+        spec = []
+        for i in range(x.ndim):
+            d = dims[i] if i < len(dims) else None
+            names = (d,) if isinstance(d, str) else (d or ())
+            names = tuple(n for n in names if n in mesh.axis_names
+                          and x.shape[i] % int(mesh.shape[n]) == 0)
+            spec.append(names if names else None)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+    def compute_loss(p, *batch):
+        inputs = batch_constraint(batch[0])
+        rest = [batch_constraint(b) for b in batch[1:]]
+        with _tape.no_grad():
+            out = model.func_call(p, Tensor(inputs))
+            loss = loss_fn(out, *(Tensor(r) for r in rest))
+        return unwrap(loss).astype(jnp.float32)
+
+    def step(p, s, *batch):
+        loss, grads = jax.value_and_grad(compute_loss)(p, *batch)
+        new_p, new_s = adamw_update(
+            p, grads, s, jnp.asarray(lr, jnp.float32),
+            weight_decay=weight_decay, grad_clip_norm=grad_clip_norm)
+        return loss, new_p, new_s
+
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def step_fn(p, s, *batch):
+        loss, new_p, new_s = jitted(p, s, *batch)
+        # keep the Layer view fresh: donation invalidated the old arrays
+        # (pointer swap only, no transfer)
+        model.load_raw_state(new_p)
+        return loss, new_p, new_s
+
+    return step_fn, params, opt_state
+
+
+def make_eval_step(model: Layer, mesh: Optional[Mesh] = None):
+    mesh = mesh or mesh_mod.get_global_mesh()
+
+    def fwd(p, inputs):
+        with _tape.no_grad():
+            return unwrap(model.func_call(p, Tensor(inputs), training=False))
+
+    return jax.jit(fwd)
