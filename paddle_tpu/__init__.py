@@ -44,6 +44,12 @@ from . import parallel  # noqa: F401
 from . import parallel as distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import kernels  # noqa: F401
+from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from . import distribution  # noqa: F401
+from . import profiler  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
 import sys as _sys0
 # alias paddle_tpu.distributed (and every submodule) to paddle_tpu.parallel
 # so both import paths resolve to the SAME module objects

@@ -1,0 +1,772 @@
+"""paddle.distribution equivalent (reference: python/paddle/distribution —
+Distribution base, 25+ distributions, kl_divergence + register_kl registry).
+
+TPU-native: sampling draws from the global generator's JAX PRNG key
+(framework.random), log_prob/entropy are jnp closed forms; everything is
+Tensor-in/Tensor-out and differentiable through dispatch.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..framework import random as _random
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "Poisson", "Cauchy", "StudentT", "Binomial",
+    "kl_divergence", "register_kl",
+]
+
+
+def _key():
+    return _random.next_key()
+
+
+def _param(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x._array.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+class Distribution:
+    """reference: distribution/distribution.py Distribution(batch_shape,
+    event_shape)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(unwrap(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    """reference: distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            var = self.scale ** 2
+            return (-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+        return dispatch("normal_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def cdf(self, value):
+        return Tensor(jax.scipy.stats.norm.cdf(
+            unwrap(value), self.loc, self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(unwrap(self._base.sample(shape))))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            logv = jnp.log(v)
+            var = self.scale ** 2
+            return (-((logv - self.loc) ** 2) / (2 * var) - logv
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+        return dispatch("lognormal_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale) + self.loc)
+
+
+class Uniform(Distribution):
+    """reference: distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low),
+                             -jnp.inf)
+
+        return dispatch("uniform_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    """reference: distribution/bernoulli.py (probs parameterization)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor((u < self.probs).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def impl(v):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return dispatch("bernoulli_log_prob", impl, (value,))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k >= 0 (reference: distribution/geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        def impl(v):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            return v * jnp.log1p(-p) + jnp.log(p)
+
+        return dispatch("geometric_log_prob", impl, (value,))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Categorical(Distribution):
+    """reference: distribution/categorical.py (logits input)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _param(logits)
+            self._probs = jax.nn.softmax(self.logits, axis=-1)
+        else:
+            self._probs = _param(probs)
+            self._probs = self._probs / self._probs.sum(-1, keepdims=True)
+            self.logits = jnp.log(jnp.clip(self._probs, 1e-12))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(self._probs)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_key(), self.logits,
+                                     shape=tuple(shape) + self.batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        def impl(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return dispatch("categorical_log_prob", impl, (value,))
+
+    def probabilities(self, value=None):
+        return self.probs
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-(jnp.exp(logp) * logp).sum(-1))
+
+
+class Multinomial(Distribution):
+    """reference: distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def impl(v):
+            logp = jnp.log(jnp.clip(self.probs, 1e-12))
+            return (jax.scipy.special.gammaln(self.total_count + 1.0)
+                    - jax.scipy.special.gammaln(v + 1.0).sum(-1)
+                    + (v * logp).sum(-1))
+
+        return dispatch("multinomial_log_prob", impl, (value,))
+
+
+class Beta(Distribution):
+    """reference: distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta,
+                                      self._extend(shape)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            return ((self.alpha - 1) * jnp.log(v)
+                    + (self.beta - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(self.alpha)
+                       + jax.scipy.special.gammaln(self.beta)
+                       - jax.scipy.special.gammaln(self.alpha + self.beta)))
+
+        return dispatch("beta_log_prob", impl, (value,))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lnB = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+               - jax.scipy.special.gammaln(a + b))
+        return Tensor(lnB - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    """reference: distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _param(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration,
+            tuple(shape) + self.batch_shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            a = self.concentration
+            lnB = (jax.scipy.special.gammaln(a).sum(-1)
+                   - jax.scipy.special.gammaln(a.sum(-1)))
+            return ((a - 1) * jnp.log(v)).sum(-1) - lnB
+
+        return dispatch("dirichlet_log_prob", impl, (value,))
+
+
+class Exponential(Distribution):
+    """reference: distribution/exponential.py (rate param)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate ** -2)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.exponential(
+            _key(), self._extend(shape)) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            return jnp.log(self.rate) - self.rate * v
+
+        return dispatch("exponential_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    """reference: distribution/gamma.py (concentration, rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_key(), self.concentration,
+                             self._extend(shape))
+        return Tensor(g / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            a, b = self.concentration, self.rate
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - jax.scipy.special.gammaln(a))
+
+        return dispatch("gamma_log_prob", impl, (value,))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        dg = jax.scipy.special.digamma
+        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                      + (1 - a) * dg(a))
+
+
+class Laplace(Distribution):
+    """reference: distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale ** 2)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape),
+                               minval=-0.5, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            return (-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+
+        return dispatch("laplace_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    """reference: distribution/gumbel.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return dispatch("gumbel_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + np.euler_gamma)
+
+
+class Poisson(Distribution):
+    """reference: distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.poisson(
+            _key(), self.rate, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def impl(v):
+            return (v * jnp.log(self.rate) - self.rate
+                    - jax.scipy.special.gammaln(v + 1.0))
+
+        return dispatch("poisson_log_prob", impl, (value,))
+
+
+class Cauchy(Distribution):
+    """reference: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        return Tensor(self.loc + self.scale
+                      * jax.random.cauchy(_key(), self._extend(shape)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            z = (v - self.loc) / self.scale
+            return (-jnp.log(math.pi) - jnp.log(self.scale)
+                    - jnp.log1p(z ** 2))
+
+        return dispatch("cauchy_log_prob", impl, (value,))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale))
+
+
+class StudentT(Distribution):
+    """reference: distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return Tensor(jnp.where(self.df > 2, v, jnp.nan))
+
+    def sample(self, shape=()):
+        t = jax.random.t(_key(), self.df, self._extend(shape))
+        return Tensor(self.loc + self.scale * t)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v):
+            d = self.df
+            z = (v - self.loc) / self.scale
+            return (jax.scipy.special.gammaln((d + 1) / 2)
+                    - jax.scipy.special.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                    - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+        return dispatch("studentt_log_prob", impl, (value,))
+
+
+class Binomial(Distribution):
+    """reference: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _param(total_count)
+        self.probs = _param(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        n = int(np.max(np.asarray(self.total_count)))
+        u = jax.random.uniform(_key(), (n,) + self._extend(shape))
+        idx = jnp.arange(n).reshape((n,) + (1,) * len(self._extend(shape)))
+        draws = ((u < self.probs) & (idx < self.total_count)).sum(0)
+        return Tensor(draws.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def impl(v):
+            n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            lgam = jax.scipy.special.gammaln
+            return (lgam(n + 1) - lgam(v + 1) - lgam(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+        return dispatch("binomial_log_prob", impl, (value,))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: distribution/kl.py register_kl)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, axis=-1)
+    lq = jax.nn.log_softmax(q.logits, axis=-1)
+    return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    ratio = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + ratio - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    dg = jax.scipy.special.digamma
+    lgam = jax.scipy.special.gammaln
+    a_p, b_p = p.concentration, p.rate
+    a_q, b_q = q.concentration, q.rate
+    return Tensor((a_p - a_q) * dg(a_p) - lgam(a_p) + lgam(a_q)
+                  + a_q * (jnp.log(b_p) - jnp.log(b_q))
+                  + a_p * (b_q - b_p) / b_p)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    dg = jax.scipy.special.digamma
+    lgam = jax.scipy.special.gammaln
+    sp = p.alpha + p.beta
+    sq = q.alpha + q.beta
+    lnB_p = lgam(p.alpha) + lgam(p.beta) - lgam(sp)
+    lnB_q = lgam(q.alpha) + lgam(q.beta) - lgam(sq)
+    return Tensor(lnB_q - lnB_p
+                  + (p.alpha - q.alpha) * dg(p.alpha)
+                  + (p.beta - q.beta) * dg(p.beta)
+                  + (sq - sp) * dg(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    lgam = jax.scipy.special.gammaln
+    ap = p.concentration
+    aq = q.concentration
+    sp = ap.sum(-1)
+    return Tensor(lgam(sp) - lgam(aq.sum(-1))
+                  - (lgam(ap) - lgam(aq)).sum(-1)
+                  + ((ap - aq) * (dg(ap) - dg(sp)[..., None])).sum(-1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    # KL = log(b2/b1) + d/b2 + (b1/b2) exp(-d/b1) - 1,  d = |mu1 - mu2|
+    d = jnp.abs(p.loc - q.loc)
+    return Tensor(jnp.log(q.scale / p.scale) + d / q.scale
+                  + (p.scale / q.scale) * jnp.exp(-d / p.scale) - 1)

@@ -1,0 +1,7 @@
+"""paddle.hapi equivalent (reference: python/paddle/hapi — Model trainer,
+callbacks, summary/flops)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+from .model import Model, summary  # noqa: F401

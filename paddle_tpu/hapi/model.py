@@ -1,0 +1,277 @@
+"""hapi.Model: the fit/evaluate/predict trainer (reference:
+python/paddle/hapi/model.py:1081 Model, fit at :1807).
+
+TPU-native: train/eval steps run through the eager tape (backward + step);
+the flagship path for scale is paddle_tpu.parallel.make_train_step — hapi
+keeps the reference's convenience trainer surface.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model", "summary"]
+
+
+class _InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """reference: hapi/model.py Model(network, inputs, labels)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle.metric.Metric, got {m}")
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        loss_fn = self._loss
+        if loss_fn is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        if callable(loss_fn) and not isinstance(loss_fn, (list, tuple)):
+            return loss_fn(*outs, *labs)
+        raise TypeError("loss must be callable")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = [Tensor(np.asarray(i)) if not isinstance(i, Tensor) else i
+                  for i in _to_list(inputs)]
+        labels = [Tensor(np.asarray(l)) if not isinstance(l, Tensor) else l
+                  for l in _to_list(labels)]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*_to_list(outputs), *labels)))
+            metrics.append(m.accumulate())
+        out = [float(loss.numpy())]
+        return (out, metrics) if metrics else out
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core import tape as _tape
+
+        with _tape.no_grad():
+            inputs = [Tensor(np.asarray(i)) if not isinstance(i, Tensor)
+                      else i for i in _to_list(inputs)]
+            labels = [Tensor(np.asarray(l)) if not isinstance(l, Tensor)
+                      else l for l in _to_list(labels)]
+            outputs = self.network(*inputs)
+            losses = ([float(self._compute_loss(outputs, labels).numpy())]
+                      if self._loss is not None and labels else [])
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*_to_list(outputs), *labels)))
+            metrics.append(m.accumulate())
+        return (losses, metrics) if metrics else losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core import tape as _tape
+
+        with _tape.no_grad():
+            inputs = [Tensor(np.asarray(i)) if not isinstance(i, Tensor)
+                      else i for i in _to_list(inputs)]
+            outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    # ------------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference: hapi/model.py fit (:1807)."""
+        loader = self._make_loader(train_data, batch_size, shuffle)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+        cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
+                                                                  verbose)])
+        cbks.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose, "metrics": self._metric_names()})
+        self.stop_training = False
+        cbks.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, labs, update=update)
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        cbks = (callbacks if isinstance(callbacks, CallbackList)
+                else CallbackList(_to_list(callbacks)
+                                  or [ProgBarLogger(log_freq, verbose)]))
+        cbks.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = self._pack_logs(res)
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, labeled=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([b[i] for b in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def _split_batch(self, batch, labeled=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if len(batch) > 1:
+                # last element is the label (reference: fit assumes
+                # (input..., label) batches); predict drops it
+                return batch[:-1], (batch[-1:] if labeled else [])
+            return batch, []
+        return [batch], []
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    def _pack_logs(self, res):
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        logs = {"loss": losses}
+        for m, v in zip(self._metrics, metrics):
+            n = m.name()
+            logs[n[0] if isinstance(n, list) else n] = v
+        return logs
+
+    # ------------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def save(self, path, training=True):
+        """reference: hapi/model.py save — params (+ optimizer state)."""
+        from ..framework.io import save
+        import os
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None:
+            try:
+                self._optimizer.set_state_dict(load(path + ".pdopt"))
+            except FileNotFoundError:
+                pass
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """reference: hapi/model_summary.py — layer table + param counts."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, list(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':>12}",
+             "-" * (width + 32)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<20}{n:>12,}")
+    lines.append("-" * (width + 32))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

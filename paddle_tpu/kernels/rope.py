@@ -77,6 +77,15 @@ def apply_rotary_emb(q, k=None, v=None, sin=None, cos=None,
         if cos.shape[-1] == dh:
             cos = cos[..., : dh // 2]
             sin = sin[..., : dh // 2]
+        if position_ids is not None:
+            # gather table rows per position (KV-cache decode pattern);
+            # result [..., seq, dh/2] broadcasts against q's batch
+            pid = jnp.asarray(position_ids)
+            cos = jnp.take(cos, pid, axis=0)
+            sin = jnp.take(sin, pid, axis=0)
+        elif cos.shape[0] != seq:
+            cos = cos[:seq]
+            sin = sin[:seq]
     rot = _rotate_neox if use_neox_rotary_style else _rotate_interleaved
     cos = cos.astype(q.dtype)
     sin = sin.astype(q.dtype)

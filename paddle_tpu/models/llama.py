@@ -298,13 +298,19 @@ class LlamaForCausalLM(Layer):
         caches = [(None, None)] * self.config.num_hidden_layers
         logits, caches = self(ids, caches=caches)
         out = [ids]
-        last = Tensor(jnp.argmax(unwrap(logits)[:, -1:], axis=-1))
+        last = jnp.argmax(unwrap(logits)[:, -1:], axis=-1)
         offset = ids.shape[1]
-        for _ in range(max_new_tokens):
-            out.append(last)
-            logits, caches = self(last, caches=caches, position_offset=offset)
+        for step in range(max_new_tokens):
+            out.append(Tensor(last))
+            if eos_token_id is not None and bool(
+                    jnp.all(last == eos_token_id)):
+                break
+            if step == max_new_tokens - 1:
+                break  # the last appended token needs no further forward
+            logits, caches = self(Tensor(last), caches=caches,
+                                  position_offset=offset)
             offset += 1
-            last = Tensor(jnp.argmax(unwrap(logits)[:, -1:], axis=-1))
+            last = jnp.argmax(unwrap(logits)[:, -1:], axis=-1)
         return Tensor(jnp.concatenate([unwrap(t) for t in out], axis=1))
 
 

@@ -69,12 +69,12 @@ class _Fleet:
             d = int(hc.get(key, 1) or 1)
             if axis != "dp":
                 degrees[axis] = d
+        # dp_degree=1 is the strategy default and means "infer"; an explicit
+        # dp_degree>1 participates in the product check inside auto_mesh
+        cfg_dp = int(hc.get("dp_degree", 1) or 1)
+        if cfg_dp > 1:
+            degrees["dp"] = cfg_dp
         mesh = auto_mesh(**degrees)
-        cfg_dp = int(hc.get("dp_degree", 0) or 0)
-        if cfg_dp and cfg_dp != int(mesh.shape["dp"]):
-            raise ValueError(
-                f"dp_degree={cfg_dp} inconsistent with device count: "
-                f"inferred dp={int(mesh.shape['dp'])}")
         self._hcg = HybridCommunicateGroup(mesh)
         self._strategy = strategy
         self._is_initialized = True
@@ -93,9 +93,15 @@ class _Fleet:
 
             return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_sharding_parallel_world_size() > 1:
-            from .sharding import shard_params_stage3
+            # stage selection follows the reference default (stage 1:
+            # optimizer states only, applied in distributed_optimizer);
+            # params are sharded here only for stage 3
+            stage = int((self._strategy.sharding_configs or {}).get(
+                "stage", 1)) if self._strategy is not None else 1
+            if stage >= 3:
+                from .sharding import shard_params_stage3
 
-            model = shard_params_stage3(model, hcg.mesh)
+                model = shard_params_stage3(model, hcg.mesh)
         if hcg.get_data_parallel_world_size() > 1:
             return DataParallel(model)
         return model

@@ -134,10 +134,22 @@ def make_train_step(model: Layer, loss_fn: Callable, mesh: Optional[Mesh] = None
     return step_fn, params, opt_state
 
 
-def make_eval_step(model: Layer, mesh: Optional[Mesh] = None):
+def make_eval_step(model: Layer, mesh: Optional[Mesh] = None,
+                   batch_spec: Optional[Tuple] = None):
     mesh = mesh or mesh_mod.get_global_mesh()
 
     def fwd(p, inputs):
+        if mesh is not None:
+            dims = batch_spec or (("dp", "sharding"), "sep")
+            spec = []
+            for i in range(inputs.ndim):
+                d = dims[i] if i < len(dims) else None
+                names = (d,) if isinstance(d, str) else (d or ())
+                names = tuple(n for n in names if n in mesh.axis_names
+                              and inputs.shape[i] % int(mesh.shape[n]) == 0)
+                spec.append(names if names else None)
+            inputs = jax.lax.with_sharding_constraint(
+                inputs, NamedSharding(mesh, P(*spec)))
         with _tape.no_grad():
             return unwrap(model.func_call(p, Tensor(inputs), training=False))
 

@@ -1,0 +1,274 @@
+"""paddle.profiler equivalent (reference: python/paddle/profiler/profiler.py
+— Profiler with scheduler states, RecordEvent spans, chrome-trace export,
+summary tables).
+
+TPU-native: device-side tracing is `jax.profiler` (XPlane; view in
+TensorBoard/Perfetto); host-side spans are recorded by RecordEvent into a
+lightweight event list exported as chrome://tracing JSON — mirroring the
+reference's host_tracer + chrometracing_logger (paddle/fluid/platform/
+profiler/chrometracing_logger.cc). `jax.named_scope` tags spans into the
+device trace so both views correlate.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView", "SortedKeys"]
+
+
+class ProfilerState(enum.Enum):
+    """reference: profiler.py:79 ProfilerState."""
+
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(enum.Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+_events = []
+_events_lock = threading.Lock()
+_recording = False
+
+
+class RecordEvent:
+    """User span (reference: profiler/utils.py RecordEvent); context manager
+    or begin()/end()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._scope = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        self._scope = jax.named_scope(self.name)
+        self._scope.__enter__()
+
+    def end(self):
+        if self._scope is not None:
+            self._scope.__exit__(None, None, None)
+            self._scope = None
+        if self._t0 is not None and _recording:
+            t1 = time.perf_counter_ns()
+            with _events_lock:
+                _events.append({"name": self.name, "ts": self._t0 / 1000.0,
+                                "dur": (t1 - self._t0) / 1000.0,
+                                "ph": "X", "pid": os.getpid(),
+                                "tid": threading.get_ident()})
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference: profiler.py make_scheduler — step-phase state machine."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = step % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+class Profiler:
+    """reference: profiler.py:346 Profiler(targets, scheduler, on_trace_ready,
+    timer_only)."""
+
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, timer_only=False, record_shapes=False,
+                 profile_memory=False, with_flops=False,
+                 emit_nvtx=False, custom_device_types=None):
+        if isinstance(scheduler, (tuple, list)):
+            start, stop = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=stop - start, repeat=1)
+        self._scheduler = scheduler or _default_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._device_dir = None
+        self._device_tracing = False
+        self._step_times = []
+        self._step_t0 = None
+
+    # ------------------------------------------------------------------
+    def start(self):
+        global _recording
+        self._state = self._scheduler(self.step_num)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            _recording = True
+            self._start_device_trace()
+        self._step_t0 = time.perf_counter()
+
+    def stop(self):
+        global _recording
+        self._stop_device_trace()
+        _recording = False
+        if self._on_trace_ready is not None \
+                and self._state == ProfilerState.RECORD_AND_RETURN:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        """Advance the scheduler one training step."""
+        global _recording
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append((now - self._step_t0, num_samples))
+        self._step_t0 = now
+        prev = self._state
+        self.step_num += 1
+        self._state = self._scheduler(self.step_num)
+        rec_states = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev not in rec_states and self._state in rec_states:
+            _recording = True
+            self._start_device_trace()
+        if prev in rec_states and self._state not in rec_states:
+            self._stop_device_trace()
+            _recording = False
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        dur, n = self._step_times[-1]
+        ips = f", ips: {n / dur:.2f} {unit or 'samples'}/s" if n else ""
+        return f"step time: {dur * 1000:.2f} ms{ips}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------------
+    def _start_device_trace(self):
+        if self._timer_only or self._device_tracing:
+            return
+        self._device_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                          "/tmp/paddle_tpu_profile")
+        try:
+            jax.profiler.start_trace(self._device_dir)
+            self._device_tracing = True
+        except Exception:
+            self._device_tracing = False
+
+    def _stop_device_trace(self):
+        if self._device_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # ------------------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        """Export host spans as chrome://tracing JSON (reference:
+        profiler.py export / chrome_tracing export at :215)."""
+        with _events_lock:
+            events = list(_events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        """Host-span aggregate table (reference: profiler_statistic.py)."""
+        with _events_lock:
+            events = list(_events)
+        agg = {}
+        for e in events:
+            a = agg.setdefault(e["name"], [0.0, 0])
+            a[0] += e["dur"] / 1000.0
+            a[1] += 1
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}",
+                 "-" * 72]
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<40}{cnt:>8}{tot:>12.3f}{tot / cnt:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """reference: profiler.py export_chrome_tracing — on_trace_ready
+    factory."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: Profiler):
+        name = worker_name or f"host_{os.getpid()}"
+        prof.export(os.path.join(dir_name, f"{name}.pb.trace.json"))
+
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)

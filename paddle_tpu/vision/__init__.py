@@ -1,0 +1,22 @@
+"""paddle.vision equivalent (reference: python/paddle/vision — 15.7k LoC:
+models, datasets, transforms, detection ops)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"unknown backend {backend}")
+
+
+def get_image_backend():
+    return "numpy"
+
+
+def image_load(path, backend=None):
+    from .datasets import _default_loader
+
+    return _default_loader(path)

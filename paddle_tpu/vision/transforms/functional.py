@@ -1,0 +1,222 @@
+"""Image transform functionals (reference: python/paddle/vision/transforms/
+functional.py + functional_tensor.py).
+
+Numpy/Tensor based (HWC uint8/float or CHW Tensor); no PIL dependency — the
+reference's cv2/PIL backends collapse to one numpy backend here.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _as_hwc(img):
+    if isinstance(img, Tensor):
+        img = img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC [0,255] uint8 (or float) -> CHW float32 [0,1] Tensor."""
+    arr = _as_hwc(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = img.numpy()
+    else:
+        arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        shaped = (-1, 1, 1)
+    else:
+        shaped = (1, 1, -1)
+    out = (arr - mean.reshape(shaped)) / std.reshape(shaped)
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _interp_resize(arr, h, w):
+    """Bilinear resize of an HWC numpy image."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32), (h, w, arr.shape[2]),
+                           method="bilinear")
+    res = np.asarray(out)
+    if arr.dtype == np.uint8:
+        res = np.clip(np.round(res), 0, 255).astype(np.uint8)
+    return res.astype(arr.dtype) if arr.dtype != np.uint8 else res
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _as_hwc(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        short, long_ = (w, h) if w <= h else (h, w)
+        new_short = int(size)
+        new_long = int(size * long_ / short)
+        nh, nw = (new_long, new_short) if h >= w else (new_short, new_long)
+    else:
+        nh, nw = size
+    out = _interp_resize(arr, int(nh), int(nw))
+    return out[:, :, 0] if squeeze else out
+
+
+def crop(img, top, left, height, width):
+    arr = _as_hwc(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, pads, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, pads, mode=mode)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """90-degree-exact fast paths; arbitrary angles via scipy-free bilinear
+    sampling."""
+    arr = _as_hwc(img)
+    a = angle % 360
+    if a == 0:
+        return arr
+    if a == 90:
+        return np.rot90(arr, k=1).copy()
+    if a == 180:
+        return np.rot90(arr, k=2).copy()
+    if a == 270:
+        return np.rot90(arr, k=3).copy()
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else center[::-1]
+    rad = np.deg2rad(a)
+    ys, xs = np.mgrid[0:h, 0:w]
+    y0 = (ys - cy) * np.cos(rad) - (xs - cx) * np.sin(rad) + cy
+    x0 = (ys - cy) * np.sin(rad) + (xs - cx) * np.cos(rad) + cx
+    yi = np.clip(np.round(y0).astype(int), 0, h - 1)
+    xi = np.clip(np.round(x0).astype(int), 0, w - 1)
+    out = arr[yi, xi]
+    mask = (y0 < 0) | (y0 > h - 1) | (x0 < 0) | (x0 > w - 1)
+    out[mask] = fill
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _as_hwc(img).astype(np.float32)
+    out = arr * brightness_factor
+    return _clip_like(out, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _as_hwc(img).astype(np.float32)
+    mean = arr.mean()
+    out = (arr - mean) * contrast_factor + mean
+    return _clip_like(out, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _as_hwc(img).astype(np.float32)
+    gray = arr @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = (arr - gray[..., None]) * saturation_factor + gray[..., None]
+    return _clip_like(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    arr = _as_hwc(img).astype(np.float32) / 255.0
+    import colorsys  # noqa: F401  (documented algorithm; vectorized below)
+
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr.max(-1)
+    minc = arr.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0)
+    rc = np.where(delta > 0, (maxc - r) / np.maximum(delta, 1e-12), 0)
+    gc = np.where(delta > 0, (maxc - g) / np.maximum(delta, 1e-12), 0)
+    bc = np.where(delta > 0, (maxc - b) / np.maximum(delta, 1e-12), 0)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc)) / 6.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(int) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1) * 255.0
+    return _clip_like(out, img)
+
+
+def _clip_like(out, img):
+    src = _as_hwc(img)
+    if src.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(src.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_hwc(img).astype(np.float32)
+    gray = arr @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _clip_like(out, img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    if isinstance(img, Tensor):  # CHW
+        arr = img.numpy().copy()
+        arr[..., i:i + h, j:j + w] = v
+        return Tensor(arr)
+    arr = np.array(img, copy=not inplace)
+    arr[i:i + h, j:j + w] = v
+    return arr
