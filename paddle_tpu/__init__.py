@@ -49,6 +49,10 @@ from . import metric  # noqa: F401
 from . import hapi  # noqa: F401
 from . import distribution  # noqa: F401
 from . import profiler  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import audio  # noqa: F401
+from . import models  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 import sys as _sys0
 # alias paddle_tpu.distributed (and every submodule) to paddle_tpu.parallel

@@ -1,0 +1,5 @@
+"""paddle.audio equivalent (reference: python/paddle/audio — functional
+(window/spectral ops) + features (Spectrogram/MelSpectrogram/LogMelSpectrogram
+/MFCC) layers)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

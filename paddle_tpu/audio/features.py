@@ -1,0 +1,119 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..nn.layer.layers import Layer
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft(x, n_fft, hop_length, win, center, pad_mode):
+    """x: [..., T] -> complex [..., n_fft//2+1, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx]                      # [..., frames, n_fft]
+    frames = frames * win
+    spec = jnp.fft.rfft(frames, axis=-1)      # [..., frames, n_fft//2+1]
+    return jnp.swapaxes(spec, -1, -2)         # [..., freq, frames]
+
+
+class Spectrogram(Layer):
+    """reference: audio/features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = unwrap(F.get_window(window, self.win_length, dtype=dtype))
+        if self.win_length < n_fft:  # pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self._window = w
+
+    def forward(self, x):
+        def impl(a):
+            spec = _stft(a, self.n_fft, self.hop_length, self._window,
+                         self.center, self.pad_mode)
+            return jnp.abs(spec) ** self.power
+
+        return dispatch("spectrogram", impl, (x,))
+
+
+class MelSpectrogram(Layer):
+    """reference: layers.py MelSpectrogram = Spectrogram @ fbank."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank = unwrap(F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+
+        def impl(s):
+            return jnp.einsum("mf,...ft->...mt",
+                              self.fbank.astype(s.dtype), s)
+
+        return dispatch("mel_spectrogram", impl, (spec,))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **kwargs):
+        super().__init__()
+        self._mel = MelSpectrogram(sr=sr, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """reference: layers.py MFCC = DCT @ LogMel."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **kwargs):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kwargs)
+        self.dct = unwrap(F.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        logmel = self._log_mel(x)
+
+        def impl(m):
+            # dct: [n_mels, n_mfcc]
+            return jnp.einsum("nk,...nt->...kt", self.dct.astype(m.dtype), m)
+
+        return dispatch("mfcc", impl, (logmel,))

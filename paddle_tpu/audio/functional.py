@@ -1,0 +1,143 @@
+"""Audio functionals (reference: python/paddle/audio/functional/
+{window.py, functional.py} — get_window, hz<->mel, fft_frequencies,
+compute_fbank_matrix, create_dct, power_to_db)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "create_dct",
+           "power_to_db"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float64") -> Tensor:
+    """reference: audio/functional/window.py get_window."""
+    n = win_length
+    sym = not fftbins
+    m = n if sym else n + 1
+    k = np.arange(m)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (m - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (m - 1)))
+    elif window in ("rect", "boxcar", "rectangular"):
+        w = np.ones(m)
+    elif window == "triang":
+        w = 1 - np.abs((k - (m - 1) / 2) / ((m - 1) / 2))
+    elif window == "bartlett":
+        w = 1 - np.abs((k - (m - 1) / 2) / ((m - 1) / 2))
+    elif window == "gaussian":
+        sigma = 0.4 * (m - 1) / 2
+        w = np.exp(-0.5 * ((k - (m - 1) / 2) / sigma) ** 2)
+    else:
+        raise ValueError(f"unknown window {window}")
+    if not sym:
+        w = w[:-1]
+    return Tensor(np.asarray(w, dtype))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """reference: audio/functional/functional.py hz_to_mel (slaney
+    default)."""
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = np.asarray(unwrap(freq) if isinstance(freq, Tensor) else freq,
+                   np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    if scalar:
+        return float(mel)
+    return Tensor(mel) if isinstance(freq, Tensor) else mel
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = np.asarray(unwrap(mel) if isinstance(mel, Tensor) else mel,
+                   np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    if scalar:
+        return float(hz)
+    return Tensor(hz) if isinstance(mel, Tensor) else hz
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float64"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(np.asarray(mel_to_hz(mels, htk), dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float64"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney", dtype: str = "float64"):
+    """reference: functional.py compute_fbank_matrix — triangular mel
+    filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = sr / 2
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft))
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho",
+               dtype: str = "float64"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference: functional.py
+    create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.T.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float = 80.0):
+    """reference: functional.py power_to_db."""
+    s = unwrap(spect) if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec

@@ -22,7 +22,15 @@ class Generator:
 
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.key(seed)
+        # key creation is LAZY: touching jax.random at import time would
+        # initialize the backend in every process that merely imports the
+        # package (e.g. DataLoader workers, which must stay host-only)
+        self._key = None
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int):
         self._seed = seed
@@ -34,11 +42,12 @@ class Generator:
 
     def split(self):
         """Return a fresh subkey, advancing internal state."""
+        self._ensure()
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
-        return self._key
+        return self._ensure()
 
     def set_state(self, key):
         self._key = key

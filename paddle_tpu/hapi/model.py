@@ -152,6 +152,7 @@ class Model:
                 cbks.on_train_batch_end(step, logs)
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
                     break
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import math
 import queue
+import sys
 import threading
 from typing import Any, Iterable, List, Optional
 
@@ -296,7 +297,7 @@ def default_collate_fn(batch):
         return Tensor(np.stack([np.asarray(b._array) for b in batch]))
     if isinstance(sample, np.ndarray):
         return Tensor(np.stack(batch))
-    if isinstance(sample, (int, float)):
+    if isinstance(sample, (int, float, np.generic)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (str, bytes)):
         return batch
@@ -326,6 +327,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -392,5 +394,14 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers > 0 and not self._iterable_mode and self.batch_sampler is not None:
+            if self.use_shared_memory and sys.platform.startswith("linux"):
+                # native path: worker processes + shm ring transport
+                # (reference: _DataLoaderIterMultiProcess)
+                try:
+                    from .multiprocess import MultiprocessIter
+
+                    return MultiprocessIter(self)
+                except Exception:
+                    pass  # fall back to the thread prefetch pool
             return self._iter_workers()
         return self._iter_single()

@@ -1,0 +1,121 @@
+"""ctypes binding + on-demand build of the native shm ring
+(native/shm_ring.cpp). pybind11 is deliberately avoided — a stable C ABI
+via ctypes keeps the binding dependency-free (see repo environment notes).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "shm_ring.cpp")
+_OUT_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_OUT = os.path.join(_OUT_DIR, "libshm_ring.so")
+
+
+def _build() -> str | None:
+    if os.path.exists(_OUT) and os.path.getmtime(_OUT) >= os.path.getmtime(
+            _SRC):
+        return _OUT
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _OUT,
+           "-lpthread", "-lrt"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _OUT
+    except Exception:
+        return None
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_uint32]
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int]
+        lib.shm_ring_pop.restype = ctypes.c_int64
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_int]
+        lib.shm_ring_close.restype = None
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shm_ring_slot_size.restype = ctypes.c_uint64
+        lib.shm_ring_slot_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class ShmRing:
+    """Python handle over the native ring (create in parent, open in
+    workers)."""
+
+    def __init__(self, handle, lib, name: str, owner: bool):
+        self._h = handle
+        self._lib = lib
+        self.name = name
+        self._owner = owner
+        self.slot_size = int(lib.shm_ring_slot_size(handle))
+
+    @classmethod
+    def create(cls, name: str, slot_size: int, n_slots: int):
+        lib = get_lib()
+        if lib is None:
+            return None
+        h = lib.shm_ring_create(name.encode(), slot_size, n_slots)
+        if not h:
+            return None
+        return cls(h, lib, name, owner=True)
+
+    @classmethod
+    def open(cls, name: str):
+        lib = get_lib()
+        if lib is None:
+            return None
+        h = lib.shm_ring_open(name.encode())
+        if not h:
+            return None
+        return cls(h, lib, name, owner=False)
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> int:
+        return self._lib.shm_ring_push(self._h, data, len(data), timeout_ms)
+
+    def pop(self, timeout_ms: int = -1):
+        buf = ctypes.create_string_buffer(self.slot_size)
+        n = self._lib.shm_ring_pop(self._h, buf, self.slot_size, timeout_ms)
+        if n < 0:
+            return None
+        # bytearray keeps the payload WRITABLE so np.frombuffer views over
+        # it are mutable (parity with the single-process path)
+        return bytearray(memoryview(buf)[:n])
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h, 1 if self._owner else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

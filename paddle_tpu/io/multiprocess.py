@@ -1,0 +1,282 @@
+"""Multiprocess DataLoader iterator over the native shm ring.
+
+Reference: python/paddle/io/dataloader/dataloader_iter.py
+(_DataLoaderIterMultiProcess) + worker.py — worker processes pull index
+batches from a queue, materialize + collate samples, and return batches
+through shared memory; the parent reorders by batch index.
+
+Transport: batches are serialized as raw numpy buffers (zero pickling for
+the tensor payload) into the native MPSC ring (native/shm_ring.cpp); a
+pickle fallback covers non-array structures and oversized batches.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import queue as pyqueue
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ._native import ShmRing
+
+_MAGIC = 0x5044
+_MODE_ARRAYS = 0
+_MODE_PICKLE = 1
+
+
+def _flatten_batch(batch):
+    """Decompose a collated batch into (structure, [np arrays]) if it is a
+    (nested) tuple/list/dict of Tensors/ndarrays; None if not encodable."""
+    from ..core.tensor import Tensor
+
+    arrays = []
+
+    def rec(x):
+        if isinstance(x, Tensor):
+            arrays.append(np.asarray(x._array))
+            return ("t", len(arrays) - 1)
+        if isinstance(x, np.ndarray):
+            arrays.append(x)
+            return ("a", len(arrays) - 1)
+        if isinstance(x, (list, tuple)):
+            return ("l" if isinstance(x, list) else "u",
+                    [rec(v) for v in x])
+        if isinstance(x, dict):
+            return ("d", {k: rec(v) for k, v in x.items()})
+        raise TypeError
+
+    try:
+        return rec(batch), arrays
+    except TypeError:
+        return None, None
+
+
+def _rebuild(node, arrays):
+    from ..core.tensor import Tensor
+
+    kind, payload = node
+    if kind == "t":
+        return Tensor(arrays[payload])
+    if kind == "a":
+        return arrays[payload]
+    if kind == "l":
+        return [_rebuild(v, arrays) for v in payload]
+    if kind == "u":
+        return tuple(_rebuild(v, arrays) for v in payload)
+    if kind == "d":
+        return {k: _rebuild(v, arrays) for k, v in payload.items()}
+    raise ValueError(kind)
+
+
+def encode_batch(batch_idx: int, batch) -> bytes:
+    structure, arrays = _flatten_batch(batch)
+    if structure is None:
+        body = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+        return struct.pack("<HBQ", _MAGIC, _MODE_PICKLE, batch_idx) + body
+    head = struct.pack("<HBQ", _MAGIC, _MODE_ARRAYS, batch_idx)
+    sbytes = pickle.dumps(structure, protocol=pickle.HIGHEST_PROTOCOL)
+    parts = [head, struct.pack("<I", len(sbytes)), sbytes,
+             struct.pack("<I", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = str(a.dtype).encode()
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_batch(data: bytes):
+    magic, mode, batch_idx = struct.unpack_from("<HBQ", data, 0)
+    off = struct.calcsize("<HBQ")
+    if magic != _MAGIC:
+        raise ValueError("corrupt batch message")
+    if mode == _MODE_PICKLE:
+        return batch_idx, pickle.loads(data[off:])
+    (slen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    structure = pickle.loads(data[off:off + slen])
+    off += slen
+    (n,) = struct.unpack_from("<I", data, off)
+    off += 4
+    arrays = []
+    for _ in range(n):
+        (dl,) = struct.unpack_from("<B", data, off)
+        off += 1
+        dt = data[off:off + dl].decode()
+        off += dl
+        (nd,) = struct.unpack_from("<B", data, off)
+        off += 1
+        shape = struct.unpack_from(f"<{nd}q", data, off)
+        off += 8 * nd
+        (nb,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        arrays.append(np.frombuffer(data, dtype=dt, count=nb
+                                    // np.dtype(dt).itemsize,
+                                    offset=off).reshape(shape))
+        off += nb
+    return batch_idx, _rebuild(structure, arrays)
+
+
+def _worker_loop(dataset, collate_fn, index_queue, ring_name, fallback_queue,
+                 worker_id, num_workers, worker_init_fn, seed):
+    """Runs in a child process (reference: io/dataloader/worker.py
+    _worker_loop)."""
+    # workers do host-side numpy work only; never let a worker grab the
+    # parent's accelerator
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from . import WorkerInfo, _worker_info
+
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    ring = ShmRing.open(ring_name)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_idx, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            msg = encode_batch(batch_idx, batch)
+            if ring is not None and len(msg) <= ring.slot_size:
+                if ring.push(msg, timeout_ms=-1) == 0:
+                    continue
+            fallback_queue.put((batch_idx, pickle.dumps(batch)))
+        except Exception as e:  # surface worker errors to the parent
+            fallback_queue.put((batch_idx, e))
+    if ring is not None:
+        ring.close()
+
+
+class MultiprocessIter:
+    """Ordered multiprocess prefetch iterator."""
+
+    def __init__(self, loader, slot_mb: int = 64):
+        self.loader = loader
+        self.num_workers = loader.num_workers
+        # spawn, not fork: the parent holds live JAX threads and a TPU
+        # client; forking that process is deadlock-prone
+        ctx = mp.get_context("spawn")
+        self.index_queue = ctx.Queue()
+        self.fallback_queue = ctx.Queue()
+        ring_name = f"/pdtpu_ring_{os.getpid()}_{id(self)}"
+        self.ring = ShmRing.create(ring_name, slot_mb * 1024 * 1024,
+                                   max(2, 2 * self.num_workers))
+        self.batches = list(loader.batch_sampler)
+        self.n_batches = len(self.batches)
+        self.next_submit = 0
+        self.next_yield = 0
+        self.reorder = {}
+        self.workers = []
+        seed = int.from_bytes(os.urandom(4), "little")
+        # children inherit the environment at spawn: pin them to the CPU
+        # backend so no worker touches the parent's accelerator
+        saved_platform = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in range(self.num_workers):
+                p = ctx.Process(
+                    target=_worker_loop,
+                    args=(loader.dataset, loader.collate_fn,
+                          self.index_queue, ring_name, self.fallback_queue,
+                          w, self.num_workers, loader.worker_init_fn, seed),
+                    daemon=True)
+                p.start()
+                self.workers.append(p)
+        finally:
+            if saved_platform is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved_platform
+        # prefill
+        for _ in range(self.num_workers * loader.prefetch_factor):
+            self._submit()
+
+    def _submit(self):
+        if self.next_submit < self.n_batches:
+            self.index_queue.put((self.next_submit,
+                                  self.batches[self.next_submit]))
+            self.next_submit += 1
+
+    def _drain_fallback(self):
+        while True:
+            try:
+                idx, payload = self.fallback_queue.get_nowait()
+            except pyqueue.Empty:
+                return
+            if isinstance(payload, Exception):
+                self.shutdown()
+                raise payload
+            self.reorder[idx] = pickle.loads(payload)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_yield >= self.n_batches:
+            self.shutdown()
+            raise StopIteration
+        while self.next_yield not in self.reorder:
+            self._drain_fallback()
+            if self.next_yield in self.reorder:
+                break
+            if self.ring is not None:
+                msg = self.ring.pop(timeout_ms=100)
+                if msg is not None:
+                    idx, batch = decode_batch(msg)
+                    self.reorder[idx] = batch
+            else:
+                try:
+                    idx, payload = self.fallback_queue.get(timeout=0.1)
+                    if isinstance(payload, Exception):
+                        self.shutdown()
+                        raise payload
+                    self.reorder[idx] = pickle.loads(payload)
+                except pyqueue.Empty:
+                    pass
+            if not any(w.is_alive() for w in self.workers) \
+                    and self.next_yield not in self.reorder:
+                self._drain_fallback()
+                if self.next_yield not in self.reorder:
+                    self.shutdown()
+                    raise RuntimeError("DataLoader workers exited "
+                                       "unexpectedly")
+        batch = self.reorder.pop(self.next_yield)
+        self.next_yield += 1
+        self._submit()
+        return batch
+
+    def shutdown(self):
+        for _ in self.workers:
+            try:
+                self.index_queue.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

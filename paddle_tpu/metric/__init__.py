@@ -55,8 +55,11 @@ class Accuracy(Metric):
         pred = _np(pred)
         label = _np(label)
         idx = np.argsort(-pred, axis=-1)[..., : self.maxk]
-        if label.ndim == pred.ndim:  # one-hot / soft label
-            label = np.argmax(label, axis=-1)
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == 1:   # (N, 1) index labels (paddle default)
+                label = label[..., 0]
+            else:                      # one-hot / soft label
+                label = np.argmax(label, axis=-1)
         correct = (idx == label[..., None]).astype(np.float32)
         return correct
 
@@ -185,6 +188,6 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     lab = _np(label)
     idx = np.argsort(-pred, axis=-1)[..., :k]
     if lab.ndim == pred.ndim:
-        lab = np.argmax(lab, axis=-1)
+        lab = lab[..., 0] if lab.shape[-1] == 1 else np.argmax(lab, axis=-1)
     corr = (idx == lab[..., None]).any(-1).mean()
     return Tensor(np.asarray([corr], np.float32))

@@ -10,3 +10,9 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
     llama_sharding_rules, shard_llama,
 )
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, shard_gpt  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+    ErnieConfig, ErnieForMaskedLM, ErnieForSequenceClassification,
+    ErnieModel,
+)

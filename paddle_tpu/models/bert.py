@@ -1,0 +1,195 @@
+"""BERT/ERNIE-class bidirectional encoder (reference anchor: ERNIE-3.0 is
+BASELINE config 2; the reference's in-repo encoder surface is
+paddle.nn.TransformerEncoder, PaddleNLP ernie modeling upstream).
+
+Pre-computed token+position+segment embeddings -> post-LN transformer
+encoder -> pooler; heads for masked-LM pretraining and sequence
+classification (the finetune benchmark path).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.activation import Tanh
+from ..parallel import mesh as mesh_mod
+from .llama import _constrain, BATCH_AXES, MP_AXIS
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    dtype: str = "float32"
+
+    @staticmethod
+    def tiny(**over):
+        return BertConfig(vocab_size=128, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128,
+                          max_position_embeddings=64, **over)
+
+    @staticmethod
+    def ernie3_base(**over):
+        return BertConfig(vocab_size=40000, hidden_size=768,
+                          num_hidden_layers=12, num_attention_heads=12,
+                          intermediate_size=3072, **over)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s)[None])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(
+                input_ids._array if isinstance(input_ids, Tensor)
+                else input_ids))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.query = Linear(h, h)
+        self.key = Linear(h, h)
+        self.value = Linear(h, h)
+        self.out = Linear(h, h)
+        self.dropout = Dropout(config.attention_probs_dropout_prob)
+
+    def forward(self, x, attention_mask=None, mesh=None):
+        b, s, h = x.shape
+        q = self.query(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.key(x).reshape([b, s, self.num_heads, self.head_dim])
+        v = self.value(x).reshape([b, s, self.num_heads, self.head_dim])
+        q = _constrain(q, mesh, BATCH_AXES, None, MP_AXIS, None)
+        if attention_mask is not None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attention_mask)
+        else:
+            out, _ = F.flash_attention(q, k, v, causal=False)
+        return self.dropout(self.out(out.reshape([b, s, h])))
+
+
+class BertLayer(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(config.hidden_size,
+                                   epsilon=config.layer_norm_eps)
+        self.intermediate = Linear(config.hidden_size,
+                                   config.intermediate_size)
+        self.output = Linear(config.intermediate_size, config.hidden_size)
+        self.out_norm = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.act = {"gelu": F.gelu, "relu": F.relu}[config.hidden_act]
+
+    def forward(self, x, attention_mask=None, mesh=None):
+        x = self.attn_norm(x + self.attention(x, attention_mask, mesh))
+        m = self.output(self.act(self.intermediate(x)))
+        return self.out_norm(x + self.dropout(m))
+
+
+class BertModel(Layer):
+    """reference surface: paddle.nn-based BERT encoders used by the hapi
+    finetune flows."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        from ..nn.layer.container import LayerList
+
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_hidden_layers)])
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+        self.pooler_act = Tanh()
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        mesh = mesh_mod.get_global_mesh()
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] padding mask -> additive [B, 1, 1, S]
+            am = attention_mask._array if isinstance(attention_mask, Tensor) \
+                else jnp.asarray(attention_mask)
+            attention_mask = Tensor(
+                (1.0 - am.astype(jnp.float32))[:, None, None, :] * -1e4)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = _constrain(x, mesh, BATCH_AXES, None, None)
+        for layer in self.encoder:
+            x = layer(x, attention_mask, mesh)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight
+        return dispatch("mlm_head", lambda a, e: jnp.matmul(a, e.T), (h, w))
+
+
+ErnieConfig = BertConfig
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification
+ErnieForMaskedLM = BertForMaskedLM

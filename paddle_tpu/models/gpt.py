@@ -1,0 +1,163 @@
+"""GPT-2-class decoder LM (reference anchor: the reference's fleet tests use
+GPT models for hybrid parallel, e.g. test/collective/fleet/
+hybrid_parallel_*; PaddleNLP gpt modeling is the upstream surface).
+
+Learned positional embeddings + pre-LN blocks; attention rides the same
+Pallas flash kernel as Llama. TP/FSDP via the shared logical-axis rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.norm import LayerNorm
+from ..parallel import mesh as mesh_mod
+from .llama import _constrain, BATCH_AXES, MP_AXIS, SEQ_AXIS
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.0
+    tie_word_embeddings: bool = True
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def tiny(**over):
+        return GPTConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64,
+                         **over)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = Linear(h, 3 * h)
+        self.out_proj = Linear(h, h)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x, mesh=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = _constrain(q, mesh, BATCH_AXES, None, MP_AXIS, None)
+        out, _ = F.flash_attention(q, k, v, causal=True)
+        out = out.reshape([b, s, h])
+        return self.dropout(self.out_proj(out))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x, mesh=None):
+        x = x + self.attn(self.ln_1(x), mesh=mesh)
+        m = self.fc_out(F.gelu(self.fc_in(self.ln_2(x))))
+        x = x + self.dropout(m)
+        return _constrain(x, mesh, BATCH_AXES, SEQ_AXIS, None)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size)
+        from ..nn.layer.container import LayerList
+
+        self.h = LayerList([GPTBlock(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, position_ids=None):
+        mesh = mesh_mod.get_global_mesh()
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s)[None])
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = _constrain(x, mesh, BATCH_AXES, SEQ_AXIS, None)
+        for block in self.h:
+            x = block(x, mesh=mesh)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        if self.config.tie_word_embeddings:
+            w = self.gpt.wte.weight
+            return dispatch("tied_lm_head",
+                            lambda h, e: jnp.matmul(h, e.T), (hidden, w))
+        return self.lm_head(hidden)
+
+
+def shard_gpt(model: Layer, mesh: Optional[Mesh] = None) -> Layer:
+    """TP over mp (qkv/fc_in column, out_proj/fc_out row), FSDP over
+    sharding — same recipe as shard_llama."""
+    mesh = mesh or mesh_mod.get_global_mesh()
+    if mesh is None:
+        return model
+    rules = [
+        ("wte.weight", (MP_AXIS, "sharding")),
+        ("wpe.weight", (None, "sharding")),
+        ("qkv_proj.weight", ("sharding", MP_AXIS)),
+        ("fc_in.weight", ("sharding", MP_AXIS)),
+        ("out_proj.weight", (MP_AXIS, "sharding")),
+        ("fc_out.weight", (MP_AXIS, "sharding")),
+        ("lm_head.weight", ("sharding", MP_AXIS)),
+    ]
+    for name, p in model.named_parameters():
+        spec = [None] * p.ndim
+        for suffix, dims in rules:
+            if name.endswith(suffix):
+                for i in range(p.ndim):
+                    d = dims[i] if i < len(dims) else None
+                    if d is not None and d in mesh.axis_names \
+                            and p.shape[i] % int(mesh.shape[d]) == 0:
+                        spec[i] = d
+                break
+        sh = NamedSharding(mesh, P(*spec))
+        p._array = jax.device_put(p._array, sh)
+    return model

@@ -107,10 +107,17 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
         # assemble the full logical tensor from saved chunks (overlap math
         # degenerates to direct placement on a single controller)
         full = np.zeros(shape, dtype)
+        covered = np.zeros(shape, bool) if entry["chunks"] else None
         for ch in entry["chunks"]:
             sl = tuple(slice(o, o + s)
                        for o, s in zip(ch["offset"], ch["shape"]))
             full[sl] = _file(ch["file"])[ch["key"]]
+            covered[sl] = True
+        if covered is None or not covered.all():
+            raise ValueError(
+                f"{name}: checkpoint chunks do not cover the full tensor "
+                f"(e.g. metadata written by a coordinator that could not "
+                f"address every shard) — refusing to load zeros")
         sharding = getattr(arr, "sharding", None)
         new = (jax.device_put(jax.numpy.asarray(full), sharding)
                if sharding is not None else jax.numpy.asarray(full))

@@ -31,6 +31,24 @@ from ..nn.layer.layers import Layer
 from . import mesh as mesh_mod
 
 
+def batch_sharding(mesh: Mesh, shape, batch_spec=None) -> NamedSharding:
+    """NamedSharding for a data batch: dim i takes batch_spec[i]'s axes,
+    keeping only axis groups whose PRODUCT divides the dim size."""
+    dims = batch_spec or (("dp", "sharding"), "sep")
+    spec = []
+    for i in range(len(shape)):
+        d = dims[i] if i < len(dims) else None
+        names = (d,) if isinstance(d, str) else (d or ())
+        names = tuple(n for n in names if n in mesh.axis_names)
+        size = 1
+        for n in names:
+            size *= int(mesh.shape[n])
+        if not names or shape[i] % size != 0:
+            names = ()
+        spec.append(names if names else None)
+    return NamedSharding(mesh, P(*spec))
+
+
 class AdamWState(NamedTuple):
     m: Any
     v: Any
@@ -97,15 +115,8 @@ def make_train_step(model: Layer, loss_fn: Callable, mesh: Optional[Mesh] = None
     def batch_constraint(x):
         if mesh is None:
             return x
-        dims = batch_spec or (("dp", "sharding"), "sep")
-        spec = []
-        for i in range(x.ndim):
-            d = dims[i] if i < len(dims) else None
-            names = (d,) if isinstance(d, str) else (d or ())
-            names = tuple(n for n in names if n in mesh.axis_names
-                          and x.shape[i] % int(mesh.shape[n]) == 0)
-            spec.append(names if names else None)
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+        return jax.lax.with_sharding_constraint(
+            x, batch_sharding(mesh, x.shape, batch_spec))
 
     def compute_loss(p, *batch):
         inputs = batch_constraint(batch[0])
@@ -140,16 +151,8 @@ def make_eval_step(model: Layer, mesh: Optional[Mesh] = None,
 
     def fwd(p, inputs):
         if mesh is not None:
-            dims = batch_spec or (("dp", "sharding"), "sep")
-            spec = []
-            for i in range(inputs.ndim):
-                d = dims[i] if i < len(dims) else None
-                names = (d,) if isinstance(d, str) else (d or ())
-                names = tuple(n for n in names if n in mesh.axis_names
-                              and inputs.shape[i] % int(mesh.shape[n]) == 0)
-                spec.append(names if names else None)
             inputs = jax.lax.with_sharding_constraint(
-                inputs, NamedSharding(mesh, P(*spec)))
+                inputs, batch_sharding(mesh, inputs.shape, batch_spec))
         with _tape.no_grad():
             return unwrap(model.func_call(p, Tensor(inputs), training=False))
 

@@ -1,0 +1,242 @@
+"""paddle.quantization equivalent (reference: python/paddle/quantization —
+QuantConfig, QAT/PTQ drivers, observers/quanters, 3.8k LoC).
+
+TPU-native: fake-quant (quantize-dequantize) in bf16/fp32 compute, the
+standard QAT simulation; int8 inference lowering is XLA's job
+(`jax.lax.dot_general` with int8 inputs hits the MXU natively).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "QuanterFactory",
+           "FakeQuanterWithAbsMaxObserver", "quant", "dequant",
+           "BaseObserver", "BaseQuanter"]
+
+
+def quant(x, scale, bits: int = 8):
+    """Symmetric linear quantize (reference: quanted ops in
+    paddle/phi/kernels/quantize_linear_kernel)."""
+    qmax = 2 ** (bits - 1) - 1
+
+    def impl(a, s):
+        return jnp.clip(jnp.round(a / s * qmax), -qmax - 1, qmax)
+
+    return dispatch("quantize_linear", impl, (x, scale))
+
+
+def dequant(x, scale, bits: int = 8):
+    qmax = 2 ** (bits - 1) - 1
+
+    def impl(a, s):
+        return a.astype(jnp.float32) * s / qmax
+
+    return dispatch("dequantize_linear", impl, (x, scale))
+
+
+def _fake_quant(a, s, qmax):
+    q = jnp.clip(jnp.round(a / s * qmax), -qmax - 1, qmax)
+    out = q * s / qmax
+    # straight-through estimator: gradient passes through unchanged
+    return a + jax.lax.stop_gradient(out - a)
+
+
+class BaseObserver(Layer):
+    """Collects statistics during calibration (reference:
+    quantization/base_observer.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+class AbsmaxObserver(BaseObserver):
+    """reference: quantization/observers/abs_max.py."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 1e-9
+
+    def forward(self, x):
+        self._max = max(self._max, float(jnp.max(jnp.abs(unwrap(x)))))
+        self._scale = self._max
+        return x
+
+
+class BaseQuanter(Layer):
+    pass
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT fake-quant with EMA absmax (reference:
+    quantization/quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self._qmax = 2 ** (quant_bits - 1) - 1
+        self._scale = 1.0
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.max(jnp.abs(unwrap(x)))) + 1e-9
+            r = self._moving_rate
+            self._scale = r * self._scale + (1 - r) * cur
+        s = self._scale
+
+        def impl(a):
+            return _fake_quant(a, s, self._qmax)
+
+        return dispatch("fake_quant_absmax", impl, (x,))
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class QuanterFactory:
+    """reference: quantization/factory.py quanter wrapper."""
+
+    def __init__(self, cls: Type[BaseQuanter], **kwargs):
+        self.cls = cls
+        self.kwargs = kwargs
+
+    def instance(self, layer=None):
+        return self.cls(**self.kwargs)
+
+
+class QuantConfig:
+    """reference: quantization/config.py QuantConfig(activation, weight)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = self._factory(activation)
+        self.weight = self._factory(weight)
+        self._type_configs: Dict[type, dict] = {}
+        self._layer_configs: Dict[int, dict] = {}
+
+    @staticmethod
+    def _factory(q):
+        if q is None or isinstance(q, QuanterFactory):
+            return q
+        return QuanterFactory(q)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = {
+                "activation": self._factory(activation),
+                "weight": self._factory(weight)}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[id(l)] = {
+                "activation": self._factory(activation),
+                "weight": self._factory(weight)}
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self.activation or self.weight:
+            return {"activation": self.activation, "weight": self.weight}
+        return None
+
+
+class _QuantedLayer(Layer):
+    """Wraps a leaf layer with activation/weight fake-quant."""
+
+    def __init__(self, inner: Layer, cfg):
+        super().__init__()
+        self.inner = inner
+        act = cfg.get("activation")
+        wq = cfg.get("weight")
+        self.act_quanter = act.instance(inner) if act else None
+        self.w_quanter = wq.instance(inner) if wq else None
+
+    def forward(self, *args, **kwargs):
+        if self.act_quanter is not None:
+            args = tuple(self.act_quanter(a) if isinstance(a, Tensor) else a
+                         for a in args)
+        if self.w_quanter is not None and hasattr(self.inner, "weight") \
+                and self.inner.weight is not None:
+            w = self.inner.weight
+            saved = w._array
+            w._array = unwrap(self.w_quanter(Tensor(saved)))
+            try:
+                return self.inner(*args, **kwargs)
+            finally:
+                w._array = saved
+        return self.inner(*args, **kwargs)
+
+
+def _wrap_leaves(model: Layer, config: QuantConfig):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    for holder in model.sublayers(include_self=True):
+        for name, sub in list(holder._sub_layers.items()):
+            if sub is None or isinstance(sub, _QuantedLayer):
+                continue
+            if isinstance(sub, (Linear, Conv2D)):
+                cfg = config._config_for(sub)
+                if cfg:
+                    holder._sub_layers[name] = _QuantedLayer(sub, cfg)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference:
+    quantization/qat.py QAT.quantize)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.train()
+        return _wrap_leaves(model, self._config)
+
+    def convert(self, model: Layer, inplace=False):
+        """Strip quant wrappers, baking weight scales (deploy form)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        for holder in model.sublayers(include_self=True):
+            for name, sub in list(holder._sub_layers.items()):
+                if isinstance(sub, _QuantedLayer):
+                    holder._sub_layers[name] = sub.inner
+        return model
+
+
+class PTQ(QAT):
+    """Post-training quantization: calibrate with observers, then convert
+    (reference: quantization/ptq.py)."""
+
+    def quantize(self, model: Layer, inplace=False):
+        m = super().quantize(model, inplace=inplace)
+        m.eval()
+        return m

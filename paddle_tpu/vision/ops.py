@@ -101,10 +101,13 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     def impl(feat, rois, rois_num):
         n = rois.shape[0]
-        # map each roi to its batch image
-        reps = jnp.repeat(jnp.arange(rois_num.shape[0]), n // max(1, rois_num.shape[0]))[:n] \
-            if rois_num is None else jnp.repeat(
-                jnp.arange(rois_num.shape[0]), rois_num, total_repeat_length=n)
+        # map each roi to its batch image; no boxes_num -> all rois on
+        # image 0 (reference requires boxes_num except single-image use)
+        if rois_num is None:
+            reps = jnp.zeros(n, jnp.int32)
+        else:
+            reps = jnp.repeat(jnp.arange(rois_num.shape[0]), rois_num,
+                              total_repeat_length=n)
         off = 0.5 if aligned else 0.0
         sr = sampling_ratio if sampling_ratio > 0 else 2
 
@@ -129,9 +132,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
         return jax.vmap(one_roi)(rois, reps)
 
-    num = boxes_num if boxes_num is not None else None
+    if boxes_num is None:
+        return dispatch("roi_align", lambda f, r: impl(f, r, None),
+                        (x, boxes))
     return dispatch("roi_align", lambda f, r, rn: impl(f, r, rn),
-                    (x, boxes, num))
+                    (x, boxes, boxes_num))
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
@@ -187,16 +192,18 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         out_w = (win + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
         xa = jnp.pad(xa, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
 
-        # offsets: [N, 2*dg*kh*kw, out_h, out_w]
-        off = off.reshape(n, deformable_groups, 2, kh * kw, out_h, out_w)
+        # offsets: [N, dg*kh*kw*2, out_h, out_w] with (y, x) INTERLEAVED per
+        # kernel point — channel 2*(i*kw+j) is y, 2*(i*kw+j)+1 is x
+        # (reference: paddle/phi/kernels/funcs/deformable_conv_functor.cc)
+        off = off.reshape(n, deformable_groups, kh * kw, 2, out_h, out_w)
 
         def per_image(img, o, m):
-            # img: [C, H, W]; o: [dg, 2, kh*kw, oh, ow]
+            # img: [C, H, W]; o: [dg, kh*kw, 2, oh, ow]
             cg = cin // deformable_groups
 
             def per_dg(feat, od, md):
-                oy = od[0].reshape(kh, kw, out_h, out_w)
-                ox = od[1].reshape(kh, kw, out_h, out_w)
+                oy = od[:, 0].reshape(kh, kw, out_h, out_w)
+                ox = od[:, 1].reshape(kh, kw, out_h, out_w)
                 # sample positions: [kh, kw, oh, ow]
                 pos_y = (jnp.arange(out_h)[None, None, :, None] * sh
                          + (jnp.arange(kh) * dh)[:, None, None, None] + oy)

@@ -122,30 +122,50 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    """90-degree-exact fast paths; arbitrary angles via scipy-free bilinear
-    sampling."""
+    """Rotate with optional canvas expansion; nearest or bilinear inverse
+    sampling (90-degree multiples take the exact np.rot90 path)."""
     arr = _as_hwc(img)
     a = angle % 360
-    if a == 0:
+    if a == 0 and not expand:
         return arr
-    if a == 90:
-        return np.rot90(arr, k=1).copy()
-    if a == 180:
-        return np.rot90(arr, k=2).copy()
-    if a == 270:
-        return np.rot90(arr, k=3).copy()
+    if a in (90, 180, 270) and center is None:
+        return np.rot90(arr, k=int(a // 90)).copy()
     h, w = arr.shape[:2]
     cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else center[::-1]
     rad = np.deg2rad(a)
-    ys, xs = np.mgrid[0:h, 0:w]
-    y0 = (ys - cy) * np.cos(rad) - (xs - cx) * np.sin(rad) + cy
-    x0 = (ys - cy) * np.sin(rad) + (xs - cx) * np.cos(rad) + cx
-    yi = np.clip(np.round(y0).astype(int), 0, h - 1)
-    xi = np.clip(np.round(x0).astype(int), 0, w - 1)
-    out = arr[yi, xi]
-    mask = (y0 < 0) | (y0 > h - 1) | (x0 < 0) | (x0 > w - 1)
-    out[mask] = fill
-    return out
+    cos_a, sin_a = np.cos(rad), np.sin(rad)
+    if expand:
+        nh = int(np.ceil(abs(h * cos_a) + abs(w * sin_a)))
+        nw = int(np.ceil(abs(w * cos_a) + abs(h * sin_a)))
+        ocy, ocx = (nh - 1) / 2, (nw - 1) / 2
+    else:
+        nh, nw = h, w
+        ocy, ocx = cy, cx
+    ys, xs = np.mgrid[0:nh, 0:nw]
+    # inverse map: output pixel -> source coordinate
+    y0 = (ys - ocy) * cos_a - (xs - ocx) * sin_a + cy
+    x0 = (ys - ocy) * sin_a + (xs - ocx) * cos_a + cx
+    oob = (y0 < 0) | (y0 > h - 1) | (x0 < 0) | (x0 > w - 1)
+    if interpolation == "bilinear":
+        yf = np.clip(y0, 0, h - 1)
+        xf = np.clip(x0, 0, w - 1)
+        yl = np.floor(yf).astype(int)
+        xl = np.floor(xf).astype(int)
+        yh_ = np.minimum(yl + 1, h - 1)
+        xh_ = np.minimum(xl + 1, w - 1)
+        wy = (yf - yl)[..., None] if arr.ndim == 3 else (yf - yl)
+        wx = (xf - xl)[..., None] if arr.ndim == 3 else (xf - xl)
+        src = arr.astype(np.float32)
+        out = (src[yl, xl] * (1 - wy) * (1 - wx) + src[yl, xh_] * (1 - wy) * wx
+               + src[yh_, xl] * wy * (1 - wx) + src[yh_, xh_] * wy * wx)
+    else:
+        yi = np.clip(np.round(y0).astype(int), 0, h - 1)
+        xi = np.clip(np.round(x0).astype(int), 0, w - 1)
+        out = arr[yi, xi].astype(np.float32)
+    out[oob] = fill
+    if arr.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
 
 
 def adjust_brightness(img, brightness_factor):

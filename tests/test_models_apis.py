@@ -1,0 +1,173 @@
+"""GPT/BERT model families + sparse/quantization/audio API tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_global_mesh(None)
+
+
+class TestGPT:
+    def test_hybrid_training_decreases_loss(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM, shard_gpt
+        from paddle_tpu.parallel import make_train_step
+
+        mesh = build_mesh({"dp": 2, "sharding": 2, "mp": 2, "sep": 1})
+        set_global_mesh(mesh)
+        paddle.seed(0)
+        model = shard_gpt(GPTForCausalLM(GPTConfig.tiny()), mesh)
+        crit = nn.CrossEntropyLoss()
+        step, p, o = make_train_step(
+            model,
+            lambda lg, lb: crit(lg.reshape([-1, lg.shape[-1]]),
+                                lb.reshape([-1])), mesh, lr=1e-3)
+        x = jnp.asarray(np.random.randint(0, 128, (4, 32)))
+        y = jnp.asarray(np.random.randint(0, 128, (4, 32)))
+        l1, p, o = step(p, o, x, y)
+        l2, p, o = step(p, o, x, y)
+        assert float(l2) < float(l1)
+
+    def test_tied_embeddings(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        m = GPTForCausalLM(GPTConfig.tiny())
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)))
+        logits = m(ids)
+        assert logits.shape == [2, 16, 128]
+        assert not hasattr(m, "lm_head")
+
+
+class TestBert:
+    def test_classification_with_padding_mask(self):
+        from paddle_tpu.models import BertConfig, BertForSequenceClassification
+
+        m = BertForSequenceClassification(BertConfig.tiny())
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)))
+        mask = paddle.to_tensor(np.concatenate(
+            [np.ones((2, 10)), np.zeros((2, 6))], 1).astype(np.float32))
+        logits = m(ids, attention_mask=mask)
+        loss = nn.functional.cross_entropy(
+            logits, paddle.to_tensor(np.array([0, 1])))
+        loss.backward()
+        g = m.bert.encoder[0].attention.query.weight.grad
+        assert g is not None and float((g * g).sum().numpy()) > 0
+
+    def test_padding_tokens_do_not_affect_pooled(self):
+        """Changing content in masked positions must not change the CLS
+        output."""
+        from paddle_tpu.models import BertConfig, BertModel
+
+        paddle.seed(1)
+        m = BertModel(BertConfig.tiny(hidden_dropout_prob=0.0,
+                                      attention_probs_dropout_prob=0.0))
+        m.eval()
+        ids = np.random.randint(1, 128, (1, 16))
+        mask = np.concatenate([np.ones((1, 10)), np.zeros((1, 6))],
+                              1).astype(np.float32)
+        _, p1 = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(mask))
+        ids2 = ids.copy()
+        ids2[:, 10:] = (ids2[:, 10:] + 7) % 128
+        _, p2 = m(paddle.to_tensor(ids2),
+                  attention_mask=paddle.to_tensor(mask))
+        # masked-out keys cannot influence attended positions; embeddings of
+        # pad positions only affect their own (ignored) outputs
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-5)
+
+    def test_mlm_head(self):
+        from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+        m = BertForMaskedLM(BertConfig.tiny())
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 8)))
+        logits = m(ids)
+        assert logits.shape == [2, 8, 128]
+
+
+class TestSparse:
+    def test_coo_csr_roundtrip(self):
+        sp = paddle.sparse.sparse_coo_tensor(
+            [[0, 1, 2], [1, 2, 0]], [1.0, 2.0, 3.0], (3, 3))
+        dense = np.zeros((3, 3), np.float32)
+        dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(sp.to_dense().numpy(), dense)
+        csr = sp.to_sparse_csr()
+        np.testing.assert_array_equal(csr.to_dense().numpy(), dense)
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(), dense)
+
+    def test_spmm_and_elementwise(self):
+        sp = paddle.sparse.sparse_coo_tensor(
+            [[0, 1], [1, 0]], [2.0, -3.0], (2, 2))
+        d = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        np.testing.assert_allclose(
+            paddle.sparse.matmul(sp, d).numpy(),
+            sp.to_dense().numpy())
+        r = paddle.sparse.relu(sp)
+        assert float(r.to_dense().numpy().min()) == 0.0
+
+
+class TestQuantization:
+    def test_qat_fake_quant_and_convert(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver,
+                                             QAT, QuantConfig, QuanterFactory)
+
+        cfg = QuantConfig(
+            activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+            weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        qm = QAT(cfg).quantize(model, inplace=True)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        out = qm(x)
+        out.sum().backward()
+        g = qm[0].inner.weight.grad
+        assert g is not None  # STE passes gradients through
+        deploy = QAT(cfg).convert(qm, inplace=True)
+        assert deploy(x).shape == [4, 2]
+
+    def test_quant_dequant_roundtrip(self):
+        from paddle_tpu.quantization import dequant, quant
+
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        s = paddle.to_tensor(np.float32(1.0))
+        q = quant(x, s, bits=8)
+        dq = dequant(q, s, bits=8)
+        np.testing.assert_allclose(dq.numpy(), x.numpy(), atol=1 / 127)
+
+
+class TestAudio:
+    def test_window_matches_scipy(self):
+        import scipy.signal as ss
+
+        for w in ("hann", "hamming", "blackman"):
+            np.testing.assert_allclose(
+                paddle.audio.functional.get_window(w, 64).numpy(),
+                ss.get_window(w, 64), atol=1e-10)
+
+    def test_mel_pipeline_shapes(self):
+        from paddle_tpu.audio.features import (LogMelSpectrogram, MFCC,
+                                               MelSpectrogram, Spectrogram)
+
+        sig = paddle.to_tensor(
+            np.sin(np.linspace(0, 1000, 4000)).astype(np.float32)[None])
+        assert Spectrogram(n_fft=256)(sig).shape[1] == 129
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(sig)
+        assert mel.shape[1] == 32
+        assert LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(
+            sig).shape[1] == 32
+        assert MFCC(sr=8000, n_mfcc=13, n_mels=32, n_fft=256)(
+            sig).shape[1] == 13
+
+    def test_hz_mel_inverse(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+
+        f = np.array([100.0, 440.0, 4000.0])
+        np.testing.assert_allclose(
+            np.asarray(mel_to_hz(hz_to_mel(f))), f, rtol=1e-6)
