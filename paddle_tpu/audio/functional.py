@@ -32,7 +32,14 @@ def get_window(window: str, win_length: int, fftbins: bool = True,
     elif window in ("rect", "boxcar", "rectangular"):
         w = np.ones(m)
     elif window == "triang":
-        w = 1 - np.abs((k - (m - 1) / 2) / ((m - 1) / 2))
+        # non-zero endpoints, unlike bartlett (scipy convention)
+        nn = np.arange(1, (m + 1) // 2 + 1)
+        if m % 2 == 0:
+            half = (2 * nn - 1.0) / m
+            w = np.concatenate([half, half[::-1]])
+        else:
+            half = 2 * nn / (m + 1.0)
+            w = np.concatenate([half, half[-2::-1]])
     elif window == "bartlett":
         w = 1 - np.abs((k - (m - 1) / 2) / ((m - 1) / 2))
     elif window == "gaussian":

@@ -401,7 +401,11 @@ class DataLoader:
                     from .multiprocess import MultiprocessIter
 
                     return MultiprocessIter(self)
-                except Exception:
-                    pass  # fall back to the thread prefetch pool
+                except Exception as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"multiprocess DataLoader unavailable ({e!r}); "
+                        "falling back to thread prefetch", RuntimeWarning)
             return self._iter_workers()
         return self._iter_single()

@@ -76,6 +76,10 @@ class ShmRing:
         self.name = name
         self._owner = owner
         self.slot_size = int(lib.shm_ring_slot_size(handle))
+        # one reusable receive buffer per ring (the consumer side is
+        # single-threaded by design) — allocating slot_size per pop would
+        # memset tens of MB on every empty poll tick
+        self._rxbuf = None
 
     @classmethod
     def create(cls, name: str, slot_size: int, n_slots: int):
@@ -101,13 +105,15 @@ class ShmRing:
         return self._lib.shm_ring_push(self._h, data, len(data), timeout_ms)
 
     def pop(self, timeout_ms: int = -1):
-        buf = ctypes.create_string_buffer(self.slot_size)
-        n = self._lib.shm_ring_pop(self._h, buf, self.slot_size, timeout_ms)
+        if self._rxbuf is None:
+            self._rxbuf = ctypes.create_string_buffer(self.slot_size)
+        n = self._lib.shm_ring_pop(self._h, self._rxbuf, self.slot_size,
+                                   timeout_ms)
         if n < 0:
             return None
         # bytearray keeps the payload WRITABLE so np.frombuffer views over
         # it are mutable (parity with the single-process path)
-        return bytearray(memoryview(buf)[:n])
+        return bytearray(memoryview(self._rxbuf)[:n])
 
     def close(self):
         if self._h:

@@ -197,6 +197,11 @@ class MultiprocessIter:
                     daemon=True)
                 p.start()
                 self.workers.append(p)
+        except Exception:
+            # partial start-up failure: reap already-launched workers and
+            # unlink the shm segment before surfacing the error
+            self.shutdown()
+            raise
         finally:
             if saved_platform is None:
                 os.environ.pop("JAX_PLATFORMS", None)

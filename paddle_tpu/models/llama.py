@@ -174,8 +174,13 @@ class LlamaAttention(Layer):
                 v = Tensor(jnp.concatenate([unwrap(pv), unwrap(v)], axis=1))
             new_cache = (k, v)
         causal = cache is None or k.shape[1] == s
-        # heads sharded over mp; batch over dp+sharding
-        q = _constrain(q, mesh, BATCH_AXES, None, MP_AXIS, None)
+        # heads sharded over mp AND sep (Ulysses: the seq->head all-to-all
+        # falls out of re-constraining seq-sharded activations to
+        # head-sharded here; reference analog: SegmentParallel sep axis,
+        # fleet/base/topology.py:224); batch over dp+sharding
+        q = _constrain(q, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
+        k = _constrain(k, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
+        v = _constrain(v, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
         out, _ = F.flash_attention(q, k, v, causal=causal)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)

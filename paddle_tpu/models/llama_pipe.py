@@ -1,0 +1,148 @@
+"""Pipeline-parallel Llama training step.
+
+Reference: fleet PipelineLayer + PipelineParallel.train_batch
+(fleet/meta_parallel/parallel_layers/pp_layers.py:257 SegmentLayers —
+partitioning decoder layers into stages — and pipeline_parallel.py 1F1B).
+
+TPU-native: decoder layers are grouped into `pp` stages; per-stage parameter
+pytrees are stacked with the stage dim sharded over the `pp` mesh axis and
+the microbatch loop runs as scan+ppermute inside ONE jitted program
+(parallel/pipeline_spmd.py). Embedding, final norm and the LM head run
+outside the pipeline region (replicated over pp, still TP/FSDP-sharded over
+the other axes) — the reference shares the embedding across first/last
+stages similarly.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..core.tensor import Tensor, unwrap
+from ..core import tape as _tape
+from ..kernels.rope import rope_freqs
+from ..parallel import mesh as mesh_mod
+from ..parallel.pipeline_spmd import pipeline_forward, stack_stage_params
+from ..parallel.trainer import AdamWState, adamw_update, batch_sharding, \
+    init_adamw_state
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+
+__all__ = ["make_llama_pp_train_step", "split_llama_state"]
+
+_LAYER_PREFIX = "llama.layers."
+
+
+def split_llama_state(state: Dict[str, jax.Array], n_layers: int,
+                      n_stages: int, mesh: Optional[Mesh] = None):
+    """Split a flat raw_state into (outer_params, stacked_stage_params).
+
+    Layer params are grouped into n_stages contiguous blocks (reference:
+    SegmentLayers uniform partition), stacked [n_stages, layers_per_stage,
+    ...] with the stage dim sharded over `pp`."""
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible into "
+                         f"{n_stages} stages")
+    per_layer = []
+    outer = {}
+    for k, v in state.items():
+        if k.startswith(_LAYER_PREFIX):
+            rest = k[len(_LAYER_PREFIX):]
+            idx, sub = rest.split(".", 1)
+            idx = int(idx)
+            while len(per_layer) <= idx:
+                per_layer.append({})
+            per_layer[idx][sub] = v
+        else:
+            outer[k] = v
+    lps = n_layers // n_stages
+    per_stage = []
+    for s in range(n_stages):
+        block = per_layer[s * lps:(s + 1) * lps]
+        per_stage.append(jax.tree.map(lambda *xs: jnp.stack(xs), *block))
+    stacked = stack_stage_params(per_stage, mesh, axis="pp")
+    return outer, stacked
+
+
+def merge_llama_state(outer: Dict, stacked, n_layers: int) -> Dict:
+    """Inverse of split_llama_state (for state_dict/checkpoint export)."""
+    state = dict(outer)
+    leaves_keys = jax.tree.leaves(jax.tree.map(lambda _: None, stacked))
+    n_stages = jax.tree.leaves(stacked)[0].shape[0]
+    lps = n_layers // n_stages
+    flat = jax.tree.flatten_with_path(stacked)[0]
+    for path, arr in flat:
+        sub = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        for s in range(n_stages):
+            for l in range(lps):
+                state[f"{_LAYER_PREFIX}{s * lps + l}.{sub}"] = arr[s, l]
+    return state
+
+
+def make_llama_pp_train_step(model: LlamaForCausalLM,
+                             mesh: Optional[Mesh] = None,
+                             n_micro: Optional[int] = None,
+                             lr: float = 1e-4, weight_decay: float = 0.01,
+                             grad_clip_norm: Optional[float] = 1.0):
+    """Build (step_fn, params, opt_state) where params =
+    {"outer": ..., "stages": ...} and step_fn runs embed -> pp pipeline of
+    decoder stages -> norm -> head -> CE loss -> AdamW, fully jitted."""
+    mesh = mesh or mesh_mod.get_global_mesh()
+    cfg = model.config
+    n_stages = int(mesh.shape["pp"]) if (mesh is not None
+                                         and "pp" in mesh.axis_names) else 1
+    outer, stacked = split_llama_state(dict(model.raw_state()),
+                                       cfg.num_hidden_layers, n_stages, mesh)
+    params = {"outer": outer, "stages": stacked}
+    opt_state = init_adamw_state(params)
+    template = model.llama.layers[0]
+    crit = LlamaPretrainingCriterion(cfg)
+    lps = cfg.num_hidden_layers // n_stages
+
+    def stage_fn(stage_params, h):
+        s = h.shape[1]
+        cos, sin = rope_freqs(s, cfg.head_dim, base=cfg.rope_theta)
+        for i in range(lps):
+            lp = jax.tree.map(lambda t, i=i: t[i], stage_params)
+            with _tape.no_grad():
+                # mesh=None: no explicit activation constraints inside the
+                # manual-pp region (they would reference Auto-typed axes);
+                # the weights' shardings still steer GSPMD on auto axes
+                h = unwrap(template.func_call(lp, Tensor(h), cos, sin,
+                                              mesh=None))
+        return h
+
+    def compute_loss(p, x, y):
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(
+                x, batch_sharding(mesh, x.shape, (("dp", "sharding"),)))
+        with _tape.no_grad():
+            hidden = unwrap(model.llama.embed_tokens.func_call(
+                {"weight": p["outer"]["llama.embed_tokens.weight"]},
+                Tensor(x)))
+        hidden = pipeline_forward(stage_fn, p["stages"], hidden,
+                                  mesh=mesh, axis="pp", n_micro=n_micro)
+        with _tape.no_grad():
+            from ..kernels.rms_norm import rms_norm as _k_rms
+
+            hidden = _k_rms(hidden, p["outer"]["llama.norm.weight"],
+                            cfg.rms_norm_eps)
+            if cfg.tie_word_embeddings:
+                logits = hidden @ p["outer"][
+                    "llama.embed_tokens.weight"].T
+            else:
+                logits = hidden @ p["outer"]["lm_head.weight"]
+            loss = crit(Tensor(logits), Tensor(y))
+        return unwrap(loss).astype(jnp.float32)
+
+    def step(p, s, x, y):
+        loss, grads = jax.value_and_grad(compute_loss)(p, x, y)
+        new_p, new_s = adamw_update(
+            p, grads, s, jnp.asarray(lr, jnp.float32),
+            weight_decay=weight_decay, grad_clip_norm=grad_clip_norm)
+        return loss, new_p, new_s
+
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+    return jitted, params, opt_state

@@ -1,0 +1,138 @@
+"""SPMD pipeline parallelism: microbatch loop over a `pp` mesh axis.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py (1F1B train_batch :697,
+forward_backward_pipeline :459) and the static pipeline_scheduler passes
+(FThenB/1F1B/VPP/ZB). There, stages are separate processes exchanging
+activations via NCCL p2p (pp_utils/p2p_communication.py batch_isend_irecv).
+
+TPU-native: ONE program under `jax.shard_map` over the `pp` axis. The stage
+dimension of the stacked layer parameters is sharded over `pp`, so each
+device holds its stage's weights. The schedule is a `lax.scan` over
+T = n_micro + n_stages - 1 ticks; each tick every stage processes one
+microbatch slot and the boundary activation moves to the next stage with
+`lax.ppermute` — the classic collective-permute pipeline from the public
+scaling playbook. Autodiff through scan+ppermute gives the backward
+schedule for free (fwd-then-bwd, GPipe-equivalent bubble profile; the
+1F1B/ZB memory refinements are schedule *passes* in the reference and are
+future work here).
+
+Because everything is one XLA program, this composes with dp/mp/sharding
+axes of the same mesh: the non-pp axes partition the per-stage math.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+__all__ = ["pipeline_forward", "stack_stage_params", "unstack_stage_params"]
+
+
+def stack_stage_params(per_stage_params: list, mesh: Optional[Mesh] = None,
+                       axis: str = "pp"):
+    """Stack a list of per-stage pytrees along a new leading stage dim and
+    shard that dim over `axis` (each pp rank stores only its stage's
+    weights — the pp analog of ZeRO partitioning)."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+    mesh = mesh or mesh_mod.get_global_mesh()
+    if mesh is not None and axis in mesh.axis_names:
+        def put(x):
+            spec = [axis] + [None] * (x.ndim - 1)
+            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+        stacked = jax.tree.map(put, stacked)
+    return stacked
+
+
+def unstack_stage_params(stacked, n_stages: int):
+    return [jax.tree.map(lambda x, i=i: x[i], stacked)
+            for i in range(n_stages)]
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, x, *,
+                     mesh: Optional[Mesh] = None, axis: str = "pp",
+                     n_micro: Optional[int] = None):
+    """Run x through n_stages pipeline stages with microbatching.
+
+    stage_fn(stage_params, h) -> h  (the per-stage computation; it may use
+    other mesh axes internally — their sharding propagates through
+    shard_map via the residual spec being Replicated on `axis` only).
+
+    x: [batch, ...] global input activations (already embedded);
+    returns [batch, ...] output of the last stage, replicated over `axis`.
+    """
+    mesh = mesh or mesh_mod.get_global_mesh()
+    if mesh is None or axis not in mesh.axis_names \
+            or int(mesh.shape[axis]) == 1:
+        # degenerate: run stages sequentially in one program
+        n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+        h = x
+        for i in range(n_stages):
+            p_i = jax.tree.map(lambda t, i=i: t[i], stacked_params)
+            h = stage_fn(p_i, h)
+        return h
+
+    n_stages = int(mesh.shape[axis])
+    batch = x.shape[0]
+    n_micro = n_micro or n_stages
+    if batch % n_micro != 0:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    mb = batch // n_micro
+
+    other_axes = tuple(a for a in mesh.axis_names if a != axis)
+
+    # manual only over `axis`: the other mesh axes stay "auto" so TP/FSDP
+    # shardings of the per-stage weights keep working inside the body
+    # (check_vma must stay on — partial-manual mode relies on it)
+    @partial(jax.shard_map, mesh=mesh, axis_names={axis},
+             in_specs=(P(axis), P()), out_specs=P())
+    def run(params_local, xg):
+        # params_local: stage dim reduced to 1 on this rank
+        p_stage = jax.tree.map(lambda t: t[0], params_local)
+        stage_id = jax.lax.axis_index(axis)
+        micro = xg.reshape((n_micro, mb) + xg.shape[1:])
+
+        t_total = n_micro + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            boundary, outputs = carry
+            # microbatch index this stage works on at tick t
+            mb_idx = t - stage_id
+            active = (mb_idx >= 0) & (mb_idx < n_micro)
+            # stage 0 reads its microbatch; others read the boundary
+            # activation received from the previous stage
+            x_in = jnp.where(
+                stage_id == 0,
+                micro[jnp.clip(mb_idx, 0, n_micro - 1)],
+                boundary)
+            y = stage_fn(p_stage, x_in)
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            # last stage records its finished microbatch
+            outputs = jnp.where(
+                (stage_id == n_stages - 1) & active,
+                outputs.at[jnp.clip(mb_idx, 0, n_micro - 1)].set(y),
+                outputs)
+            # activation moves stage s -> s+1 for the next tick
+            boundary = jax.lax.ppermute(y, axis, perm)
+            return (boundary, outputs), None
+
+        boundary0 = jax.lax.pvary(
+            jnp.zeros((mb,) + xg.shape[1:], xg.dtype), axis)
+        outputs0 = jax.lax.pvary(
+            jnp.zeros((n_micro, mb) + xg.shape[1:], xg.dtype), axis)
+        (boundary, outputs), _ = jax.lax.scan(
+            tick, (boundary0, outputs0), jnp.arange(t_total))
+        out = outputs.reshape((batch,) + xg.shape[1:])
+        # every rank returns the same value: broadcast the last stage's
+        # outputs (psum over one-hot mask keeps it differentiable)
+        mask = (stage_id == n_stages - 1).astype(out.dtype)
+        return jax.lax.psum(out * mask, axis)
+
+    return run(stacked_params, x)

@@ -40,12 +40,17 @@ def batch_sharding(mesh: Mesh, shape, batch_spec=None) -> NamedSharding:
         d = dims[i] if i < len(dims) else None
         names = (d,) if isinstance(d, str) else (d or ())
         names = tuple(n for n in names if n in mesh.axis_names)
+        # keep the longest prefix of the axis group whose PRODUCT divides
+        # the dim (partial sharding beats full replication on uneven dims)
+        kept = []
         size = 1
         for n in names:
-            size *= int(mesh.shape[n])
-        if not names or shape[i] % size != 0:
-            names = ()
-        spec.append(names if names else None)
+            if shape[i] % (size * int(mesh.shape[n])) == 0:
+                kept.append(n)
+                size *= int(mesh.shape[n])
+            else:
+                break
+        spec.append(tuple(kept) if kept else None)
     return NamedSharding(mesh, P(*spec))
 
 

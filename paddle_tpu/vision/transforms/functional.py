@@ -128,9 +128,13 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     a = angle % 360
     if a == 0 and not expand:
         return arr
-    if a in (90, 180, 270) and center is None:
-        return np.rot90(arr, k=int(a // 90)).copy()
     h, w = arr.shape[:2]
+    # exact fast path (np.rot90 is CCW, the paddle/PIL convention); only
+    # when the canvas swap is acceptable: expand=True, or a square image,
+    # or a 180-degree turn
+    if a in (90, 180, 270) and center is None \
+            and (expand or h == w or a == 180):
+        return np.rot90(arr, k=int(a // 90)).copy()
     cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else center[::-1]
     rad = np.deg2rad(a)
     cos_a, sin_a = np.cos(rad), np.sin(rad)
@@ -142,9 +146,10 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         nh, nw = h, w
         ocy, ocx = cy, cx
     ys, xs = np.mgrid[0:nh, 0:nw]
-    # inverse map: output pixel -> source coordinate
-    y0 = (ys - ocy) * cos_a - (xs - ocx) * sin_a + cy
-    x0 = (ys - ocy) * sin_a + (xs - ocx) * cos_a + cx
+    # inverse map for a COUNTER-clockwise rotation (y axis points down, so
+    # the inverse applies rotation by +a to output coordinates)
+    y0 = (ys - ocy) * cos_a + (xs - ocx) * sin_a + cy
+    x0 = -(ys - ocy) * sin_a + (xs - ocx) * cos_a + cx
     oob = (y0 < 0) | (y0 > h - 1) | (x0 < 0) | (x0 > w - 1)
     if interpolation == "bilinear":
         yf = np.clip(y0, 0, h - 1)
