@@ -1,0 +1,91 @@
+"""paddle.geometric equivalent (reference: python/paddle/geometric —
+message passing send_u_recv/send_ue_recv, segment ops, sampling).
+
+TPU-native: message passing is scatter-reduce, which XLA lowers to
+sorted-segment ops; jax.ops.segment_* are the primitives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _seg_reduce(data, seg, n, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, seg, n)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  seg, n)
+        return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (data.ndim - 1)]
+    return _REDUCERS[pool](data, seg, n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst (reference:
+    geometric/message_passing/send_recv.py send_u_recv; phi kernel
+    graph_send_recv)."""
+    def impl(xa, s, d):
+        n = out_size or xa.shape[0]
+        return _seg_reduce(xa[s.astype(jnp.int32)], d.astype(jnp.int32),
+                           n, reduce_op)
+
+    return dispatch("send_u_recv", impl, (x, src_index, dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """x[src] (op) edge_feature y, reduced onto dst (reference:
+    send_ue_recv; phi graph_send_ue_recv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+
+    def impl(xa, ya, s, d):
+        n = out_size or xa.shape[0]
+        msg = ops[message_op](xa[s.astype(jnp.int32)], ya)
+        return _seg_reduce(msg, d.astype(jnp.int32), n, reduce_op)
+
+    return dispatch("send_ue_recv", impl, (x, y, src_index, dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (reference: send_uv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+
+    def impl(xa, ya, s, d):
+        return ops[message_op](xa[s.astype(jnp.int32)],
+                               ya[d.astype(jnp.int32)])
+
+    return dispatch("send_uv", impl, (x, y, src_index, dst_index))
+
+
+def _segment(name, pool):
+    def op(data, segment_ids, name_=None):
+        def impl(da, seg):
+            n = int(jnp.max(seg)) + 1 if not isinstance(
+                seg, jax.core.Tracer) else da.shape[0]
+            return _seg_reduce(da, seg.astype(jnp.int32), n, pool)
+
+        return dispatch(name, impl, (data, segment_ids))
+
+    op.__name__ = name
+    return op
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_max = _segment("segment_max", "max")
+segment_min = _segment("segment_min", "min")

@@ -98,12 +98,17 @@ def _act_spec(mesh: Optional[Mesh], shape, *dims) -> Optional[NamedSharding]:
             continue
         names = (d,) if isinstance(d, str) else d
         names = tuple(n for n in names if n in mesh.axis_names)
+        # keep the longest prefix whose PRODUCT divides the dim (partial
+        # sharding beats full replication on non-divisible dims)
+        kept = []
         size = 1
         for n in names:
-            size *= int(mesh.shape[n])
-        if not names or shape[i] % size != 0:
-            names = ()
-        out.append(names if names else None)
+            if shape[i] % (size * int(mesh.shape[n])) == 0:
+                kept.append(n)
+                size *= int(mesh.shape[n])
+            else:
+                break
+        out.append(tuple(kept) if kept else None)
     return NamedSharding(mesh, P(*out))
 
 

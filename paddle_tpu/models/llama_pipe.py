@@ -25,7 +25,7 @@ from ..core import tape as _tape
 from ..kernels.rope import rope_freqs
 from ..parallel import mesh as mesh_mod
 from ..parallel.pipeline_spmd import pipeline_forward, stack_stage_params
-from ..parallel.trainer import AdamWState, adamw_update, batch_sharding, \
+from ..parallel.trainer import adamw_update, batch_sharding, \
     init_adamw_state
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
 
@@ -68,7 +68,6 @@ def split_llama_state(state: Dict[str, jax.Array], n_layers: int,
 def merge_llama_state(outer: Dict, stacked, n_layers: int) -> Dict:
     """Inverse of split_llama_state (for state_dict/checkpoint export)."""
     state = dict(outer)
-    leaves_keys = jax.tree.leaves(jax.tree.map(lambda _: None, stacked))
     n_stages = jax.tree.leaves(stacked)[0].shape[0]
     lps = n_layers // n_stages
     flat = jax.tree.flatten_with_path(stacked)[0]

@@ -46,7 +46,13 @@ from .sequence_parallel import (  # noqa: F401
 )
 from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate, moe_dispatch  # noqa: F401
 from .fleet import DistributedStrategy, fleet  # noqa: F401
+from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import elastic  # noqa: F401
+from . import pipeline_spmd  # noqa: F401
+from .pipeline_spmd import pipeline_forward, stack_stage_params  # noqa: F401
+from .elastic import ElasticManager  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .trainer import (  # noqa: F401
     AdamWState, adamw_update, init_adamw_state, make_eval_step,

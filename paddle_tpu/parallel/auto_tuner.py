@@ -1,0 +1,144 @@
+"""Auto-tuner: search the hybrid-parallel config space.
+
+Reference: python/paddle/distributed/auto_tuner/{tuner.py, search.py,
+prune.py, cost_model.py, memory_cost_model.py} — grid/prune search over
+(dp, mp, pp, sharding, micro batch, recompute) with analytic pruning then
+measured trials, launched via `launch --auto_tuner_json`.
+
+TPU-native: the candidate space is mesh factorizations of the chip count;
+pruning uses the analytic cost/memory models (cost_model.py); optional
+measured trials call a user-provided `trial_fn(cfg) -> tokens/sec`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Dict, List, Optional
+
+from .cost_model import (DeviceSpec, V5E, transformer_memory_gb,
+                         transformer_step_cost)
+
+__all__ = ["TunerConfig", "AutoTuner", "Candidate"]
+
+
+@dataclasses.dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    n_micro: int = 1
+    recompute: bool = False
+    predicted_tokens_per_sec: float = 0.0
+    predicted_memory_gb: float = 0.0
+    measured_tokens_per_sec: Optional[float] = None
+
+    def mesh_shape(self) -> Dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "sharding": self.sharding,
+                "mp": self.mp}
+
+
+@dataclasses.dataclass
+class TunerConfig:
+    n_chips: int = 8
+    device: DeviceSpec = dataclasses.field(default_factory=lambda: V5E)
+    n_params: float = 7e9
+    n_layers: int = 32
+    hidden: int = 4096
+    seq: int = 2048
+    global_batch: int = 32            # sequences
+    max_mp: int = 8                   # TP beyond one host is wasteful
+    max_pp: int = 8
+    micro_candidates: tuple = (1, 2, 4, 8)
+    memory_headroom: float = 0.9      # usable HBM fraction
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    """reference: auto_tuner/tuner.py AutoTuner — candidate generation,
+    pruning, ranking, optional measured trials."""
+
+    def __init__(self, config: TunerConfig):
+        self.cfg = config
+
+    # ------------------------------------------------------------------
+    def candidates(self) -> List[Candidate]:
+        """All mesh factorizations dp*mp*pp*sharding == n_chips with prune
+        rules (reference: auto_tuner/prune.py)."""
+        c = self.cfg
+        out = []
+        for mp, pp in itertools.product(_divisors(c.n_chips),
+                                        _divisors(c.n_chips)):
+            if mp > c.max_mp or pp > c.max_pp or pp > c.n_layers:
+                continue
+            rest = c.n_chips // mp
+            if c.n_chips % (mp * pp):
+                continue
+            rest = c.n_chips // (mp * pp)
+            for sharding in _divisors(rest):
+                dp = rest // sharding
+                if c.global_batch % (dp * sharding):
+                    continue  # batch must divide over data axes
+                if c.n_layers % pp:
+                    continue
+                for n_micro in c.micro_candidates:
+                    if pp > 1 and c.global_batch % n_micro:
+                        continue
+                    if pp == 1 and n_micro != 1:
+                        continue
+                    for recompute in (False, True):
+                        out.append(Candidate(dp=dp, mp=mp, pp=pp,
+                                             sharding=sharding,
+                                             n_micro=n_micro,
+                                             recompute=recompute))
+        return out
+
+    # ------------------------------------------------------------------
+    def prune_and_rank(self) -> List[Candidate]:
+        c = self.cfg
+        tokens = c.global_batch * c.seq
+        ranked = []
+        for cand in self.candidates():
+            mem = transformer_memory_gb(
+                n_params=c.n_params, batch_tokens=tokens, dp=cand.dp,
+                mp=cand.mp, pp=cand.pp, sharding=cand.sharding,
+                hidden=c.hidden, n_layers=c.n_layers,
+                recompute=cand.recompute)
+            cand.predicted_memory_gb = mem
+            if mem > c.device.hbm_gb * c.memory_headroom:
+                continue  # OOM prune (memory_cost_model analog)
+            cost = transformer_step_cost(
+                n_params=c.n_params, batch_tokens=tokens, dev=c.device,
+                dp=cand.dp, mp=cand.mp, pp=cand.pp,
+                sharding=cand.sharding, n_micro=cand.n_micro,
+                n_layers=c.n_layers, hidden=c.hidden, seq=c.seq,
+                recompute=cand.recompute)
+            cand.predicted_tokens_per_sec = cost["tokens_per_sec"]
+            ranked.append(cand)
+        ranked.sort(key=lambda x: -x.predicted_tokens_per_sec)
+        return ranked
+
+    # ------------------------------------------------------------------
+    def tune(self, trial_fn: Optional[Callable[[Candidate], float]] = None,
+             max_trials: int = 4) -> Candidate:
+        """Rank analytically; optionally measure the top candidates with
+        `trial_fn` (reference: tuner.py get_best_cfg loop)."""
+        ranked = self.prune_and_rank()
+        if not ranked:
+            raise RuntimeError("no feasible parallel config (all pruned by "
+                               "the memory model)")
+        if trial_fn is None:
+            return ranked[0]
+        best, best_t = None, -1.0
+        for cand in ranked[:max_trials]:
+            try:
+                t = trial_fn(cand)
+            except Exception:
+                continue
+            cand.measured_tokens_per_sec = t
+            if t > best_t:
+                best, best_t = cand, t
+        return best or ranked[0]

