@@ -36,7 +36,7 @@ def _on_tpu() -> bool:
 # forward kernel: grid (batch*q_heads, num_q_blocks, num_k_blocks)
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
                 block_q: int, block_k: int, q_offset: int):
     """q_offset = sk - sq aligns the causal diagonal to the END of the kv
@@ -85,13 +85,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref,
     @pl.when(ki == nk - 1)
     def _final():
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+        # row statistic replicated across the 128 lanes (min tile layout)
+        lse_ref[0] = m_scr[...] + jnp.log(l_scr[...])
 
 
 def _fwd_pallas(q, k, v, causal: bool, scale: float,
-                block_q: int = 128, block_k: int = 128):
-    """q: [BH, Sq, D]; k/v: [BKVH, Sk, D]. Returns out [BH, Sq, D].
-    Softmax stats are NOT saved: the FA2-style backward recomputes them,
-    which keeps the forward output layout trivially tileable."""
+                block_q: int = 512, block_k: int = 512):
+    """q: [BH, Sq, D]; k/v: [BKVH, Sk, D]. Returns (out [BH, Sq, D],
+    lse [BH, Sq, 128] fp32 — the row statistic replicated across lanes,
+    the TPU-tileable layout the backward kernels consume directly)."""
     bh, sq, d = q.shape
     bkv, sk, _ = k.shape
     rep = bh // bkv                      # q heads per kv head (GQA)
@@ -104,7 +106,7 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float,
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, q_offset=sk - sq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -112,8 +114,14 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, i, j, rep=rep: (b // rep, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j, rep=rep: (b // rep, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -123,7 +131,7 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(q, k, v)
-    return out
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -148,15 +156,173 @@ def _fwd_ref(q, k, v, causal: bool, scale: float):
     return out
 
 
+def _pallas_ok(q, k):
+    # must match the kernels' default block choice (min(512, seq))
+    return (q.shape[1] % min(512, q.shape[1]) == 0
+            and k.shape[1] % min(512, k.shape[1]) == 0
+            and q.shape[0] % k.shape[0] == 0)
+
+
 def _fwd_core(q, k, v, causal, scale):
-    if (q.shape[1] % min(128, q.shape[1]) == 0
-            and k.shape[1] % min(128, k.shape[1]) == 0
-            and q.shape[0] % k.shape[0] == 0):
+    """Returns (out, lse) — lse is [BH,Sq,128] from the pallas path or None
+    (jnp fallback recomputes stats in the backward)."""
+    if _pallas_ok(q, k):
         try:
             return _fwd_pallas(q, k, v, causal, scale)
         except Exception:
             pass
-    return _fwd_ref(q, k, v, causal, scale)
+    return _fwd_ref(q, k, v, causal, scale), None
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FA2): dq over k blocks; dk/dv over q blocks
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   dq_scr, *, causal: bool, scale: float, block_q: int,
+                   block_k: int, q_offset: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = ((qi * block_q + block_q - 1 + q_offset >= ki * block_k)
+           if causal else True)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                       # [block_q, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                    scale: float, block_q: int, block_k: int,
+                    q_offset: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = ((qi * block_q + block_q - 1 + q_offset >= ki * block_k)
+           if causal else True)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # [block_q, block_k]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, out, lse, do, causal: bool, scale: float,
+                block_q: int = 512, block_k: int = 512):
+    """Flash backward. Returns (dq [BH,Sq,D], dk/dv [BH,Sk,D] per q-head —
+    caller reduces over GQA groups)."""
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    rep = bh // bkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    kern_kw = dict(causal=causal, scale=scale, block_q=block_q,
+                   block_k=block_k, q_offset=sk - sq)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d),
+                           lambda b, i, j, rep=rep: (b // rep, j, 0))
+    lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kern_kw),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(q, k, v, out, do, lse)
+    # dkv grid: (bh, k blocks, q blocks) — q innermost for accumulation
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d),
+                            lambda b, j, i, rep=rep: (b // rep, j, 0))
+    lse_spec2 = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    dkv_out = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kern_kw),
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(q, k, v, out, do, lse)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -165,22 +331,29 @@ def _fwd_core(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal: bool, scale: float):
-    return _fwd_core(q, k, v, causal, scale)
+    return _fwd_core(q, k, v, causal, scale)[0]
 
 
 def _flash_core_fwd(q, k, v, causal, scale):
-    out = _fwd_core(q, k, v, causal, scale)
-    return out, (q, k, v, out)
+    out, lse = _fwd_core(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(causal, scale, res, do):
-    """FA2-style recompute backward: recompute scores + LSE, then
-      dv = P^T dO ; dS = P * (dO V^T - rowsum(dO*O)) * scale ;
-      dq = dS K ; dk = dS^T Q.
-    (reference math: paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu via
-    the flashattn library)."""
-    q, k, v, out = res
+    """FA2 backward: dv = P^T dO ; dS = P * (dO V^T - rowsum(dO*O)) * scale;
+    dq = dS K ; dk = dS^T Q (reference math:
+    paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu via the flashattn
+    library). Pallas kernels when the forward saved LSE; jnp recompute
+    fallback otherwise."""
+    q, k, v, out, lse = res
     bh, sq, d = q.shape
+    if lse is not None:
+        dq, dk, dv = _bwd_pallas(q, k, v, out, lse, do, causal, scale)
+        rep = bh // k.shape[0]
+        if rep > 1:
+            dk = dk.reshape(k.shape[0], rep, *dk.shape[1:]).sum(1)
+            dv = dv.reshape(v.shape[0], rep, *dv.shape[1:]).sum(1)
+        return dq, dk, dv
     bkv, sk, _ = k.shape
     rep = bh // bkv
     kr = jnp.repeat(k, rep, axis=0) if rep > 1 else k
@@ -211,17 +384,49 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 # public API, paddle layout [B, S, H, D]
 # ---------------------------------------------------------------------------
 
+def _bundled_ok(sq, sk, hq, hk, dh) -> bool:
+    """Shapes the vendored jax pallas kernel handles well (MHA, long
+    block-divisible sequences)."""
+    return (_on_tpu() and hq == hk and dh % 128 == 0
+            and sq % 512 == 0 and sk % 512 == 0 and sq == sk)
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
     """Differentiable flash attention; layout [B, S, H, D] (paddle
-    flash_attn layout, ops.yaml:1765). kv heads may divide q heads (GQA)."""
+    flash_attn layout, ops.yaml:1765). kv heads may divide q heads (GQA).
+
+    Fast path: the pallas flash kernel bundled with the installed jax
+    (jax.experimental.pallas.ops.tpu.flash_attention) — the TPU analog of
+    the reference vendoring Dao's flash-attn library
+    (third_party/flashattn). GQA/odd shapes take the in-repo kernel pack;
+    CPU takes the jnp reference.
+    """
     b, sq, hq, dh = q.shape
     hk = k.shape[2]
+    sk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
+    if _bundled_ok(sq, sk, hq, hk, dh):
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                BlockSizes, flash_attention as _jax_fa)
+
+            bs = min(512, sq)
+            blocks = BlockSizes(
+                block_q=bs, block_k_major=bs, block_k=bs, block_b=1,
+                block_q_major_dkv=bs, block_k_major_dkv=bs,
+                block_k_dkv=bs, block_q_dkv=bs,
+                block_k_major_dq=bs, block_k_dq=bs, block_q_dq=bs)
+            out = _jax_fa(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                          jnp.swapaxes(v, 1, 2), causal=causal,
+                          sm_scale=scale, block_sizes=blocks)
+            return jnp.swapaxes(out, 1, 2)
+        except Exception:
+            pass
     qc = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, dh)
-    kc = jnp.swapaxes(k, 1, 2).reshape(b * hk, k.shape[1], dh)
-    vc = jnp.swapaxes(v, 1, 2).reshape(b * hk, v.shape[1], dh)
+    kc = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, dh)
+    vc = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, dh)
     out = _flash_core(qc, kc, vc, causal, scale)
     return jnp.swapaxes(out.reshape(b, hq, sq, dh), 1, 2)
 

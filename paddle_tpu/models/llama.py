@@ -50,6 +50,11 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     recompute: bool = False          # per-layer remat
+    # remat policy: "none" saves only layer boundaries (recompute all);
+    # "save_attn" additionally keeps attention outputs, skipping the flash
+    # forward re-run in the backward pass (reference analog: selective
+    # recompute in fleet recompute_hybrid)
+    remat_policy: str = "none"
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -187,6 +192,11 @@ class LlamaAttention(Layer):
         k = _constrain(k, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
         v = _constrain(v, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
         out, _ = F.flash_attention(q, k, v, causal=causal)
+        if self.config.remat_policy == "save_attn":
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = dispatch("ckpt_name",
+                           lambda a: checkpoint_name(a, "attn_out"), (out,))
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if cache is not None:
@@ -265,7 +275,12 @@ class LlamaModel(Layer):
                 def run(h, l=layer):
                     return unwrap(l(Tensor(h), cos, sin, mesh=mesh))
 
-                hidden = Tensor(jax.checkpoint(run)(unwrap(hidden)))
+                policy = None
+                if self.config.remat_policy == "save_attn":
+                    policy = jax.checkpoint_policies.save_only_these_names(
+                        "attn_out")
+                hidden = Tensor(jax.checkpoint(run, policy=policy)(
+                    unwrap(hidden)))
             else:
                 hidden = layer(hidden, cos, sin, mesh=mesh)
         hidden = self.norm(hidden)
