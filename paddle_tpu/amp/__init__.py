@@ -220,3 +220,5 @@ def is_float16_supported(device=None):
 
 def is_bfloat16_supported(device=None):
     return True
+
+from . import debugging  # noqa: F401,E402

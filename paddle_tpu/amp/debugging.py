@@ -1,0 +1,140 @@
+"""AMP numerical debugging tools.
+
+Reference: python/paddle/amp/debugging.py — enable_operator_stats_collection,
+collect_operator_stats, enable_tensor_checker / TensorCheckerConfig,
+compare_accuracy (accuracy_compare.py).
+
+TPU-native: op invocation counts per dtype are collected at the dispatch
+layer; the tensor checker rides the FLAGS_check_nan_inf sanitizer.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..framework import flags as _flags
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "compare_accuracy"]
+
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+_checker_config: Optional["TensorCheckerConfig"] = None
+
+
+def _record_op(name: str, dtype):
+    if _op_stats is None or dtype is None:
+        return
+    _op_stats[name][str(dtype)] += 1
+
+
+def _should_check(op_name: str) -> bool:
+    """Op filter for the NaN/Inf sanitizer (checked/skipped op lists)."""
+    cfg = _checker_config
+    if cfg is None:
+        return True
+    if cfg.skipped_op_list and op_name in cfg.skipped_op_list:
+        return False
+    if cfg.checked_op_list:
+        return op_name in cfg.checked_op_list
+    return True
+
+
+def enable_operator_stats_collection():
+    """reference: debugging.py enable_operator_stats_collection."""
+    global _op_stats
+    _op_stats = defaultdict(lambda: defaultdict(int))
+
+
+def disable_operator_stats_collection():
+    """Print the collected table and stop collecting."""
+    global _op_stats
+    stats = _op_stats
+    _op_stats = None
+    if not stats:
+        print("<no operator stats collected>")
+        return {}
+    cols = sorted({d for per_op in stats.values() for d in per_op})
+    head = f"{'op':<30}" + "".join(f"{c:>12}" for c in cols)
+    print(head)
+    print("-" * len(head))
+    for op in sorted(stats):
+        row = f"{op:<30}" + "".join(
+            f"{stats[op].get(c, 0):>12}" for c in cols)
+        print(row)
+    return {k: dict(v) for k, v in stats.items()}
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+class TensorCheckerConfig:
+    """reference: debugging.py TensorCheckerConfig — enable + op filters.
+    debug_step/output_dir/stack_height_limit are accepted for parity but
+    not implemented (a warning is emitted if set)."""
+
+    def __init__(self, enable: bool = True, debug_mode=None,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        if debug_step or output_dir:
+            import warnings
+
+            warnings.warn("TensorCheckerConfig: debug_step/output_dir are "
+                          "not implemented; all steps are checked",
+                          RuntimeWarning)
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    """Turn on per-op NaN/Inf checking (rides FLAGS_check_nan_inf; op
+    filters honored via checked_op_list/skipped_op_list)."""
+    global _checker_config
+    if config.enable:
+        _checker_config = config
+        _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    global _checker_config
+    _checker_config = None
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def compare_accuracy(run_fn, dtypes=("float32", "bfloat16"), atol=1e-2,
+                     rtol=1e-2):
+    """Run `run_fn(dtype) -> Tensor/array` under each dtype and report
+    max/mean abs diff vs the first (reference: amp/accuracy_compare.py
+    workflow, condensed to a functional form)."""
+    from ..core.tensor import Tensor
+
+    results = {}
+    for dt in dtypes:
+        out = run_fn(dt)
+        results[dt] = np.asarray(out.numpy() if isinstance(out, Tensor)
+                                 else out, np.float64)
+    base_key = dtypes[0]
+    base = results[base_key]
+    report = {}
+    for dt in dtypes[1:]:
+        diff = np.abs(results[dt] - base)
+        denom = np.maximum(np.abs(base), 1e-12)
+        report[dt] = {"max_abs_diff": float(diff.max()),
+                      "mean_abs_diff": float(diff.mean()),
+                      "max_rel_diff": float((diff / denom).max()),
+                      "within_tol": bool(np.allclose(
+                          results[dt], base, atol=atol, rtol=rtol))}
+        print(f"{base_key} vs {dt}: {report[dt]}")
+    return report

@@ -261,12 +261,27 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
 
     if _amp.amp_state() is not None:
         arrs = _amp.maybe_cast_inputs(name, arrs)
+    from ..amp import debugging as _amp_dbg
+
+    if _amp_dbg._op_stats is not None:
+        # one count per invocation, keyed by the compute dtype (first
+        # floating input; reference: op stats audit bf16-vs-fp32 coverage)
+        dt = None
+        for a in arrs:
+            adt = getattr(a, "dtype", None)
+            if adt is not None and jnp.issubdtype(adt, jnp.inexact):
+                dt = adt
+                break
+            if adt is not None and dt is None:
+                dt = adt
+        _amp_dbg._record_op(name, dt)
     need_grad = _tape.grad_enabled() and any(
         isinstance(a, Tensor) and not a.stop_gradient and _is_inexact_arr(a._array)
         for a in tensor_args
     )
     if not need_grad:
         out = fn(*arrs)
+        _maybe_check_nan_inf(name, out)
         return _wrap_outputs(out, None)
 
     diff_idx = [
@@ -282,8 +297,38 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
         return fn(*full)
 
     out, vjp_fn = jax.vjp(g, *[arrs[i] for i in diff_idx])
+    _maybe_check_nan_inf(name, out)
     node = _tape.TapeNode(name, vjp_fn, [tensor_args[i] for i in diff_idx], 1)
     return _wrap_outputs(out, node)
+
+
+def _maybe_check_nan_inf(name: str, out):
+    """Eager NaN/Inf sanitizer (reference: FLAGS_check_nan_inf +
+    check_nan_inf_level; eager check paddle/fluid/eager/nan_inf_utils.h:38).
+    Checks concrete outputs only — inside a jit trace this is a no-op (use
+    jax.debug_nans there)."""
+    from ..framework import flags as _flags
+
+    if not _flags.flag("FLAGS_check_nan_inf"):
+        return
+    from ..amp import debugging as _amp_dbg
+
+    if not _amp_dbg._should_check(name):
+        return
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer) or not _is_inexact_arr(o):
+            continue
+        bad = int(jnp.sum(~jnp.isfinite(o)))
+        if bad:
+            msg = (f"op '{name}' output {i} contains {bad} non-finite "
+                   f"values (shape {tuple(o.shape)}, dtype {o.dtype})")
+            if int(_flags.flag("FLAGS_check_nan_inf_level")) > 0:
+                import warnings
+
+                warnings.warn(msg, RuntimeWarning)
+            else:
+                raise FloatingPointError(msg)
 
 
 def _wrap_outputs(out, node):

@@ -55,6 +55,10 @@ class LlamaConfig:
     # forward re-run in the backward pass (reference analog: selective
     # recompute in fleet recompute_hybrid)
     remat_policy: str = "none"
+    # attention over the sep axis: "ulysses" (all-to-all seq->head reshard)
+    # or "ring" (ring attention — k/v rotate with ppermute, exact blockwise
+    # softmax; the long-context leapfrog the reference lacks)
+    attention_impl: str = "ulysses"
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -184,14 +188,30 @@ class LlamaAttention(Layer):
                 v = Tensor(jnp.concatenate([unwrap(pv), unwrap(v)], axis=1))
             new_cache = (k, v)
         causal = cache is None or k.shape[1] == s
-        # heads sharded over mp AND sep (Ulysses: the seq->head all-to-all
-        # falls out of re-constraining seq-sharded activations to
-        # head-sharded here; reference analog: SegmentParallel sep axis,
-        # fleet/base/topology.py:224); batch over dp+sharding
-        q = _constrain(q, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
-        k = _constrain(k, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
-        v = _constrain(v, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS), None)
-        out, _ = F.flash_attention(q, k, v, causal=causal)
+        use_ring = (self.config.attention_impl == "ring" and cache is None
+                    and mesh is not None and SEQ_AXIS in mesh.axis_names
+                    and int(mesh.shape[SEQ_AXIS]) > 1)
+        if use_ring:
+            from ..parallel.ring_attention import ring_attention
+
+            # GQA handled inside the ring by grouped einsum — no repeat
+            out = dispatch(
+                "ring_attention",
+                lambda qa, ka, va: ring_attention(
+                    qa, ka, va, mesh=mesh, axis=SEQ_AXIS, causal=causal),
+                (q, k, v))
+        else:
+            # heads sharded over mp AND sep (Ulysses: the seq->head
+            # all-to-all falls out of re-constraining seq-sharded
+            # activations to head-sharded here; reference analog:
+            # SegmentParallel sep axis, fleet/base/topology.py:224)
+            q = _constrain(q, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS),
+                           None)
+            k = _constrain(k, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS),
+                           None)
+            v = _constrain(v, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS),
+                           None)
+            out, _ = F.flash_attention(q, k, v, causal=causal)
         if self.config.remat_policy == "save_attn":
             from jax.ad_checkpoint import checkpoint_name
 
