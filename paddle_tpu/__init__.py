@@ -56,6 +56,8 @@ from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import text  # noqa: F401
 from . import geometric  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 import sys as _sys0
 # alias paddle_tpu.distributed (and every submodule) to paddle_tpu.parallel

@@ -54,7 +54,9 @@ class Spectrogram(Layer):
                          self.center, self.pad_mode)
             return jnp.abs(spec) ** self.power
 
-        return dispatch("spectrogram", impl, (x,))
+        from ..fft import host_fallback_dispatch
+
+        return host_fallback_dispatch("spectrogram", impl, (x,))
 
 
 class MelSpectrogram(Layer):

@@ -1,0 +1,106 @@
+"""paddle.fft equivalent (reference: python/paddle/fft.py — fft_c2c/c2r/r2c
+ops, paddle/phi/kernels/fft_kernel). Differentiable via dispatch on
+backends with an XLA FFT lowering; on TPU backends without one the
+computation falls back to the host CPU (eager-only, like the reference's
+CPU fft kernels serving as the fallback path)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor, dispatch, unwrap
+
+
+def _tpu_no_fft() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def host_fallback_dispatch(name, impl, tensors):
+    """dispatch(), except on TPU backends the impl runs eagerly on the host
+    CPU (no gradient tape — FFT grads are CPU-backend only)."""
+    if _tpu_no_fft():
+        arrs = [np.asarray(jax.device_get(unwrap(t))) if t is not None
+                else None for t in tensors]
+        with jax.default_device(jax.devices("cpu")[0]):
+            out = impl(*arrs)
+
+        def wrap(o):
+            # complex dtypes have no TPU representation on this backend:
+            # keep them CPU-committed; real results go back uncommitted
+            if jnp.issubdtype(o.dtype, jnp.complexfloating):
+                return Tensor(o)
+            return Tensor(np.asarray(o))
+
+        if isinstance(out, (tuple, list)):
+            return tuple(wrap(o) for o in out)
+        return wrap(out)
+    return dispatch(name, impl, tensors)
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return host_fallback_dispatch(
+            name, lambda a: fn(a, n=n, axis=axis, norm=norm), (x,))
+
+    op.__name__ = name
+    return op
+
+
+def _wrap2(name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        return host_fallback_dispatch(
+            name, lambda a: fn(a, s=s, axes=axes, norm=norm), (x,))
+
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return host_fallback_dispatch(
+            name, lambda a: fn(a, s=s, axes=axes, norm=norm), (x,))
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return dispatch("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                    (x,))
+
+
+def ifftshift(x, axes=None, name=None):
+    return dispatch("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                    (x,))

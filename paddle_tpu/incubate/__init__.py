@@ -5,3 +5,4 @@ are the fused LLM ops (nn/functional), which here ride the Pallas kernel
 pack instead of hand-written CUDA.
 """
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,115 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+LookAhead (lookahead.py), ModelAverage (modelaverage.py))."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """reference: incubate/optimizer/lookahead.py — wrap an inner optimizer;
+    every k steps pull slow weights toward fast weights by alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        self._param_groups = inner_optimizer._param_groups
+        # slow weights snapshot at wrap time (reference: lookahead.py).
+        # COPIES: the inner optimizer's jitted update donates parameter
+        # buffers, which would invalidate aliased snapshots
+        self._slow = {id(p): jnp.copy(p._array)
+                      for p in self._parameter_list}
+        self._steps = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in self._parameter_list:
+            slow = self._slow[id(p)]
+            slow = slow + self.alpha * (p._array - slow)
+            self._slow[id(p)] = slow
+            # the param gets a separate copy — its buffer will be donated
+            # by the next inner step
+            p._array = jnp.copy(slow)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["lookahead_steps"] = self._steps
+        return out
+
+    def set_state_dict(self, sd):
+        self._steps = int(sd.pop("lookahead_steps", 0))
+        self.inner_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage(Optimizer):
+    """reference: incubate/optimizer/modelaverage.py — running average of
+    parameters with an apply()/restore() window."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._sums = {id(p): jnp.zeros_like(p._array)
+                      for p in self._parameter_list}
+        self._counts = {id(p): 0 for p in self._parameter_list}
+        self._backup = None
+
+    def step(self):
+        for p in self._parameter_list:
+            pid = id(p)
+            if self._counts[pid] >= self.max_window:
+                # restart the window (reference: num_updates reset)
+                self._sums[pid] = jnp.zeros_like(p._array)
+                self._counts[pid] = 0
+            self._sums[pid] = self._sums[pid] + p._array
+            self._counts[pid] += 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged params in (context manager; reference
+        ModelAverage.apply)."""
+        self._backup = {id(p): p._array for p in self._parameter_list}
+        for p in self._parameter_list:
+            pid = id(p)
+            if self._counts[pid]:
+                p._array = (self._sums[pid] / self._counts[pid]).astype(
+                    p._array.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameter_list:
+                if id(p) in self._backup:
+                    p._array = self._backup[id(p)]
+            self._backup = None

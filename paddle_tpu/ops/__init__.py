@@ -12,10 +12,10 @@ import builtins
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, dispatch, unwrap
-from . import creation, linalg, logic, manipulation, math, search
+from . import creation, extras, linalg, logic, manipulation, math, search
 from .registry import OPS, OpDef, get_op, register_op
 
-_MODULES = (math, manipulation, creation, linalg, logic, search)
+_MODULES = (math, manipulation, creation, linalg, logic, search, extras)
 
 # hoist all ops into this namespace
 for _mod in _MODULES:

@@ -1,0 +1,138 @@
+"""Long-tail tensor ops surfaced by the ops.yaml coverage audit
+(reference: paddle/phi/ops/yaml/ops.yaml — unstack, fill_diagonal,
+increment, as_strided, view, clip_by_norm, p_norm...)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["unstack", "fill_diagonal", "fill_diagonal_", "fill_diagonal_tensor",
+           "increment", "as_strided", "view", "view_as", "reverse",
+           "clip_by_norm", "p_norm"]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """reference: ops.yaml unstack — split along axis into a list, squeezing
+    the axis."""
+    n = num or x.shape[axis]
+
+    def impl(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+
+    out = dispatch("unstack", impl, (x,))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """reference: fill_diagonal op (2-D main diagonal band)."""
+    def impl(a):
+        h, w = a.shape[-2], a.shape[-1]
+        n = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+        rows = jnp.arange(max(n, 0)) + max(-offset, 0)
+        cols = jnp.arange(max(n, 0)) + max(offset, 0)
+        return a.at[..., rows, cols].set(value)
+
+    return dispatch("fill_diagonal", impl, (x,))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    out = fill_diagonal(x, value, offset, wrap)
+    x._replace(out._array, out._node, out._out_idx)
+    return x
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """reference: fill_diagonal_tensor — write tensor y onto the diagonal
+    plane spanned by (dim1, dim2)."""
+    def impl(a, b):
+        a_m = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        h, w = a_m.shape[-2], a_m.shape[-1]
+        n = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+        rows = jnp.arange(n) + max(-offset, 0)
+        cols = jnp.arange(n) + max(offset, 0)
+        # b carries the diagonal on its last axis (paddle convention)
+        a_m = a_m.at[..., rows, cols].set(b)
+        return jnp.moveaxis(a_m, (-2, -1), (dim1, dim2))
+
+    return dispatch("fill_diagonal_tensor", impl, (x, y))
+
+
+def increment(x, value=1.0, name=None):
+    """reference: increment op (in-place scalar add)."""
+    out = dispatch("increment", lambda a: a + value, (x,))
+    x._replace(out._array, out._node, out._out_idx)
+    return x
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """reference: as_strided op (stride tricks over the flat buffer)."""
+    def impl(a):
+        flat = a.reshape(-1)
+        grids = jnp.indices(tuple(shape))
+        lin = jnp.full(tuple(shape), offset, jnp.int32)
+        for g, st in zip(grids, stride):
+            lin = lin + g * st
+        return flat[lin]
+
+    return dispatch("as_strided", impl, (x,))
+
+
+def view(x, shape_or_dtype, name=None):
+    """reference: view_shape / view_dtype ops."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        s = [int(v) for v in shape_or_dtype]
+        return dispatch("view_shape", lambda a: a.reshape(s), (x,))
+    dt = np.dtype(shape_or_dtype if not isinstance(shape_or_dtype, str)
+                  else shape_or_dtype)
+    return dispatch("view_dtype", lambda a: jax.lax.bitcast_convert_type(
+        a, dt), (x,))
+
+
+def view_as(x, other, name=None):
+    return view(x, other.shape)
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference: op_compat reverse -> flip)."""
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    return dispatch("reverse", lambda a: jnp.flip(a, axes), (x,))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """reference: clip_by_norm op — scale so l2norm(x) <= max_norm."""
+    def impl(a):
+        norm = jnp.sqrt(jnp.sum(jnp.square(
+            a.astype(jnp.float32))))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+    return dispatch("clip_by_norm", impl, (x,))
+
+
+def p_norm(x, p=2.0, axis=None, epsilon=1e-12, keepdim=False, asvector=False,
+           name=None):
+    """reference: p_norm op (also surfaced as paddle.linalg.norm)."""
+    def impl(a):
+        a32 = a.astype(jnp.float32)
+        if asvector or axis is None:
+            a32 = a32.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(a32), axis=ax, keepdims=keepdim)
+        elif p == float("-inf"):
+            r = jnp.min(jnp.abs(a32), axis=ax, keepdims=keepdim)
+        elif p == 0:
+            r = jnp.sum((a32 != 0).astype(jnp.float32), axis=ax,
+                        keepdims=keepdim)
+        else:
+            r = jnp.power(jnp.sum(jnp.power(jnp.abs(a32), p), axis=ax,
+                                  keepdims=keepdim) + epsilon, 1.0 / p)
+        return r.astype(a.dtype)
+
+    return dispatch("p_norm", impl, (x,))

@@ -1,0 +1,131 @@
+"""paddle.signal equivalent (reference: python/paddle/signal.py — stft,
+istft over frame/overlap_add ops)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, dispatch, unwrap
+from .fft import host_fallback_dispatch
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames (reference: signal.py frame; phi frame
+    kernel). axis=-1: [..., T] -> [..., frame_length, n_frames];
+    axis=0: [T, ...] -> [n_frames, frame_length, ...]."""
+    def impl(a):
+        if axis in (-1, a.ndim - 1):
+            t = a.shape[-1]
+            n = 1 + (t - frame_length) // hop_length
+            idx = (jnp.arange(n)[None, :] * hop_length
+                   + jnp.arange(frame_length)[:, None])
+            return a[..., idx]
+        t = a.shape[0]
+        n = 1 + (t - frame_length) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        return a[idx]
+
+    return dispatch("frame", impl, (x,))
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame (reference: phi overlap_add kernel)."""
+    def impl(a):
+        if axis in (-1, a.ndim - 1):
+            fl, n = a.shape[-2], a.shape[-1]
+            t = (n - 1) * hop_length + fl
+            out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
+            for i in range(n):  # static unroll; n is static
+                out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                    a[..., i])
+            return out
+        n, fl = a.shape[0], a.shape[1]
+        t = (n - 1) * hop_length + fl
+        out = jnp.zeros((t,) + a.shape[2:], a.dtype)
+        for i in range(n):
+            out = out.at[i * hop_length:i * hop_length + fl].add(a[i])
+        return out
+
+    return dispatch("overlap_add", impl, (x,))
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """reference: signal.py stft. x: [..., T] ->
+    [..., n_fft//2+1 (or n_fft), n_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    if window is not None:
+        w = unwrap(window).astype(jnp.float32)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def impl(a, *rest):
+        arr = a
+        if center:
+            pads = [(0, 0)] * (arr.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            arr = jnp.pad(arr, pads, mode=pad_mode)
+        t = arr.shape[-1]
+        n = 1 + (t - n_fft) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = arr[..., idx] * w                       # [..., n, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)                # [..., freq, n]
+
+    return host_fallback_dispatch("stft", impl, (x,))
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """reference: signal.py istft — least-squares inverse with window
+    normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = unwrap(window).astype(jnp.float32)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def impl(a):
+        spec = jnp.swapaxes(a, -1, -2)                   # [..., n, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * w
+        n = frames.shape[-2]
+        t = (n - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
+        wsum = jnp.zeros(t, jnp.float32)
+        for i in range(n):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(w * w)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: t - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return host_fallback_dispatch("istft", impl, (x,))

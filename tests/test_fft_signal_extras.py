@@ -1,0 +1,126 @@
+"""fft / signal / long-tail op tests (numpy+scipy oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+class TestFFT:
+    def test_matches_numpy(self):
+        x = np.random.randn(4, 32).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.fft.fft(t).numpy(),
+                                   np.fft.fft(x), atol=1e-4)
+        np.testing.assert_allclose(paddle.fft.rfft(t).numpy(),
+                                   np.fft.rfft(x), atol=1e-4)
+        np.testing.assert_allclose(paddle.fft.fft2(t).numpy(),
+                                   np.fft.fft2(x), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(t).numpy(), np.fft.fftshift(x), atol=1e-6)
+
+    def test_roundtrip_and_grad(self):
+        import jax
+
+        x = np.random.randn(8, 64).astype(np.float32)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        rec = paddle.fft.irfft(paddle.fft.rfft(t))
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-5)
+        loss = (rec * rec).sum()
+        loss.backward()
+        assert t.grad is not None
+        np.testing.assert_allclose(t.grad.numpy(), 2 * x, atol=1e-4)
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        sig = np.sin(np.linspace(0, 100, 2048)).astype(np.float32)
+        w = paddle.audio.functional.get_window("hann", 256).numpy().astype(
+            np.float32)
+        S = paddle.signal.stft(paddle.to_tensor(sig[None]), 256, 64,
+                               window=paddle.to_tensor(w))
+        assert S.shape == [1, 129, 33]
+        rec = paddle.signal.istft(S, 256, 64, window=paddle.to_tensor(w))
+        n = min(rec.shape[-1], len(sig))
+        err = np.abs(rec.numpy()[0, :n] - sig[:n])[128:-128].max()
+        assert err < 1e-5
+
+    def test_frame_overlap_add_roundtrip(self):
+        sig = np.arange(1024, dtype=np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(sig[None]), 128, 128)
+        rec = paddle.signal.overlap_add(fr, 128)
+        np.testing.assert_array_equal(rec.numpy()[0], sig)
+
+
+class TestExtras:
+    def test_fill_diagonal_and_tensor(self):
+        t = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        out = paddle.fill_diagonal(t, 5.0)
+        np.testing.assert_array_equal(np.diag(out.numpy()), [5, 5, 5])
+        d = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out2 = paddle.fill_diagonal_tensor(t, d)
+        np.testing.assert_array_equal(np.diag(out2.numpy()), [1, 2, 3])
+
+    def test_unstack_view_reverse(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        parts = paddle.unstack(paddle.to_tensor(x))
+        assert len(parts) == 2 and parts[0].shape == [3]
+        v = paddle.view(paddle.to_tensor(x), [3, 2])
+        assert v.shape == [3, 2]
+        r = paddle.reverse(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(r.numpy(), x[:, ::-1])
+
+    def test_norm_clip_increment(self):
+        v = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        assert abs(float(paddle.p_norm(v).numpy()) - 5.0) < 1e-5
+        np.testing.assert_allclose(
+            paddle.clip_by_norm(v, 1.0).numpy(), [0.6, 0.8], atol=1e-6)
+        t = paddle.to_tensor(np.array([1.0], np.float32))
+        paddle.increment(t, 2.0)
+        assert float(t.numpy()) == 3.0
+
+    def test_as_strided(self):
+        x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+        # sliding windows of 3 with stride 2
+        out = paddle.as_strided(x, [4, 3], [2, 1])
+        np.testing.assert_array_equal(
+            out.numpy(), [[0, 1, 2], [2, 3, 4], [4, 5, 6], [6, 7, 8]])
+
+
+class TestIncubateOptimizers:
+    def test_lookahead(self):
+        def train(use_lookahead):
+            paddle.seed(3)
+            m = nn.Linear(4, 4)
+            o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
+            if use_lookahead:
+                o = paddle.incubate.optimizer.LookAhead(o, alpha=0.5, k=2)
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            w0 = m.weight.numpy().copy()
+            for _ in range(2):
+                loss = (m(x) ** 2).sum()
+                loss.backward()
+                o.step()
+                o.clear_grad()
+            return w0, m.weight.numpy()
+
+        w0, w_look = train(True)
+        _, w_fast = train(False)
+        # after k=2 steps: lookahead = slow(=w0) + 0.5 * (fast - slow).
+        # NOTE the trajectories coincide until the first pull, so the plain
+        # run's weights ARE the fast weights at that moment.
+        np.testing.assert_allclose(w_look, (w0 + w_fast) / 2, atol=1e-5)
+
+    def test_model_average(self):
+        m = nn.Linear(2, 2)
+        ma = paddle.incubate.optimizer.ModelAverage(
+            0.15, parameters=m.parameters())
+        w0 = m.weight.numpy().copy()
+        ma.step()
+        m.weight._array = m.weight._array + 1.0
+        ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(m.weight.numpy(), w0 + 0.5,
+                                       atol=1e-6)
+        np.testing.assert_allclose(m.weight.numpy(), w0 + 1.0, atol=1e-6)
