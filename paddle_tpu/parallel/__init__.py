@@ -54,6 +54,8 @@ from . import pipeline_spmd  # noqa: F401
 from .pipeline_spmd import pipeline_forward, stack_stage_params  # noqa: F401
 from . import ring_attention as ring_attention_mod  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from . import watchdog  # noqa: F401
+from .watchdog import StepWatchdog, barrier  # noqa: F401
 from .elastic import ElasticManager  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .trainer import (  # noqa: F401

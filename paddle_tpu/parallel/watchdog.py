@@ -1,0 +1,125 @@
+"""Collective hang / desync detection.
+
+Reference: async CommTaskManager watchdog
+(paddle/phi/core/distributed/comm_task_manager.h:37,55) — a thread tracks
+every NCCL task with a timeout and dumps comm state on hang; store-based
+barrier checks in phi/core/distributed/check/.
+
+TPU-native: XLA collectives cannot be tracked per-op from Python, but step
+hangs can — `watch()` wraps a step boundary with a heartbeat deadline; if
+the step does not complete in time, the watchdog fires a diagnostic dump
+(mesh, process info, stack traces of all threads) exactly like the
+reference's CommTaskManager abort path. `barrier()` gives the store-based
+liveness check across hosts.
+"""
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+from . import mesh as mesh_mod
+
+__all__ = ["StepWatchdog", "watch", "barrier"]
+
+
+class StepWatchdog:
+    """Deadline-based hang detector for train steps (reference:
+    CommTaskManager + FLAGS_enable_async_trace)."""
+
+    def __init__(self, timeout_s: float = 600.0,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 dump_stacks: bool = True, raise_on_timeout: bool = False):
+        self.timeout_s = timeout_s
+        self.on_timeout = on_timeout
+        self.dump_stacks = dump_stacks
+        self.raise_on_timeout = raise_on_timeout
+        self._deadline = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1)
+
+    def _loop(self):
+        while not self._stop.wait(min(1.0, self.timeout_s / 10)):
+            with self._lock:
+                deadline = self._deadline
+            if deadline is not None and time.monotonic() > deadline \
+                    and not self._fired:
+                self._fired = True
+                self._dump()
+                if self.on_timeout is not None:
+                    self.on_timeout()
+
+    def _dump(self):
+        mesh = mesh_mod.get_global_mesh()
+        print(f"[watchdog] step exceeded {self.timeout_s}s — possible "
+              f"collective hang. mesh="
+              f"{dict(mesh.shape) if mesh else None} "
+              f"process={getattr(jax, 'process_index', lambda: 0)()}",
+              file=sys.stderr)
+        if self.dump_stacks:
+            faulthandler.dump_traceback(file=sys.stderr)
+
+    # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def step(self):
+        """Arm the deadline for one step; disarm on completion."""
+        with self._lock:
+            self._deadline = time.monotonic() + self.timeout_s
+            self._fired = False
+        try:
+            yield
+            if self._fired and self.raise_on_timeout:
+                raise TimeoutError(
+                    f"step exceeded watchdog timeout {self.timeout_s}s")
+        finally:
+            with self._lock:
+                self._deadline = None
+
+
+@contextlib.contextmanager
+def watch(timeout_s: float = 600.0, **kw):
+    """One-shot: `with watch(30): step(...)`."""
+    wd = StepWatchdog(timeout_s, **kw).start()
+    try:
+        with wd.step():
+            yield wd
+    finally:
+        wd.stop()
+
+
+def barrier(timeout_s: float = 300.0):
+    """Cross-host liveness barrier (reference: store barrier in
+    phi/core/distributed/check/). Single-controller JAX: a tiny psum over
+    all devices forces every host through the same program point."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh_mod.get_global_mesh()
+    with watch(timeout_s, raise_on_timeout=True):
+        if mesh is None:
+            jax.block_until_ready(jnp.zeros(()) + 1)
+            return
+        x = jax.device_put(
+            jnp.ones((mesh.size,)),
+            NamedSharding(mesh, P(mesh.axis_names)))
+        total = jax.jit(lambda v: v.sum())(x)
+        # device_get is the reliable cross-host sync point
+        assert int(jax.device_get(total)) == mesh.size

@@ -20,8 +20,11 @@ class TestLauncher:
         with open(script, "w") as f:
             f.write(
                 "import os, sys\n"
-                "print('rank', os.environ['PADDLE_TRAINER_ID'],\n"
-                "      'of', os.environ['PADDLE_TRAINERS_NUM'])\n"
+                # single atomic write: both workers share the stdout pipe
+                "sys.stdout.write('rank %s of %s\\n' % ("
+                "os.environ['PADDLE_TRAINER_ID'], "
+                "os.environ['PADDLE_TRAINERS_NUM']))\n"
+                "sys.stdout.flush()\n"
                 "if os.environ.get('FAIL_ONCE') and "
                 "os.environ['PADDLE_TRAINER_ID'] == '1' and "
                 "not os.path.exists('/tmp/pdtpu_launch_marker'):\n"
@@ -184,3 +187,65 @@ class TestViterbi:
                     best, bp = s, p
             assert list(bp) == paths.numpy()[b].tolist()
             assert abs(best - scores.numpy()[b]) < 1e-4
+
+
+class TestWatchdog:
+    def test_fires_only_on_slow_steps(self):
+        import time
+
+        from paddle_tpu.parallel.watchdog import StepWatchdog
+
+        fired = []
+        wd = StepWatchdog(timeout_s=0.4, on_timeout=lambda: fired.append(1),
+                          dump_stacks=False).start()
+        with wd.step():
+            time.sleep(0.05)
+        assert not fired
+        with wd.step():
+            time.sleep(0.9)
+        assert fired
+        wd.stop()
+
+    def test_barrier_over_mesh(self):
+        from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
+        from paddle_tpu.parallel.watchdog import barrier
+
+        mesh = build_mesh({"dp": 8})
+        set_global_mesh(mesh)
+        barrier(timeout_s=60)
+        set_global_mesh(None)
+
+
+class TestExpertParallelDryrun:
+    def test_moe_train_step_on_ep_mesh(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.parallel import make_train_step
+        from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
+        from paddle_tpu.parallel.moe import MoELayer
+
+        mesh = build_mesh({"dp": 4, "ep": 2})
+        set_global_mesh(mesh)
+        paddle.seed(0)
+
+        class TinyMoE(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.moe = MoELayer(d_model=16, num_experts=4, d_hidden=32,
+                                    topk=2)
+                self.head = nn.Linear(16, 8)
+
+            def forward(self, x):
+                return self.head(self.moe(x))
+
+        m = TinyMoE()
+        crit = nn.CrossEntropyLoss()
+        step, p, o = make_train_step(m, lambda lg, lb: crit(lg, lb), mesh,
+                                     lr=1e-3, batch_spec=(("dp",),))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16)),
+                        jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 8, (8,)))
+        l1, p, o = step(p, o, x, y)
+        l2, p, o = step(p, o, x, y)
+        assert float(l2) < float(l1)
+        set_global_mesh(None)
