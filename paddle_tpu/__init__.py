@@ -59,6 +59,7 @@ from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from .hapi.flops import flops  # noqa: F401
 import sys as _sys0
 # alias paddle_tpu.distributed (and every submodule) to paddle_tpu.parallel
 # so both import paths resolve to the SAME module objects

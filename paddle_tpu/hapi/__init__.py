@@ -5,3 +5,4 @@ from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
 )
 from .model import Model, summary  # noqa: F401
+from .flops import flops  # noqa: F401

@@ -1,0 +1,53 @@
+"""Model FLOPs counting (reference: python/paddle/hapi/dynamic_flops.py
+paddle.flops — per-layer hooks summing handwritten op formulas).
+
+TPU-native: ask the compiler. The forward is traced with jax.jit and XLA's
+cost analysis reports exact FLOPs/bytes for the optimized program — no
+per-op formula table to maintain.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+from ..core import tape as _tape
+
+__all__ = ["flops"]
+
+
+def flops(net, input_size: Optional[Sequence[int]] = None, inputs=None,
+          custom_ops=None, print_detail: bool = False):
+    """Return total FLOPs of one forward pass (reference: hapi
+    dynamic_flops.flops(net, input_size, print_detail))."""
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("provide input_size or inputs")
+        inputs = [Tensor(np.zeros(tuple(input_size), np.float32))]
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    params = dict(net.raw_state())
+
+    def fwd(p, *xs):
+        with _tape.no_grad():
+            out = net.func_call(p, *(Tensor(x) for x in xs),
+                                training=False)
+        return unwrap(out) if not isinstance(out, (tuple, list)) \
+            else tuple(unwrap(o) for o in out)
+
+    arrs = [unwrap(i) for i in inputs]
+    compiled = jax.jit(fwd).lower(params, *arrs).compile()
+    analyses = compiled.cost_analysis()
+    analysis = analyses[0] if isinstance(analyses, (list, tuple)) \
+        else analyses
+    total = int(analysis.get("flops", 0))
+    if print_detail:
+        n_params = sum(int(np.prod(v.shape)) for v in params.values())
+        print(f"Total Flops: {total}     Total Params: {n_params}")
+        for k in sorted(analysis):
+            if "flops" in k or "bytes" in k:
+                print(f"  {k}: {analysis[k]:.0f}")
+    return total

@@ -412,7 +412,7 @@ def flash_attention(q, k, v, causal: bool = False,
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 BlockSizes, flash_attention as _jax_fa)
 
-            bs = min(512, sq)
+            bs = min(1024, sq)
             blocks = BlockSizes(
                 block_q=bs, block_k_major=bs, block_k=bs, block_b=1,
                 block_q_major_dkv=bs, block_k_major_dkv=bs,

@@ -37,9 +37,30 @@ def _proc_tag() -> int:
 
 def save_state_dict(state_dict: Dict, path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    unique_id=None, async_save=False) -> None:
+                    unique_id=None, async_save=False):
     """Write one `{rank}.npz` per process + metadata.json of global offsets
-    (reference: save_state_dict.py:94)."""
+    (reference: save_state_dict.py:94). async_save=True fetches shards to
+    host synchronously (cheap) and writes files on a background thread
+    (the orbax-style async pattern); returns the Thread to join."""
+    if async_save:
+        import copy
+        import threading
+
+        # snapshot to HOST now: later train steps may donate (delete) the
+        # device buffers, and values must not see later updates
+        host_snapshot = {}
+        for name, t in state_dict.items():
+            arr = unwrap(t) if isinstance(t, Tensor) else t
+            host_snapshot[name] = np.asarray(jax.device_get(arr)) \
+                if isinstance(arr, jax.Array) else np.asarray(arr)
+
+        def _write():
+            save_state_dict(host_snapshot, path, process_group,
+                            coordinator_rank, unique_id, async_save=False)
+
+        th = threading.Thread(target=_write, daemon=True)
+        th.start()
+        return th
     os.makedirs(path, exist_ok=True)
     rank = _proc_tag()
     meta: Dict[str, dict] = {}

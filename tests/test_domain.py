@@ -248,3 +248,27 @@ class TestDistributedCheckpoint:
         tgt = {"m": paddle.to_tensor(np.zeros((4, 4), np.float32))}
         load_state_dict(tgt, str(tmp_path / "c2"))
         np.testing.assert_array_equal(tgt["m"].numpy(), sd["m"].numpy())
+
+
+class TestFlops:
+    def test_lenet_flops_exact_order(self):
+        from paddle_tpu.vision.models import LeNet
+
+        f = paddle.flops(LeNet(), input_size=(1, 1, 28, 28))
+        # hand count: conv1 ~84k + conv2 ~480k + fcs ~118k
+        assert 5e5 < f < 1e6
+
+
+class TestAsyncCheckpoint:
+    def test_snapshot_isolated_from_later_updates(self, tmp_path):
+        from paddle_tpu.parallel import load_state_dict, save_state_dict
+
+        sd = {"w": paddle.to_tensor(
+            np.random.randn(16, 16).astype(np.float32))}
+        orig = sd["w"].numpy().copy()
+        th = save_state_dict(sd, str(tmp_path / "ck"), async_save=True)
+        sd["w"]._array = sd["w"]._array * 0
+        th.join()
+        tgt = {"w": paddle.to_tensor(np.zeros((16, 16), np.float32))}
+        load_state_dict(tgt, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(tgt["w"].numpy(), orig)
