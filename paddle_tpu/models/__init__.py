@@ -11,6 +11,7 @@ from .llama import (  # noqa: F401
     llama_sharding_rules, shard_llama,
 )
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, shard_gpt  # noqa: F401
+from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
     ErnieConfig, ErnieForMaskedLM, ErnieForSequenceClassification,

@@ -1,0 +1,282 @@
+"""Stable-Diffusion-class conditional UNet (BASELINE config 5).
+
+Reference anchor: the reference's diffusion stack lives in PaddleMIX/ppdiffusers
+(UNet2DConditionModel); the in-repo hooks are the fused attention op family
+it rides (memory_efficient_attention, ops.yaml). Architecture follows the
+public SD-1.5 topology: ResBlocks with timestep injection + spatial
+transformers (self-attn over HW tokens, cross-attn to text context, GEGLU
+ff) at the lower resolutions.
+
+TPU-first: attention flattens NCHW -> [B, HW, heads, dim] and rides the
+flash kernel path (cross-attention uses sq != sk); convs are NCHW XLA convs
+on the MXU; GroupNorm in fp32.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    attention_resolutions: Tuple[int, ...] = (0, 1, 2)  # level indices
+    num_attention_heads: int = 8
+    cross_attention_dim: int = 768
+    norm_num_groups: int = 32
+    dtype: str = "float32"
+
+    @staticmethod
+    def sd15(**over):
+        return UNetConfig(**over)
+
+    @staticmethod
+    def tiny(**over):
+        return UNetConfig(block_out_channels=(32, 64), layers_per_block=1,
+                          attention_resolutions=(1,), num_attention_heads=2,
+                          cross_attention_dim=32, norm_num_groups=8, **over)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep embedding (public DDPM formulation)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = jnp.asarray(t, jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(groups, out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.skip = (nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch
+                     else nn.Identity())
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        t = self.time_emb_proj(F.silu(temb))
+        h = h + t.reshape([t.shape[0], -1, 1, 1])
+        h = self.conv2(F.silu(self.norm2(h)))
+        return self.skip(x) + h
+
+
+class CrossAttention(nn.Layer):
+    def __init__(self, query_dim, context_dim, heads, dim_head):
+        super().__init__()
+        inner = heads * dim_head
+        self.heads = heads
+        self.dim_head = dim_head
+        self.to_q = nn.Linear(query_dim, inner, bias_attr=False)
+        self.to_k = nn.Linear(context_dim, inner, bias_attr=False)
+        self.to_v = nn.Linear(context_dim, inner, bias_attr=False)
+        self.to_out = nn.Linear(inner, query_dim)
+
+    def forward(self, x, context=None):
+        ctx = x if context is None else context
+        b, sq, _ = x.shape
+        sk = ctx.shape[1]
+        q = self.to_q(x).reshape([b, sq, self.heads, self.dim_head])
+        k = self.to_k(ctx).reshape([b, sk, self.heads, self.dim_head])
+        v = self.to_v(ctx).reshape([b, sk, self.heads, self.dim_head])
+        out, _ = F.flash_attention(q, k, v, causal=False)
+        return self.to_out(out.reshape([b, sq, self.heads * self.dim_head]))
+
+
+class GEGLU(nn.Layer):
+    def __init__(self, dim_in, dim_out):
+        super().__init__()
+        self.proj = nn.Linear(dim_in, dim_out * 2)
+
+    def forward(self, x):
+        h = self.proj(x)
+        a, g = h.chunk(2, axis=-1)
+        return a * F.gelu(g)
+
+
+class TransformerBlock(nn.Layer):
+    def __init__(self, dim, context_dim, heads, dim_head):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, dim, heads, dim_head)       # self
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, context_dim, heads, dim_head)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = nn.Sequential(GEGLU(dim, dim * 4),
+                                nn.Linear(dim * 4, dim))
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        return x + self.ff(self.norm3(x))
+
+
+class SpatialTransformer(nn.Layer):
+    """NCHW -> tokens -> transformer block -> NCHW (SD topology)."""
+
+    def __init__(self, channels, context_dim, heads, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, channels)
+        self.proj_in = nn.Conv2D(channels, channels, 1)
+        self.block = TransformerBlock(channels, context_dim, heads,
+                                      channels // heads)
+        self.proj_out = nn.Conv2D(channels, channels, 1)
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        res = x
+        y = self.proj_in(self.norm(x))
+        tokens = y.reshape([b, c, h * w]).transpose([0, 2, 1])
+        tokens = self.block(tokens, context)
+        y = tokens.transpose([0, 2, 1]).reshape([b, c, h, w])
+        return res + self.proj_out(y)
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.op = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        y = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(y)
+
+
+class UNet2DConditionModel(nn.Layer):
+    """SD-1.5-class UNet: (latents [B,4,H,W], t [B], context [B,77,768])
+    -> noise prediction [B,4,H,W]."""
+
+    def __init__(self, config: Optional[UNetConfig] = None, **over):
+        super().__init__()
+        config = config or UNetConfig(**over)
+        self.config = config
+        chs = config.block_out_channels
+        temb_ch = chs[0] * 4
+        g = config.norm_num_groups
+        self.time_embed = nn.Sequential(nn.Linear(chs[0], temb_ch),
+                                        nn.Silu(),
+                                        nn.Linear(temb_ch, temb_ch))
+        self.conv_in = nn.Conv2D(config.in_channels, chs[0], 3, padding=1)
+
+        from ..nn.layer.container import LayerList
+
+        self.down_blocks = LayerList()
+        self.down_attns = LayerList()
+        self.downsamples = LayerList()
+        skip_chs = [chs[0]]
+        ch = chs[0]
+        for level, out_ch in enumerate(chs):
+            for _ in range(config.layers_per_block):
+                self.down_blocks.append(ResBlock(ch, out_ch, temb_ch, g))
+                ch = out_ch
+                self.down_attns.append(
+                    SpatialTransformer(ch, config.cross_attention_dim,
+                                       config.num_attention_heads, g)
+                    if level in config.attention_resolutions
+                    else nn.Identity())
+                skip_chs.append(ch)
+            if level != len(chs) - 1:
+                self.downsamples.append(Downsample(ch))
+                skip_chs.append(ch)
+            else:
+                self.downsamples.append(nn.Identity())
+
+        self.mid_block1 = ResBlock(ch, ch, temb_ch, g)
+        self.mid_attn = SpatialTransformer(ch, config.cross_attention_dim,
+                                           config.num_attention_heads, g)
+        self.mid_block2 = ResBlock(ch, ch, temb_ch, g)
+
+        self.up_blocks = LayerList()
+        self.up_attns = LayerList()
+        self.upsamples = LayerList()
+        for level, out_ch in reversed(list(enumerate(chs))):
+            for _ in range(config.layers_per_block + 1):
+                self.up_blocks.append(
+                    ResBlock(ch + skip_chs.pop(), out_ch, temb_ch, g))
+                ch = out_ch
+                self.up_attns.append(
+                    SpatialTransformer(ch, config.cross_attention_dim,
+                                       config.num_attention_heads, g)
+                    if level in config.attention_resolutions
+                    else nn.Identity())
+            if level != 0:
+                self.upsamples.append(Upsample(ch))
+            else:
+                self.upsamples.append(nn.Identity())
+
+        self.norm_out = nn.GroupNorm(g, ch)
+        self.conv_out = nn.Conv2D(ch, config.out_channels, 3, padding=1)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, sample, timesteps, encoder_hidden_states):
+        cfg = self.config
+        temb_raw = dispatch(
+            "timestep_embedding",
+            lambda t: timestep_embedding(t, cfg.block_out_channels[0]),
+            (timesteps,))
+        if self._dtype != jnp.float32:
+            temb_raw = Tensor(unwrap(temb_raw).astype(self._dtype))
+        temb = self.time_embed(temb_raw)
+
+        h = self.conv_in(sample)
+        skips = [h]
+        i = 0
+        for level in range(len(cfg.block_out_channels)):
+            for _ in range(cfg.layers_per_block):
+                h = self.down_blocks[i](h, temb)
+                h = self._apply_attn(self.down_attns[i], h,
+                                     encoder_hidden_states)
+                skips.append(h)
+                i += 1
+            h = self.downsamples[level](h)
+            if level != len(cfg.block_out_channels) - 1:
+                skips.append(h)
+
+        h = self.mid_block1(h, temb)
+        h = self.mid_attn(h, encoder_hidden_states)
+        h = self.mid_block2(h, temb)
+
+        i = 0
+        for level in reversed(range(len(cfg.block_out_channels))):
+            for _ in range(cfg.layers_per_block + 1):
+                from ..ops import manipulation as manip
+
+                h = manip.concat([h, skips.pop()], axis=1)
+                h = self.up_blocks[i](h, temb)
+                h = self._apply_attn(self.up_attns[i], h,
+                                     encoder_hidden_states)
+                i += 1
+            h = self.upsamples[len(cfg.block_out_channels) - 1 - level](h)
+
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+    @staticmethod
+    def _apply_attn(attn, h, context):
+        if isinstance(attn, SpatialTransformer):
+            return attn(h, context)
+        return attn(h)
