@@ -1,5 +1,6 @@
-"""Model zoo (reference: python/paddle/vision/models/__init__.py — 13
-families; inception/googlenet pending)."""
+"""Model zoo (reference: python/paddle/vision/models/__init__.py — the 13
+families: resnet, resnext, wide_resnet, vgg, alexnet, lenet, squeezenet,
+mobilenet v1/v2/v3, densenet, shufflenetv2, googlenet, inceptionv3)."""
 from .resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
     resnet101, resnet152, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
@@ -13,6 +14,12 @@ from .small import (  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, MobileNetV3Large, MobileNetV3Small,
     mobilenet_v1, mobilenet_v2, mobilenet_v3_large, mobilenet_v3_small,
+)
+from .inception import (  # noqa: F401
+    GoogLeNet, InceptionV3, googlenet, inception_v3,
+)
+from .ppyoloe import (  # noqa: F401
+    PPYOLOE, PPYOLOEConfig, PPYOLOELoss,
 )
 from .densenet import (  # noqa: F401
     DenseNet, ShuffleNetV2, densenet121, densenet161, densenet169,

@@ -1,0 +1,210 @@
+"""GoogLeNet + InceptionV3 (reference: python/paddle/vision/models/
+{googlenet.py, inceptionv3.py})."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as _manip
+
+
+def _cat(xs):
+    return _manip.concat(xs, axis=1)
+
+
+class _BN(nn.Sequential):
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_ch), nn.ReLU())
+
+
+# ------------------------------------------------------------- GoogLeNet
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _BN(in_ch, c1, 1)
+        self.b2 = nn.Sequential(_BN(in_ch, c3r, 1), _BN(c3r, c3, 3,
+                                                        padding=1))
+        self.b3 = nn.Sequential(_BN(in_ch, c5r, 1), _BN(c5r, c5, 5,
+                                                        padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                _BN(in_ch, proj, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)])
+
+
+class GoogLeNet(nn.Layer):
+    """reference: vision/models/googlenet.py (aux heads produce out1/out2
+    during training)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BN(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2,
+                                                             padding=1),
+            _BN(64, 64, 1), _BN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x)))))
+        x = self.pool4(x)
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return GoogLeNet(**kwargs)
+
+
+# ------------------------------------------------------------ InceptionV3
+class _InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1 = _BN(in_ch, 64, 1)
+        self.b5 = nn.Sequential(_BN(in_ch, 48, 1), _BN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BN(in_ch, 64, 1), _BN(64, 96, 3, padding=1),
+                                _BN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _BN(in_ch, pool_features, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _BN(in_ch, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_BN(in_ch, 64, 1), _BN(64, 96, 3,
+                                                        padding=1),
+                                 _BN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.b1 = _BN(in_ch, 192, 1)
+        self.b7 = nn.Sequential(
+            _BN(in_ch, c7, 1), _BN(c7, c7, (1, 7), padding=(0, 3)),
+            _BN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _BN(in_ch, c7, 1), _BN(c7, c7, (7, 1), padding=(3, 0)),
+            _BN(c7, c7, (1, 7), padding=(0, 3)),
+            _BN(c7, c7, (7, 1), padding=(3, 0)),
+            _BN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _BN(in_ch, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)])
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = nn.Sequential(_BN(in_ch, 192, 1), _BN(192, 320, 3,
+                                                        stride=2))
+        self.b7 = nn.Sequential(
+            _BN(in_ch, 192, 1), _BN(192, 192, (1, 7), padding=(0, 3)),
+            _BN(192, 192, (7, 1), padding=(3, 0)), _BN(192, 192, 3,
+                                                       stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _BN(in_ch, 320, 1)
+        self.b3_stem = _BN(in_ch, 384, 1)
+        self.b3_a = _BN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_BN(in_ch, 448, 1),
+                                      _BN(448, 384, 3, padding=1))
+        self.b3d_a = _BN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _BN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _BN(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b3_a(s), self.b3_b(s)]),
+                     _cat([self.b3d_a(d), self.b3d_b(d)]),
+                     self.bp(x)])
+
+
+class InceptionV3(nn.Layer):
+    """reference: vision/models/inceptionv3.py."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BN(3, 32, 3, stride=2), _BN(32, 32, 3), _BN(32, 64, 3,
+                                                         padding=1),
+            nn.MaxPool2D(3, 2), _BN(64, 80, 1), _BN(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,382 @@
+"""PP-YOLOE-class anchor-free detector (BASELINE config 4).
+
+Reference anchor: PP-YOLOE lives in PaddleDetection; the core-repo hooks it
+rides are the detection ops implemented here (nms, roi/deform ops in
+paddle_tpu.vision.ops). Topology follows the public PP-YOLOE description:
+CSPResNet backbone -> CSP-PAN neck -> decoupled ET-head with DFL regression
+over anchor-free points.
+
+Round-1 scope: full architecture fwd + DFL/IoU decode + NMS post-process +
+a training loss (varifocal cls + DFL + GIoU) with a center-prior assigner
+(the production TAL's task-aligned weighting simplified to its center/IoU
+core; documented deviation).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...nn import functional as F
+from ...ops import manipulation as _manip
+
+
+class ConvBNAct(nn.Sequential):
+    def __init__(self, in_ch, out_ch, k=3, stride=1, groups=1, act="swish"):
+        layers = [nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                            padding=(k - 1) // 2, groups=groups,
+                            bias_attr=False),
+                  nn.BatchNorm2D(out_ch)]
+        if act:
+            layers.append(nn.Swish() if act == "swish" else nn.ReLU())
+        super().__init__(*layers)
+
+
+class ESEAttn(nn.Layer):
+    """Effective squeeze-excitation (PP-YOLOE head attention)."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.fc = nn.Conv2D(ch, ch, 1)
+        self.conv = ConvBNAct(ch, ch, 1)
+
+    def forward(self, feat, avg_feat):
+        w = F.sigmoid(self.fc(avg_feat))
+        return self.conv(feat * w)
+
+
+class _CSPBlock(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = ConvBNAct(ch, ch, 3)
+        self.conv2 = ConvBNAct(ch, ch, 3)
+
+    def forward(self, x):
+        return x + self.conv2(self.conv1(x))
+
+
+class CSPStage(nn.Layer):
+    def __init__(self, in_ch, out_ch, n_blocks, stride=2):
+        super().__init__()
+        self.down = ConvBNAct(in_ch, out_ch, 3, stride=stride)
+        mid = out_ch // 2
+        self.split1 = ConvBNAct(out_ch, mid, 1)
+        self.split2 = ConvBNAct(out_ch, mid, 1)
+        self.blocks = nn.Sequential(*[_CSPBlock(mid)
+                                      for _ in range(n_blocks)])
+        self.merge = ConvBNAct(out_ch, out_ch, 1)
+
+    def forward(self, x):
+        x = self.down(x)
+        a = self.blocks(self.split1(x))
+        b = self.split2(x)
+        return self.merge(_manip.concat([a, b], axis=1))
+
+
+class CSPResNet(nn.Layer):
+    """Backbone: stem + 4 CSP stages; returns C3, C4, C5."""
+
+    def __init__(self, width=1.0, depth=1.0):
+        super().__init__()
+        chs = [int(c * width) for c in (64, 128, 256, 512, 1024)]
+        blocks = [max(1, round(b * depth)) for b in (3, 6, 6, 3)]
+        self.stem = nn.Sequential(ConvBNAct(3, chs[0] // 2, 3, stride=2),
+                                  ConvBNAct(chs[0] // 2, chs[0], 3,
+                                            stride=2))
+        self.stage1 = CSPStage(chs[0], chs[1], blocks[0])
+        self.stage2 = CSPStage(chs[1], chs[2], blocks[1])
+        self.stage3 = CSPStage(chs[2], chs[3], blocks[2])
+        self.stage4 = CSPStage(chs[3], chs[4], blocks[3])
+        self.out_channels = chs[2:]
+
+    def forward(self, x):
+        x = self.stem(x)
+        c2 = self.stage1(x)
+        c3 = self.stage2(c2)
+        c4 = self.stage3(c3)
+        c5 = self.stage4(c4)
+        return [c3, c4, c5]
+
+
+class CSPPAN(nn.Layer):
+    """Neck: top-down + bottom-up feature fusion at 3 levels."""
+
+    def __init__(self, in_chs, out_ch=None):
+        super().__init__()
+        out_ch = out_ch or in_chs[0]
+        self.reduce = nn.LayerList([ConvBNAct(c, out_ch, 1)
+                                    for c in in_chs])
+        self.td_blocks = nn.LayerList([CSPStage(out_ch * 2, out_ch, 1,
+                                                stride=1)
+                                       for _ in range(len(in_chs) - 1)])
+        self.bu_downs = nn.LayerList([ConvBNAct(out_ch, out_ch, 3, stride=2)
+                                      for _ in range(len(in_chs) - 1)])
+        self.bu_blocks = nn.LayerList([CSPStage(out_ch * 2, out_ch, 1,
+                                                stride=1)
+                                       for _ in range(len(in_chs) - 1)])
+        self.out_channels = [out_ch] * len(in_chs)
+
+    def forward(self, feats):
+        feats = [r(f) for r, f in zip(self.reduce, feats)]
+        # top-down
+        td = [feats[-1]]
+        for i in range(len(feats) - 2, -1, -1):
+            up = F.interpolate(td[0], scale_factor=2, mode="nearest")
+            td.insert(0, self.td_blocks[i](
+                _manip.concat([feats[i], up], axis=1)))
+        # bottom-up
+        outs = [td[0]]
+        for i in range(len(feats) - 1):
+            down = self.bu_downs[i](outs[-1])
+            outs.append(self.bu_blocks[i](
+                _manip.concat([td[i + 1], down], axis=1)))
+        return outs
+
+
+class PPYOLOEHead(nn.Layer):
+    """Decoupled ET-head: per-level cls logits [B,C,H,W] and DFL regression
+    [B, 4*(reg_max+1), H, W] over anchor-free center points."""
+
+    def __init__(self, in_ch, num_classes=80, reg_max=16):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.stem_cls = ESEAttn(in_ch)
+        self.stem_reg = ESEAttn(in_ch)
+        self.pred_cls = nn.Conv2D(in_ch, num_classes, 3, padding=1)
+        self.pred_reg = nn.Conv2D(in_ch, 4 * (reg_max + 1), 3, padding=1)
+
+    def forward(self, feat):
+        avg = F.adaptive_avg_pool2d(feat, 1)
+        cls_logit = self.pred_cls(self.stem_cls(feat, avg))
+        reg_dist = self.pred_reg(self.stem_reg(feat, avg))
+        return cls_logit, reg_dist
+
+
+@dataclasses.dataclass
+class PPYOLOEConfig:
+    num_classes: int = 80
+    width: float = 1.0     # "l" scale
+    depth: float = 1.0
+    reg_max: int = 16
+    strides: Tuple[int, ...] = (8, 16, 32)
+
+    @staticmethod
+    def ppyoloe_l(**over):
+        return PPYOLOEConfig(**over)
+
+    @staticmethod
+    def tiny(**over):
+        return PPYOLOEConfig(num_classes=4, width=0.125, depth=0.33, **over)
+
+
+class PPYOLOE(nn.Layer):
+    def __init__(self, config: Optional[PPYOLOEConfig] = None, **over):
+        super().__init__()
+        config = config or PPYOLOEConfig(**over)
+        self.config = config
+        self.backbone = CSPResNet(config.width, config.depth)
+        self.neck = CSPPAN(self.backbone.out_channels)
+        ch = self.neck.out_channels[0]
+        self.heads = nn.LayerList([
+            PPYOLOEHead(ch, config.num_classes, config.reg_max)
+            for _ in config.strides])
+
+    def forward(self, x):
+        feats = self.neck(self.backbone(x))
+        return [h(f) for h, f in zip(self.heads, feats)]
+
+    # --------------------------------------------------------------
+    def _flatten_outputs(self, outputs):
+        """-> cls [B, A, C] logits, dist [B, A, 4*(m+1)], centers [A, 2],
+        strides [A]."""
+        cls_all, reg_all, centers, strides = [], [], [], []
+        for (cls, reg), s in zip(outputs, self.config.strides):
+            b, c, h, w = cls.shape
+            cls_all.append(cls.reshape([b, c, h * w]).transpose([0, 2, 1]))
+            rm = reg.shape[1]
+            reg_all.append(reg.reshape([b, rm, h * w]).transpose([0, 2, 1]))
+            ys = (jnp.arange(h) + 0.5) * s
+            xs = (jnp.arange(w) + 0.5) * s
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            centers.append(jnp.stack([gx.reshape(-1), gy.reshape(-1)], -1))
+            strides.append(jnp.full((h * w,), s, jnp.float32))
+        cls_cat = _manip.concat(cls_all, axis=1)
+        reg_cat = _manip.concat(reg_all, axis=1)
+        return cls_cat, reg_cat, jnp.concatenate(centers), \
+            jnp.concatenate(strides)
+
+    def _decode_boxes(self, dist_arr, centers, strides):
+        """DFL expectation -> ltrb distances -> xyxy boxes (jnp arrays)."""
+        m = self.config.reg_max
+        b, a, _ = dist_arr.shape
+        logits = dist_arr.reshape(b, a, 4, m + 1)
+        proj = jnp.arange(m + 1, dtype=jnp.float32)
+        ltrb = (jax.nn.softmax(logits, -1) * proj).sum(-1) \
+            * strides[None, :, None]
+        x1 = centers[None, :, 0] - ltrb[..., 0]
+        y1 = centers[None, :, 1] - ltrb[..., 1]
+        x2 = centers[None, :, 0] + ltrb[..., 2]
+        y2 = centers[None, :, 1] + ltrb[..., 3]
+        return jnp.stack([x1, y1, x2, y2], -1)
+
+    def predict(self, x, score_threshold=0.05, nms_threshold=0.6,
+                top_k=100):
+        """Inference: decode + class-aware NMS (vision.ops.nms)."""
+        from ...core import tape as _tape
+        from ..ops import nms
+
+        self.eval()
+        with _tape.no_grad():
+            outputs = self(x)
+            cls_cat, reg_cat, centers, strides = self._flatten_outputs(
+                outputs)
+            scores = jax.nn.sigmoid(unwrap(cls_cat))
+            boxes = self._decode_boxes(unwrap(reg_cat), centers, strides)
+        results = []
+        for b in range(scores.shape[0]):
+            conf = scores[b].max(-1)
+            labels = scores[b].argmax(-1)
+            keep_mask = conf > score_threshold
+            idx = jnp.where(keep_mask)[0]
+            if idx.size == 0:
+                results.append({"boxes": jnp.zeros((0, 4)),
+                                "scores": jnp.zeros((0,)),
+                                "labels": jnp.zeros((0,), jnp.int32)})
+                continue
+            kept = nms(Tensor(boxes[b][idx]), nms_threshold,
+                       Tensor(conf[idx]), category_idxs=Tensor(labels[idx]),
+                       top_k=top_k)
+            sel = idx[unwrap(kept)]
+            results.append({"boxes": boxes[b][sel], "scores": conf[sel],
+                            "labels": labels[sel].astype(jnp.int32)})
+        return results
+
+
+def _giou(b1, b2):
+    """boxes xyxy [..., 4] -> GIoU [...]. Public formulation."""
+    x1 = jnp.maximum(b1[..., 0], b2[..., 0])
+    y1 = jnp.maximum(b1[..., 1], b2[..., 1])
+    x2 = jnp.minimum(b1[..., 2], b2[..., 2])
+    y2 = jnp.minimum(b1[..., 3], b2[..., 3])
+    inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+    a1 = (b1[..., 2] - b1[..., 0]) * (b1[..., 3] - b1[..., 1])
+    a2 = (b2[..., 2] - b2[..., 0]) * (b2[..., 3] - b2[..., 1])
+    union = a1 + a2 - inter
+    iou = inter / jnp.maximum(union, 1e-9)
+    cx1 = jnp.minimum(b1[..., 0], b2[..., 0])
+    cy1 = jnp.minimum(b1[..., 1], b2[..., 1])
+    cx2 = jnp.maximum(b1[..., 2], b2[..., 2])
+    cy2 = jnp.maximum(b1[..., 3], b2[..., 3])
+    carea = jnp.maximum((cx2 - cx1) * (cy2 - cy1), 1e-9)
+    return iou - (carea - union) / carea
+
+
+class PPYOLOELoss(nn.Layer):
+    """Varifocal cls + GIoU box + DFL losses with a center-prior assigner:
+    an anchor point is positive for the gt box whose center cell contains
+    it (ties -> smallest box). Deviation from production TAL noted in the
+    module docstring."""
+
+    def __init__(self, model: PPYOLOE, cls_weight=1.0, iou_weight=2.5,
+                 dfl_weight=0.5):
+        super().__init__()
+        self.model = model
+        self.w = (cls_weight, iou_weight, dfl_weight)
+
+    def forward(self, outputs, gt_boxes, gt_labels):
+        """gt_boxes: [B, G, 4] xyxy (padded with zeros); gt_labels: [B, G]
+        (-1 padding)."""
+        cfg = self.model.config
+        m = cfg.reg_max
+
+        def impl(*arrs):
+            n_levels = len(cfg.strides)
+            cls_list = arrs[:n_levels]
+            reg_list = arrs[n_levels:2 * n_levels]
+            gtb, gtl = arrs[2 * n_levels], arrs[2 * n_levels + 1]
+            # flatten
+            cls_cat, reg_cat, centers, strides = [], [], [], []
+            for cls, reg, s in zip(cls_list, reg_list, cfg.strides):
+                b, c, h, w = cls.shape
+                cls_cat.append(cls.reshape(b, c, h * w).transpose(0, 2, 1))
+                reg_cat.append(reg.reshape(b, reg.shape[1], h * w)
+                               .transpose(0, 2, 1))
+                ys = (jnp.arange(h) + 0.5) * s
+                xs = (jnp.arange(w) + 0.5) * s
+                gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+                centers.append(jnp.stack([gx.reshape(-1), gy.reshape(-1)],
+                                         -1))
+                strides.append(jnp.full((h * w,), s, jnp.float32))
+            cls_cat = jnp.concatenate(cls_cat, 1)      # [B, A, C]
+            reg_cat = jnp.concatenate(reg_cat, 1)
+            centers = jnp.concatenate(centers)
+            strides = jnp.concatenate(strides)
+            boxes = self.model._decode_boxes(reg_cat, centers, strides)
+
+            # assign: point inside gt box -> candidate; pick smallest box
+            valid = gtl >= 0                            # [B, G]
+            cx = centers[None, :, None, 0]
+            cy = centers[None, :, None, 1]
+            inside = ((cx >= gtb[:, None, :, 0]) & (cx <= gtb[:, None, :, 2])
+                      & (cy >= gtb[:, None, :, 1])
+                      & (cy <= gtb[:, None, :, 3])
+                      & valid[:, None, :])              # [B, A, G]
+            area = ((gtb[..., 2] - gtb[..., 0])
+                    * (gtb[..., 3] - gtb[..., 1]))[:, None]  # [B, 1, G]
+            area = jnp.where(inside, area, jnp.inf)
+            gt_idx = jnp.argmin(area, -1)               # [B, A]
+            pos = jnp.isfinite(jnp.min(area, -1))       # [B, A]
+
+            tgt_box = jnp.take_along_axis(
+                gtb, gt_idx[..., None].repeat(4, -1), 1)  # [B, A, 4]
+            tgt_lab = jnp.take_along_axis(gtl, gt_idx, 1)  # [B, A]
+            iou = jnp.clip(_giou(boxes, tgt_box), 0.0)
+
+            # varifocal: target = iou for positives (class-aligned)
+            c = cls_cat.shape[-1]
+            onehot = jax.nn.one_hot(jnp.clip(tgt_lab, 0), c)
+            q = jnp.where(pos[..., None], onehot * iou[..., None], 0.0)
+            p = jax.nn.sigmoid(cls_cat)
+            weight = jnp.where(q > 0, q, 0.75 * p ** 2)
+            bce = -(q * jax.nn.log_sigmoid(cls_cat)
+                    + (1 - q) * jax.nn.log_sigmoid(-cls_cat))
+            n_pos = jnp.maximum(pos.sum(), 1.0)
+            loss_cls = (weight * bce).sum() / n_pos
+
+            loss_iou = (jnp.where(pos, 1.0 - _giou(boxes, tgt_box), 0.0)
+                        .sum() / n_pos)
+
+            # DFL: distribution CE to the fractional ltrb target
+            ltrb_t = jnp.stack(
+                [centers[None, :, 0] - tgt_box[..., 0],
+                 centers[None, :, 1] - tgt_box[..., 1],
+                 tgt_box[..., 2] - centers[None, :, 0],
+                 tgt_box[..., 3] - centers[None, :, 1]], -1)
+            ltrb_t = jnp.clip(ltrb_t / strides[None, :, None], 0, m - 0.01)
+            lo = jnp.floor(ltrb_t).astype(jnp.int32)
+            hi = lo + 1
+            wl = hi.astype(jnp.float32) - ltrb_t
+            logp = jax.nn.log_softmax(
+                reg_cat.reshape(*reg_cat.shape[:2], 4, m + 1), -1)
+            ce = -(wl * jnp.take_along_axis(logp, lo[..., None], -1)[..., 0]
+                   + (1 - wl) * jnp.take_along_axis(
+                       logp, hi[..., None], -1)[..., 0])
+            loss_dfl = (jnp.where(pos[..., None], ce, 0.0).sum()
+                        / (n_pos * 4))
+
+            cw, iw, dw = self.w
+            return cw * loss_cls + iw * loss_iou + dw * loss_dfl
+
+        flat = []
+        for cls, reg in outputs:
+            flat.append(cls)
+        for cls, reg in outputs:
+            flat.append(reg)
+        return dispatch("ppyoloe_loss", impl,
+                        tuple(flat) + (gt_boxes, gt_labels))

@@ -272,3 +272,46 @@ class TestAsyncCheckpoint:
         tgt = {"w": paddle.to_tensor(np.zeros((16, 16), np.float32))}
         load_state_dict(tgt, str(tmp_path / "ck"))
         np.testing.assert_array_equal(tgt["w"].numpy(), orig)
+
+
+class TestMoreVisionFamilies:
+    def test_googlenet_inception_forward(self):
+        from paddle_tpu.vision.models import googlenet, inception_v3
+
+        g = googlenet(num_classes=10)
+        g.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 96, 96).astype(np.float32))
+        assert g(x).shape == [1, 10]
+        iv = inception_v3(num_classes=10)
+        iv.eval()
+        x2 = paddle.to_tensor(
+            np.random.randn(1, 3, 299, 299).astype(np.float32))
+        assert iv(x2).shape == [1, 10]
+
+
+class TestPPYOLOE:
+    def test_train_and_predict(self):
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.vision.models import (PPYOLOE, PPYOLOEConfig,
+                                              PPYOLOELoss)
+
+        paddle.seed(0)
+        m = PPYOLOE(PPYOLOEConfig.tiny())
+        crit = PPYOLOELoss(m)
+        x = paddle.to_tensor(np.random.randn(2, 3, 64, 64).astype(np.float32))
+        gtb = paddle.to_tensor(np.array(
+            [[[4, 4, 30, 30], [32, 32, 60, 60]],
+             [[10, 10, 50, 50], [0, 0, 0, 0]]], np.float32))
+        gtl = paddle.to_tensor(np.array([[0, 2], [1, -1]], np.int32))
+        o = popt.Adam(learning_rate=1e-3, parameters=m.parameters())
+        losses = []
+        for _ in range(3):
+            loss = crit(m(x), gtb, gtl)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        res = m.predict(x, score_threshold=0.0, top_k=10)
+        assert res[0]["boxes"].shape[-1] == 4
+        assert len(res) == 2
