@@ -58,6 +58,56 @@ from . import text  # noqa: F401
 from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+
+# public-surface aliases (reference top-level __all__ parity)
+from .nn.initializer import ParamAttr  # noqa: F401
+from .autograd import grad  # noqa: F401
+from .framework import get_rng_state as get_cuda_rng_state  # noqa: F401
+from .framework import set_rng_state as set_cuda_rng_state  # noqa: F401
+bool = bool_  # noqa: F821  (paddle.bool dtype alias)
+dtype = __import__("numpy").dtype
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: paddle.create_parameter (static nn helper)."""
+    from .nn.initializer import Constant, XavierNormal, _resolve_param_attr
+
+    attr = _resolve_param_attr(attr)
+    init = (attr.initializer if attr and attr.initializer else
+            default_initializer or (Constant(0.0) if is_bias
+                                    else XavierNormal()))
+    arr = init(tuple(int(s) for s in shape),
+               __import__("numpy").dtype(dtype))
+    return Parameter(arr, dtype=dtype, name=name or (attr.name if attr
+                                                     else None))
+
+
+class LazyGuard:
+    """reference: paddle.LazyGuard — delayed parameter initialization.
+    Eager TPU init is cheap (arrays materialize lazily in XLA), so this is
+    a no-op context for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: paddle.batch (legacy reader decorator)."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return gen
 from .hapi import Model, summary  # noqa: F401
 from .hapi.flops import flops  # noqa: F401
 import sys as _sys0

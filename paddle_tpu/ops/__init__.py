@@ -12,17 +12,26 @@ import builtins
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, dispatch, unwrap
-from . import creation, extras, linalg, logic, manipulation, math, search
+from . import (creation, extras, linalg, logic, manipulation, math,
+               public_extras, search)
 from .registry import OPS, OpDef, get_op, register_op
 
-_MODULES = (math, manipulation, creation, linalg, logic, search, extras)
+_MODULES = (math, manipulation, creation, linalg, logic, search, extras,
+            public_extras)
 
 # hoist all ops into this namespace
 for _mod in _MODULES:
     for _name in _mod.__all__:
         globals()[_name] = getattr(_mod, _name)
 
-__all__ = sorted({n for m in _MODULES for n in m.__all__})
+# generated in-place variants (<name>_) over everything hoisted so far
+from . import inplace as _inplace_mod
+
+_generated_inplace = _inplace_mod.generate(globals())
+globals().update(_generated_inplace)
+
+__all__ = sorted({n for m in _MODULES for n in m.__all__}
+                 | set(_generated_inplace))
 
 
 # ---------------------------------------------------------------------------
@@ -80,6 +89,12 @@ def _patch_methods():
         if fn is None or hasattr(Tensor, name):
             continue
         setattr(Tensor, name, fn)
+    # in-place variants become methods too (x.cos_(), x.bernoulli_())
+    for name in list(_generated_inplace) + [
+            n for n in __all__ if n.endswith("_") and not n.startswith("_")]:
+        fn = ns.get(name)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
 
     # determinant lives at paddle.linalg.det but Tensor.det exists too
     Tensor.det = ns["det"]

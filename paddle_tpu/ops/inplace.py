@@ -1,0 +1,60 @@
+"""Auto-generated in-place op variants (`add_`, `cos_`, ...).
+
+Reference: the eager codegen emits an inplace ad_func per op flagged
+`inplace` in ops.yaml. Here every variant is out-of-place compute + buffer
+swap on the input Tensor (mutation = array replacement; core/tensor.py),
+generated from the base functions at import time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..core.tensor import Tensor
+
+# base-op name -> generated "<name>_" in-place form. Only ops whose first
+# argument shape/dtype is preserved qualify.
+_INPLACE_BASES = [
+    # NOTE: bernoulli_ is hand-written (paddle's bernoulli_(x, p) draws with
+    # probability p — NOT the out-of-place bernoulli(x) signature)
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "ceil", "clip", "copysign",
+    "cos", "cosh", "cumprod", "cumsum", "digamma", "divide", "equal", "erf",
+    "erfinv", "exp", "expm1", "fill", "flatten", "floor", "floor_divide",
+    "floor_mod", "frac", "gammainc", "gammaincc", "gammaln", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "lcm", "ldexp",
+    "less_equal", "less_than", "lerp", "lgamma", "log", "log10", "log1p",
+    "log2", "logical_and", "logical_not", "logical_or", "logical_xor",
+    "logit", "masked_fill", "masked_scatter", "mod", "multigammaln",
+    "multiply", "nan_to_num", "neg", "polygamma", "pow", "reciprocal",
+    "remainder", "renorm", "round", "rsqrt", "scale", "sigmoid", "sign",
+    "sin", "sinc", "sinh", "sqrt", "square", "squeeze", "subtract", "t",
+    "tan", "tanh", "tril", "triu", "trunc", "unsqueeze", "uniform",
+    "where", "transpose", "addmm",
+]
+
+
+def _make_inplace(base: Callable, name: str):
+    def op(x, *args, **kwargs):
+        out = base(x, *args, **kwargs)
+        x._replace(out._array, out._node, out._out_idx)
+        return x
+
+    op.__name__ = name
+    op.__doc__ = f"In-place variant of `{name[:-1]}` (buffer swap)."
+    return op
+
+
+def generate(namespace: Dict) -> Dict[str, Callable]:
+    """Build `<name>_` for every base present in `namespace`; returns the
+    new functions (also usable as Tensor methods)."""
+    out = {}
+    for base_name in _INPLACE_BASES:
+        base = namespace.get(base_name)
+        if base is None:
+            continue
+        iname = base_name + "_"
+        if iname in namespace:  # hand-written variant wins
+            continue
+        out[iname] = _make_inplace(base, iname)
+    return out

@@ -1,0 +1,252 @@
+"""Public-surface completion ops (audit vs the reference's top-level
+`paddle.*` __all__): add_n, block_diag, cdist/pdist, *_scatter,
+d/h/vsplit, frexp, multigammaln, take, unflatten, reduce_as, sgn,
+log_normal, printoptions."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..framework import random as _random
+
+__all__ = ["add_n", "bernoulli_", "block_diag", "cartesian_prod", "cdist",
+           "pdist", "diagonal_scatter", "select_scatter", "slice_scatter",
+           "dsplit", "hsplit", "vsplit", "frexp", "multigammaln",
+           "log_normal", "sgn", "take", "unflatten", "reduce_as",
+           "set_printoptions", "check_shape", "tolist"]
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place: fill x with bernoulli(p) draws (reference:
+    paddle.Tensor.bernoulli_(p))."""
+    key = _random.next_key()
+    draws = (jax.random.uniform(key, tuple(x.shape)) < p).astype(
+        unwrap(x).dtype)
+    x._replace(draws)
+    return x
+
+
+def add_n(inputs, name=None):
+    """reference: paddle.add_n — elementwise sum of a tensor list."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return dispatch("add_n", lambda *xs: sum(xs[1:], xs[0]), tuple(inputs))
+
+
+def block_diag(inputs, name=None):
+    def impl(*xs):
+        xs = [x if x.ndim == 2 else x.reshape(1, -1) for x in xs]
+        rows = sum(x.shape[0] for x in xs)
+        cols = sum(x.shape[1] for x in xs)
+        out = jnp.zeros((rows, cols), xs[0].dtype)
+        r = c = 0
+        for x in xs:
+            out = out.at[r:r + x.shape[0], c:c + x.shape[1]].set(x)
+            r += x.shape[0]
+            c += x.shape[1]
+        return out
+
+    return dispatch("block_diag", impl, tuple(inputs))
+
+
+def cartesian_prod(x, name=None):
+    def impl(*xs):
+        grids = jnp.meshgrid(*xs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return dispatch("cartesian_prod", impl, tuple(x))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """reference: paddle.cdist — pairwise p-distance [..., M, N]."""
+    def impl(a, b):
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        if p == float("inf"):
+            return diff.max(-1)
+        return (diff ** p).sum(-1) ** (1.0 / p)
+
+    return dispatch("cdist", impl, (x, y))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (reference: paddle.pdist)."""
+    def impl(a):
+        n = a.shape[0]
+        full = jnp.abs(a[:, None, :] - a[None, :, :])
+        if p == float("inf"):
+            d = full.max(-1)
+        else:
+            d = (full ** p).sum(-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return dispatch("pdist", impl, (x,))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def impl(a, b):
+        a_m = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        h, w = a_m.shape[-2:]
+        n = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+        rows = jnp.arange(n) + max(-offset, 0)
+        cols = jnp.arange(n) + max(offset, 0)
+        a_m = a_m.at[..., rows, cols].set(b)
+        return jnp.moveaxis(a_m, (-2, -1), (axis1, axis2))
+
+    return dispatch("diagonal_scatter", impl, (x, y))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def impl(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+
+    return dispatch("select_scatter", impl, (x, values))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def impl(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sd)
+        return a.at[tuple(idx)].set(v)
+
+    return dispatch("slice_scatter", impl, (x, value))
+
+
+def _split_along(x, num_or_sections, axis):
+    def impl(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = np.cumsum(num_or_sections)[:-1].tolist()
+        return tuple(jnp.split(a, secs, axis=axis))
+
+    out = dispatch(f"split_axis{axis}", impl, (x,))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def hsplit(x, num_or_indices, name=None):
+    return _split_along(x, num_or_indices, 1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_along(x, num_or_indices, 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_along(x, num_or_indices, 2)
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = m * 2**e, 0.5 <= |m| < 1."""
+    def impl(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return dispatch("frexp", impl, (x,))
+
+
+def multigammaln(x, p, name=None):
+    """log multivariate gamma (reference: paddle.multigammaln)."""
+    def impl(a):
+        j = jnp.arange(1, p + 1, dtype=jnp.float32)
+        return (p * (p - 1) / 4.0 * np.log(np.pi)
+                + jax.scipy.special.gammaln(
+                    a[..., None] + (1 - j) / 2).sum(-1))
+
+    return dispatch("multigammaln", impl, (x,))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """Sample exp(N(mean, std)) (reference: paddle.log_normal)."""
+    key = _random.next_key()
+    shape = tuple(shape or [1])
+    z = jax.random.normal(key, shape) * std + mean
+    return Tensor(jnp.exp(z).astype(dtype or "float32"))
+
+
+def sgn(x, name=None):
+    """Complex-aware sign (reference: paddle.sgn)."""
+    def impl(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-38))
+        return jnp.sign(a)
+
+    return dispatch("sgn", impl, (x,))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather with wrap/clip modes (reference: paddle.take)."""
+    def impl(a, idx):
+        flat = a.reshape(-1)
+        i = idx.astype(jnp.int32)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        elif mode == "clip":
+            # reference clamps to [0, n-1]: negatives select the FIRST
+            # element (python/paddle/tensor/math.py take)
+            i = jnp.clip(i, 0, n - 1)
+        i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return dispatch("take", impl, (x, index))
+
+
+def unflatten(x, axis, shape, name=None):
+    def impl(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(new)
+
+    return dispatch("unflatten", impl, (x,))
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference: paddle.reduce_as)."""
+    def impl(a, t):
+        extra = a.ndim - t.ndim
+        if extra:
+            a = a.sum(axis=tuple(range(extra)))
+        axes = tuple(i for i in range(a.ndim)
+                     if t.shape[i] == 1 and a.shape[i] != 1)
+        if axes:
+            a = a.sum(axis=axes, keepdims=True)
+        return a
+
+    return dispatch("reduce_as", impl, (x, target))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: paddle.set_printoptions — maps onto numpy's."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(x, name=None):
+    """Static-graph shape assertion helper (eager: returns the shape)."""
+    return list(x.shape)
+
+
+def tolist(x):
+    return unwrap(x).tolist()

@@ -34,9 +34,28 @@ class _Inception(nn.Layer):
         return _cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)])
 
 
+class _AuxHead(nn.Layer):
+    """GoogLeNet auxiliary classifier (reference: googlenet.py out1/out2)."""
+
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _BN(in_ch, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = nn.Flatten(1)(x)
+        x = self.dropout(self.relu(self.fc1(x)))
+        return self.fc2(x)
+
+
 class GoogLeNet(nn.Layer):
-    """reference: vision/models/googlenet.py (aux heads produce out1/out2
-    during training)."""
+    """reference: vision/models/googlenet.py — forward returns
+    (out, out1, out2): the main head plus two auxiliary classifier heads."""
 
     def __init__(self, num_classes=1000, with_pool=True):
         super().__init__()
@@ -63,18 +82,24 @@ class GoogLeNet(nn.Layer):
         if num_classes > 0:
             self.dropout = nn.Dropout(0.2)
             self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
 
     def forward(self, x):
         x = self.stem(x)
         x = self.pool3(self.i3b(self.i3a(x)))
-        x = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x)))))
-        x = self.pool4(x)
+        x = self.i4a(x)
+        out1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        out2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
         x = self.i5b(self.i5a(x))
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
             x = nn.Flatten(1)(x)
-            x = self.fc(self.dropout(x))
+            out = self.fc(self.dropout(x))
+            return out, out1, out2
         return x
 
 

@@ -158,6 +158,25 @@ class PPYOLOEHead(nn.Layer):
         return cls_logit, reg_dist
 
 
+def _flatten_levels(cls_arrs, reg_arrs, level_strides):
+    """Array-level flatten shared by inference decode and the training loss:
+    per-level [B,C,H,W] maps -> cls [B,A,C], reg [B,A,4*(m+1)],
+    anchor centers [A,2], per-anchor strides [A]."""
+    cls_all, reg_all, centers, strides = [], [], [], []
+    for cls, reg, s in zip(cls_arrs, reg_arrs, level_strides):
+        b, c, h, w = cls.shape
+        cls_all.append(cls.reshape(b, c, h * w).transpose(0, 2, 1))
+        reg_all.append(reg.reshape(b, reg.shape[1], h * w)
+                       .transpose(0, 2, 1))
+        ys = (jnp.arange(h) + 0.5) * s
+        xs = (jnp.arange(w) + 0.5) * s
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        centers.append(jnp.stack([gx.reshape(-1), gy.reshape(-1)], -1))
+        strides.append(jnp.full((h * w,), s, jnp.float32))
+    return (jnp.concatenate(cls_all, 1), jnp.concatenate(reg_all, 1),
+            jnp.concatenate(centers), jnp.concatenate(strides))
+
+
 @dataclasses.dataclass
 class PPYOLOEConfig:
     num_classes: int = 80
@@ -194,22 +213,10 @@ class PPYOLOE(nn.Layer):
     # --------------------------------------------------------------
     def _flatten_outputs(self, outputs):
         """-> cls [B, A, C] logits, dist [B, A, 4*(m+1)], centers [A, 2],
-        strides [A]."""
-        cls_all, reg_all, centers, strides = [], [], [], []
-        for (cls, reg), s in zip(outputs, self.config.strides):
-            b, c, h, w = cls.shape
-            cls_all.append(cls.reshape([b, c, h * w]).transpose([0, 2, 1]))
-            rm = reg.shape[1]
-            reg_all.append(reg.reshape([b, rm, h * w]).transpose([0, 2, 1]))
-            ys = (jnp.arange(h) + 0.5) * s
-            xs = (jnp.arange(w) + 0.5) * s
-            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-            centers.append(jnp.stack([gx.reshape(-1), gy.reshape(-1)], -1))
-            strides.append(jnp.full((h * w,), s, jnp.float32))
-        cls_cat = _manip.concat(cls_all, axis=1)
-        reg_cat = _manip.concat(reg_all, axis=1)
-        return cls_cat, reg_cat, jnp.concatenate(centers), \
-            jnp.concatenate(strides)
+        strides [A] (jnp arrays; shared helper with the loss)."""
+        return _flatten_levels([unwrap(c) for c, _ in outputs],
+                               [unwrap(r) for _, r in outputs],
+                               self.config.strides)
 
     def _decode_boxes(self, dist_arr, centers, strides):
         """DFL expectation -> ltrb distances -> xyxy boxes (jnp arrays)."""
@@ -231,13 +238,16 @@ class PPYOLOE(nn.Layer):
         from ...core import tape as _tape
         from ..ops import nms
 
+        was_training = self.training
         self.eval()
         with _tape.no_grad():
             outputs = self(x)
             cls_cat, reg_cat, centers, strides = self._flatten_outputs(
                 outputs)
-            scores = jax.nn.sigmoid(unwrap(cls_cat))
-            boxes = self._decode_boxes(unwrap(reg_cat), centers, strides)
+            scores = jax.nn.sigmoid(cls_cat)
+            boxes = self._decode_boxes(reg_cat, centers, strides)
+        if was_training:
+            self.train()
         results = []
         for b in range(scores.shape[0]):
             conf = scores[b].max(-1)
@@ -300,23 +310,8 @@ class PPYOLOELoss(nn.Layer):
             cls_list = arrs[:n_levels]
             reg_list = arrs[n_levels:2 * n_levels]
             gtb, gtl = arrs[2 * n_levels], arrs[2 * n_levels + 1]
-            # flatten
-            cls_cat, reg_cat, centers, strides = [], [], [], []
-            for cls, reg, s in zip(cls_list, reg_list, cfg.strides):
-                b, c, h, w = cls.shape
-                cls_cat.append(cls.reshape(b, c, h * w).transpose(0, 2, 1))
-                reg_cat.append(reg.reshape(b, reg.shape[1], h * w)
-                               .transpose(0, 2, 1))
-                ys = (jnp.arange(h) + 0.5) * s
-                xs = (jnp.arange(w) + 0.5) * s
-                gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-                centers.append(jnp.stack([gx.reshape(-1), gy.reshape(-1)],
-                                         -1))
-                strides.append(jnp.full((h * w,), s, jnp.float32))
-            cls_cat = jnp.concatenate(cls_cat, 1)      # [B, A, C]
-            reg_cat = jnp.concatenate(reg_cat, 1)
-            centers = jnp.concatenate(centers)
-            strides = jnp.concatenate(strides)
+            cls_cat, reg_cat, centers, strides = _flatten_levels(
+                cls_list, reg_list, cfg.strides)      # [B,A,C] / [B,A,4m]
             boxes = self.model._decode_boxes(reg_cat, centers, strides)
 
             # assign: point inside gt box -> candidate; pick smallest box

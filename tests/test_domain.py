@@ -281,7 +281,9 @@ class TestMoreVisionFamilies:
         g = googlenet(num_classes=10)
         g.eval()
         x = paddle.to_tensor(np.random.randn(1, 3, 96, 96).astype(np.float32))
-        assert g(x).shape == [1, 10]
+        out, out1, out2 = g(x)  # main + two aux heads (reference contract)
+        assert out.shape == [1, 10]
+        assert out1.shape == [1, 10] and out2.shape == [1, 10]
         iv = inception_v3(num_classes=10)
         iv.eval()
         x2 = paddle.to_tensor(

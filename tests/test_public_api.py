@@ -1,0 +1,104 @@
+"""Public API surface parity: every name in the reference's top-level
+`paddle.*` __all__ must exist on paddle_tpu (skipped when the reference
+checkout is not mounted). Plus functional checks of the surface-completion
+ops against scipy/numpy oracles."""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_REF = "/root/reference/python/paddle/__init__.py"
+
+
+class TestSurface:
+    @pytest.mark.skipif(not os.path.exists(_REF),
+                        reason="reference checkout not mounted")
+    def test_top_level_all_parity(self):
+        src = open(_REF).read()
+        names = set(re.findall(r"^\s+'(\w+)',\s*$", src, re.M))
+        missing = sorted(n for n in names if not hasattr(paddle, n))
+        assert not missing, f"missing public names: {missing}"
+
+
+class TestInplaceVariants:
+    def test_buffer_swap_semantics(self):
+        x = paddle.to_tensor(np.array([1.0, 4.0, 9.0]))
+        y = x.sqrt_()
+        assert y is x
+        np.testing.assert_allclose(x.numpy(), [1, 2, 3])
+        x.multiply_(paddle.to_tensor(np.array([2.0, 2.0, 2.0])))
+        np.testing.assert_allclose(x.numpy(), [2, 4, 6])
+
+    def test_generated_set_nontrivial(self):
+        for name in ("cos_", "tanh_", "clip_", "tril_", "cumsum_"):
+            assert hasattr(paddle, name), name
+            assert hasattr(paddle.Tensor, name), name
+
+
+class TestSurfaceOps:
+    def test_cdist_pdist_scipy(self):
+        from scipy.spatial.distance import cdist as scdist, pdist as spdist
+
+        a = np.random.randn(4, 3)
+        b = np.random.randn(5, 3)
+        np.testing.assert_allclose(
+            paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            scdist(a, b), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.pdist(paddle.to_tensor(a)).numpy(), spdist(a), rtol=1e-5)
+
+    def test_block_diag_and_splits(self):
+        out = paddle.block_diag([paddle.to_tensor(np.ones((2, 2))),
+                                 paddle.to_tensor(2 * np.ones((1, 3)))])
+        assert out.shape == [3, 5]
+        assert float(out.numpy()[2, 2]) == 2.0
+        parts = paddle.hsplit(paddle.to_tensor(np.zeros((4, 6))), 3)
+        assert [p.shape for p in parts] == [[4, 2]] * 3
+
+    def test_take_modes(self):
+        x = paddle.to_tensor(np.arange(6).reshape(2, 3))
+        np.testing.assert_array_equal(
+            paddle.take(x, paddle.to_tensor(np.array([7, -1])),
+                        mode="wrap").numpy(), [1, 5])
+        np.testing.assert_array_equal(
+            paddle.take(x, paddle.to_tensor(np.array([99])),
+                        mode="clip").numpy(), [5])
+
+    def test_multigammaln_scipy(self):
+        import scipy.special as ss
+
+        v = np.array([3.0, 5.5])
+        np.testing.assert_allclose(
+            paddle.multigammaln(paddle.to_tensor(v), 3).numpy(),
+            [ss.multigammaln(x, 3) for x in v], rtol=1e-5)
+
+    def test_scatter_family(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        d = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = paddle.diagonal_scatter(x, d)
+        np.testing.assert_array_equal(np.diag(out.numpy()), [1, 2, 3])
+        out2 = paddle.select_scatter(x, d, axis=0, index=1)
+        np.testing.assert_array_equal(out2.numpy()[1], [1, 2, 3])
+        out3 = paddle.slice_scatter(
+            x, paddle.to_tensor(np.ones((3, 1), np.float32)),
+            axes=[1], starts=[2], ends=[3], strides=[1])
+        np.testing.assert_array_equal(out3.numpy()[:, 2], [1, 1, 1])
+
+    def test_reduce_as(self):
+        x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+        t = paddle.to_tensor(np.zeros((3, 1), np.float32))
+        out = paddle.reduce_as(x, t)
+        assert out.shape == [3, 1]
+        np.testing.assert_allclose(out.numpy(), np.full((3, 1), 8.0))
+
+    def test_unflatten_frexp_sgn(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32))
+        assert paddle.unflatten(x, 0, [3, 4]).shape == [3, 4]
+        m, e = paddle.frexp(paddle.to_tensor(np.array([8.0])))
+        assert float(m.numpy()[0]) == 0.5 and int(e.numpy()[0]) == 4
+        np.testing.assert_array_equal(
+            paddle.sgn(paddle.to_tensor(np.array([-3.0, 0.0, 2.0]))).numpy(),
+            [-1, 0, 1])
