@@ -22,6 +22,13 @@ __all__ = [
     "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
     "LogNormal", "Multinomial", "Poisson", "Cauchy", "StudentT", "Binomial",
     "kl_divergence", "register_kl",
+    # extras.py (imported at the bottom of this module)
+    "Chi2", "ContinuousBernoulli", "ExponentialFamily", "Independent",
+    "MultivariateNormal", "LKJCholesky", "TransformedDistribution",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
 ]
 
 
@@ -770,3 +777,13 @@ def _kl_laplace_laplace(p, q):
     d = jnp.abs(p.loc - q.loc)
     return Tensor(jnp.log(q.scale / p.scale) + d / q.scale
                   + (p.scale / q.scale) * jnp.exp(-d / p.scale) - 1)
+
+
+# late import: extras builds on the classes above (no cycle — extras pulls
+# names from this module after they are defined)
+from .extras import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, Chi2, ContinuousBernoulli,
+    ExponentialFamily, ExpTransform, Independent, IndependentTransform,
+    LKJCholesky, MultivariateNormal, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform, TransformedDistribution)

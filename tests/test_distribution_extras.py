@@ -1,0 +1,342 @@
+"""Tests for the remaining paddle.distribution surface (extras.py):
+Chi2, ContinuousBernoulli, Independent, MultivariateNormal, LKJCholesky,
+ExponentialFamily, Transform family, TransformedDistribution, KL registry.
+
+Strategy mirrors the reference's distribution tests (scipy/numpy as oracle,
+MC agreement for samplers)."""
+import math
+import unittest
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def setUpModule():
+    paddle.seed(0)
+
+
+class TestChi2(unittest.TestCase):
+    def test_moments_and_logprob(self):
+        c = D.Chi2(3.0)
+        s = c.sample((20000,)).numpy()
+        np.testing.assert_allclose(s.mean(), 3.0, atol=0.1)
+        np.testing.assert_allclose(s.var(), 6.0, atol=0.5)
+        from scipy.stats import chi2
+        v = np.array([0.5, 2.0, 7.0], np.float32)
+        np.testing.assert_allclose(
+            c.log_prob(paddle.to_tensor(v)).numpy(),
+            chi2(3.0).logpdf(v), rtol=1e-4)
+
+    def test_kl_via_gamma_registry(self):
+        # Chi2 subclasses Gamma, so the Gamma KL rule applies
+        kl = D.kl_divergence(D.Chi2(4.0), D.Chi2(6.0))
+        self.assertGreater(float(kl.numpy()), 0.0)
+
+
+class TestContinuousBernoulli(unittest.TestCase):
+    def test_density_integrates_to_one(self):
+        for lam in (0.2, 0.499, 0.5, 0.8):
+            cb = D.ContinuousBernoulli(lam)
+            xs = np.linspace(1e-4, 1 - 1e-4, 4001, dtype=np.float32)
+            p = np.exp(cb.log_prob(paddle.to_tensor(xs)).numpy())
+            self.assertAlmostEqual(np.trapezoid(p, xs), 1.0, places=3)
+
+    def test_sampler_matches_moments(self):
+        cb = D.ContinuousBernoulli(0.3)
+        s = cb.sample((40000,)).numpy()
+        np.testing.assert_allclose(s.mean(), float(cb.mean.numpy()),
+                                   atol=5e-3)
+        np.testing.assert_allclose(s.var(), float(cb.variance.numpy()),
+                                   atol=5e-3)
+
+    def test_cdf_icdf_roundtrip(self):
+        cb = D.ContinuousBernoulli(0.7)
+        u = np.linspace(0.01, 0.99, 50).astype(np.float32)
+        np.testing.assert_allclose(
+            cb.cdf(cb.icdf(paddle.to_tensor(u))).numpy(), u, atol=1e-5)
+
+    def test_entropy_mc(self):
+        cb = D.ContinuousBernoulli(0.25)
+        s = cb.sample((40000,))
+        ent_mc = -cb.log_prob(s).numpy().mean()
+        np.testing.assert_allclose(float(cb.entropy().numpy()), ent_mc,
+                                   atol=5e-3)
+
+
+class TestMultivariateNormal(unittest.TestCase):
+    def setUp(self):
+        self.cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        self.mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                                        covariance_matrix=self.cov)
+
+    def test_logprob_vs_scipy(self):
+        from scipy.stats import multivariate_normal as smvn
+        x = np.array([0.3, -0.4], np.float32)
+        np.testing.assert_allclose(
+            float(self.mvn.log_prob(paddle.to_tensor(x)).numpy()),
+            smvn(np.zeros(2), self.cov).logpdf(x), rtol=1e-4)
+
+    def test_entropy_vs_scipy(self):
+        from scipy.stats import multivariate_normal as smvn
+        np.testing.assert_allclose(
+            float(self.mvn.entropy().numpy()),
+            smvn(np.zeros(2), self.cov).entropy(), rtol=1e-5)
+
+    def test_sample_cov(self):
+        s = self.mvn.sample((50000,)).numpy()
+        np.testing.assert_allclose(np.cov(s.T), self.cov, atol=0.05)
+
+    def test_parameterizations_agree(self):
+        prec = np.linalg.inv(self.cov).astype(np.float32)
+        tril = np.linalg.cholesky(self.cov).astype(np.float32)
+        for kw in (dict(precision_matrix=prec), dict(scale_tril=tril)):
+            other = D.MultivariateNormal(np.zeros(2, np.float32), **kw)
+            np.testing.assert_allclose(
+                other.covariance_matrix.numpy(), self.cov, atol=1e-5)
+
+    def test_kl(self):
+        q = D.MultivariateNormal(np.ones(2, np.float32),
+                                 covariance_matrix=np.eye(2, dtype=np.float32))
+        kl = float(D.kl_divergence(self.mvn, q).numpy())
+        kl_ref = 0.5 * (np.trace(self.cov) + 2.0 - 2
+                        - np.log(np.linalg.det(self.cov)))
+        np.testing.assert_allclose(kl, kl_ref, rtol=1e-5)
+
+
+class TestIndependent(unittest.TestCase):
+    def test_event_reinterpretation(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        self.assertEqual(ind.batch_shape, (3,))
+        self.assertEqual(ind.event_shape, (4,))
+        lp = ind.log_prob(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+        self.assertEqual(list(lp.shape), [3])
+        np.testing.assert_allclose(
+            lp.numpy(), 4 * (-0.5 * math.log(2 * math.pi)), rtol=1e-6)
+
+    def test_kl(self):
+        b1 = D.Independent(D.Normal(np.zeros(4, np.float32),
+                                    np.ones(4, np.float32)), 1)
+        b2 = D.Independent(D.Normal(np.ones(4, np.float32),
+                                    np.ones(4, np.float32)), 1)
+        np.testing.assert_allclose(float(D.kl_divergence(b1, b2).numpy()),
+                                   2.0, rtol=1e-5)
+
+
+class TestLKJCholesky(unittest.TestCase):
+    def test_sample_is_correlation_cholesky(self):
+        lkj = D.LKJCholesky(3, 2.0)
+        L = lkj.sample((500,)).numpy()
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        # lower triangular
+        self.assertTrue(np.allclose(np.triu(L, 1), 0.0))
+        # off-diagonals centred for symmetric prior
+        self.assertLess(abs(corr[:, 1, 0].mean()), 0.1)
+
+    def test_logprob_uniform_case_is_constant(self):
+        # concentration=1 -> density over correlations is uniform, so
+        # log_prob depends on L only through the cholesky volume factor
+        lkj = D.LKJCholesky(2, 1.0)
+        L = lkj.sample((4,))
+        lp = lkj.log_prob(L).numpy()
+        self.assertEqual(lp.shape, (4,))
+        self.assertTrue(np.isfinite(lp).all())
+
+    def test_cvine_marginal(self):
+        # LKJ(d, eta) marginal of each correlation is Beta(a, a) on [-1,1]
+        # with a = eta + (d-2)/2 — holds for BOTH samplers
+        from scipy.stats import beta as sbeta
+        for method in ("onion", "cvine"):
+            lkj = D.LKJCholesky(4, 2.0, sample_method=method)
+            L = lkj.sample((3000,)).numpy()
+            corr = L @ np.swapaxes(L, -1, -2)
+            np.testing.assert_allclose(
+                np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+            emp = (corr[:, 1, 0] + 1) / 2
+            self.assertLess(abs(emp.mean() - 0.5), 0.03, method)
+            self.assertLess(abs(emp.var() - sbeta(3, 3).var()), 0.006,
+                            method)
+
+    def test_batched_exponential_family_entropy(self):
+        class NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.asarray(loc, jnp.float32)
+                self.scale = jnp.asarray(scale, jnp.float32)
+                super().__init__(self.loc.shape, ())
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * math.log(2 * math.pi)
+
+        ne = NormalEF(np.array([0.0, 1.3], np.float32),
+                      np.array([1.0, 2.0], np.float32))
+        ref = 0.5 + 0.5 * np.log(2 * np.pi * np.array([1.0, 4.0]))
+        np.testing.assert_allclose(ne.entropy().numpy(), ref, rtol=1e-5)
+
+    def test_logprob_mc_normalization_d2(self):
+        # d=2: r = L[1,0] ~ uniform on [-1,1] scaled by Beta; check that
+        # exp(log_prob) integrates to 1 over the 1-dof manifold
+        lkj = D.LKJCholesky(2, 1.5)
+        rs = np.linspace(-0.999, 0.999, 2001, dtype=np.float32)
+        Ls = np.zeros((2001, 2, 2), np.float32)
+        Ls[:, 0, 0] = 1.0
+        Ls[:, 1, 0] = rs
+        Ls[:, 1, 1] = np.sqrt(1 - rs ** 2)
+        # density over r needs the change of volume dL -> dr: for d=2 the
+        # cholesky density IS the density of r (L11 determined by r)
+        p = np.exp(lkj.log_prob(paddle.to_tensor(Ls)).numpy())
+        self.assertAlmostEqual(np.trapezoid(p, rs), 1.0, places=2)
+
+
+class TestTransforms(unittest.TestCase):
+    def test_roundtrips_and_jacobians(self):
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(7)
+                        .astype(np.float32))
+        for t in (D.ExpTransform(), D.TanhTransform(),
+                  D.SigmoidTransform(), D.AffineTransform(1.0, 3.0)):
+            y = t._forward(x)
+            np.testing.assert_allclose(np.asarray(t._inverse(y)),
+                                       np.asarray(x), rtol=1e-4, atol=1e-5)
+            # fldj vs autodiff
+            d = jax.vmap(jax.grad(lambda v: t._forward(v)))(x)
+            np.testing.assert_allclose(
+                np.asarray(t._forward_log_det_jacobian(x)),
+                np.log(np.abs(np.asarray(d))), rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(5)
+                        .astype(np.float32))
+        y = t._forward(x)
+        self.assertAlmostEqual(float(np.asarray(y).sum()), 1.0, places=5)
+        np.testing.assert_allclose(np.asarray(t._inverse(y)),
+                                   np.asarray(x), rtol=1e-3, atol=1e-4)
+        jac = jax.jacfwd(t._forward)(x)[:-1, :]
+        _, ld = np.linalg.slogdet(np.asarray(jac))
+        np.testing.assert_allclose(
+            float(t._forward_log_det_jacobian(x)), ld, rtol=1e-4)
+        self.assertEqual(t.forward_shape((5,)), (6,))
+        self.assertEqual(t.inverse_shape((6,)), (5,))
+
+    def test_reshape_and_chain_and_stack(self):
+        r = D.ReshapeTransform((6,), (2, 3))
+        x = jnp.arange(6, dtype=jnp.float32)
+        self.assertEqual(r._forward(x).shape, (2, 3))
+        np.testing.assert_allclose(np.asarray(r._inverse(r._forward(x))),
+                                   np.asarray(x))
+        ch = D.ChainTransform([D.ExpTransform(),
+                               D.AffineTransform(0.0, 2.0)])
+        np.testing.assert_allclose(np.asarray(ch._forward(x)),
+                                   2 * np.exp(np.arange(6)), rtol=1e-5)
+        st = D.StackTransform([D.ExpTransform(), D.AffineTransform(0., 1.)],
+                              axis=0)
+        y = st._forward(jnp.ones((2, 3)))
+        np.testing.assert_allclose(np.asarray(y)[0], math.e, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(y)[1], 1.0, rtol=1e-6)
+
+
+class TestTransformedDistribution(unittest.TestCase):
+    def test_matches_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.2, 0.7),
+                                       [D.ExpTransform()])
+        ln = D.LogNormal(0.2, 0.7)
+        v = paddle.to_tensor(1.3)
+        np.testing.assert_allclose(float(td.log_prob(v).numpy()),
+                                   float(ln.log_prob(v).numpy()), rtol=1e-5)
+
+    def test_tanh_normal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.TanhTransform()])
+        s = td.sample((1000,)).numpy()
+        self.assertTrue((np.abs(s) <= 1).all())
+        v = np.array(0.5, np.float32)
+        x = np.arctanh(v)
+        ref = -0.5 * np.log(2 * np.pi) - x ** 2 / 2 - np.log1p(-v ** 2)
+        np.testing.assert_allclose(
+            float(td.log_prob(paddle.to_tensor(v)).numpy()), ref, rtol=1e-5)
+
+    def test_chain(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0),
+            [D.ExpTransform(), D.AffineTransform(0.0, 2.0)])
+        v = np.array(1.7, np.float32)
+        z = np.log(v / 2)
+        ref = (-0.5 * np.log(2 * np.pi) - z ** 2 / 2) - np.log(v / 2) \
+            - np.log(2.0)
+        np.testing.assert_allclose(
+            float(td.log_prob(paddle.to_tensor(v)).numpy()), ref, rtol=1e-4)
+
+    def test_kl_same_chain(self):
+        p = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                      [D.ExpTransform()])
+        q = D.TransformedDistribution(D.Normal(1.0, 1.0),
+                                      [D.ExpTransform()])
+        np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()),
+                                   0.5, rtol=1e-5)
+
+    def test_kl_refuses_differing_parameters(self):
+        # same transform TYPE but different scale => different pushforwards
+        p = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                      [D.AffineTransform(0.0, 1.0)])
+        q = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                      [D.AffineTransform(0.0, 2.0)])
+        with self.assertRaises(NotImplementedError):
+            D.kl_divergence(p, q)
+
+    def test_event_absorbing_transform_sums_base(self):
+        # IndependentTransform absorbs base batch dims into the event:
+        # log_prob must sum the base log_prob over those dims
+        base = D.Normal(np.zeros((2, 3), np.float32),
+                        np.ones((2, 3), np.float32))
+        td = D.TransformedDistribution(
+            base, [D.IndependentTransform(D.ExpTransform(), 1)])
+        lp = td.log_prob(paddle.to_tensor(np.ones((2, 3), np.float32)))
+        self.assertEqual(list(lp.shape), [2])
+        ln = D.LogNormal(0.0, 1.0)
+        ref = 3 * float(ln.log_prob(paddle.to_tensor(1.0)).numpy())
+        np.testing.assert_allclose(lp.numpy(), ref, rtol=1e-5)
+
+
+class TestExponentialFamily(unittest.TestCase):
+    def test_bregman_entropy_matches_normal(self):
+        class NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.float32(loc)
+                self.scale = jnp.float32(scale)
+                super().__init__((), ())
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * math.log(2 * math.pi)  # E[log h(X)]
+
+        ne = NormalEF(1.3, 2.0)
+        ref = 0.5 + 0.5 * math.log(2 * math.pi * 4.0)
+        np.testing.assert_allclose(float(ne.entropy().numpy()), ref,
+                                   rtol=1e-5)
+
+
+if __name__ == "__main__":
+    unittest.main()
