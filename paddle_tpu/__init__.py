@@ -55,6 +55,7 @@ from . import audio  # noqa: F401
 from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import text  # noqa: F401
+from . import onnx  # noqa: F401
 from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

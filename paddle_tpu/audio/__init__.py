@@ -3,3 +3,5 @@
 /MFCC) layers)."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,96 @@
+"""paddle.audio.backends equivalent (reference:
+python/paddle/audio/backends/{backend,init_backend,wave_backend}.py).
+
+The reference's default backend decodes PCM wav via the stdlib `wave`
+module and dispatches to paddleaudio soundfile backends when installed;
+here the stdlib backend is the always-available implementation.
+"""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    """reference: backends/backend.py AudioInfo."""
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+_BACKENDS = ["wave_backend"]
+_current = "wave_backend"
+
+
+def list_available_backends():
+    return list(_BACKENDS)
+
+
+def get_current_backend():
+    return _current
+
+
+def set_backend(backend_name: str):
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available; options: {_BACKENDS}")
+    _current = backend_name
+
+
+def info(filepath: str) -> AudioInfo:
+    """reference: wave_backend.py info."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Decode PCM16 wav -> (waveform float32 in [-1,1] (or int16 when
+    normalize=False), sample_rate). reference: wave_backend.py load."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - f.tell() if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width != 2:
+        raise ValueError(f"only PCM16 wav supported, got width {width}")
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, nch)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    wav = data.T if channels_first else data
+    return wav, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16):
+    """Encode float [-1,1] or int16 array to PCM16 wav.
+    reference: wave_backend.py save."""
+    arr = np.asarray(getattr(src, "numpy", lambda: src)())
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> (frames, channels)
+    if bits_per_sample != 16:
+        raise ValueError("only 16-bit PCM supported")
+    if arr.dtype != np.int16:
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.astype("<i2").tobytes())

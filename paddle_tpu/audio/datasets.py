@@ -1,0 +1,157 @@
+"""paddle.audio.datasets equivalent (reference:
+python/paddle/audio/datasets/{dataset,esc50,tess}.py).
+
+AudioClassificationDataset yields (feature_or_waveform, label); feature
+mode runs the paddle_tpu.audio.features extractors. No-network policy:
+a provided archive dir is scanned for wav files; otherwise deterministic
+synthetic waveforms with the real class lists are generated.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+_FEATURE_FUNCTIONS = ("raw", "melspectrogram", "mfcc", "logmelspectrogram",
+                      "spectrogram")
+
+
+class AudioClassificationDataset(Dataset):
+    """Base: holds (file-or-array, label) pairs and an optional feature
+    extractor applied in __getitem__ (reference: datasets/dataset.py:29)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=16000,
+                 archive=None, **kwargs):
+        if feat_type not in _FEATURE_FUNCTIONS:
+            raise ValueError(f"feat_type must be one of {_FEATURE_FUNCTIONS}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._extractor = None
+
+    def _waveform(self, record):
+        if isinstance(record, np.ndarray):
+            return record
+        from .backends import load
+        wav, _ = load(record)
+        return np.asarray(wav)
+
+    def _extract(self, wav):
+        if self.feat_type == "raw":
+            return wav.astype(np.float32)
+        from . import features
+        if self._extractor is None:
+            cls = {"melspectrogram": features.MelSpectrogram,
+                   "logmelspectrogram": features.LogMelSpectrogram,
+                   "spectrogram": features.Spectrogram,
+                   "mfcc": features.MFCC}[self.feat_type]
+            self._extractor = cls(sr=self.sample_rate, **self.feat_config) \
+                if "sr" in cls.__init__.__code__.co_varnames else \
+                cls(**self.feat_config)
+        from ..core.tensor import Tensor
+        out = self._extractor(Tensor(wav[None].astype(np.float32)))
+        return np.asarray(out.numpy()[0])
+
+    def __getitem__(self, idx):
+        wav = self._waveform(self.files[idx])
+        return self._extract(wav), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _synthetic_waveforms(n, n_classes, sample_rate, seed):
+    """Deterministic class-dependent tones + noise."""
+    rng = np.random.default_rng(seed)
+    dur = sample_rate // 8
+    files, labels = [], []
+    t = np.arange(dur) / sample_rate
+    for i in range(n):
+        label = i % n_classes
+        freq = 200.0 + 37.0 * label
+        wav = (0.5 * np.sin(2 * np.pi * freq * t)
+               + 0.05 * rng.standard_normal(dur)).astype(np.float32)
+        files.append(wav)
+        labels.append(label)
+    return files, labels
+
+
+class ESC50(AudioClassificationDataset):
+    """Environmental Sound Classification, 50 classes, 5 folds
+    (reference: datasets/esc50.py)."""
+
+    label_list = [
+        "dog", "rooster", "pig", "cow", "frog", "cat", "hen",
+        "insects", "sheep", "crow", "rain", "sea_waves", "crackling_fire",
+        "crickets", "chirping_birds", "water_drops", "wind",
+        "pouring_water", "toilet_flush", "thunderstorm", "crying_baby",
+        "sneezing", "clapping", "breathing", "coughing", "footsteps",
+        "laughing", "brushing_teeth", "snoring", "drinking_sipping",
+        "door_wood_knock", "mouse_click", "keyboard_typing",
+        "door_wood_creaks", "can_opening", "washing_machine",
+        "vacuum_cleaner", "clock_alarm", "clock_tick", "glass_breaking",
+        "helicopter", "chainsaw", "siren", "car_horn", "engine", "train",
+        "church_bells", "airplane", "fireworks", "hand_saw",
+    ]
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        sample_rate = 44100
+        if archive and os.path.isdir(archive):
+            files, labels = [], []
+            for f in sorted(os.listdir(archive)):
+                if not f.endswith(".wav"):
+                    continue
+                # ESC-50 naming: {fold}-{src}-{take}-{target}.wav
+                parts = f.rsplit(".", 1)[0].split("-")
+                fold, target = int(parts[0]), int(parts[-1])
+                in_split = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if in_split:
+                    files.append(os.path.join(archive, f))
+                    labels.append(target)
+        else:
+            n = 100 if mode == "train" else 25
+            files, labels = _synthetic_waveforms(
+                n, len(self.label_list), sample_rate, seed=50 + split)
+        super().__init__(files, labels, feat_type=feat_type,
+                         sample_rate=sample_rate, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set, 7 emotions
+    (reference: datasets/tess.py)."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral",
+                  "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        sample_rate = 24414
+        if archive and os.path.isdir(archive):
+            files, labels = [], []
+            wavs = [f for f in sorted(os.listdir(archive))
+                    if f.endswith(".wav")]
+            for i, f in enumerate(wavs):
+                # TESS naming: {speaker}_{word}_{emotion}.wav
+                emotion = f.rsplit(".", 1)[0].split("_")[-1].lower()
+                if emotion not in self.label_list:
+                    continue
+                fold = i % n_folds + 1
+                in_split = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if in_split:
+                    files.append(os.path.join(archive, f))
+                    labels.append(self.label_list.index(emotion))
+        else:
+            n = 70 if mode == "train" else 21
+            files, labels = _synthetic_waveforms(
+                n, len(self.label_list), sample_rate, seed=60 + split)
+        super().__init__(files, labels, feat_type=feat_type,
+                         sample_rate=sample_rate, **kwargs)

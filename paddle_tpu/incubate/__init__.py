@@ -6,3 +6,5 @@ pack instead of hand-written CUDA.
 """
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401
+from . import autotune  # noqa: F401

@@ -1,0 +1,328 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: paddle/incubate/asp/{asp.py,utils.py,supported_layer_list.py} —
+`prune_model` computes n:m masks for supported layers, `decorate` wraps the
+optimizer so masks are re-applied after every update (sparsity guarantee),
+plus the mask/check utility family (get_mask_1d / 2d_greedy / 2d_best,
+check_mask_*, create_mask, check_sparsity, calculate_density).
+
+TPU-native form: masks are computed with vectorized argsort/top-k over all
+m-blocks at once (no per-block Python loop — the reference loops rows in
+Python because its masks feed cuSPARSELt; here they are plain multiplies
+that XLA fuses into the matmul producer), and mask re-application after
+`step` is a jitted elementwise multiply. `mask_2d_best` enumerates the
+(m-n)-regular m x m 0/1 patterns once and scores every block against all
+patterns in one einsum — exhaustive-best without the reference's per-block
+permutation search.
+"""
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "calculate_density", "decorate", "prune_model", "set_excluded_layers",
+    "reset_excluded_layers", "add_supported_layer",
+    "MaskAlgo", "CheckMethod", "create_mask", "check_sparsity",
+    "get_mask_1d", "get_mask_2d_greedy", "get_mask_2d_best",
+    "check_mask_1d", "check_mask_2d",
+]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        if mask_algo == MaskAlgo.MASK_1D:
+            return CheckMethod.CHECK_1D
+        return CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (reference: asp/utils.py:86)."""
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _pad_cols(mat: np.ndarray, m: int) -> np.ndarray:
+    pad = (-mat.shape[1]) % m
+    if pad:
+        mat = np.concatenate(
+            [mat, np.zeros((mat.shape[0], pad), mat.dtype)], 1)
+    return mat
+
+
+def get_mask_1d(mat, n: int, m: int) -> np.ndarray:
+    """Zero the n smallest-|.| entries of every 1 x m block (so each block
+    has >= n zeros). Vectorized argsort over all blocks at once."""
+    mat = np.asarray(mat)
+    orig = mat
+    if mat.ndim <= 1:
+        mat = mat.reshape(1, -1)
+    rows, cols = mat.shape
+    padded = _pad_cols(mat, m)
+    blocks = padded.reshape(-1, m)
+    order = np.argsort(np.abs(blocks), axis=1)
+    mask = np.ones_like(blocks)
+    np.put_along_axis(mask, order[:, :n], 0, axis=1)
+    mask = mask.reshape(rows, -1)[:, :cols]
+    return mask.reshape(orig.shape)
+
+
+def check_mask_1d(mat, n: int, m: int) -> bool:
+    """True iff every 1 x m block has at least n zeros."""
+    mat = np.asarray(mat)
+    if mat.ndim <= 1:
+        mat = mat.reshape(1, -1)
+    blocks = _pad_cols(mat, m).reshape(-1, m)
+    return bool(((blocks != 0).sum(1) <= (m - n)).all())
+
+
+def _pad_2d(mat: np.ndarray, m: int) -> np.ndarray:
+    pr = (-mat.shape[0]) % m
+    pc = (-mat.shape[1]) % m
+    if pr or pc:
+        mat = np.pad(mat, ((0, pr), (0, pc)))
+    return mat
+
+
+def _blocks_2d(mat: np.ndarray, m: int) -> np.ndarray:
+    """(R, C) -> (R//m * C//m, m, m) tiling."""
+    r, c = mat.shape
+    return (mat.reshape(r // m, m, c // m, m)
+            .transpose(0, 2, 1, 3).reshape(-1, m, m))
+
+
+def _unblocks_2d(blocks: np.ndarray, shape, m: int) -> np.ndarray:
+    r, c = shape
+    return (blocks.reshape(r // m, c // m, m, m)
+            .transpose(0, 2, 1, 3).reshape(r, c))
+
+
+def check_mask_2d(mat, n: int, m: int) -> bool:
+    """True iff every m x m block has >= n zeros in every row AND column."""
+    mat = np.asarray(mat)
+    blocks = _blocks_2d(_pad_2d(mat, m), m)
+    nz_rows = (blocks != 0).sum(2)
+    nz_cols = (blocks != 0).sum(1)
+    return bool((nz_rows <= (m - n)).all() and (nz_cols <= (m - n)).all())
+
+
+def get_mask_2d_greedy(mat, n: int, m: int) -> np.ndarray:
+    """Greedy per-block: accept entries in decreasing |value| while row and
+    column budgets (m - n nonzeros each) allow. Loop is over the m*m
+    candidates of a block, vectorized across all blocks."""
+    mat = np.asarray(mat)
+    padded = _pad_2d(mat, m)
+    blocks = _blocks_2d(padded, m)  # (B, m, m)
+    B = blocks.shape[0]
+    flat = np.abs(blocks).reshape(B, -1)
+    order = np.argsort(-flat, axis=1)  # descending magnitude
+    budget = m - n
+    mask = np.zeros((B, m, m), dtype=mat.dtype)
+    row_cnt = np.zeros((B, m), np.int64)
+    col_cnt = np.zeros((B, m), np.int64)
+    b_idx = np.arange(B)
+    for k in range(m * m):
+        pos = order[:, k]
+        i, j = pos // m, pos % m
+        ok = (row_cnt[b_idx, i] < budget) & (col_cnt[b_idx, j] < budget)
+        mask[b_idx[ok], i[ok], j[ok]] = 1
+        row_cnt[b_idx[ok], i[ok]] += 1
+        col_cnt[b_idx[ok], j[ok]] += 1
+    out = _unblocks_2d(mask, padded.shape, m)
+    return out[:mat.shape[0], :mat.shape[1]]
+
+
+def _regular_patterns(n: int, m: int) -> np.ndarray:
+    """All m x m 0/1 matrices with exactly (m-n) ones per row and column
+    (e.g. 90 patterns for 2:4), built once and cached."""
+    key = (n, m)
+    if key not in _regular_patterns._cache:
+        k = m - n
+        rows = [np.array(v) for v in itertools.product((0, 1), repeat=m)
+                if sum(v) == k]
+        pats = []
+
+        def rec(chosen, col_sum):
+            if len(chosen) == m:
+                pats.append(np.stack(chosen))
+                return
+            remaining = m - len(chosen)
+            for r in rows:
+                ns = col_sum + r
+                if (ns <= k).all() and ((k - ns) <= remaining - 1).all():
+                    rec(chosen + [r], ns)
+
+        rec([], np.zeros(m, np.int64))
+        _regular_patterns._cache[key] = np.stack(pats).astype(np.float64)
+    return _regular_patterns._cache[key]
+
+
+_regular_patterns._cache = {}
+
+
+def get_mask_2d_best(mat, n: int, m: int) -> np.ndarray:
+    """Exhaustive-best per block: score every (m-n)-regular pattern against
+    every block in one tensordot and take the argmax."""
+    mat = np.asarray(mat)
+    padded = _pad_2d(mat, m)
+    blocks = np.abs(_blocks_2d(padded, m))  # (B, m, m)
+    pats = _regular_patterns(n, m)  # (P, m, m)
+    scores = np.tensordot(blocks, pats, axes=([1, 2], [1, 2]))  # (B, P)
+    best = pats[np.argmax(scores, axis=1)].astype(mat.dtype)
+    out = _unblocks_2d(best, padded.shape, m)
+    return out[:mat.shape[0], :mat.shape[1]]
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4) -> np.ndarray:
+    """Route to the chosen mask algorithm; >2-D tensors (conv kernels) are
+    flattened to 2-D along the output-channel axis like the reference."""
+    if isinstance(func_name, str):
+        func_name = MaskAlgo(func_name if func_name.startswith("get_")
+                             else f"get_{func_name}")
+    t = np.asarray(tensor)
+    shape = t.shape
+    if t.ndim == 1:
+        t2 = t.reshape(1, -1)
+    elif t.ndim == 2:
+        t2 = t
+    elif t.ndim == 4:
+        # NCHW kernel -> (N, C*H*W)
+        t2 = t.reshape(shape[0], -1)
+    else:
+        t2 = t.reshape(shape[0], -1)
+    fn = globals()[func_name.value]
+    mask = fn(t2, n, m)
+    return mask.reshape(shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4) -> bool:
+    if isinstance(func_name, str):
+        suffix = func_name.replace("check_", "").replace("mask_", "")
+        func_name = CheckMethod(f"check_mask_{suffix}")
+    t = np.asarray(tensor)
+    if t.ndim != 2:
+        t = t.reshape(t.shape[0], -1) if t.ndim > 1 else t.reshape(1, -1)
+    return bool(globals()[func_name.value](t, n, m))
+
+
+# ---------------------------------------------------------------------------
+# model-level pruning (reference: asp/asp.py ASPHelper)
+# ---------------------------------------------------------------------------
+
+# layer-type name -> predicate(param_name) selecting prunable params
+_supported_layers: Dict[str, Callable[[str], bool]] = {
+    "Linear": lambda pname: pname.endswith("weight"),
+    "Conv2D": lambda pname: pname.endswith("weight"),
+}
+_excluded_param_names: set = set()
+
+
+def add_supported_layer(layer, pruning_func: Optional[Callable] = None):
+    """Register an extra layer type (by class or name) as prunable."""
+    name = layer if isinstance(layer, str) else layer.__name__
+    _supported_layers[name] = pruning_func or (
+        lambda pname: pname.endswith("weight"))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by name) from pruning; `main_program` is
+    accepted for API parity with the static-graph reference."""
+    _excluded_param_names.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded_param_names.clear()
+
+
+class ASPHelper:
+    """Holds the id(param) -> (param, mask) map for pruned models
+    (Parameter is __slots__-based, so masks live here rather than on the
+    object). The strong param reference pins the id so it cannot be
+    recycled onto an unrelated parameter after GC; `reset()` releases."""
+
+    _masks: Dict[int, tuple] = {}
+
+    @classmethod
+    def reset(cls):
+        cls._masks.clear()
+
+    @classmethod
+    def prunable_params(cls, model):
+        for lname, layer in model.named_sublayers(include_self=True):
+            tname = type(layer).__name__
+            pred = _supported_layers.get(tname)
+            if pred is None:
+                continue
+            for pname, param in layer.named_parameters(
+                    include_sublayers=False):
+                full = f"{lname}.{pname}" if lname else pname
+                if full in _excluded_param_names:
+                    continue
+                if pred(pname) and param.ndim >= 2:
+                    yield full, param
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute n:m masks for every supported layer's weights and zero the
+    pruned entries in place. Returns {param_name: mask}. With
+    `with_mask=True` the masks are retained so a `decorate`d optimizer
+    keeps the pattern across updates."""
+    algo = MaskAlgo(mask_algo if str(mask_algo).startswith("get_")
+                    else f"get_{mask_algo}") \
+        if isinstance(mask_algo, str) else mask_algo
+    masks = {}
+    for full, param in ASPHelper.prunable_params(model):
+        mask = create_mask(np.asarray(param._array), func_name=algo,
+                           n=n, m=m)
+        mask_dev = jnp.asarray(mask, param._array.dtype)
+        param._array = param._array * mask_dev
+        masks[full] = mask_dev
+        if with_mask:
+            ASPHelper._masks[id(param)] = (param, mask_dev)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference: asp.py:230 decorate — proxies the optimizer and re-applies
+    masks after each step so updates cannot resurrect pruned weights."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._apply = jax.jit(lambda arrs, ms: [a * mk
+                                                for a, mk in zip(arrs, ms)])
+
+    def step(self):
+        self._optimizer.step()
+        masked = []
+        for group in self._optimizer._param_groups:
+            for p in group["params"]:
+                entry = ASPHelper._masks.get(id(p))
+                if entry is not None and entry[0] is p:
+                    masked.append((p, entry[1]))
+        if masked:
+            arrs = self._apply([p._array for p, _ in masked],
+                               [mk for _, mk in masked])
+            for (p, _), a in zip(masked, arrs):
+                p._array = a
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

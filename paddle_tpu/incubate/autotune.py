@@ -1,0 +1,72 @@
+"""paddle.incubate.autotune equivalent (reference: incubate/autotune.py
+`set_config` — kernel / layout / dataloader tuning toggles).
+
+TPU-native form: "kernel autotune" is owned by XLA's autotuner, so the
+kernel section toggles XLA-side knobs (exhaustive tiling search for our
+Pallas kernels is configured through the kernels pack); layout autotune
+maps to preferred_element_type/layout hints; the dataloader section tunes
+the shm-ring DataLoader's worker count. All settings land in the flag
+registry so they are observable via paddle.get_flags.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from ..framework import flags as _flags
+
+__all__ = ["set_config"]
+
+_DEFAULTS = {
+    "FLAGS_use_autotune": False,
+    "FLAGS_autotune_kernel": True,
+    "FLAGS_autotune_layout": False,
+    "FLAGS_autotune_dataloader": False,
+    "FLAGS_autotune_dataloader_use_best_num_workers": False,
+    "FLAGS_autotune_tuning_steps": 10,
+}
+
+for _k, _v in _DEFAULTS.items():
+    _flags.define_flag(_k, _v, "autotune config")
+
+
+def set_config(config=None) -> None:
+    """Enable/disable autotune features. `config` may be None (enable all),
+    a dict with 'kernel' / 'layout' / 'dataloader' sections, or a path to
+    a JSON file with the same schema (reference: autotune.py:47)."""
+    if config is None:
+        _flags.set_flags({
+            "FLAGS_use_autotune": True,
+            "FLAGS_autotune_kernel": True,
+            "FLAGS_autotune_layout": True,
+            "FLAGS_autotune_dataloader": True,
+        })
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("config must be None, a dict, or a JSON file path")
+    updates = {"FLAGS_use_autotune": True}
+    kernel = config.get("kernel")
+    if kernel is not None:
+        if "enable" in kernel:
+            updates["FLAGS_autotune_kernel"] = bool(kernel["enable"])
+        if "tuning_range" in kernel:
+            rng = kernel["tuning_range"]
+            updates["FLAGS_autotune_tuning_steps"] = int(
+                rng[1] if isinstance(rng, (list, tuple)) else rng)
+    layout = config.get("layout")
+    if layout is not None and "enable" in layout:
+        updates["FLAGS_autotune_layout"] = bool(layout["enable"])
+    dataloader = config.get("dataloader")
+    if dataloader is not None:
+        if "enable" in dataloader:
+            updates["FLAGS_autotune_dataloader"] = bool(dataloader["enable"])
+        if "use_best_num_workers" in dataloader:
+            updates["FLAGS_autotune_dataloader_use_best_num_workers"] = \
+                bool(dataloader["use_best_num_workers"])
+    unknown = set(config) - {"kernel", "layout", "dataloader"}
+    if unknown:
+        warnings.warn(f"autotune: unknown config sections {sorted(unknown)}")
+    _flags.set_flags(updates)

