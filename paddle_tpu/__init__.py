@@ -56,6 +56,8 @@ from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
+from . import utils  # noqa: F401
+from . import cost_model  # noqa: F401
 from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

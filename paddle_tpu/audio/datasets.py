@@ -39,8 +39,10 @@ class AudioClassificationDataset(Dataset):
         if isinstance(record, np.ndarray):
             return record
         from .backends import load
-        wav, _ = load(record)
-        return np.asarray(wav)
+        wav, _ = load(record)  # channels-first (C, N)
+        # datasets are mono: collapse channels so file-backed and synthetic
+        # samples share the same 1-D shape
+        return np.asarray(wav).mean(axis=0)
 
     def _extract(self, wav):
         if self.feat_type == "raw":
@@ -108,9 +110,13 @@ class ESC50(AudioClassificationDataset):
             for f in sorted(os.listdir(archive)):
                 if not f.endswith(".wav"):
                     continue
-                # ESC-50 naming: {fold}-{src}-{take}-{target}.wav
+                # ESC-50 naming: {fold}-{src}-{take}-{target}.wav; skip
+                # non-conforming files rather than failing the dataset
                 parts = f.rsplit(".", 1)[0].split("-")
-                fold, target = int(parts[0]), int(parts[-1])
+                try:
+                    fold, target = int(parts[0]), int(parts[-1])
+                except (ValueError, IndexError):
+                    continue
                 in_split = (fold != split) if mode == "train" \
                     else (fold == split)
                 if in_split:

@@ -1,0 +1,67 @@
+"""paddle.cost_model equivalent (reference: python/paddle/cost_model —
+CostModel.profile_measure runs a program and records per-op time/memory
+feeding the auto-parallel planners, plus the measured
+static_op_benchmark.json table).
+
+TPU-native form: per-op latency comes from timing jitted single-op
+programs on the live backend (XLA cost modelling subsumes the reference's
+per-kernel table); the measured table feeds parallel.auto_tuner /
+parallel.cost_model the way static_op_benchmark.json feeds the
+reference's planner.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    """reference: cost_model/cost_model.py CostModel."""
+
+    def __init__(self):
+        self._table: Dict[str, float] = {}
+
+    def profile_measure(self, fn=None, args=(), device=None,
+                        fetch_cost_list=("time",), iters=10, warmup=2):
+        """Measure wall time (ms) of a jitted callable on the live backend.
+        With fn=None, measures a small representative op set and fills the
+        internal table."""
+        if fn is None:
+            sizes = {"matmul": lambda: jnp.ones((512, 512)) @ jnp.ones((512, 512)),
+                     "add": lambda: jnp.ones((1 << 20,)) + 1.0,
+                     "reduce_sum": lambda: jnp.sum(jnp.ones((1 << 20,)))}
+            for name, thunk in sizes.items():
+                self._table[name] = self._time(jax.jit(thunk), (), iters,
+                                               warmup)
+            return dict(self._table)
+        cost = self._time(jax.jit(fn) if not hasattr(fn, "lower") else fn,
+                          args, iters, warmup)
+        return {"time": cost}
+
+    @staticmethod
+    def _time(jfn, args, iters, warmup):
+        for _ in range(warmup):
+            jax.block_until_ready(jfn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+    def static_cost_data(self, path=None):
+        """Load (or return) the measured op-latency table (reference:
+        cost_model/static_op_benchmark.json)."""
+        if path is not None:
+            with open(path) as f:
+                self._table.update(json.load(f))
+        return dict(self._table)
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        key = op_name if forward else f"{op_name}_grad"
+        return self._table.get(key)

@@ -8,3 +8,5 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
+from . import autograd  # noqa: F401
+from . import multiprocessing  # noqa: F401

@@ -18,6 +18,7 @@ permutation search.
 from __future__ import annotations
 
 import itertools
+import weakref
 from enum import Enum
 from typing import Callable, Dict, Optional
 
@@ -250,12 +251,19 @@ def reset_excluded_layers(main_program=None):
 
 
 class ASPHelper:
-    """Holds the id(param) -> (param, mask) map for pruned models
+    """Holds the id(param) -> (weakref(param), mask) map for pruned models
     (Parameter is __slots__-based, so masks live here rather than on the
-    object). The strong param reference pins the id so it cannot be
-    recycled onto an unrelated parameter after GC; `reset()` releases."""
+    object). Weak references let dead models' masks be evicted instead of
+    pinning every pruned model's memory for the process lifetime; the
+    identity check on lookup protects against CPython id reuse."""
 
     _masks: Dict[int, tuple] = {}
+
+    @classmethod
+    def _evict_dead(cls):
+        dead = [k for k, (ref, _) in cls._masks.items() if ref() is None]
+        for k in dead:
+            del cls._masks[k]
 
     @classmethod
     def reset(cls):
@@ -293,7 +301,8 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         param._array = param._array * mask_dev
         masks[full] = mask_dev
         if with_mask:
-            ASPHelper._masks[id(param)] = (param, mask_dev)
+            ASPHelper._evict_dead()
+            ASPHelper._masks[id(param)] = (weakref.ref(param), mask_dev)
     return masks
 
 
@@ -312,7 +321,7 @@ class OptimizerWithSparsityGuarantee:
         for group in self._optimizer._param_groups:
             for p in group["params"]:
                 entry = ASPHelper._masks.get(id(p))
-                if entry is not None and entry[0] is p:
+                if entry is not None and entry[0]() is p:
                     masked.append((p, entry[1]))
         if masked:
             arrs = self._apply([p._array for p, _ in masked],

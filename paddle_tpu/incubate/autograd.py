@@ -1,0 +1,51 @@
+"""paddle.incubate.autograd equivalent (reference:
+python/paddle/incubate/autograd — functional jvp/vjp/Jacobian/Hessian and
+the primx primitive-transform system: enable_prim/disable_prim switch op
+lowering into the 126-op primitive set before autodiff).
+
+TPU-native form: jax IS the primitive+transform system (SURVEY §7.1 maps
+primitive.yaml onto jax primitives), so the functional API re-exports the
+core autograd transforms and the prim switches toggle a flag that is
+always-on semantically — every traced graph already lowers to jax
+primitives before differentiation.
+"""
+from __future__ import annotations
+
+from ..autograd import Jacobian, hessian as Hessian  # noqa: F401
+from ..autograd import jvp, vjp  # noqa: F401
+from ..framework import flags as _flags
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+_flags.define_flag("FLAGS_prim_enabled", True,
+                   "composite ops lower to primitives before autodiff")
+
+
+def enable_prim():
+    """reference: primapi — on TPU lowering-to-primitives is inherent to
+    tracing; the flag is kept for API/introspection parity."""
+    _flags.set_flags({"FLAGS_prim_enabled": True})
+
+
+def disable_prim():
+    _flags.set_flags({"FLAGS_prim_enabled": False})
+
+
+def prim_enabled() -> bool:
+    return bool(_flags.get_flags(["FLAGS_prim_enabled"])
+                ["FLAGS_prim_enabled"])
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (reference: primapi.forward_grad) — jvp with
+    default tangents of ones."""
+    outs, tangents = jvp(func, xs, v)
+    return tangents
+
+
+def grad(func, xs, v=None):
+    """Reverse-mode (reference: primapi.grad) — vjp with default cotangent
+    of ones."""
+    outs, grads = vjp(func, xs, v)
+    return grads

@@ -1,0 +1,73 @@
+"""paddle.incubate.multiprocessing equivalent (reference:
+python/paddle/incubate/multiprocessing/{__init__,reductions}.py — pickle
+reductions that pass Tensors between processes through shared memory
+instead of serializing the payload).
+
+TPU-native form: device arrays must round-trip through host anyway, so the
+shared segment holds the host copy via multiprocessing.shared_memory; the
+receiving process re-uploads lazily on first use (mirrors the reference's
+CPU shared-memory path; its CUDA-IPC path has no TPU analog because chips
+are single-controller).
+"""
+from __future__ import annotations
+
+import copyreg
+import multiprocessing
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["init_reductions", "get_context"]
+
+_SEGMENTS = []  # sender-side keepalives, unlinked at process exit
+
+
+def _cleanup_segments():
+    for shm in _SEGMENTS:
+        try:
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    _SEGMENTS.clear()
+
+
+import atexit  # noqa: E402
+
+atexit.register(_cleanup_segments)
+
+
+def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
+    finally:
+        shm.close()
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t: Tensor):
+    arr = np.asarray(t.numpy())
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    view = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+    view[...] = arr
+    _SEGMENTS.append(shm)  # keep mapped until the process exits
+    return _rebuild_tensor, (shm.name, arr.shape, arr.dtype.str,
+                             t.stop_gradient)
+
+
+def init_reductions():
+    """Install the shared-memory pickle reduction for Tensor (reference:
+    reductions.py init_reductions)."""
+    copyreg.pickle(Tensor, _reduce_tensor)
+    from ..core.tensor import Parameter
+    if Parameter is not Tensor:
+        copyreg.pickle(Parameter, _reduce_tensor)
+
+
+def get_context(method="spawn"):
+    return multiprocessing.get_context(method)

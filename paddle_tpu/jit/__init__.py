@@ -1,3 +1,5 @@
-from .api import to_static, not_to_static, ignore_module, save, load, TranslatedLayer
+from .api import (to_static, not_to_static, ignore_module, save, load,
+                  TranslatedLayer, enable_to_static)
 
-__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load", "TranslatedLayer"]
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "TranslatedLayer", "enable_to_static"]

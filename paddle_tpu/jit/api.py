@@ -49,6 +49,16 @@ def _guard_key(args, kwargs):
     return (tuple(leaf_key(a) for a in args), leaf_key(kwargs))
 
 
+_TO_STATIC_ENABLED = True
+
+
+def enable_to_static(enable: bool = True):
+    """Global switch (reference: jit/api.py `enable_to_static`): when off,
+    every StaticFunction runs its original eager python body."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(enable)
+
+
 class StaticFunction:
     """Compiled-function wrapper (reference:
     python/paddle/jit/dy2static/program_translator.py:711
@@ -58,6 +68,8 @@ class StaticFunction:
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        self._full_graph = full_graph
+        self._fallback_keys = set()  # guard keys that graph-broke
         self._cache = {}  # guard key -> (jitted, n_params, n_buffers, out_treedef)
         functools.update_wrapper(self, fn)
 
@@ -82,11 +94,32 @@ class StaticFunction:
         return params, buffers
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            return self._fn(*args, **kwargs)
         params, buffers = self._collect_state()
         key = _guard_key(args, kwargs)
+        if key in self._fallback_keys:
+            return self._fn(*args, **kwargs)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._trace(params, buffers, args, kwargs)
+            try:
+                entry = self._trace(params, buffers, args, kwargs)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerIntegerConversionError) as e:
+                # SOT graph-break contract: untraceable python (data-
+                # dependent control flow, .numpy() mid-graph) falls back
+                # to eager for this guard instead of erroring
+                if self._full_graph:
+                    raise
+                import warnings
+                warnings.warn(
+                    f"to_static: graph break in {self._fn.__name__} "
+                    f"({type(e).__name__}); running this specialisation "
+                    "eagerly")
+                self._fallback_keys.add(key)
+                return self._fn(*args, **kwargs)
             self._cache[key] = entry
         jitted, out_treedef, n_out = entry
 
@@ -99,7 +132,25 @@ class StaticFunction:
         # retracing (keys-as-generator; see framework/random.py)
         all_inputs = [_random.next_key()] + params + tensor_args + buffers
 
-        outs = dispatch(f"to_static:{self._fn.__name__}", jitted, tuple(all_inputs))
+        try:
+            outs = dispatch(f"to_static:{self._fn.__name__}", jitted,
+                            tuple(all_inputs))
+        except jax.errors.JaxRuntimeError as e:
+            # some PJRT runtimes (e.g. tunneled single-chip dev backends)
+            # reject host callbacks inside compiled programs; treat that as
+            # a graph break rather than a hard failure
+            if "host send/recv" not in str(e) and "callback" not in str(e):
+                raise
+            if self._full_graph:
+                raise
+            import warnings
+            warnings.warn(
+                f"to_static: graph break in {self._fn.__name__} (backend "
+                "does not support host callbacks under jit); running this "
+                "specialisation eagerly")
+            self._fallback_keys.add(key)
+            self._cache.pop(key, None)
+            return self._fn(*args, **kwargs)
         if not isinstance(outs, tuple):
             outs = (outs,)
         # write back updated buffers
@@ -174,17 +225,22 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """paddle.jit.to_static (ref: python/paddle/jit/api.py:182)."""
+    """paddle.jit.to_static (ref: python/paddle/jit/api.py:182).
+    `full_graph=False` (the default, like the reference's SOT front-end)
+    permits graph breaks: specialisations that cannot trace run eagerly."""
+    full_graph = kwargs.pop("full_graph", False)
 
     def decorate(fn):
         from ..nn.layer.layers import Layer
 
         if isinstance(fn, Layer):
             layer = fn
-            sf = StaticFunction(layer.forward, input_spec=input_spec, layer=layer)
+            sf = StaticFunction(layer.forward, input_spec=input_spec,
+                                full_graph=full_graph, layer=layer)
             layer.forward = sf
             return layer
-        return StaticFunction(fn, input_spec=input_spec)
+        return StaticFunction(fn, input_spec=input_spec,
+                              full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

@@ -13,8 +13,9 @@ __all__ = ["export"]
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
     """Export `layer` for external runtimes (reference: onnx/export.py
-    `export`). Writes `<path>` StableHLO artifacts via jit.save; emits
-    `<path>.onnx` too when the `onnx` package is installed."""
+    `export`). Writes StableHLO artifacts via jit.save; converting those
+    to an .onnx protobuf is left to external tooling, as the reference
+    leaves it to paddle2onnx."""
     if path.endswith(".onnx"):
         path = path[:-5]
     from ..jit.api import save as jit_save

@@ -38,7 +38,9 @@ class Imikolov(Dataset):
                     freq[w] = freq.get(w, 0) + 1
             words = sorted(w for w, c in freq.items() if c >= min_word_freq)
             self.word_idx = {w: i for i, w in enumerate(words)}
-            unk = self.word_idx["<unk>"] = len(self.word_idx)
+            # corpora often contain a literal <unk> token already
+            unk = self.word_idx.setdefault("<unk>",
+                                           len(self.word_idx))
             split = int(len(lines) * 0.9)
             lines = lines[:split] if self.mode == "train" else lines[split:]
             sents = [[self.word_idx.get(w, unk) for w in ln]
@@ -81,6 +83,43 @@ class Movielens(Dataset):
                  rand_seed=0, download=True):
         self.mode = mode.lower()
         rng = np.random.default_rng(rand_seed)
+        if data_file is not None:
+            # ML-1M layout: a directory with ratings.dat / users.dat
+            # ("::"-separated)
+            records = self._parse_ml1m(data_file)
+        else:
+            records = self._synthetic(rng)
+        is_test = rng.random(len(records)) < test_ratio
+        sel = is_test if self.mode == "test" else ~is_test
+        self.data = [records[k] for k in np.nonzero(sel)[0]]
+
+    def _parse_ml1m(self, root):
+        import os
+        ratings_path = os.path.join(root, "ratings.dat") \
+            if os.path.isdir(root) else root
+        users = {}
+        users_path = os.path.join(os.path.dirname(ratings_path),
+                                  "users.dat")
+        if os.path.exists(users_path):
+            with open(users_path, encoding="latin-1") as f:
+                for ln in f:
+                    uid, gender, age, job = ln.strip().split("::")[:4]
+                    users[int(uid)] = (int(gender == "M"), int(age) % 7,
+                                       int(job))
+        records = []
+        with open(ratings_path, encoding="latin-1") as f:
+            for ln in f:
+                uid, mid, rating = ln.strip().split("::")[:3]
+                uid, mid = int(uid), int(mid)
+                g, a, j = users.get(uid, (0, 0, 0))
+                title = np.zeros(4, np.int64)
+                cats = np.zeros(3, np.int64)
+                records.append((np.int64(uid), np.int64(g), np.int64(a),
+                                np.int64(j), np.int64(mid), title, cats,
+                                np.array([float(rating)], np.float32)))
+        return records
+
+    def _synthetic(self, rng):
         n_users, n_movies, title_vocab = 120, 180, 400
         n = 1500
         users = rng.integers(1, n_users, n)
@@ -89,16 +128,15 @@ class Movielens(Dataset):
         genders = rng.integers(0, 2, n)
         ages = rng.integers(0, self.N_AGES, n)
         jobs = rng.integers(0, self.N_JOBS, n)
-        is_test = rng.random(n) < test_ratio
-        sel = is_test if self.mode == "test" else ~is_test
-        self.data = []
-        for k in np.nonzero(sel)[0]:
+        records = []
+        for k in range(n):
             title = rng.integers(0, title_vocab, 4).astype(np.int64)
             cats = rng.integers(0, self.N_CATEGORIES, 3).astype(np.int64)
-            self.data.append((np.int64(users[k]), np.int64(genders[k]),
-                              np.int64(ages[k]), np.int64(jobs[k]),
-                              np.int64(movies[k]), title, cats,
-                              np.array([ratings[k]], np.float32)))
+            records.append((np.int64(users[k]), np.int64(genders[k]),
+                            np.int64(ages[k]), np.int64(jobs[k]),
+                            np.int64(movies[k]), title, cats,
+                            np.array([ratings[k]], np.float32)))
+        return records
 
     def __getitem__(self, i):
         return self.data[i]
@@ -115,6 +153,11 @@ class Conll05st(Dataset):
     def __init__(self, data_file=None, word_dict_file=None,
                  verb_dict_file=None, target_dict_file=None, emb_file=None,
                  mode="train", download=True):
+        if data_file is not None:
+            raise NotImplementedError(
+                "Conll05st archive parsing is not supported in the "
+                "no-download build; omit data_file for the hermetic "
+                "synthetic corpus")
         self.mode = mode.lower()
         rng = np.random.default_rng(31 if self.mode == "train" else 32)
         vocab, n_preds, n_labels = 300, 40, 19
@@ -157,6 +200,36 @@ class _WMTBase(Dataset):
     def __init__(self, data_file=None, mode="train", dict_size=-1,
                  lang="en", download=True):
         self.mode = mode.lower()
+        bos, eos, unk = 0, 1, 2
+        if data_file is not None:
+            # plain parallel text: one "src<TAB>trg" pair per line
+            with open(data_file, encoding="utf-8") as f:
+                pairs = [ln.rstrip("\n").split("\t")
+                         for ln in f if "\t" in ln]
+            src_vocab = {"<s>": 0, "<e>": 1, "<unk>": 2}
+            trg_vocab = {"<s>": 0, "<e>": 1, "<unk>": 2}
+            for s, t in pairs:
+                for w in s.split():
+                    src_vocab.setdefault(w, len(src_vocab))
+                for w in t.split():
+                    trg_vocab.setdefault(w, len(trg_vocab))
+            if dict_size > 0:
+                src_vocab = {w: i for w, i in src_vocab.items()
+                             if i < dict_size}
+                trg_vocab = {w: i for w, i in trg_vocab.items()
+                             if i < dict_size}
+            self.src_ids, self.trg_ids = src_vocab, trg_vocab
+            self._dict_size = max(len(src_vocab), len(trg_vocab))
+            self.data = []
+            for s, t in pairs:
+                src = np.asarray([src_vocab.get(w, unk)
+                                  for w in s.split()], np.int64)
+                trg = np.asarray([trg_vocab.get(w, unk)
+                                  for w in t.split()], np.int64)
+                trg_in = np.concatenate([[bos], trg]).astype(np.int64)
+                trg_next = np.concatenate([trg, [eos]]).astype(np.int64)
+                self.data.append((src, trg_in, trg_next))
+            return
         dict_size = 150 if dict_size < 0 else dict_size
         self._dict_size = dict_size
         self.src_ids = {f"s{i}": i for i in range(dict_size)}
@@ -165,13 +238,12 @@ class _WMTBase(Dataset):
             self._seed + {"train": 0, "test": 1, "gen": 2,
                           "dev": 3, "val": 3}.get(self.mode, 4))
         n = {"train": 100, "test": 25}.get(self.mode, 20)
-        bos, eos = 0, 1
         self.data = []
         for _ in range(n):
             sl = int(rng.integers(4, 20))
             tl = int(rng.integers(4, 20))
-            src = rng.integers(2, dict_size, sl).astype(np.int64)
-            trg = rng.integers(2, dict_size, tl).astype(np.int64)
+            src = rng.integers(3, dict_size, sl).astype(np.int64)
+            trg = rng.integers(3, dict_size, tl).astype(np.int64)
             trg_in = np.concatenate([[bos], trg]).astype(np.int64)
             trg_next = np.concatenate([trg, [eos]]).astype(np.int64)
             self.data.append((src, trg_in, trg_next))

@@ -1,0 +1,24 @@
+"""paddle.utils equivalent — the pieces with user-facing API surface
+(reference: python/paddle/utils: cpp_extension build system, try_import,
+unique_name). The reference's C++ utility types (variant/optional/
+small_vector) are Python natives here."""
+from . import cpp_extension  # noqa: F401
+
+_UNIQUE_COUNTERS = {}
+
+
+def unique_name(prefix="tmp"):
+    """reference: python/paddle/utils/unique_name.py generate()."""
+    n = _UNIQUE_COUNTERS.get(prefix, 0)
+    _UNIQUE_COUNTERS[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+def try_import(module_name, err_msg=None):
+    """reference: python/paddle/utils/lazy_import.py try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed")

@@ -1,0 +1,35 @@
+// Stable C ABI for out-of-tree custom ops (reference: paddle/phi/capi +
+// PD_BUILD_OP, paddle/utils/cpp_extension). A custom op is an extern "C"
+// symbol:  void <name>(const PTTensor* ins, int n_in,
+//                      PTTensor* outs, int n_out);
+// Tensors are dense host buffers; outputs are pre-allocated by the caller
+// from the python-side infer_meta function.
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+enum PTDtype : int32_t {
+  PT_FLOAT32 = 0,
+  PT_FLOAT64 = 1,
+  PT_INT32 = 2,
+  PT_INT64 = 3,
+  PT_BOOL = 4,
+};
+
+typedef struct {
+  void* data;
+  int64_t ndim;
+  int64_t shape[8];
+  int32_t dtype;
+} PTTensor;
+
+}  // extern "C"
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+static inline int64_t pt_numel(const PTTensor* t) {
+  int64_t n = 1;
+  for (int64_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}

@@ -1,0 +1,235 @@
+"""Tests for the SOT-contract jit additions (graph-break fallback,
+enable_to_static), the cpp_extension custom-op build system, cost_model,
+and incubate.autograd / incubate.multiprocessing."""
+import os
+import pickle
+import tempfile
+import unittest
+import warnings
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def setUpModule():
+    paddle.seed(0)
+
+
+class TestGraphBreakFallback(unittest.TestCase):
+    def test_untraceable_falls_back_to_eager(self):
+        @paddle.jit.to_static
+        def f(x):
+            if float(x.sum().numpy()) > 0:  # data-dependent python branch
+                return x * 2
+            return x - 1
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(x)
+            self.assertTrue(any("graph break" in str(m.message) for m in w))
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones(4))
+        # the eager path really runs the branch logic
+        out2 = f(paddle.to_tensor(-np.ones(4, np.float32)))
+        np.testing.assert_allclose(out2.numpy(), -2 * np.ones(4))
+
+    def test_full_graph_true_raises(self):
+        @paddle.jit.to_static(full_graph=True)
+        def g(x):
+            if float(x.sum().numpy()) > 0:
+                return x * 2
+            return x - 1
+
+        with self.assertRaises(Exception):
+            g(paddle.to_tensor(np.ones(4, np.float32)))
+
+    def test_cache_and_enable_switch(self):
+        calls = [0]
+
+        @paddle.jit.to_static
+        def h(x):
+            calls[0] += 1
+            return x * 3
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        h(x)
+        h(x)
+        self.assertEqual(calls[0], 1)  # guard hit -> no retrace
+        paddle.jit.enable_to_static(False)
+        try:
+            h(x)
+            self.assertEqual(calls[0], 2)  # eager body ran
+        finally:
+            paddle.jit.enable_to_static(True)
+        h(x)
+        self.assertEqual(calls[0], 2)  # cache again
+
+
+_EXT_SRC = r"""
+#include "ext_api.h"
+#include <cmath>
+
+PT_EXPORT void scaled_add(const PTTensor* ins, int n_in,
+                          PTTensor* outs, int n_out) {
+  const float* x = static_cast<const float*>(ins[0].data);
+  const float* y = static_cast<const float*>(ins[1].data);
+  float* out = static_cast<float*>(outs[0].data);
+  int64_t n = pt_numel(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * x[i] + y[i];
+}
+
+PT_EXPORT void minmax(const PTTensor* ins, int n_in,
+                      PTTensor* outs, int n_out) {
+  const float* x = static_cast<const float*>(ins[0].data);
+  float* mn = static_cast<float*>(outs[0].data);
+  float* mx = static_cast<float*>(outs[1].data);
+  int64_t n = pt_numel(&ins[0]);
+  mn[0] = x[0]; mx[0] = x[0];
+  for (int64_t i = 1; i < n; ++i) {
+    if (x[i] < mn[0]) mn[0] = x[i];
+    if (x[i] > mx[0]) mx[0] = x[i];
+  }
+}
+"""
+
+
+class TestCppExtension(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        from paddle_tpu.utils import cpp_extension
+        src = os.path.join(tempfile.mkdtemp(), "ops.cc")
+        with open(src, "w") as f:
+            f.write(_EXT_SRC)
+        cls.ext = cpp_extension.load(
+            "test_ops", [src],
+            functions={
+                "scaled_add": lambda a, b: (a[0], a[1]),
+                "minmax": lambda a: [((), a[1]), ((), a[1])],
+            })
+
+    def test_single_output(self):
+        rng = np.random.default_rng(0)
+        a = paddle.to_tensor(rng.normal(size=(4, 5)).astype(np.float32))
+        b = paddle.to_tensor(rng.normal(size=(4, 5)).astype(np.float32))
+        out = self.ext.scaled_add(a, b)
+        np.testing.assert_allclose(out.numpy(), 2 * a.numpy() + b.numpy(),
+                                   rtol=1e-6)
+
+    def test_multi_output(self):
+        x = paddle.to_tensor(np.array([3.0, -1.0, 7.0], np.float32))
+        mn, mx = self.ext.minmax(x)
+        self.assertEqual(float(mn.numpy()), -1.0)
+        self.assertEqual(float(mx.numpy()), 7.0)
+
+    def test_under_jit(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return self.ext.scaled_add(a * 2, b)
+
+        a = paddle.to_tensor(np.ones((2, 3), np.float32))
+        b = paddle.to_tensor(np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(f(a, b).numpy(), 5 * np.ones((2, 3)),
+                                   rtol=1e-6)
+
+    def test_compile_error_reported(self):
+        from paddle_tpu.utils import cpp_extension
+        src = os.path.join(tempfile.mkdtemp(), "bad.cc")
+        with open(src, "w") as f:
+            f.write("this is not C++")
+        with self.assertRaises(RuntimeError):
+            cpp_extension.load("bad_ext", [src],
+                               functions={"f": lambda a: a})
+
+    def test_build_cache_reuses_so(self):
+        from paddle_tpu.utils import cpp_extension
+        src = os.path.join(tempfile.mkdtemp(), "ops.cc")
+        with open(src, "w") as f:
+            f.write(_EXT_SRC)
+        m1 = cpp_extension.load("cache_probe", [src],
+                                functions={"minmax": lambda a: [((), a[1]),
+                                                                ((), a[1])]})
+        m2 = cpp_extension.load("cache_probe", [src],
+                                functions={"minmax": lambda a: [((), a[1]),
+                                                                ((), a[1])]})
+        self.assertEqual(m1.so_path, m2.so_path)
+
+
+class TestCostModel(unittest.TestCase):
+    def test_profile_measure(self):
+        cm = paddle.cost_model.CostModel()
+        table = cm.profile_measure(iters=2, warmup=1)
+        self.assertEqual(set(table), {"matmul", "add", "reduce_sum"})
+        self.assertTrue(all(v > 0 for v in table.values()))
+        self.assertGreater(cm.get_static_op_time("matmul"), 0)
+        r = cm.profile_measure(lambda a: a @ a,
+                               (np.ones((32, 32), np.float32),),
+                               iters=2, warmup=1)
+        self.assertGreater(r["time"], 0)
+
+    def test_static_table_load(self):
+        import json
+        p = tempfile.mktemp(suffix=".json")
+        with open(p, "w") as f:
+            json.dump({"softmax": 0.12}, f)
+        cm = paddle.cost_model.CostModel()
+        cm.static_cost_data(p)
+        self.assertEqual(cm.get_static_op_time("softmax"), 0.12)
+
+
+class TestIncubateAutograd(unittest.TestCase):
+    def test_functional(self):
+        from paddle_tpu.incubate import autograd as iag
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        out, g = iag.vjp(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(g.numpy(), 2 * np.arange(4), rtol=1e-6)
+        np.testing.assert_allclose(
+            iag.grad(lambda t: (t * t).sum(), x).numpy(),
+            2 * np.arange(4), rtol=1e-6)
+        tg = iag.forward_grad(lambda t: t * 3.0, x)
+        np.testing.assert_allclose(tg.numpy(), 3 * np.ones(4), rtol=1e-6)
+
+    def test_prim_switch(self):
+        from paddle_tpu.incubate import autograd as iag
+        self.assertTrue(iag.prim_enabled())
+        iag.disable_prim()
+        self.assertFalse(iag.prim_enabled())
+        iag.enable_prim()
+        self.assertTrue(iag.prim_enabled())
+
+
+class TestIncubateMultiprocessing(unittest.TestCase):
+    def test_shared_memory_pickle_roundtrip(self):
+        from paddle_tpu.incubate import multiprocessing as imp
+        imp.init_reductions()
+        t = paddle.to_tensor(np.random.default_rng(0)
+                             .normal(size=(16,)).astype(np.float32))
+        t2 = pickle.loads(pickle.dumps(t))
+        np.testing.assert_array_equal(t2.numpy(), t.numpy())
+        self.assertEqual(t2.stop_gradient, t.stop_gradient)
+
+    def test_cross_process(self):
+        from paddle_tpu.incubate import multiprocessing as imp
+        imp.init_reductions()
+        ctx = imp.get_context("spawn")
+        q = ctx.Queue()
+        t = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        p = ctx.Process(target=_child_sum, args=(q, pickle.dumps(t)))
+        p.start()
+        got = q.get(timeout=60)
+        p.join(timeout=60)
+        self.assertEqual(got, float(np.arange(8).sum()))
+
+
+def _child_sum(q, payload):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    t = pickle.loads(payload)
+    q.put(float(t.numpy().sum()))
+
+
+if __name__ == "__main__":
+    unittest.main()
