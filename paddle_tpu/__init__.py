@@ -58,6 +58,11 @@ from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
 from . import cost_model  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
 from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

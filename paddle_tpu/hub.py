@@ -1,0 +1,59 @@
+"""paddle.hub equivalent (reference: python/paddle/hapi/hub.py —
+list/help/load entrypoints from a repo's hubconf.py; sources github/gitee/
+local).
+
+No-network policy: only source='local' is supported; remote sources raise
+with a clear message instead of attempting a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(f"unknown source {source!r}")
+    if source != "local":
+        raise RuntimeError(
+            "remote hub sources are unavailable in the no-network build; "
+            "clone the repo and use source='local'")
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop("paddle_tpu_hubconf", None)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf
+    (reference: hub.py list)."""
+    mod = _load_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint (reference: hub.py help)."""
+    mod = _load_hubconf(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate one entrypoint (reference: hub.py load)."""
+    mod = _load_hubconf(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry(**kwargs)

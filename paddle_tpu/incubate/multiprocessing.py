@@ -21,17 +21,23 @@ from ..core.tensor import Tensor
 
 __all__ = ["init_reductions", "get_context"]
 
-_SEGMENTS = []  # sender-side keepalives, unlinked at process exit
+from collections import deque
+
+# sender-side keepalives: a bounded window so unconsumed payloads do not
+# grow /dev/shm without bound (receivers unlink on rebuild; these handles
+# only cover the pickling->unpickling gap)
+_SEGMENT_WINDOW = 64
+_SEGMENTS = deque()
 
 
 def _cleanup_segments():
-    for shm in _SEGMENTS:
+    while _SEGMENTS:
+        shm = _SEGMENTS.popleft()
         try:
             shm.close()
             shm.unlink()
         except FileNotFoundError:
             pass
-    _SEGMENTS.clear()
 
 
 import atexit  # noqa: E402
@@ -45,6 +51,13 @@ def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
         arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
     finally:
         shm.close()
+        # payload is copied out, so the receiver releases the segment —
+        # transfers are one-shot (unpickling the same payload twice is not
+        # supported, unlike the reference's refcounted CUDA-IPC path)
+        try:
+            shared_memory.SharedMemory(name=shm_name).unlink()
+        except FileNotFoundError:
+            pass
     t = Tensor(arr)
     t.stop_gradient = stop_gradient
     return t
@@ -55,7 +68,14 @@ def _reduce_tensor(t: Tensor):
     shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
     view = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
     view[...] = arr
-    _SEGMENTS.append(shm)  # keep mapped until the process exits
+    _SEGMENTS.append(shm)
+    while len(_SEGMENTS) > _SEGMENT_WINDOW:
+        old = _SEGMENTS.popleft()
+        old.close()
+        try:
+            old.unlink()  # no-op if the receiver already unlinked
+        except FileNotFoundError:
+            pass
     return _rebuild_tensor, (shm.name, arr.shape, arr.dtype.str,
                              t.stop_gradient)
 

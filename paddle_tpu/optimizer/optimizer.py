@@ -106,17 +106,28 @@ class Optimizer:
             if not self._accumulators.get(id(p)):
                 self._accumulators[id(p)] = self._create_accumulators(p)
 
-        wd_flags = []
+        from ..regularizer import L1Decay
+
+        wd_flags, l1_flags = [], []
         for group in self._param_groups:
-            wd = self._weight_decay_value(group)
+            raw = group.get("weight_decay", self._weight_decay)
+            is_l1 = isinstance(raw, L1Decay)
+            wd = 0.0 if is_l1 else self._weight_decay_value(group)
+            l1 = float(raw) if is_l1 else 0.0
             for p in group["params"]:
                 if p._grad is None or p.stop_gradient:
                     continue
-                wd_flags.append(wd if self._apply_decay(p) else 0.0)
+                apply = self._apply_decay(p)
+                wd_flags.append(wd if apply else 0.0)
+                l1_flags.append(l1 if apply else 0.0)
 
         def update_all(param_arrs, grad_arrs, state_list, lr_, step_):
             new_params, new_states = [], []
-            for pa, ga, st, wd in zip(param_arrs, grad_arrs, state_list, wd_flags):
+            for pa, ga, st, wd, l1 in zip(param_arrs, grad_arrs, state_list,
+                                          wd_flags, l1_flags):
+                if l1:
+                    # L1Decay: subgradient coeff * sign(w) joins the grad
+                    ga = ga + l1 * jnp.sign(pa)
                 np_, ns = self._update_rule_arr(pa, ga, st, lr_, wd, step_)
                 new_params.append(np_)
                 new_states.append(ns)

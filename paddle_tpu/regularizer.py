@@ -1,0 +1,36 @@
+"""paddle.regularizer equivalent (reference: python/paddle/regularizer.py
+L1Decay / L2Decay attached via Optimizer(weight_decay=...) or per-param
+`ParamAttr.regularizer`).
+
+TPU-native: decay folds into the jitted optimizer update (L2 as decoupled
+weight decay; L1 as a sign penalty added to the gradient) instead of the
+reference's separate regularization ops appended to the graph.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __float__(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """reference: regularizer.py L2Decay — coeff * ||w||^2 penalty,
+    realised as weight decay in the fused update."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """reference: regularizer.py L1Decay — coeff * ||w||_1; the optimizer
+    adds coeff * sign(w) to the gradient before the update."""

@@ -1,0 +1,16 @@
+"""paddle.sysconfig equivalent (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building extensions against the installed tree)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory of the custom-op C ABI headers (ext_api.h)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "utils")
+
+
+def get_lib():
+    """Directory containing native libraries shipped with the package."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "native")

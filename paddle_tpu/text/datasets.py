@@ -104,7 +104,12 @@ class Movielens(Dataset):
             with open(users_path, encoding="latin-1") as f:
                 for ln in f:
                     uid, gender, age, job = ln.strip().split("::")[:4]
-                    users[int(uid)] = (int(gender == "M"), int(age) % 7,
+                    # ML-1M age codes {1,18,25,35,45,50,56} rank-mapped
+                    # to 0..6 (reference: movielens.py age_table)
+                    ages = [1, 18, 25, 35, 45, 50, 56]
+                    code = int(age)
+                    bucket = ages.index(code) if code in ages else 0
+                    users[int(uid)] = (int(gender == "M"), bucket,
                                        int(job))
         records = []
         with open(ratings_path, encoding="latin-1") as f:

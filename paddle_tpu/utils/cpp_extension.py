@@ -64,6 +64,11 @@ def _compile(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
     for s in sources:
         with open(s, "rb") as f:
             tag.update(f.read())
+    # the ABI header and compiler version are part of the binary contract
+    with open(os.path.join(_include_dir(), "ext_api.h"), "rb") as f:
+        tag.update(f.read())
+    tag.update(subprocess.run(["g++", "--version"], capture_output=True)
+               .stdout)
     tag.update(" ".join(list(extra_cflags) + list(extra_ldflags)).encode())
     so_path = os.path.join(build_dir, f"{name}_{tag.hexdigest()[:12]}.so")
     if os.path.exists(so_path):
@@ -96,12 +101,10 @@ class CustomOp:
     pure_callback (the XLA custom-call analog of the reference's custom
     OpKernel)."""
 
-    def __init__(self, cfunc, name: str,
-                 infer_meta: Callable, n_outputs: int):
+    def __init__(self, cfunc, name: str, infer_meta: Callable):
         self._cfunc = cfunc
         self._name = name
         self._infer_meta = infer_meta
-        self._n_outputs = n_outputs
 
     def _host_call(self, *arrays):
         arrays = [np.ascontiguousarray(np.asarray(a)) for a in arrays]
@@ -154,7 +157,7 @@ def load(name: str, sources: Sequence[str],
          functions: Optional[Dict[str, Callable]] = None,
          extra_cflags: Sequence[str] = (), extra_ldflags: Sequence[str] = (),
          build_directory: Optional[str] = None, verbose: bool = False,
-         n_outputs: int = 1, **kwargs) -> CustomOpModule:
+         **kwargs) -> CustomOpModule:
     """JIT-compile and bind a custom-op extension (reference:
     cpp_extension.py:806 `load`).
 
@@ -176,7 +179,7 @@ def load(name: str, sources: Sequence[str],
         cfunc.restype = None
         cfunc.argtypes = [ctypes.POINTER(_PTTensor), ctypes.c_int,
                           ctypes.POINTER(_PTTensor), ctypes.c_int]
-        ops[sym] = CustomOp(cfunc, sym, infer_meta, n_outputs)
+        ops[sym] = CustomOp(cfunc, sym, infer_meta)
     return CustomOpModule(so_path, ops)
 
 

@@ -1,0 +1,65 @@
+"""paddle.version equivalent (reference: generated python/paddle/version
+module — full_version/major/minor/patch/rc plus build metadata introspection
+helpers)."""
+import jax
+
+full_version = "3.0.0-tpu.1"
+major, minor, patch, rc = "3", "0", "0", "0"
+commit = "tpu-native"
+istaged = True
+with_pip_cuda_libraries = "OFF"
+
+cuda_version = "False"
+cudnn_version = "False"
+nccl_version = "0"
+is_tagged = istaged
+xpu_version = "False"
+xpu_xccl_version = "False"
+xpu_xhpc_version = "False"
+cinn_version = "False"
+tensorrt_version = "None"
+
+
+def show():
+    print("full_version:", full_version)
+    print("commit:", commit)
+    print("jax:", jax.__version__)
+    print("backend:", jax.default_backend())
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def nccl():
+    return nccl_version
+
+
+def xpu():
+    return xpu_version
+
+
+def xpu_xccl():
+    return xpu_xccl_version
+
+
+def xpu_xhpc():
+    return xpu_xhpc_version
+
+
+def cinn():
+    return cinn_version
+
+
+def tensorrt():
+    return tensorrt_version
+
+
+def tpu():
+    """TPU-native addition: the live accelerator generation."""
+    devs = jax.devices()
+    return getattr(devs[0], "device_kind", "unknown") if devs else "none"

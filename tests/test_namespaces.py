@@ -1,0 +1,95 @@
+"""Tests for the small top-level namespaces: regularizer, callbacks, hub,
+version, sysconfig — and their integration points (optimizer decay)."""
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def setUpModule():
+    paddle.seed(0)
+
+
+class TestRegularizer(unittest.TestCase):
+    def test_l1_sign_penalty_exact(self):
+        m = nn.Linear(4, 4)
+        w0 = np.asarray(m.weight._array).copy()
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters(),
+                    weight_decay=paddle.regularizer.L1Decay(0.05))
+        for _ in range(3):
+            loss = (m(paddle.to_tensor(np.zeros((1, 4), np.float32)))
+                    * 0).sum()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        exp = w0 - 3 * 0.1 * 0.05 * np.sign(w0)
+        np.testing.assert_allclose(np.asarray(m.weight._array), exp,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_l2_decay_shrinks_weights(self):
+        m = nn.Linear(4, 4)
+        w0 = np.abs(np.asarray(m.weight._array)).sum()
+        o = opt.AdamW(learning_rate=0.01, parameters=m.parameters(),
+                      weight_decay=paddle.regularizer.L2Decay(0.1))
+        for _ in range(3):
+            loss = (m(paddle.to_tensor(np.zeros((1, 4), np.float32)))
+                    * 0).sum()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        self.assertLess(np.abs(np.asarray(m.weight._array)).sum(), w0)
+
+    def test_float_coercion(self):
+        self.assertEqual(float(paddle.regularizer.L2Decay(0.25)), 0.25)
+        self.assertEqual(paddle.regularizer.L1Decay(0.5).coeff, 0.5)
+
+
+class TestHub(unittest.TestCase):
+    def setUp(self):
+        self.repo = tempfile.mkdtemp()
+        with open(os.path.join(self.repo, "hubconf.py"), "w") as f:
+            f.write(
+                "import paddle_tpu.nn as nn\n"
+                "def tiny_mlp(hidden=8):\n"
+                '    """A tiny MLP entrypoint."""\n'
+                "    return nn.Sequential(nn.Linear(4, hidden), nn.ReLU(),\n"
+                "                         nn.Linear(hidden, 2))\n")
+
+    def test_list_help_load(self):
+        self.assertEqual(paddle.hub.list(self.repo), ["tiny_mlp"])
+        self.assertIn("tiny MLP", paddle.hub.help(self.repo, "tiny_mlp"))
+        m = paddle.hub.load(self.repo, "tiny_mlp", hidden=16)
+        out = m(paddle.to_tensor(np.ones((1, 4), np.float32)))
+        self.assertEqual(list(out.shape), [1, 2])
+
+    def test_remote_refused(self):
+        with self.assertRaises(RuntimeError):
+            paddle.hub.load("user/repo", "model", source="github")
+
+    def test_missing_entrypoint(self):
+        with self.assertRaises(RuntimeError):
+            paddle.hub.load(self.repo, "nope")
+
+
+class TestVersionSysconfigCallbacks(unittest.TestCase):
+    def test_version(self):
+        self.assertTrue(paddle.version.full_version)
+        self.assertEqual(paddle.version.cuda(), "False")
+        paddle.version.show()  # smoke
+
+    def test_sysconfig(self):
+        inc = paddle.sysconfig.get_include()
+        self.assertTrue(os.path.exists(os.path.join(inc, "ext_api.h")))
+
+    def test_callbacks_namespace(self):
+        self.assertTrue(callable(paddle.callbacks.EarlyStopping))
+        self.assertTrue(callable(paddle.callbacks.ProgBarLogger))
+
+
+if __name__ == "__main__":
+    unittest.main()
