@@ -29,6 +29,7 @@ def main():
     n_dev = jax.device_count()
     if on_tpu:
         cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=True,
+                                   recompute_skip=4,
                                    max_position_embeddings=2048)
         batch, seq, iters = 8, 2048, 10
     else:  # CPU smoke config so the harness always yields a number

@@ -26,7 +26,8 @@ from collections import deque
 # sender-side keepalives: a bounded window so unconsumed payloads do not
 # grow /dev/shm without bound (receivers unlink on rebuild; these handles
 # only cover the pickling->unpickling gap)
-_SEGMENT_WINDOW = 64
+import os as _os
+_SEGMENT_WINDOW = int(_os.environ.get("PADDLE_SHM_WINDOW", "256"))
 _SEGMENTS = deque()
 
 
@@ -46,16 +47,23 @@ atexit.register(_cleanup_segments)
 
 
 def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
-    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        shm = shared_memory.SharedMemory(name=shm_name)
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"shared-memory payload {shm_name!r} is gone: it was either "
+            "already unpickled once (transfers are one-shot) or evicted "
+            "after the sender queued more than "
+            f"{_SEGMENT_WINDOW} unconsumed tensors")
     try:
         arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
     finally:
-        shm.close()
         # payload is copied out, so the receiver releases the segment —
         # transfers are one-shot (unpickling the same payload twice is not
         # supported, unlike the reference's refcounted CUDA-IPC path)
+        shm.close()
         try:
-            shared_memory.SharedMemory(name=shm_name).unlink()
+            shm.unlink()
         except FileNotFoundError:
             pass
     t = Tensor(arr)

@@ -50,6 +50,11 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     recompute: bool = False          # per-layer remat
+    # skip remat for the last K layers: their saved activations live the
+    # shortest (backward frees them first), so exempting them buys back
+    # recompute FLOPs at minimal peak-memory cost (analog of the
+    # reference's selective recompute_interval in fleet pp_layers)
+    recompute_skip: int = 0
     # remat policy: "none" saves only layer boundaries (recompute all);
     # "save_attn" additionally keeps attention outputs, skipping the flash
     # forward re-run in the backward pass (reference analog: selective
@@ -291,7 +296,8 @@ class LlamaModel(Layer):
             if caches is not None:
                 hidden, c = layer(hidden, cos, sin, cache=caches[li], mesh=mesh)
                 new_caches.append(c)
-            elif use_ckpt:
+            elif use_ckpt and li < len(self.layers) - \
+                    self.config.recompute_skip:
                 def run(h, l=layer):
                     return unwrap(l(Tensor(h), cos, sin, mesh=mesh))
 

@@ -56,6 +56,22 @@ def _include_dir() -> str:
     return os.path.dirname(os.path.abspath(__file__))
 
 
+_COMPILER_VERSION = None
+
+
+def _compiler_version() -> bytes:
+    global _COMPILER_VERSION
+    if _COMPILER_VERSION is None:
+        try:
+            _COMPILER_VERSION = subprocess.run(
+                ["g++", "--version"], capture_output=True).stdout
+        except FileNotFoundError:
+            # no compiler on this host: cache hits still work, a cache
+            # miss fails later in the g++ invocation with a clear error
+            _COMPILER_VERSION = b"g++-absent"
+    return _COMPILER_VERSION
+
+
 def _compile(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
              build_directory: Optional[str], verbose: bool) -> str:
     build_dir = build_directory or get_build_directory()
@@ -67,8 +83,7 @@ def _compile(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
     # the ABI header and compiler version are part of the binary contract
     with open(os.path.join(_include_dir(), "ext_api.h"), "rb") as f:
         tag.update(f.read())
-    tag.update(subprocess.run(["g++", "--version"], capture_output=True)
-               .stdout)
+    tag.update(_compiler_version())
     tag.update(" ".join(list(extra_cflags) + list(extra_ldflags)).encode())
     so_path = os.path.join(build_dir, f"{name}_{tag.hexdigest()[:12]}.so")
     if os.path.exists(so_path):
