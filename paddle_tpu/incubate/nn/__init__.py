@@ -1,1 +1,5 @@
 from . import functional  # noqa: F401
+from .layer import (FusedDropoutAdd, FusedFeedForward,  # noqa: F401
+                    FusedLinear, FusedMultiHeadAttention,
+                    FusedMultiTransformer,
+                    FusedTransformerEncoderLayer)

@@ -23,7 +23,11 @@ from ....nn.functional.activation import swiglu  # fused op already  # noqa: F40
 __all__ = [
     "fused_rotary_position_embedding", "fused_rms_norm", "fused_layer_norm",
     "swiglu", "fused_bias_act", "fused_linear", "fused_linear_activation",
+    "masked_multihead_attention", "block_multihead_attention",
 ]
+
+from .attention import (block_multihead_attention,  # noqa: E402,F401
+                        masked_multihead_attention)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,

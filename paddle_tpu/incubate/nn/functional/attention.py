@@ -1,0 +1,225 @@
+"""LLM-inference attention family (reference:
+python/paddle/incubate/nn/functional/{masked_multihead_attention,
+block_multihead_attention}.py — the serving-path fused CUDA kernels).
+
+TPU-native form:
+- masked_multihead_attention (decode step over a contiguous KV cache) is a
+  fully vectorized jnp computation: cache update is a one-hot scatter and
+  the masked softmax runs in fp32 — XLA fuses it into a single decode
+  kernel, and the whole thing is jit/`to_static`-safe (no data-dependent
+  python).
+- block_multihead_attention (paged KV cache with block tables) keeps the
+  reference's cache layout [max_block_num, num_head, block_size, head_dim]
+  so serving engines can manage pages identically; gathers ride
+  jnp.take over the block table. Prefill and decode are handled in one
+  call per the seq_lens_encoder/decoder convention.
+
+Quant in/out scales (int8 serving) are out of scope here — the TPU quant
+path lives in paddle_tpu.quantization.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, dispatch
+
+__all__ = ["masked_multihead_attention", "block_multihead_attention"]
+
+
+def _split_qkv(x, num_head, head_dim):
+    b = x.shape[0]
+    qkv = x.reshape(b, 3, num_head, head_dim)
+    return qkv[:, 0], qkv[:, 1], qkv[:, 2]
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+        sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+        qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+        rotary_emb_dims=0, use_neox_rotary_style=False,
+        compute_dtype="default", out_scale=-1, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0):
+    """One decode step of masked MHA over a contiguous cache (reference:
+    masked_multihead_attention.py:19; CUDA kernel
+    paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    x: [B, 3*H*D] packed qkv for the current token.
+    cache_kv: [2, B, H, max_seq, D]; sequence_lengths: [B, 1] tokens
+    already cached. Returns (out [B, H*D], updated cache_kv).
+    """
+    if cache_kv is None:
+        raise ValueError(
+            "masked_multihead_attention requires cache_kv "
+            "[2, batch, heads, max_seq, head_dim]")
+    args = [a for a in (x, cache_kv, bias, src_mask, sequence_lengths,
+                        rotary_tensor) if a is not None]
+
+    def impl(*arrs):
+        it = iter(arrs)
+        xa = next(it)
+        cache = next(it)
+        ba = next(it) if bias is not None else None
+        mask = next(it) if src_mask is not None else None
+        lens = next(it) if sequence_lengths is not None else None
+        rot = next(it) if rotary_tensor is not None else None
+
+        _, b, h, max_seq, d = cache.shape
+        if ba is not None:
+            xa = xa + ba.reshape(1, -1)
+        q, k, v = _split_qkv(xa, h, d)  # [B, H, D]
+
+        if rot is not None and rotary_emb_dims > 0:
+            # rotary_tensor: [B, 1, 1, S, D] cos/sin interleaved as in the
+            # reference; take the entry at the current position
+            pos = (lens.reshape(-1).astype(jnp.int32)
+                   if lens is not None else jnp.zeros((b,), jnp.int32))
+            rt = rot[:, 0, 0]                      # [B, S, D]
+            rt_t = jnp.take_along_axis(
+                rt, pos[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+            cos, sin = rt_t[..., 0::2], rt_t[..., 1::2]
+            cos = jnp.repeat(cos, 2, axis=-1)[..., :d][:, None, :]
+            sin = jnp.repeat(sin, 2, axis=-1)[..., :d][:, None, :]
+
+            def rope(t):
+                if use_neox_rotary_style:
+                    t1, t2 = t[..., : d // 2], t[..., d // 2:]
+                    rotated = jnp.concatenate([-t2, t1], -1)
+                else:
+                    t1, t2 = t[..., 0::2], t[..., 1::2]
+                    rotated = jnp.stack([-t2, t1], -1).reshape(t.shape)
+                return t * cos + rotated * sin
+
+            q, k = rope(q), rope(k)
+
+        pos = (lens.reshape(-1).astype(jnp.int32)
+               if lens is not None else jnp.zeros((b,), jnp.int32))
+        # scatter this step's k/v at position `pos` per batch row
+        onehot = jax.nn.one_hot(pos, max_seq, dtype=cache.dtype)  # [B, S]
+        upd_k = cache[0] * (1 - onehot[:, None, :, None]) + \
+            k[:, :, None, :] * onehot[:, None, :, None]
+        upd_v = cache[1] * (1 - onehot[:, None, :, None]) + \
+            v[:, :, None, :] * onehot[:, None, :, None]
+        new_cache = jnp.stack([upd_k, upd_v])
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            upd_k.astype(jnp.float32)) * scale
+        valid = jnp.arange(max_seq)[None, :] <= pos[:, None]  # [B, S]
+        logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
+        if mask is not None:
+            m = mask.reshape(b, 1, -1)[..., :max_seq]
+            logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", probs,
+                         upd_v.astype(jnp.float32))
+        out = out.astype(xa.dtype).reshape(b, h * d)
+        return out, new_cache
+
+    return dispatch("masked_multihead_attention", impl, tuple(args))
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None, pre_value_cache=None,
+        cache_k_quant_scales=None, cache_v_quant_scales=None,
+        cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+        qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None,
+        max_enc_len_this_time=None, max_dec_len_this_time=None,
+        rope_emb=None, mask=None, tgt_mask=None, max_seq_len=-1,
+        block_size=64, use_neox_style=False,
+        use_dynamic_cachekv_quant=False, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1,
+        compute_dtype="default"):
+    """Paged-KV attention with block tables (reference:
+    block_multihead_attention.py:19; CUDA kernels under
+    paddle/phi/kernels/fusion/gpu/block_attn.h).
+
+    qkv: [token_num, 3*H*D] packed, unpadded across the batch per
+    cu_seqlens_q. key_cache/value_cache: [max_block_num, H, block_size, D]
+    pages; block_tables: [B, blocks_per_seq] page ids. Per sequence i:
+    prefill when seq_lens_encoder[i] > 0 (causal attention over the new
+    tokens), decode when seq_lens_this_time[i] == 1 attending over
+    seq_lens_decoder[i] cached tokens + the new one.
+
+    Serving engines drive this eagerly step by step (shapes change every
+    iteration), so concrete python control flow over the host-visible
+    lengths is the intended mode, matching the reference's dynamic-graph
+    usage. Returns (out [token_num, H*D], key_cache, value_cache).
+    """
+    import numpy as np
+    from ....core.tensor import unwrap
+
+    def arr(v):
+        return None if v is None else np.asarray(
+            unwrap(v) if isinstance(v, Tensor) else v)
+
+    qkv_a = arr(qkv)
+    kc = np.array(arr(key_cache))
+    vc = np.array(arr(value_cache))
+    rope = arr(rope_emb)
+    enc_lens = arr(seq_lens_encoder).reshape(-1)
+    dec_lens = arr(seq_lens_decoder).reshape(-1)
+    this_lens = arr(seq_lens_this_time).reshape(-1)
+    cu_q = arr(cu_seqlens_q).reshape(-1)
+    tables = arr(block_tables)
+    bias_a = arr(qkv_bias)
+
+    bsz = len(this_lens)
+    h, d = kc.shape[1], kc.shape[3]
+    if bias_a is not None:
+        qkv_a = qkv_a + bias_a.reshape(1, -1)
+
+    outs = np.zeros((qkv_a.shape[0], h * d), qkv_a.dtype)
+    scale = 1.0 / np.sqrt(d)
+    for i in range(bsz):
+        n_new = int(this_lens[i])
+        if n_new == 0:
+            continue
+        start = int(cu_q[i])
+        toks = qkv_a[start:start + n_new].reshape(n_new, 3, h, d)
+        q, k, v = toks[:, 0], toks[:, 1], toks[:, 2]  # [n_new, H, D]
+        past = int(dec_lens[i])  # tokens already paged in
+        if rope is not None:
+            # rope_emb: [2, max_seq, head_dim] cos/sin at global positions
+            pos = past + np.arange(n_new)
+            cos = rope[0][pos][:, None, :]  # [n_new, 1, D]
+            sin = rope[1][pos][:, None, :]
+
+            def rot(t):
+                if use_neox_style:
+                    t1, t2 = t[..., : d // 2], t[..., d // 2:]
+                    r = np.concatenate([-t2, t1], -1)
+                else:
+                    t1, t2 = t[..., 0::2], t[..., 1::2]
+                    r = np.stack([-t2, t1], -1).reshape(t.shape)
+                return t * cos + r * sin
+
+            q, k = rot(q), rot(k)
+        total = past + n_new
+        # write new k/v into the pages of sequence i
+        for t in range(n_new):
+            gpos = past + t
+            page = int(tables[i, gpos // block_size])
+            slot = gpos % block_size
+            kc[page, :, slot, :] = k[t]
+            vc[page, :, slot, :] = v[t]
+        # gather keys/values for positions 0..total-1
+        pages = tables[i, : (total + block_size - 1) // block_size]
+        ks = kc[pages].transpose(1, 0, 2, 3).reshape(h, -1, d)[:, :total]
+        vs = vc[pages].transpose(1, 0, 2, 3).reshape(h, -1, d)[:, :total]
+        logits = np.einsum("nhd,hsd->hns", q.astype(np.float32),
+                           ks.astype(np.float32)) * scale
+        # causal within the new tokens; full visibility of the past
+        qpos = past + np.arange(n_new)
+        causal = np.arange(total)[None, :] <= qpos[:, None]  # [n_new, S]
+        logits = np.where(causal[None], logits, -np.inf)
+        logits = logits - logits.max(-1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("hns,hsd->nhd", p, vs.astype(np.float32))
+        outs[start:start + n_new] = o.reshape(n_new, h * d)
+
+    return (Tensor(jnp.asarray(outs)), Tensor(jnp.asarray(kc)),
+            Tensor(jnp.asarray(vc)))

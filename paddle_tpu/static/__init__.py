@@ -90,3 +90,96 @@ def name_scope(prefix=None):
         yield
 
     return _scope()
+
+
+# ---------------------------------------------------------------------------
+# program_guard / data / nn — the remaining static-graph surface
+# (reference: python/paddle/static/{__init__,input,nn/common}.py). Eager-
+# backed like Executor above: `data` returns a named placeholder Tensor and
+# static.nn layers execute immediately; deferred compilation is to_static's
+# job (SURVEY §7.1 maps ProgramDesc onto jax tracing).
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """reference: static/program.py program_guard — scopes the default
+    programs."""
+    global _MAIN, _STARTUP
+    prev = (_MAIN, _STARTUP)
+    _MAIN = main_program
+    if startup_program is not None:
+        _STARTUP = startup_program
+    try:
+        yield
+    finally:
+        _MAIN, _STARTUP = prev
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """reference: static/input.py data — a named placeholder. This facade
+    executes eagerly: the returned zero Tensor (None dims -> 1) feeds
+    static.nn builders immediately, giving shape/dtype checking and layer
+    construction. Deferred feed/fetch execution is to_static's job — wrap
+    the model body in paddle.jit.to_static (or pass callables in
+    Executor.run's fetch_list) to run against real batches."""
+    import numpy as _np
+
+    from ..core.tensor import Tensor
+
+    concrete = tuple(1 if s is None or s < 0 else int(s) for s in shape)
+    t = Tensor(_np.zeros(concrete, _np.dtype(dtype) if dtype != "float32"
+                         else _np.float32))
+    t.name = name
+    t.stop_gradient = False
+    return t
+
+
+class _StaticNN:
+    """static.nn namespace (reference: python/paddle/static/nn) — eager
+    functional forms of the legacy layer builders."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        import numpy as _np
+
+        from ..core.tensor import Tensor, unwrap
+        from .. import nn as _nn
+
+        arr = unwrap(x)
+        in_f = int(_np.prod(arr.shape[num_flatten_dims:]))
+        layer = _nn.Linear(in_f, size)
+        flat = arr.reshape(arr.shape[:num_flatten_dims] + (in_f,))
+        out = layer(Tensor(flat))
+        if activation:
+            import paddle_tpu.nn.functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,
+                  param_attr=None, dtype="float32"):
+        from .. import nn as _nn
+
+        return _nn.Embedding(size[0], size[1],
+                             padding_idx=padding_idx)(input)
+
+    @staticmethod
+    def batch_norm(input, **kwargs):
+        from .. import nn as _nn
+
+        c = input.shape[1]
+        return _nn.BatchNorm2D(c)(input) if input.ndim == 4 else \
+            _nn.BatchNorm1D(c)(input)
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               **kwargs):
+        from .. import nn as _nn
+
+        return _nn.Conv2D(input.shape[1], num_filters, filter_size,
+                          stride=stride, padding=padding)(input)
+
+
+nn = _StaticNN()

@@ -1,0 +1,339 @@
+"""Tests for the LLM-serving attention family (masked_multihead_attention,
+block_multihead_attention), the fused transformer layers, and the
+static.nn builders."""
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def setUpModule():
+    paddle.seed(0)
+
+
+class TestMaskedMultiheadAttention(unittest.TestCase):
+    B, H, D, MAX = 2, 4, 16, 32
+
+    def test_decode_matches_full_attention(self):
+        rng = np.random.default_rng(0)
+        B, H, D, MAX = self.B, self.H, self.D, self.MAX
+        cache = paddle.to_tensor(np.zeros((2, B, H, MAX, D), np.float32))
+        qs, ks, vs, outs = [], [], [], []
+        for step in range(5):
+            x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+            lens = np.full((B, 1), step, np.int32)
+            out, cache = IF.masked_multihead_attention(
+                paddle.to_tensor(x), cache_kv=cache,
+                sequence_lengths=paddle.to_tensor(lens))
+            qkv = x.reshape(B, 3, H, D)
+            qs.append(qkv[:, 0])
+            ks.append(qkv[:, 1])
+            vs.append(qkv[:, 2])
+            outs.append(out.numpy())
+        K = np.stack(ks, 2)
+        V = np.stack(vs, 2)
+        for t in range(5):
+            logits = np.einsum("bhd,bhsd->bhs", qs[t],
+                               K[:, :, :t + 1]) / np.sqrt(D)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("bhs,bhsd->bhd", p,
+                            V[:, :, :t + 1]).reshape(B, H * D)
+            np.testing.assert_allclose(outs[t], ref, rtol=1e-4, atol=1e-5)
+
+    def test_bias_and_jit(self):
+        rng = np.random.default_rng(1)
+        B, H, D, MAX = self.B, self.H, self.D, self.MAX
+        bias = rng.normal(size=(3, H, D)).astype(np.float32)
+
+        @paddle.jit.to_static
+        def decode(x, cache, lens, b):
+            return IF.masked_multihead_attention(
+                x, cache_kv=cache, bias=b, sequence_lengths=lens)
+
+        out, cache2 = decode(
+            paddle.to_tensor(rng.normal(size=(B, 3 * H * D))
+                             .astype(np.float32)),
+            paddle.to_tensor(np.zeros((2, B, H, MAX, D), np.float32)),
+            paddle.to_tensor(np.zeros((B, 1), np.int32)),
+            paddle.to_tensor(bias))
+        self.assertEqual(list(out.shape), [B, H * D])
+        # position 0 was written
+        self.assertGreater(np.abs(cache2.numpy()[0, :, :, 0]).sum(), 0)
+        self.assertEqual(np.abs(cache2.numpy()[0, :, :, 1:]).sum(), 0)
+
+
+class TestBlockMultiheadAttention(unittest.TestCase):
+    H, D, BS = 4, 16, 8
+
+    def _dense_causal(self, qkv, n):
+        H, D = self.H, self.D
+        t = qkv[:n].reshape(n, 3, H, D)
+        q, k, v = t[:, 0], t[:, 1], t[:, 2]
+        logits = np.einsum("nhd,shd->hns", q, k) / np.sqrt(D)
+        causal = np.tril(np.ones((n, n), bool))
+        logits = np.where(causal[None], logits, -np.inf)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("hns,shd->nhd", p, v).reshape(n, H * D)
+
+    def test_prefill_then_decode(self):
+        rng = np.random.default_rng(0)
+        H, D, BS = self.H, self.D, self.BS
+        kc = paddle.to_tensor(np.zeros((8, H, BS, D), np.float32))
+        vc = paddle.to_tensor(np.zeros((8, H, BS, D), np.float32))
+        tables = np.array([[0, 1, 2, 3], [4, 5, 6, 7]], np.int32)
+        l0, l1 = 10, 6
+        qkv = rng.normal(size=(l0 + l1, 3 * H * D)).astype(np.float32)
+        out, kc, vc = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc,
+            seq_lens_encoder=np.array([[l0], [l1]], np.int32),
+            seq_lens_decoder=np.array([[0], [0]], np.int32),
+            seq_lens_this_time=np.array([[l0], [l1]], np.int32),
+            padding_offsets=None, cum_offsets=None,
+            cu_seqlens_q=np.array([0, l0, l0 + l1], np.int32),
+            cu_seqlens_k=None, block_tables=tables, block_size=BS)
+        np.testing.assert_allclose(out.numpy()[:l0],
+                                   self._dense_causal(qkv, l0),
+                                   rtol=1e-4, atol=1e-5)
+        # decode one token on sequence 0
+        qkv_d = rng.normal(size=(2, 3 * H * D)).astype(np.float32)
+        out_d, kc, vc = IF.block_multihead_attention(
+            paddle.to_tensor(qkv_d), kc, vc,
+            seq_lens_encoder=np.array([[0], [0]], np.int32),
+            seq_lens_decoder=np.array([[l0], [l1]], np.int32),
+            seq_lens_this_time=np.array([[1], [1]], np.int32),
+            padding_offsets=None, cum_offsets=None,
+            cu_seqlens_q=np.array([0, 1, 2], np.int32),
+            cu_seqlens_k=None, block_tables=tables, block_size=BS)
+        t0 = qkv[:l0].reshape(l0, 3, self.H, self.D)
+        qd = qkv_d[0].reshape(3, self.H, self.D)
+        k_all = np.concatenate([t0[:, 1], qd[1][None]], 0)
+        v_all = np.concatenate([t0[:, 2], qd[2][None]], 0)
+        logits = np.einsum("hd,shd->hs", qd[0], k_all) / np.sqrt(self.D)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hs,shd->hd", p, v_all).reshape(self.H * self.D)
+        np.testing.assert_allclose(out_d.numpy()[0], ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cache_pages_round_robin(self):
+        # cross-block boundary: 10 tokens with block_size 8 span 2 pages
+        rng = np.random.default_rng(2)
+        H, D, BS = self.H, self.D, self.BS
+        kc = paddle.to_tensor(np.zeros((4, H, BS, D), np.float32))
+        vc = paddle.to_tensor(np.zeros((4, H, BS, D), np.float32))
+        tables = np.array([[2, 0]], np.int32)  # non-contiguous pages
+        n = 10
+        qkv = rng.normal(size=(n, 3 * H * D)).astype(np.float32)
+        out, kc, vc = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc,
+            seq_lens_encoder=np.array([[n]], np.int32),
+            seq_lens_decoder=np.array([[0]], np.int32),
+            seq_lens_this_time=np.array([[n]], np.int32),
+            padding_offsets=None, cum_offsets=None,
+            cu_seqlens_q=np.array([0, n], np.int32), cu_seqlens_k=None,
+            block_tables=tables, block_size=BS)
+        np.testing.assert_allclose(out.numpy(), self._dense_causal(qkv, n),
+                                   rtol=1e-4, atol=1e-5)
+        # first 8 tokens landed in page 2, overflow in page 0
+        k_ref = qkv.reshape(n, 3, H, D)[:, 1]
+        np.testing.assert_allclose(
+            kc.numpy()[2].transpose(1, 0, 2), k_ref[:8], rtol=1e-6)
+        np.testing.assert_allclose(
+            kc.numpy()[0, :, :2].transpose(1, 0, 2), k_ref[8:], rtol=1e-6)
+
+
+class TestFusedLayers(unittest.TestCase):
+    def test_fused_mha_matches_manual(self):
+        B, S, E, H = 2, 5, 32, 4
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+        attn = inn.FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                           attn_dropout_rate=0.0,
+                                           normalize_before=True)
+        attn.eval()
+        out = attn(x)
+        self.assertEqual(list(out.shape), [B, S, E])
+        # manual recompute from the same parameters
+        xa = x.numpy()
+        s, b = attn.pre_ln_scale.numpy(), attn.pre_ln_bias.numpy()
+        mu = xa.mean(-1, keepdims=True)
+        var = ((xa - mu) ** 2).mean(-1, keepdims=True)
+        xn = (xa - mu) / np.sqrt(var + attn.epsilon) * s + b
+        qkv = np.einsum("bse,nhde->nbshd", xn, attn.qkv_weight.numpy())
+        qkv = qkv + attn.qkv_bias.numpy()[:, None, None]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(E // H)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ctx = np.einsum("bhst,bthd->bshd", p, v).reshape(B, S, E)
+        ref = xa + ctx @ attn.linear_weight.numpy() + \
+            attn.linear_bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        attn = inn.FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                           attn_dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .normal(size=(1, 3, 16)).astype(np.float32))
+        loss = (attn(x) ** 2).sum()
+        loss.backward()
+        self.assertIsNotNone(attn.qkv_weight.grad)
+
+    def test_encoder_and_multi(self):
+        x = paddle.to_tensor(np.random.default_rng(2)
+                             .normal(size=(2, 4, 32)).astype(np.float32))
+        enc = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        enc.eval()
+        self.assertEqual(list(enc(x).shape), [2, 4, 32])
+        mt = inn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+        mt.eval()
+        self.assertEqual(list(mt(x).shape), [2, 4, 32])
+        self.assertEqual(len(mt.parameters()), 2 * 16)
+
+    def test_fused_linear_and_dropout_add(self):
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        fl = inn.FusedLinear(8, 4)
+        self.assertEqual(list(fl(x).shape), [2, 4])
+        da = inn.FusedDropoutAdd(p=0.0)
+        y = paddle.to_tensor(np.ones((2, 8), np.float32))
+        np.testing.assert_allclose(da(x, y).numpy(), 2.0)
+
+
+class TestServingRegressions(unittest.TestCase):
+    def test_mmha_requires_cache(self):
+        with self.assertRaises(ValueError):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(np.zeros((2, 3 * 4 * 16), np.float32)))
+
+    def test_distinct_seeded_init(self):
+        paddle.seed(0)
+        mt = inn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+        w0 = mt.layers[0].fused_attn.qkv_weight.numpy()
+        w1 = mt.layers[1].fused_attn.qkv_weight.numpy()
+        self.assertFalse(np.allclose(w0, w1))
+        paddle.seed(1)
+        mt2 = inn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+        self.assertFalse(np.allclose(
+            w0, mt2.layers[0].fused_attn.qkv_weight.numpy()))
+
+    def test_decode_step_matches_causal_forward(self):
+        B, S, E, H = 2, 4, 32, 4
+        D = E // H
+        rng = np.random.default_rng(3)
+        tokens = rng.normal(size=(B, S, E)).astype(np.float32)
+        paddle.seed(0)
+        attn = inn.FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                           attn_dropout_rate=0.0,
+                                           normalize_before=True)
+        attn.eval()
+        cache = paddle.to_tensor(np.zeros((2, B, H, 16, D), np.float32))
+        outs = []
+        for t in range(S):
+            o, cache = attn.decode_step(
+                paddle.to_tensor(tokens[:, t:t + 1]), cache,
+                paddle.to_tensor(np.full((B, 1), t, np.int32)))
+            outs.append(o.numpy())
+        dec = np.concatenate(outs, 1)
+        mask = np.where(np.tril(np.ones((S, S), bool)), 0.0,
+                        -1e9).astype(np.float32)[None, None]
+        full = attn(paddle.to_tensor(tokens),
+                    attn_mask=paddle.to_tensor(
+                        np.broadcast_to(mask, (B, 1, S, S)).copy())).numpy()
+        np.testing.assert_allclose(dec, full, rtol=1e-4, atol=1e-5)
+
+    def test_multi_transformer_cached_decode(self):
+        B, E, H = 2, 32, 4
+        D = E // H
+        paddle.seed(0)
+        mt = inn.FusedMultiTransformer(E, H, 64, num_layers=2,
+                                       normalize_before=True)
+        mt.eval()
+        caches = [paddle.to_tensor(np.zeros((2, B, H, 16, D), np.float32))
+                  for _ in range(2)]
+        rng = np.random.default_rng(4)
+        for t in range(3):
+            x = paddle.to_tensor(rng.normal(size=(B, 1, E))
+                                 .astype(np.float32))
+            h, caches = mt(x, caches=caches,
+                           seq_lens=paddle.to_tensor(
+                               np.full((B, 1), t, np.int32)))
+        self.assertTrue(np.isfinite(h.numpy()).all())
+        # caches advanced: positions 0..2 are non-zero
+        self.assertGreater(
+            np.abs(caches[0].numpy()[0, :, :, :3]).sum(), 0)
+        self.assertEqual(np.abs(caches[0].numpy()[0, :, :, 3:]).sum(), 0)
+        with self.assertRaises(ValueError):
+            mt(x, caches=caches)  # seq_lens required
+
+    def test_block_attention_rope(self):
+        H, D, BS = 4, 16, 8
+        rng = np.random.default_rng(5)
+        n, max_seq = 5, 16
+        inv = 1.0 / (10000 ** (np.arange(0, D, 2) / D))
+        ang = np.arange(max_seq)[:, None] * inv[None]
+        rope = np.stack([np.repeat(np.cos(ang), 2, -1),
+                         np.repeat(np.sin(ang), 2, -1)]).astype(np.float32)
+        qkv = rng.normal(size=(n, 3 * H * D)).astype(np.float32)
+        out, _, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv),
+            paddle.to_tensor(np.zeros((2, H, BS, D), np.float32)),
+            paddle.to_tensor(np.zeros((2, H, BS, D), np.float32)),
+            seq_lens_encoder=np.array([[n]], np.int32),
+            seq_lens_decoder=np.array([[0]], np.int32),
+            seq_lens_this_time=np.array([[n]], np.int32),
+            padding_offsets=None, cum_offsets=None,
+            cu_seqlens_q=np.array([0, n], np.int32), cu_seqlens_k=None,
+            block_tables=np.array([[0, 1]], np.int32), block_size=BS,
+            rope_emb=rope)
+        t = qkv.reshape(n, 3, H, D)
+        cos, sin = rope[0], rope[1]
+
+        def rot(x, p):
+            t1, t2 = x[..., 0::2], x[..., 1::2]
+            r = np.stack([-t2, t1], -1).reshape(x.shape)
+            return x * cos[p][None] + r * sin[p][None]
+
+        q = np.stack([rot(t[i, 0], i) for i in range(n)])
+        k = np.stack([rot(t[i, 1], i) for i in range(n)])
+        logits = np.einsum("nhd,shd->hns", q, k) / np.sqrt(D)
+        causal = np.tril(np.ones((n, n), bool))
+        logits = np.where(causal[None], logits, -np.inf)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hns,shd->nhd", p, t[:, 2]).reshape(n, H * D)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestStaticNN(unittest.TestCase):
+    def test_program_guard_scopes_defaults(self):
+        import paddle_tpu.static as static
+        main, startup = static.Program(), static.Program()
+        before = static.default_main_program()
+        with static.program_guard(main, startup):
+            self.assertIs(static.default_main_program(), main)
+        self.assertIs(static.default_main_program(), before)
+
+    def test_builders(self):
+        import paddle_tpu.static as static
+        x = static.data("X", [None, 8], "float32")
+        self.assertEqual(list(x.shape), [1, 8])
+        h = static.nn.fc(x, 16, activation="relu")
+        self.assertEqual(list(h.shape), [1, 16])
+        img = paddle.to_tensor(np.random.default_rng(0)
+                               .normal(size=(2, 3, 8, 8)).astype(np.float32))
+        self.assertEqual(list(static.nn.conv2d(img, 4, 3).shape),
+                         [2, 4, 6, 6])
+        self.assertEqual(list(static.nn.batch_norm(img).shape),
+                         [2, 3, 8, 8])
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        self.assertEqual(list(static.nn.embedding(ids, (10, 6)).shape),
+                         [2, 2, 6])
+
+
+if __name__ == "__main__":
+    unittest.main()
