@@ -165,6 +165,8 @@ def block_multihead_attention(
     cu_q = arr(cu_seqlens_q).reshape(-1)
     tables = arr(block_tables)
     bias_a = arr(qkv_bias)
+    mask_a = arr(mask)
+    tgt_mask_a = arr(tgt_mask)
 
     bsz = len(this_lens)
     h, d = kc.shape[1], kc.shape[3]
@@ -215,6 +217,16 @@ def block_multihead_attention(
         qpos = past + np.arange(n_new)
         causal = np.arange(total)[None, :] <= qpos[:, None]  # [n_new, S]
         logits = np.where(causal[None], logits, -np.inf)
+        # additive masks (reference: mask for prefill [B, 1, S, S],
+        # tgt_mask for decode [B, 1, 1, S])
+        extra = mask_a if int(enc_lens[i]) > 0 else tgt_mask_a
+        if extra is not None:
+            m = extra[i]
+            m = m[0] if m.ndim >= 3 and m.shape[0] == 1 else m
+            m = np.broadcast_to(m[-n_new:, :total] if m.ndim == 2
+                                else m.reshape(-1)[None, :total],
+                                (n_new, total))
+            logits = logits + m[None].astype(np.float32)
         logits = logits - logits.max(-1, keepdims=True)
         p = np.exp(logits)
         p /= p.sum(-1, keepdims=True)

@@ -14,9 +14,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ...core.tensor import Tensor, dispatch, unwrap
+from ...core.tensor import dispatch
 from ...nn.layer.layers import Layer
 from ...nn.initializer import Constant
 from ...nn import functional as NF
@@ -59,17 +58,6 @@ class FusedDropoutAdd(Layer):
         out = NF.dropout(x, p=self.p, training=self.training,
                          mode=self.mode)
         return out + y
-
-
-def _prob_dropout(probs_impl_fn, u, rate):
-    """Inverted dropout on attention probabilities given pre-sampled
-    uniforms (keeps RNG on the framework key plumbing, so jit/to_static
-    key threading applies)."""
-    def wrapped(*a):
-        probs = probs_impl_fn(*a)
-        keep = (u >= rate).astype(probs.dtype)
-        return probs * keep / (1.0 - rate)
-    return wrapped
 
 
 class FusedMultiHeadAttention(Layer):
@@ -220,12 +208,14 @@ class FusedFeedForward(Layer):
         self.epsilon = epsilon
         self.linear1_weight = self.create_parameter(
             (d_model, dim_feedforward), attr=linear1_weight_attr)
-        self.linear1_bias = self.create_parameter(
-            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True)
+        self.linear1_bias = None if linear1_bias_attr is False else \
+            self.create_parameter((dim_feedforward,),
+                                  attr=linear1_bias_attr, is_bias=True)
         self.linear2_weight = self.create_parameter(
             (dim_feedforward, d_model), attr=linear2_weight_attr)
-        self.linear2_bias = self.create_parameter(
-            (d_model,), attr=linear2_bias_attr, is_bias=True)
+        self.linear2_bias = None if linear2_bias_attr is False else \
+            self.create_parameter((d_model,), attr=linear2_bias_attr,
+                                  is_bias=True)
         one = Constant(1.0)
         self.ln1_scale = self.create_parameter(
             (d_model,), attr=ln1_scale_attr, default_initializer=one)

@@ -29,3 +29,11 @@ __all__ = (
     + _common.__all__ + _activation.__all__ + _conv.__all__ + _norm.__all__
     + _pooling.__all__ + _loss.__all__ + _transformer.__all__ + _rnn.__all__
 )
+
+from .layer.extras2 import (  # noqa: E402,F401
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, FeatureAlphaDropout,
+    FractionalMaxPool2D, FractionalMaxPool3D, HSigmoidLoss, ZeroPad1D,
+    ZeroPad3D, dynamic_decode)
+
+__all__ = [n for n in dir() if not n.startswith("_") and n[0].isupper()
+           or n in ("functional", "initializer", "utils", "dynamic_decode")]

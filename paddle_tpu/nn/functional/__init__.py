@@ -17,3 +17,13 @@ from .loss import __all__ as _l
 from .attention import __all__ as _at
 
 __all__ = list(_a) + list(_c) + list(_cv) + list(_p) + list(_n) + list(_l) + list(_at)
+
+from .extras2 import (  # noqa: E402,F401
+    adaptive_log_softmax_with_loss, feature_alpha_dropout,
+    flash_attention_with_sparse_mask, flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked, fractional_max_pool2d,
+    fractional_max_pool3d, gather_tree, hardtanh_, hsigmoid_loss,
+    leaky_relu_, margin_cross_entropy, pairwise_distance,
+    sparse_attention, thresholded_relu_)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

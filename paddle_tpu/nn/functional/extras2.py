@@ -1,0 +1,375 @@
+"""Remaining nn.functional surface (reference: python/paddle/nn/functional
+— pairwise_distance, fractional pooling, hierarchical/adaptive softmax
+losses, margin_cross_entropy, beam-search gather_tree, sparse attention,
+flash-attention packing variants, and trailing in-place aliases)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...framework import random as _random
+from .activation import hardtanh, leaky_relu, thresholded_relu
+from .attention import flash_attention
+from .common import alpha_dropout
+
+__all__ = [
+    "pairwise_distance", "hardtanh_", "leaky_relu_", "thresholded_relu_",
+    "feature_alpha_dropout", "fractional_max_pool2d",
+    "fractional_max_pool3d", "hsigmoid_loss", "margin_cross_entropy",
+    "gather_tree", "sparse_attention", "adaptive_log_softmax_with_loss",
+    "flash_attention_with_sparse_mask", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked",
+]
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference: nn/functional/distance.py pairwise_distance."""
+    def impl(xa, ya):
+        d = xa - ya + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return dispatch("pairwise_distance", impl, (x, y))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    out = hardtanh(x, min=min, max=max)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    out = leaky_relu(x, negative_slope=negative_slope)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    out = thresholded_relu(x, threshold=threshold, value=value)
+    return x._replace(out._array, out._node, out._out_idx)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """reference: common.py feature_alpha_dropout — alpha dropout that
+    drops whole channels (dim 1), keeping SELU self-normalisation."""
+    if not training or p == 0.0:
+        return x
+
+    def impl(xa):
+        alpha = 1.6732632423543772 * 1.0507009873554805
+        neg = -alpha
+        shape = (xa.shape[0], xa.shape[1]) + (1,) * (xa.ndim - 2)
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, shape)
+        a = (1.0 / math.sqrt((1.0 - p) * (1.0 + p * neg ** 2))) \
+            if p < 1.0 else 0.0
+        b = -a * neg * p
+        return (jnp.where(keep, xa, neg) * a + b).astype(xa.dtype)
+
+    return dispatch("feature_alpha_dropout", impl, (x,))
+
+
+def _fractional_bounds(n, m, u):
+    """Pooling-region boundaries for fractional max pooling
+    (Graham 2014): alpha = n/m, b_i = ceil(alpha*(i+u)) clipped so every
+    region is non-empty and the last ends at n."""
+    alpha = n / m
+    idx = np.arange(m + 1, dtype=np.float64)
+    b = np.ceil(alpha * (idx + u)).astype(np.int64) - int(np.ceil(alpha * u))
+    b = np.clip(b, 0, n)
+    b[0], b[-1] = 0, n
+    for i in range(1, m + 1):  # enforce strictly increasing
+        if b[i] <= b[i - 1]:
+            b[i] = b[i - 1] + 1
+    return np.minimum(b, n)
+
+
+def _fractional_pool(x, output_size, random_u, spatial):
+    xa = unwrap(x)
+    dims = xa.shape[-spatial:]
+    if isinstance(output_size, int):
+        output_size = (output_size,) * spatial
+    out_dims = tuple(dims[i] if output_size[i] is None else output_size[i]
+                     for i in range(spatial))
+    u = float(random_u) if random_u is not None else float(
+        jax.random.uniform(_random.next_key(), ()))
+    u = min(max(u, 1e-4), 1 - 1e-4)
+    bounds = [_fractional_bounds(dims[i], out_dims[i], u)
+              for i in range(spatial)]
+
+    def pool_axis(arr, axis, bnd):
+        slices = [jnp.max(jax.lax.slice_in_dim(
+            arr, int(bnd[i]), int(bnd[i + 1]), axis=axis),
+            axis=axis, keepdims=True) for i in range(len(bnd) - 1)]
+        return jnp.concatenate(slices, axis=axis)
+
+    out = xa
+    for s in range(spatial):
+        axis = out.ndim - spatial + s
+        out = pool_axis(out, axis, bounds[s])
+    return Tensor(out)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: pooling.py fractional_max_pool2d — pseudo-random pooling
+    regions (Graham, 'Fractional Max-Pooling')."""
+    out = _fractional_pool(x, output_size, random_u, spatial=2)
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, output_size, random_u, spatial=3)
+    return (out, None) if return_mask else out
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: loss.py hsigmoid_loss — hierarchical sigmoid over a
+    complete binary tree (heap numbering: leaf c = c + num_classes, parent
+    n//2, code n%2; num_classes-1 internal nodes), or a custom tree via
+    path_table/path_code."""
+    args = [a for a in (input, label, weight, bias, path_table, path_code)
+            if a is not None]
+
+    def impl(*arrs):
+        it = iter(arrs)
+        xa = next(it)
+        lab = next(it).reshape(-1).astype(jnp.int32)
+        w = next(it)
+        b = next(it) if bias is not None else None
+        pt = next(it) if path_table is not None else None
+        pc = next(it) if path_code is not None else None
+        if pt is None:
+            depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+            node = lab + num_classes  # heap leaf id
+            codes, parents, valid = [], [], []
+            for _ in range(depth):
+                parent = node // 2
+                codes.append((node % 2).astype(jnp.float32))
+                parents.append(parent - 1)  # internal node param index
+                valid.append((parent >= 1).astype(jnp.float32))
+                node = parent
+            pt = jnp.stack(parents, 1)  # [N, depth]
+            pc = jnp.stack(codes, 1)
+            vd = jnp.stack(valid, 1)
+        else:
+            vd = (pt >= 0).astype(jnp.float32)
+        pt = jnp.clip(pt, 0, w.shape[0] - 1).astype(jnp.int32)
+        wsel = w[pt]                     # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", xa, wsel)
+        if b is not None:
+            logits = logits + b.reshape(-1)[pt]
+        # sigmoid cross entropy against the path code bits
+        ce = jnp.maximum(logits, 0) - logits * pc + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return (ce * vd).sum(-1, keepdims=True)
+
+    return dispatch("hsigmoid_loss", impl, tuple(args))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """reference: loss.py margin_cross_entropy — combined-margin softmax
+    (cos(m1*theta + m2) - m3, ArcFace family). Logits must be cosine
+    similarities in [-1, 1]."""
+    def impl(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, lg.shape[-1], dtype=lg.dtype)
+        cos_t = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.where(oh > 0, modified, cos_t) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -(oh * logp).sum(-1, keepdims=True)
+        sm = jnp.exp(logp)
+        return loss, sm
+
+    loss, sm = dispatch("margin_cross_entropy", impl, (logits, label))
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, sm) if return_softmax else loss
+
+
+def gather_tree(ids, parents):
+    """reference: nn/decode.py gather_tree — backtrack full beam paths.
+    ids/parents: [max_time, batch, beam]."""
+    def impl(ia, pa):
+        T = ia.shape[0]
+
+        def step(beams, t):
+            # beams: [batch, beam] current beam index at time t+1
+            tok = jnp.take_along_axis(ia[t], beams, axis=-1)
+            prev = jnp.take_along_axis(pa[t], beams, axis=-1)
+            return prev, tok
+
+        init = jnp.broadcast_to(jnp.arange(ia.shape[2]), ia.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return dispatch("gather_tree", impl, (ids, parents))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """reference: sparse_attention.py — block-sparse attention with a CSR
+    pattern per head. Eager-oriented (CSR is data-dependent), matching the
+    reference's dynamic-graph-only support."""
+    q = np.asarray(unwrap(query))
+    k = np.asarray(unwrap(key))
+    v = np.asarray(unwrap(value))
+    off = np.asarray(unwrap(sparse_csr_offset)
+                     if isinstance(sparse_csr_offset, Tensor)
+                     else sparse_csr_offset).astype(np.int64)
+    cols = np.asarray(unwrap(sparse_csr_columns)
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns).astype(np.int64)
+    B, H, M, D = q.shape
+    out = np.zeros_like(q)
+    scale = 1.0 / math.sqrt(D)
+    for b in range(B):
+        for h in range(H):
+            for m in range(M):
+                s, e = off[b, h, m], off[b, h, m + 1]
+                if e <= s:
+                    continue
+                c = cols[b, h, s:e]
+                logits = (k[b, h, c] @ q[b, h, m]) * scale
+                p = np.exp(logits - logits.max())
+                p /= p.sum()
+                out[b, h, m] = p @ v[b, h, c]
+    return Tensor(jnp.asarray(out))
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: loss.py adaptive_log_softmax_with_loss — efficient
+    softmax: a head over frequent classes + shortlists, tail clusters with
+    low-rank projections. Returns (target log-prob, mean nll loss)."""
+    n_clusters = len(cutoffs)  # excludes the final n_classes cutoff? no:
+    # paddle convention: cutoffs excludes n_classes; tail_weights is a list
+    # of [proj_in, proj_out] pairs per cluster
+
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    flat_tails = []
+    for pair in tail_weights:
+        flat_tails.extend(pair)
+    args.extend(flat_tails)
+
+    def impl(*arrs):
+        it = iter(arrs)
+        xa = next(it)
+        lab = next(it).reshape(-1).astype(jnp.int32)
+        hw = next(it)
+        hb = next(it) if head_bias is not None else None
+        tails = []
+        for _ in range(len(tail_weights)):
+            tails.append((next(it), next(it)))
+        head_logits = xa @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+        shortlist = cutoffs[0]
+        out = jnp.zeros(xa.shape[0], xa.dtype)
+        # shortlist targets: direct head log-prob
+        in_short = lab < shortlist
+        short_lp = jnp.take_along_axis(
+            head_logp, jnp.clip(lab, 0, shortlist - 1)[:, None], 1)[:, 0]
+        out = jnp.where(in_short, short_lp, out)
+        bounds = [shortlist] + list(cutoffs[1:]) + [None]
+        for ci, (p1, p2) in enumerate(tails):
+            lo = bounds[ci]
+            hi = bounds[ci + 1]
+            hi_v = hi if hi is not None else lo + p2.shape[-1]
+            in_c = (lab >= lo) & (lab < hi_v)
+            cluster_lp_head = head_logp[:, shortlist + ci]
+            tail_logits = (xa @ p1) @ p2
+            tail_logp = jax.nn.log_softmax(tail_logits, axis=-1)
+            rel = jnp.clip(lab - lo, 0, p2.shape[-1] - 1)
+            lp = cluster_lp_head + jnp.take_along_axis(
+                tail_logp, rel[:, None], 1)[:, 0]
+            out = jnp.where(in_c, lp, out)
+        return out, -out.mean()
+
+    return dispatch("adaptive_log_softmax_with_loss", impl, tuple(args))
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """reference: flash_attention.py flash_attn_qkvpacked — packed
+    [B, S, 3, H, D] input routed to the flash path."""
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """reference: flash_attention.py flash_attn_varlen_qkvpacked —
+    unpadded [total_tokens, 3, H, D] with cu_seqlens. Eager per-sequence
+    (lengths are data-dependent, mirroring the varlen CUDA kernel's
+    dynamic use)."""
+    qkv_a = np.asarray(unwrap(qkv))
+    cu = np.asarray(unwrap(cu_seqlens_q)
+                    if isinstance(cu_seqlens_q, Tensor)
+                    else cu_seqlens_q).reshape(-1)
+    outs = np.zeros((qkv_a.shape[0],) + qkv_a.shape[2:], qkv_a.dtype)
+    for i in range(len(cu) - 1):
+        s, e = int(cu[i]), int(cu[i + 1])
+        if e <= s:
+            continue
+        seg = qkv_a[s:e]
+        out = flash_attention(Tensor(seg[None, :, 0]),
+                              Tensor(seg[None, :, 1]),
+                              Tensor(seg[None, :, 2]),
+                              causal=causal, training=training)
+        if isinstance(out, tuple):
+            out = out[0]
+        outs[s:e] = np.asarray(unwrap(out))[0]
+    result = Tensor(jnp.asarray(outs))
+    return (result, None) if return_softmax else result
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, training=True,
+                                     name=None):
+    """reference: flash_attention.py flash_attention_with_sparse_mask —
+    causal attention where row i additionally masks keys j with
+    j >= start_row_indices[..., j]: a compressed column-wise mask. Builds
+    the dense additive mask and runs the standard path."""
+    def impl(q, k, v, sri):
+        b, s = q.shape[0], q.shape[1]
+        rows = jnp.arange(s)
+        causal = rows[:, None] >= rows[None, :]  # [S_q, S_k]
+        # sri: [B, 1(or H), S_k] — queries at row >= sri[j] cannot see col j
+        sri_b = sri.reshape(b, -1, s)
+        blocked = rows[None, None, :, None] >= sri_b[:, :, None, :]
+        allowed = causal[None, None] & ~blocked
+        bias = jnp.where(allowed, 0.0, -jnp.inf).astype(jnp.float32)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        logits = logits + bias
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+        return jnp.einsum("bhst,bthd->bshd", probs,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    return dispatch("flash_attention_with_sparse_mask", impl,
+                    (query, key, value, attn_mask_start_row_indices))

@@ -1,0 +1,293 @@
+"""Remaining nn layer surface (reference: python/paddle/nn/layer —
+FeatureAlphaDropout, FractionalMaxPool2D/3D, ZeroPad1D/3D, HSigmoidLoss,
+AdaptiveLogSoftmaxWithLoss) plus the seq2seq decoding API
+(BeamSearchDecoder + dynamic_decode, reference: nn/decode.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor, unwrap
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["FeatureAlphaDropout", "FractionalMaxPool2D",
+           "FractionalMaxPool3D", "ZeroPad1D", "ZeroPad3D", "HSigmoidLoss",
+           "AdaptiveLogSoftmaxWithLoss", "BeamSearchDecoder",
+           "dynamic_decode"]
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class _ZeroPadN(Layer):
+    spatial = 1
+    default_format = "NCL"
+
+    def __init__(self, padding, data_format=None, name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self.spatial)
+        self.padding = list(padding)
+        self.data_format = data_format or self.default_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class ZeroPad1D(_ZeroPadN):
+    """reference: nn/layer/common.py ZeroPad1D — NCL (or NLC) padding."""
+    spatial = 1
+    default_format = "NCL"
+
+
+class ZeroPad3D(_ZeroPadN):
+    """reference: nn/layer/common.py ZeroPad3D — NCDHW (or NDHWC)
+    padding."""
+    spatial = 3
+    default_format = "NCDHW"
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        n_nodes = num_classes - 1
+        self.weight = self.create_parameter((n_nodes, feature_size),
+                                            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (n_nodes, 1), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        bias = None if self.bias is None else self.bias.reshape([-1])
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — head over
+    frequent classes + shortlist cluster tokens; tail clusters project to
+    in_features / div_value**i."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if any(c <= 0 or c >= n_classes for c in cutoffs) or \
+                sorted(set(cutoffs)) != cutoffs:
+            raise ValueError("cutoffs must be increasing, in (0, n_classes)")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            (in_features, self.cutoffs[0] + n_clusters), attr=weight_attr)
+        self.head_bias = self.create_parameter(
+            (self.cutoffs[0] + n_clusters,), is_bias=True) \
+            if head_bias else None
+        self.tail_weights = []
+        for i in range(n_clusters):
+            proj = max(1, int(in_features / (div_value ** (i + 1))))
+            sz = self.cutoffs[i + 1] - self.cutoffs[i]
+            p1 = self.create_parameter((in_features, proj))
+            p2 = self.create_parameter((proj, sz))
+            self.add_parameter(f"tail_{i}_proj", p1)
+            self.add_parameter(f"tail_{i}_out", p2)
+            self.tail_weights.append([p1, p2])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1], head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probability table."""
+        import jax
+        import jax.numpy as jnp
+
+        xa = unwrap(input)
+        hw = unwrap(self.head_weight)
+        logits = xa @ hw
+        if self.head_bias is not None:
+            logits = logits + unwrap(self.head_bias)
+        head_logp = jax.nn.log_softmax(logits, axis=-1)
+        shortlist = self.cutoffs[0]
+        parts = [head_logp[:, :shortlist]]
+        for i, (p1, p2) in enumerate(self.tail_weights):
+            tail_logp = jax.nn.log_softmax(
+                (xa @ unwrap(p1)) @ unwrap(p2), axis=-1)
+            parts.append(head_logp[:, shortlist + i:shortlist + i + 1]
+                         + tail_logp)
+        return Tensor(jnp.concatenate(parts, axis=-1))
+
+    def predict(self, input):
+        lp = self.log_prob(input)
+        return lp.argmax(-1)
+
+
+class BeamSearchDecoder:
+    """reference: nn/decode.py BeamSearchDecoder — beam expansion over an
+    RNN cell; finalize backtracks with gather_tree. Runs eagerly step by
+    step (the reference's dynamic-graph mode); `dynamic_decode` drives it.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _merge(self, t):
+        a = np.asarray(unwrap(t))
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a, batch):
+        a = np.asarray(a)
+        return a.reshape((batch, self.beam_size) + a.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        leaves = [np.asarray(unwrap(s)) for s in _flatten(states)]
+        batch = leaves[0].shape[0]
+        # tile cell state across beams
+        tiled = [np.repeat(a[:, None], self.beam_size, 1)
+                 .reshape((-1,) + a.shape[1:]) for a in leaves]
+        log_probs = np.full((batch, self.beam_size), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        ids = np.full((batch, self.beam_size), self.start_token, np.int64)
+        finished = np.zeros((batch, self.beam_size), bool)
+        return (ids, tiled, log_probs, finished)
+
+    def step(self, inputs, states):
+        ids, cell_states, log_probs, finished = states
+        batch = ids.shape[0]
+        flat_ids = Tensor(ids.reshape(-1))
+        emb = self.embedding_fn(flat_ids) if self.embedding_fn else flat_ids
+        cell_in = [Tensor(a) for a in cell_states]
+        out, new_states = self.cell(emb, cell_in[0] if len(cell_in) == 1
+                                    else cell_in)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = np.asarray(unwrap(out))
+        vocab = logits.shape[-1]
+        step_logp = logits - _logsumexp(logits)
+        step_logp = self._split(step_logp, batch)  # [B, beam, V]
+        # finished beams only extend with end_token at 0 cost
+        fin_mask = np.full((vocab,), -1e9, np.float32)
+        fin_mask[self.end_token] = 0.0
+        step_logp = np.where(finished[..., None], fin_mask[None, None],
+                             step_logp)
+        total = log_probs[..., None] + step_logp  # [B, beam, V]
+        flat = total.reshape(batch, -1)
+        top = np.argsort(-flat, axis=-1)[:, : self.beam_size]
+        new_logp = np.take_along_axis(flat, top, -1)
+        parent = (top // vocab).astype(np.int64)
+        token = (top % vocab).astype(np.int64)
+        new_finished = np.take_along_axis(finished, parent, -1) | \
+            (token == self.end_token)
+        # reorder cell states by parent beam
+        new_cell = []
+        flat_new = _flatten(new_states)
+        for a in flat_new:
+            a = self._split(np.asarray(unwrap(a)), batch)
+            gather = np.take_along_axis(
+                a, parent.reshape(parent.shape + (1,) * (a.ndim - 2)), 1)
+            new_cell.append(gather.reshape((-1,) + a.shape[2:]))
+        return (token, parent, new_logp), \
+            (token, new_cell, new_logp, new_finished)
+
+    def finalize(self, step_tokens, step_parents):
+        ids = Tensor(np.stack(step_tokens))      # [T, B, beam]
+        parents = Tensor(np.stack(step_parents))
+        return F.gather_tree(ids, parents)
+
+
+def _flatten(x):
+    if isinstance(x, (list, tuple)):
+        out = []
+        for i in x:
+            out.extend(_flatten(i))
+        return out
+    return [x]
+
+
+def _logsumexp(a):
+    m = a.max(-1, keepdims=True)
+    return m + np.log(np.exp(a - m).sum(-1, keepdims=True))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False,
+                   return_length=False, **kwargs):
+    """reference: nn/decode.py dynamic_decode — drive a decoder until all
+    beams finish or max_step_num. Returns (ids [B, T, beam] (or
+    time-major), final log-probs) [+ lengths]."""
+    states = decoder.initialize(inits)
+    tokens, parents = [], []
+    lengths = None
+    max_steps = max_step_num or 100
+    logp = None
+    for step in range(max_steps):
+        prev_finished = states[3]
+        (tok, par, logp), states = decoder.step(None, states)
+        tokens.append(tok)
+        parents.append(par)
+        finished = states[3]
+        if lengths is None:
+            lengths = np.zeros(finished.shape, np.int64)
+        # a beam's length includes the step where it emits end_token:
+        # count every step taken while it was still unfinished
+        lengths = np.where(~prev_finished, step + 1, lengths)
+        if finished.all():
+            break
+    ids = decoder.finalize(tokens, parents)  # [T, B, beam]
+    out = ids if output_time_major else Tensor(
+        np.asarray(unwrap(ids)).transpose(1, 0, 2))
+    res = (out, Tensor(logp))
+    if return_length:
+        res = res + (Tensor(lengths),)
+    return res

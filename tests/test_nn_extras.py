@@ -1,0 +1,244 @@
+"""Tests for the final nn/nn.functional surface: pairwise_distance,
+fractional pooling, hierarchical/adaptive softmax losses,
+margin_cross_entropy, gather_tree + beam search decode, sparse attention,
+flash packing variants, pad/dropout layers, in-place aliases."""
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def setUpModule():
+    paddle.seed(0)
+
+
+class TestFunctionalExtras(unittest.TestCase):
+    def setUp(self):
+        self.rng = np.random.default_rng(0)
+
+    def test_pairwise_distance(self):
+        x = paddle.to_tensor(self.rng.normal(size=(4, 8))
+                             .astype(np.float32))
+        y = paddle.to_tensor(self.rng.normal(size=(4, 8))
+                             .astype(np.float32))
+        np.testing.assert_allclose(
+            F.pairwise_distance(x, y).numpy(),
+            np.linalg.norm(x.numpy() - y.numpy() + 1e-6, axis=-1),
+            rtol=1e-5)
+
+    def test_inplace_aliases(self):
+        x = paddle.to_tensor(np.array([-2.0, 0.5, 2.0], np.float32))
+        F.hardtanh_(x)
+        np.testing.assert_allclose(x.numpy(), [-1, 0.5, 1])
+        x2 = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        F.leaky_relu_(x2, negative_slope=0.1)
+        np.testing.assert_allclose(x2.numpy(), [-0.1, 2.0], rtol=1e-6)
+        x3 = paddle.to_tensor(np.array([0.5, 2.0], np.float32))
+        F.thresholded_relu_(x3)
+        np.testing.assert_allclose(x3.numpy(), [0.0, 2.0])
+
+    def test_fractional_pool(self):
+        img = paddle.to_tensor(self.rng.normal(size=(2, 3, 17, 13))
+                               .astype(np.float32))
+        out = F.fractional_max_pool2d(img, output_size=5, random_u=0.3)
+        self.assertEqual(list(out.shape), [2, 3, 5, 5])
+        self.assertTrue(np.isin(out.numpy().ravel(),
+                                img.numpy().ravel()).all())
+        out3 = F.fractional_max_pool3d(
+            paddle.to_tensor(self.rng.normal(size=(1, 2, 9, 9, 9))
+                             .astype(np.float32)),
+            output_size=3, random_u=0.7)
+        self.assertEqual(list(out3.shape), [1, 2, 3, 3, 3])
+
+    def test_margin_cross_entropy_reduces_to_softmax(self):
+        cos = paddle.to_tensor((self.rng.normal(size=(5, 7)) * 0.3)
+                               .astype(np.float32))
+        lab = paddle.to_tensor(self.rng.integers(0, 7, (5,)))
+        mce = F.margin_cross_entropy(cos, lab, margin1=1.0, margin2=0.0,
+                                     margin3=0.0, scale=10.0,
+                                     reduction=None)
+        lg = cos.numpy() * 10
+        lse = np.log(np.exp(lg - lg.max(-1, keepdims=True))
+                     .sum(-1, keepdims=True)) + lg.max(-1, keepdims=True)
+        ref = -np.take_along_axis(lg - lse, lab.numpy()[:, None], 1)
+        np.testing.assert_allclose(mce.numpy(), ref, rtol=1e-4)
+
+    def test_margin_changes_target_logit(self):
+        cos = paddle.to_tensor(np.full((2, 4), 0.5, np.float32))
+        lab = paddle.to_tensor(np.array([1, 2]))
+        plain = F.margin_cross_entropy(cos, lab, margin1=1.0, margin2=0.0,
+                                       margin3=0.0)
+        arc = F.margin_cross_entropy(cos, lab, margin1=1.0, margin2=0.5,
+                                     margin3=0.0)
+        self.assertGreater(float(arc.numpy()), float(plain.numpy()))
+
+    def test_gather_tree(self):
+        ids = paddle.to_tensor(np.array([[[2, 2]], [[3, 4]], [[5, 6]]],
+                                        np.int64))
+        par = paddle.to_tensor(np.array([[[0, 0]], [[1, 0]], [[1, 0]]],
+                                        np.int64))
+        gt = F.gather_tree(ids, par).numpy()
+        np.testing.assert_array_equal(gt[:, 0, 0], [2, 4, 5])
+        np.testing.assert_array_equal(gt[:, 0, 1], [2, 3, 6])
+
+    def test_sparse_attention_full_pattern_is_dense(self):
+        B, H, M, D = 1, 2, 4, 8
+        q = self.rng.normal(size=(B, H, M, D)).astype(np.float32)
+        k = self.rng.normal(size=(B, H, M, D)).astype(np.float32)
+        v = self.rng.normal(size=(B, H, M, D)).astype(np.float32)
+        off = np.tile(np.arange(0, (M + 1) * M, M), (B, H, 1))
+        cols = np.tile(np.tile(np.arange(M), M), (B, H, 1))
+        sa = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                paddle.to_tensor(v), off, cols).numpy()
+        logits = np.einsum("bhmd,bhnd->bhmn", q, k) / np.sqrt(D)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(sa, np.einsum("bhmn,bhnd->bhmd", p, v),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_flash_packing_variants(self):
+        qkv = paddle.to_tensor(self.rng.normal(size=(2, 6, 3, 2, 8))
+                               .astype(np.float32))
+        o1 = F.flash_attn_qkvpacked(qkv, causal=True)
+        o1 = o1[0] if isinstance(o1, tuple) else o1
+        o2 = F.flash_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                               causal=True)
+        o2 = o2[0] if isinstance(o2, tuple) else o2
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-5)
+        tok = self.rng.normal(size=(10, 3, 2, 8)).astype(np.float32)
+        ov = F.flash_attn_varlen_qkvpacked(
+            paddle.to_tensor(tok), np.array([0, 4, 10]),
+            np.array([0, 4, 10]), 6, 6, causal=True)
+        seg = F.flash_attention(paddle.to_tensor(tok[None, :4, 0]),
+                                paddle.to_tensor(tok[None, :4, 1]),
+                                paddle.to_tensor(tok[None, :4, 2]),
+                                causal=True)
+        seg = seg[0] if isinstance(seg, tuple) else seg
+        np.testing.assert_allclose(ov.numpy()[:4], seg.numpy()[0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_flash_sparse_mask_blocks_columns(self):
+        S = 6
+        q = self.rng.normal(size=(1, S, 1, 8)).astype(np.float32)
+        k = self.rng.normal(size=(1, S, 1, 8)).astype(np.float32)
+        v = self.rng.normal(size=(1, S, 1, 8)).astype(np.float32)
+        sri = np.full((1, 1, S), S, np.int32)
+        sri[:, :, 0] = 3  # rows >= 3 cannot see column 0
+        out = F.flash_attention_with_sparse_mask(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(sri)).numpy()
+        # manual: causal + column block
+        logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(8)
+        rows = np.arange(S)
+        allowed = rows[:, None] >= rows[None, :]
+        allowed = allowed & ~(rows[:, None, ] >= sri[0, 0][None, :])
+        np.fill_diagonal(allowed, True)  # row 0 col 0 etc stays causal
+        allowed = (rows[:, None] >= rows[None, :]) & \
+            (rows[:, None] < sri[0, 0][None, :])
+        logits = np.where(allowed[None, None], logits, -np.inf)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = np.where(np.isnan(p), 0, p)
+        p /= np.maximum(p.sum(-1, keepdims=True), 1e-30)
+        ref = np.einsum("bhst,bthd->bshd", p, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestLossLayers(unittest.TestCase):
+    def setUp(self):
+        self.rng = np.random.default_rng(1)
+
+    def test_hsigmoid(self):
+        feat = paddle.to_tensor(self.rng.normal(size=(6, 16))
+                                .astype(np.float32), stop_gradient=False)
+        lab = paddle.to_tensor(self.rng.integers(0, 10, (6, 1)))
+        hs = nn.HSigmoidLoss(16, 10)
+        loss = hs(feat, lab)
+        self.assertEqual(list(loss.shape), [6, 1])
+        self.assertTrue((loss.numpy() > 0).all())
+        loss.sum().backward()
+        self.assertIsNotNone(hs.weight.grad)
+
+    def test_hsigmoid_custom_path(self):
+        feat = paddle.to_tensor(self.rng.normal(size=(2, 8))
+                                .astype(np.float32))
+        lab = paddle.to_tensor(np.array([[0], [1]]))
+        pt = paddle.to_tensor(np.array([[0, 1, -1], [0, 2, -1]], np.int64))
+        pc = paddle.to_tensor(np.array([[1., 0., 0.], [0., 1., 0.]],
+                                       np.float32))
+        w = paddle.to_tensor(self.rng.normal(size=(3, 8))
+                             .astype(np.float32))
+        loss = F.hsigmoid_loss(feat, lab, 4, w, path_table=pt,
+                               path_code=pc)
+        self.assertTrue(np.isfinite(loss.numpy()).all())
+
+    def test_adaptive_log_softmax(self):
+        als = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [5, 10])
+        feat = paddle.to_tensor(self.rng.normal(size=(8, 16))
+                                .astype(np.float32))
+        lab = paddle.to_tensor(self.rng.integers(0, 20, (8,)))
+        out, loss = als(feat, lab)
+        lp = als.log_prob(feat)
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0,
+                                   rtol=1e-5)
+        ref = np.take_along_axis(lp.numpy(), lab.numpy()[:, None], 1)[:, 0]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(loss.numpy()), -ref.mean(),
+                                   rtol=1e-5)
+        pred = als.predict(feat)
+        np.testing.assert_array_equal(pred.numpy(),
+                                      lp.numpy().argmax(-1))
+
+    def test_adaptive_validates_cutoffs(self):
+        with self.assertRaises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(8, 10, [5, 5])
+
+
+class TestPadDropoutLayers(unittest.TestCase):
+    def test_zeropad(self):
+        zp = nn.ZeroPad1D(2)
+        self.assertEqual(
+            list(zp(paddle.to_tensor(np.ones((1, 2, 5), np.float32)))
+                 .shape), [1, 2, 9])
+        zp3 = nn.ZeroPad3D(1)
+        self.assertEqual(
+            list(zp3(paddle.to_tensor(np.ones((1, 2, 3, 3, 3), np.float32)))
+                 .shape), [1, 2, 5, 5, 5])
+
+    def test_feature_alpha_dropout(self):
+        fad = nn.FeatureAlphaDropout(0.5)
+        fad.eval()
+        np.testing.assert_allclose(
+            fad(paddle.to_tensor(np.ones((2, 3, 4), np.float32))).numpy(),
+            1.0)
+        fad.train()
+        o = fad(paddle.to_tensor(np.ones((2, 3, 8), np.float32))).numpy()
+        # whole channels share their fate
+        flat = o.reshape(6, 8)
+        self.assertTrue((flat == flat[:, :1]).all())
+
+
+class TestBeamSearch(unittest.TestCase):
+    def test_greedy_chain(self):
+        class ToyCell:
+            V = 5
+
+            def __call__(self, inputs, state):
+                ids = np.asarray(inputs.numpy()).astype(np.int64)
+                logits = np.full((len(ids), self.V), -5.0, np.float32)
+                logits[np.arange(len(ids)), (ids + 1) % self.V] = 5.0
+                return (paddle.to_tensor(logits),
+                        [paddle.to_tensor(ids.astype(np.float32))])
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=1, end_token=0,
+                                   beam_size=2)
+        init = [paddle.to_tensor(np.zeros((3,), np.float32))]
+        ids, logp = nn.dynamic_decode(dec, inits=init, max_step_num=6)
+        np.testing.assert_array_equal(ids.numpy()[0, :4, 0], [2, 3, 4, 0])
+        self.assertEqual(list(logp.shape), [3, 2])
+
+
+if __name__ == "__main__":
+    unittest.main()
