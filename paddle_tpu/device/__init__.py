@@ -150,3 +150,53 @@ class cuda:
 
     Stream = Stream
     Event = Event
+
+
+def get_cudnn_version():
+    """reference: device/__init__.py get_cudnn_version — None off-GPU."""
+    return None
+
+
+class XPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+class IPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA is the compiler on TPU; the CINN-specific build flag is False
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    # collectives are always available through XLA
+    return True
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def set_stream(stream=None):
+    """XLA orders execution per-device; streams are a no-op facade."""
+    return stream

@@ -104,3 +104,41 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return dispatch("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
                     (x,))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference: fft.py hfft2 — hermitian-input 2-D FFT (real output)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian n-D FFT: conjugate-symmetric input -> real spectrum.
+    numpy identity: hfftn(a) == irfftn(conj(a)) with the norm direction
+    swapped (matches the reference c2r kernel)."""
+    def impl(a):
+        import numpy as _np
+
+        swap = {"backward": "forward", "forward": "backward",
+                "ortho": "ortho"}[norm]
+        return jnp.asarray(_np.fft.irfftn(_np.conj(_np.asarray(a)), s=s,
+                                          axes=axes, norm=swap))
+
+    return dispatch("hfftn", impl, (x,))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse Hermitian n-D FFT: ihfftn(a) == conj(rfftn(a)) with the
+    norm direction swapped."""
+    def impl(a):
+        import numpy as _np
+
+        swap = {"backward": "forward", "forward": "backward",
+                "ortho": "ortho"}[norm]
+        return jnp.asarray(_np.conj(_np.fft.rfftn(_np.asarray(a), s=s,
+                                                  axes=axes, norm=swap)))
+
+    return dispatch("ihfftn", impl, (x,))

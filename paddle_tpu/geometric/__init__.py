@@ -89,3 +89,134 @@ segment_sum = _segment("segment_sum", "sum")
 segment_mean = _segment("segment_mean", "mean")
 segment_max = _segment("segment_max", "max")
 segment_min = _segment("segment_min", "min")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """reference: geometric/sampling/neighbors.py:23 — sample up to
+    sample_size neighbors of each input node from a CSC graph (row =
+    neighbor ids, colptr = per-node offsets). Host-side (data-dependent
+    sizes), like the reference's dynamic-graph-only op. Returns
+    (out_neighbors, out_count[, out_eids])."""
+    import numpy as np
+
+    from ..framework import random as _random
+
+    row_a = np.asarray(unwrap(row))
+    ptr = np.asarray(unwrap(colptr))
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    eids_a = None if eids is None else np.asarray(unwrap(eids))
+    rng = np.random.default_rng(
+        int(jax.random.randint(_random.next_key(), (), 0, 2**31 - 1)))
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        s, e = int(ptr[v]), int(ptr[v + 1])
+        neigh = row_a[s:e]
+        ids = np.arange(s, e)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eids_a is not None:
+            out_e.append(eids_a[ids])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, row_a.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids and eids_a is not None:
+        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """reference: geometric/sampling/neighbors.py weighted_sample_neighbors
+    — neighbor sampling without replacement, probability proportional to
+    edge weight."""
+    import numpy as np
+
+    from ..framework import random as _random
+
+    row_a = np.asarray(unwrap(row))
+    ptr = np.asarray(unwrap(colptr))
+    w = np.asarray(unwrap(edge_weight)).astype(np.float64)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    eids_a = None if eids is None else np.asarray(unwrap(eids))
+    rng = np.random.default_rng(
+        int(jax.random.randint(_random.next_key(), (), 0, 2**31 - 1)))
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        s, e = int(ptr[v]), int(ptr[v + 1])
+        neigh = row_a[s:e]
+        ids = np.arange(s, e)
+        if 0 <= sample_size < len(neigh):
+            p = w[s:e] / w[s:e].sum()
+            pick = rng.choice(len(neigh), sample_size, replace=False, p=p)
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eids_a is not None:
+            out_e.append(eids_a[ids])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, row_a.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids and eids_a is not None:
+        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts
+
+
+def _reindex(nodes_list, neighbors_a):
+    import numpy as np
+
+    mapping = {}
+    order = []
+    for n in nodes_list:
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(mapping)
+            order.append(n)
+    for n in neighbors_a:
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(mapping)
+            order.append(n)
+    return mapping, np.asarray(order)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """reference: geometric/reindex.py:25 — relabel sampled subgraph ids
+    to 0..n-1 (input nodes first), returning (reindexed_src,
+    reindexed_dst, out_nodes)."""
+    import numpy as np
+
+    xa = np.asarray(unwrap(x)).reshape(-1)
+    na = np.asarray(unwrap(neighbors)).reshape(-1)
+    ca = np.asarray(unwrap(count)).reshape(-1)
+    mapping, order = _reindex(xa, na)
+    src = np.asarray([mapping[int(n)] for n in na], np.int64)
+    dst = np.repeat(np.arange(len(xa), dtype=np.int64), ca)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(order)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference: geometric/reindex.py reindex_heter_graph — like
+    reindex_graph over per-edge-type neighbor/count lists sharing one id
+    space."""
+    import numpy as np
+
+    xa = np.asarray(unwrap(x)).reshape(-1)
+    neigh_list = [np.asarray(unwrap(n)).reshape(-1) for n in neighbors]
+    cnt_list = [np.asarray(unwrap(c)).reshape(-1) for c in count]
+    mapping, order = _reindex(xa, np.concatenate(neigh_list))
+    srcs, dsts = [], []
+    for na, ca in zip(neigh_list, cnt_list):
+        srcs.append(np.asarray([mapping[int(n)] for n in na], np.int64))
+        dsts.append(np.repeat(np.arange(len(xa), dtype=np.int64), ca))
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(order)))

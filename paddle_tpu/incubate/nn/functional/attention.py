@@ -223,10 +223,13 @@ def block_multihead_attention(
         if extra is not None:
             m = extra[i]
             m = m[0] if m.ndim >= 3 and m.shape[0] == 1 else m
-            m = np.broadcast_to(m[-n_new:, :total] if m.ndim == 2
-                                else m.reshape(-1)[None, :total],
-                                (n_new, total))
-            logits = logits + m[None].astype(np.float32)
+            if m.ndim == 2:
+                # rows indexed by the queries' global positions
+                m = m[qpos][:, :total]
+            else:
+                m = np.broadcast_to(m.reshape(-1)[None, :total],
+                                    (n_new, total))
+            logits = logits + m.astype(np.float32)
         logits = logits - logits.max(-1, keepdims=True)
         p = np.exp(logits)
         p /= p.sum(-1, keepdims=True)

@@ -26,4 +26,6 @@ from .extras2 import (  # noqa: E402,F401
     leaky_relu_, margin_cross_entropy, pairwise_distance,
     sparse_attention, thresholded_relu_)
 
-__all__ = [n for n in dir() if not n.startswith("_")]
+import types as _types
+__all__ = [n for n, v in list(globals().items())
+           if not n.startswith("_") and not isinstance(v, _types.ModuleType)]

@@ -230,6 +230,11 @@ def sparse_attention(query, key, value, sparse_csr_offset,
     cols = np.asarray(unwrap(sparse_csr_columns)
                       if isinstance(sparse_csr_columns, Tensor)
                       else sparse_csr_columns).astype(np.int64)
+    kpm = None if key_padding_mask is None else np.asarray(
+        unwrap(key_padding_mask) if isinstance(key_padding_mask, Tensor)
+        else key_padding_mask)
+    am = None if attn_mask is None else np.asarray(
+        unwrap(attn_mask) if isinstance(attn_mask, Tensor) else attn_mask)
     B, H, M, D = q.shape
     out = np.zeros_like(q)
     scale = 1.0 / math.sqrt(D)
@@ -241,6 +246,13 @@ def sparse_attention(query, key, value, sparse_csr_offset,
                     continue
                 c = cols[b, h, s:e]
                 logits = (k[b, h, c] @ q[b, h, m]) * scale
+                # additive masks (0 keep / -inf drop) per the reference
+                if kpm is not None:
+                    logits = logits + kpm[b, c]
+                if am is not None:
+                    logits = logits + am[m, c]
+                if np.all(np.isneginf(logits)):
+                    continue
                 p = np.exp(logits - logits.max())
                 p /= p.sum()
                 out[b, h, m] = p @ v[b, h, c]

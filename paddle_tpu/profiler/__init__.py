@@ -22,7 +22,8 @@ from typing import Callable, Iterable, Optional
 import jax
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "make_scheduler", "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result",
            "SummaryView", "SortedKeys"]
 
 
@@ -272,3 +273,16 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 def load_profiler_result(path: str):
     with open(path) as f:
         return json.load(f)
+
+
+def export_protobuf(dir_name: str, worker_name=None):
+    """reference: profiler.py export_protobuf — an on_trace_ready handler
+    persisting the raw trace. The TPU-native raw format is the XPlane
+    protobuf jax.profiler already writes into `dir_name`; host spans are
+    saved alongside as JSON."""
+    def handle(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        prof.export(os.path.join(
+            dir_name, (worker_name or "worker") + "_host_events.json"))
+
+    return handle

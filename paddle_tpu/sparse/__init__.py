@@ -220,3 +220,82 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# remaining unary surface (reference: sparse/unary.py)
+tan = _ewise("tan", jnp.tan)
+asin = _ewise("asin", jnp.arcsin)
+atan = _ewise("atan", jnp.arctan)
+sinh = _ewise("sinh", jnp.sinh)
+asinh = _ewise("asinh", jnp.arcsinh)
+atanh = _ewise("atanh", jnp.arctanh)
+square = _ewise("square", jnp.square)
+log1p = _ewise("log1p", jnp.log1p)
+expm1 = _ewise("expm1", jnp.expm1)
+deg2rad = _ewise("deg2rad", jnp.deg2rad)
+rad2deg = _ewise("rad2deg", jnp.rad2deg)
+isnan = _ewise("isnan", jnp.isnan)
+
+
+def mv(x, vec, name=None):
+    """sparse matrix x dense vector (reference: sparse/binary.py mv)."""
+    sp = _sp(x)
+    return Tensor(sp @ unwrap(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference: sparse/binary.py addmm)."""
+    prod = matmul(x, y)
+    inp = (input.to_dense()
+           if isinstance(input, (SparseCooTensor, SparseCsrTensor))
+           else input)
+    return Tensor(beta * unwrap(inp) + alpha * unwrap(prod))
+
+
+def mask_as(x, mask, name=None):
+    """Dense x filtered to mask's sparsity pattern (reference:
+    sparse/unary.py mask_as)."""
+    msk = _sp(mask)
+    if isinstance(msk, jsparse.BCSR):
+        msk = msk.to_bcoo()
+    xa = unwrap(x)
+    data = xa[tuple(msk.indices[:, i] for i in range(
+        msk.indices.shape[1]))]
+    return _wrap_coo(jsparse.BCOO((data, msk.indices), shape=msk.shape))
+
+
+def reshape(x, shape, name=None):
+    """reference: sparse/unary.py reshape — via dense round-trip (XLA owns
+    the layout; sparse reshape has no TPU fast path)."""
+    sp = _sp(x)
+    dense = sp.todense() if hasattr(sp, "todense") else unwrap(x)
+    return _wrap_coo(jsparse.BCOO.fromdense(dense.reshape(tuple(shape))))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """reference: sparse/unary.py slice."""
+    sp = _sp(x)
+    dense = sp.todense() if hasattr(sp, "todense") else unwrap(x)
+    idx = [builtins_slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[int(ax)] = builtins_slice(int(s), int(e))
+    return _wrap_coo(jsparse.BCOO.fromdense(dense[tuple(idx)]))
+
+
+builtins_slice = __builtins__["slice"] if isinstance(__builtins__, dict) \
+    else __builtins__.slice
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: sparse/unary.py pca_lowrank — randomized PCA of a sparse
+    matrix (returns U, S, V)."""
+    sp = _sp(x)
+    dense = jnp.asarray(sp.todense() if hasattr(sp, "todense")
+                        else unwrap(x), jnp.float32)
+    m, n = dense.shape
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        dense = dense - dense.mean(0, keepdims=True)
+    u, s, vt = jnp.linalg.svd(dense, full_matrices=False)
+    return Tensor(u[:, :q]), Tensor(s[:q]), Tensor(vt[:q].T)

@@ -183,3 +183,338 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+
+# ---------------------------------------------------------------------------
+# remaining static-graph __all__ surface (reference:
+# python/paddle/static/__init__.py). Everything executes eagerly per this
+# facade's design; program/state (de)serialization rides the framework
+# save/load machinery.
+# ---------------------------------------------------------------------------
+import pickle as _pickle
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.initializer import ParamAttr
+
+
+Variable = Tensor  # reference: base/framework.py Variable ≙ Tensor here
+
+
+class Scope:
+    """reference: paddle/fluid/framework/scope.h — name -> variable map."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _SCOPE_STACK[-1]
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+class BuildStrategy:
+    """reference: details/build_strategy.h — knobs are accepted and kept
+    for introspection; XLA owns the corresponding decisions."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.enable_addto = False
+
+
+class CompiledProgram:
+    """reference: compiler.py CompiledProgram — pass-through (jit is the
+    compiler)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: base/backward.py append_backward — eagerly runs the
+    backward pass and returns (param, grad) pairs."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        params = []
+    out = []
+    for p in params:
+        g = getattr(p, "grad", None)
+        out.append((p, g))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: base/backward.py gradients."""
+    import paddle_tpu as _p
+
+    return _p.grad(targets, inputs, grad_outputs=target_gradients,
+                   allow_unused=True)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: static/nn/common.py Print — eager print, identity."""
+    vals = np.asarray(input.numpy()).reshape(-1)[:summarize]
+    head = (message + " ") if message else ""
+    print(f"{head}{getattr(input, 'name', '')} shape={list(input.shape)} "
+          f"values={vals}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static/nn/common.py py_func — eager call-through."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    result = func(*xs)
+    return result
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference: base/param_attr.py WeightNormParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         trainable=trainable)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """reference: static/ema.py ExponentialMovingAverage — shadow
+    variables updated as s = decay*s + (1-decay)*p, with apply/restore
+    swapping."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def register(self, parameters):
+        self._params = list(parameters)
+        for p in self._params:
+            self._shadow[id(p)] = np.asarray(p.numpy()).copy()
+
+    def update(self, parameters=None):
+        if parameters is not None and not self._params:
+            self.register(parameters)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1 - d) * np.asarray(p.numpy())
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as _jnp
+
+        for p in self._params:
+            self._backup[id(p)] = p._array
+            p._array = _jnp.asarray(self._shadow[id(p)])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._array = self._backup.pop(id(p))
+
+
+def save(program, model_path, protocol=4):
+    """reference: static/io.py save — persist a trained state."""
+    state = getattr(program, "state_dict", lambda: {})()
+    with open(model_path + ".pdparams", "wb") as f:
+        _pickle.dump({k: np.asarray(v.numpy() if hasattr(v, "numpy")
+                                    else v) for k, v in state.items()}, f,
+                     protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = _pickle.load(f)
+    set_program_state(program, state)
+    return state
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    return _pickle.dumps({"feed": [getattr(v, "name", None)
+                                   for v in feed_vars],
+                          "fetch": [getattr(v, "name", None)
+                                    for v in fetch_vars]})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    return _pickle.dumps({})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def deserialize_program(data):
+    return _pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    return _pickle.loads(data)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """reference: static/io.py save_inference_model — deployment artifact.
+    The TPU-native artifact is jit.save's StableHLO bundle; here the
+    feed/fetch signature is persisted alongside."""
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    meta = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    return [meta, meta.get("feed", []), meta.get("fetch", [])]
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return _pickle.load(f)
+
+
+def set_program_state(program, state):
+    st = getattr(program, "set_state_dict", None)
+    if st:
+        st(state)
+    return program
+
+
+class _Place:
+    def __init__(self, kind, idx=0):
+        self.kind, self.idx = kind, idx
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.idx})"
+
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [_Place("cpu", i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA on TPU builds
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(np.full(shape, value, np.dtype(dtype)))
+    t.name = name
+    global_scope().set_var(name or f"gvar_{id(t)}", t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    arr = (default_initializer(tuple(shape), dtype)
+           if callable(default_initializer)
+           else np.zeros(shape, np.dtype(dtype)))
+    return Parameter(arr, name=name)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=min(num_thresholds, 4095))
+    m.update(np.asarray(input.numpy()), np.asarray(label.numpy()))
+    from ..core.tensor import Tensor as _T
+
+    import jax.numpy as _jnp
+
+    return (_T(_jnp.asarray(m.accumulate())), None, None, None, None, None)
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """reference: base/framework.py device_guard — placement is XLA's;
+    no-op scope."""
+    yield
+
+
+@_contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """IPU support is a non-goal (SURVEY §7.4); accepted for API parity."""
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU support is a non-goal on TPU")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is a non-goal on TPU")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server stack "
+        "(non-goal, SURVEY §7.4)")

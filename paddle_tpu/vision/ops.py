@@ -319,6 +319,23 @@ class RoIPool:
 
 
 class PSRoIPool:
-    def __new__(cls, output_size, spatial_scale=1.0):
-        raise NotImplementedError("PSRoIPool pending (reference: "
-                                  "vision/ops.py psroi_pool)")
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    PSRoIPool): input channels C = out_channels * k*k; output bin (i, j)
+    average-pools the spatial window from channel group i*k + j."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size if isinstance(output_size, int) \
+            else output_size[0]
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        from .ops_detection import _psroi_pool_impl
+
+        return _psroi_pool_impl(x, boxes, boxes_num, self.output_size,
+                                self.spatial_scale)
+
+
+from .ops_detection import (box_coder, decode_jpeg,  # noqa: E402,F401
+                            distribute_fpn_proposals, generate_proposals,
+                            matrix_nms, prior_box, psroi_pool, read_file,
+                            yolo_box, yolo_loss)

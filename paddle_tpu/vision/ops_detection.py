@@ -1,0 +1,543 @@
+"""Detection-pipeline ops completing paddle.vision.ops (reference:
+python/paddle/vision/ops.py — yolo_loss/yolo_box, prior_box, box_coder,
+distribute_fpn_proposals, generate_proposals, matrix_nms, psroi_pool,
+read_file/decode_jpeg).
+
+TPU-native form: grid/anchor math is vectorized jnp that XLA fuses;
+proposal-selection ops with data-dependent output sizes (generate_proposals,
+distribute_fpn_proposals, matrix_nms) run host-side like the reference's
+dynamic-graph usage (their outputs feed variable-length RoI lists, not the
+jitted train step — PP-YOLOE-class training in this repo uses the dense
+end-to-end head instead).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
+           "distribute_fpn_proposals", "generate_proposals", "matrix_nms",
+           "psroi_pool", "read_file", "decode_jpeg"]
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """reference: vision/ops.py yolo_box — decode a YOLOv3 head feature
+    map [N, C, H, W] into (boxes [N, H*W*na, 4] xyxy, scores
+    [N, H*W*na, class_num])."""
+    na = len(anchors) // 2
+
+    def impl(xa, imgs):
+        n, c, h, w = xa.shape
+        an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        iou_pred = None
+        if iou_aware:
+            # reference layout: the first na channels are iou logits,
+            # the regular na*(5+cls) block follows
+            iou_pred = _sigmoid(xa[:, :na])
+            xa = xa[:, na:]
+            c = c - na
+        per = c // na
+        feat = xa.reshape(n, na, per, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (_sigmoid(feat[:, :, 0]) * scale_x_y - bias
+              + gx[None, None, None, :]) / w
+        cy = (_sigmoid(feat[:, :, 1]) * scale_x_y - bias
+              + gy[None, None, :, None]) / h
+        bw = jnp.exp(feat[:, :, 2]) * an[None, :, 0, None, None] \
+            / (downsample_ratio * w)
+        bh = jnp.exp(feat[:, :, 3]) * an[None, :, 1, None, None] \
+            / (downsample_ratio * h)
+        obj = _sigmoid(feat[:, :, 4])
+        if iou_pred is not None:
+            obj = obj ** (1 - iou_aware_factor) \
+                * iou_pred ** iou_aware_factor
+        cls = _sigmoid(feat[:, :, 5:5 + class_num])
+        scores = obj[:, :, None] * cls  # [N, na, cls, H, W]
+        imgh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imgw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imgw
+        y1 = (cy - bh / 2) * imgh
+        x2 = (cx + bw / 2) * imgw
+        y2 = (cy + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1)  # [N, na, H, W, 4]
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, -1, 4)
+        scores = scores.transpose(0, 3, 4, 1, 2).reshape(
+            n, -1, class_num)
+        keep = (obj.transpose(0, 2, 3, 1).reshape(n, -1)
+                > conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+
+    return dispatch("yolo_box", impl, (x, img_size))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py yolo_loss — YOLOv3 multi-part loss per
+    image: sigmoid-BCE on x/y + L1 on w/h (weighted 2 - w*h), objectness
+    BCE with IoU ignore threshold, class BCE. gt boxes are
+    center-normalized [N, B, 4]."""
+    mask = list(anchor_mask)
+    na_all = len(anchors) // 2
+
+    def impl(*arrs):
+        xa, gb, gl = arrs[:3]
+        gs = arrs[3] if gt_score is not None else None
+        n, c, h, w = xa.shape
+        na = len(mask)
+        feat = xa.reshape(n, na, c // na, h, w)
+        an_all = jnp.asarray(anchors, jnp.float32).reshape(na_all, 2)
+        an = an_all[jnp.asarray(mask)]
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+
+        px = _sigmoid(feat[:, :, 0])
+        py = _sigmoid(feat[:, :, 1])
+        pw = feat[:, :, 2]
+        ph = feat[:, :, 3]
+        pobj = feat[:, :, 4]
+        pcls = feat[:, :, 5:5 + class_num]
+
+        # decode predicted boxes (normalized) for the ignore mask
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bx = (px + gx[None, None, None, :]) / w
+        by = (py + gy[None, None, :, None]) / h
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * an[None, :, 0, None, None] \
+            / in_w
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * an[None, :, 1, None, None] \
+            / in_h
+
+        # IoU of every predicted box vs every gt (normalized cxcywh)
+        def iou(b1, b2):
+            b1x1, b1x2 = b1[..., 0] - b1[..., 2] / 2, \
+                b1[..., 0] + b1[..., 2] / 2
+            b1y1, b1y2 = b1[..., 1] - b1[..., 3] / 2, \
+                b1[..., 1] + b1[..., 3] / 2
+            b2x1, b2x2 = b2[..., 0] - b2[..., 2] / 2, \
+                b2[..., 0] + b2[..., 2] / 2
+            b2y1, b2y2 = b2[..., 1] - b2[..., 3] / 2, \
+                b2[..., 1] + b2[..., 3] / 2
+            ix = jnp.maximum(jnp.minimum(b1x2, b2x2)
+                             - jnp.maximum(b1x1, b2x1), 0)
+            iy = jnp.maximum(jnp.minimum(b1y2, b2y2)
+                             - jnp.maximum(b1y1, b2y1), 0)
+            inter = ix * iy
+            a1 = (b1x2 - b1x1) * (b1y2 - b1y1)
+            a2 = (b2x2 - b2x1) * (b2y2 - b2y1)
+            return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+        pred = jnp.stack([bx, by, bw, bh], -1)  # [N, na, H, W, 4]
+        ious = iou(pred[:, :, :, :, None, :],
+                   gb[:, None, None, None, :, :])  # [N,na,H,W,B]
+        gt_valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)  # [N, B]
+        ious = jnp.where(gt_valid[:, None, None, None, :], ious, 0.0)
+        ignore = ious.max(-1) > ignore_thresh  # [N, na, H, W]
+
+        # responsible cell/anchor per gt: best-IoU anchor (shape only)
+        gw, gh = gb[..., 2] * in_w, gb[..., 3] * in_h  # pixels
+        shape_iou = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+                     * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+        shape_union = gw[..., None] * gh[..., None] \
+            + an_all[None, None, :, 0] * an_all[None, None, :, 1] \
+            - shape_iou
+        best_anchor = jnp.argmax(shape_iou / jnp.maximum(shape_union,
+                                                         1e-10), -1)
+        gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+        mask_arr = jnp.asarray(mask)
+        hit = best_anchor[..., None] == mask_arr[None, None, :]  # [N,B,na]
+        score_w = gs if gs is not None else jnp.ones_like(gb[..., 0])
+        smooth = (1.0 / class_num if use_label_smooth and class_num > 1
+                  else 0.0)
+
+        def bce(logit_or_p, target, is_logit):
+            if is_logit:
+                return jnp.maximum(logit_or_p, 0) - logit_or_p * target \
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit_or_p)))
+            p = jnp.clip(logit_or_p, 1e-7, 1 - 1e-7)
+            return -(target * jnp.log(p) + (1 - target) * jnp.log1p(-p))
+
+        total = jnp.zeros((n,), jnp.float32)
+        obj_target = jnp.zeros((n, na, h, w))
+        obj_weight = jnp.where(ignore, 0.0, 1.0)
+        B = gb.shape[1]
+        for b_i in range(B):
+            for a_i in range(na):
+                sel = hit[:, b_i, a_i] & gt_valid[:, b_i]  # [N]
+                ii, jj = gj[:, b_i], gi[:, b_i]
+                tx = gb[:, b_i, 0] * w - jj
+                ty = gb[:, b_i, 1] * h - ii
+                tw = jnp.log(jnp.maximum(
+                    gw[:, b_i] / an[a_i, 0], 1e-9))
+                th = jnp.log(jnp.maximum(
+                    gh[:, b_i] / an[a_i, 1], 1e-9))
+                box_w = (2.0 - gb[:, b_i, 2] * gb[:, b_i, 3]) \
+                    * score_w[:, b_i]
+                bsel = jnp.arange(n)
+                lx = bce(px[bsel, a_i, ii, jj], tx, False)
+                ly = bce(py[bsel, a_i, ii, jj], ty, False)
+                lw = jnp.abs(pw[bsel, a_i, ii, jj] - tw)
+                lh = jnp.abs(ph[bsel, a_i, ii, jj] - th)
+                cls_t = jax.nn.one_hot(gl[:, b_i], class_num) \
+                    * (1 - smooth) + smooth / 2
+                lc = bce(pcls[bsel, a_i, :, ii, jj], cls_t, True).sum(-1)
+                total = total + jnp.where(
+                    sel, (lx + ly + lw + lh) * box_w
+                    + lc * score_w[:, b_i], 0.0)
+                obj_target = obj_target.at[bsel, a_i, ii, jj].set(
+                    jnp.where(sel, score_w[:, b_i],
+                              obj_target[bsel, a_i, ii, jj]))
+                obj_weight = obj_weight.at[bsel, a_i, ii, jj].set(
+                    jnp.where(sel, 1.0, obj_weight[bsel, a_i, ii, jj]))
+        lobj = bce(pobj, obj_target, True) * obj_weight
+        total = total + lobj.sum((1, 2, 3))
+        return total
+
+    args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None
+                                    else ())
+    return dispatch("yolo_loss", impl, args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference: vision/ops.py prior_box — SSD prior generation over the
+    feature-map grid. Returns (boxes [H, W, P, 4], variances same)."""
+    def impl(feat, img):
+        h, w = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        step_h = steps[1] or ih / h
+        step_w = steps[0] or iw / w
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if all(abs(ar - a) > 1e-6 for a in ars):
+                ars.append(float(ar))
+                if flip:
+                    ars.append(1.0 / float(ar))
+        boxes = []
+        for ms_i, ms in enumerate(min_sizes):
+            bw = bh = float(ms)
+            if min_max_aspect_ratios_order:
+                boxes.append((bw, bh))
+                if max_sizes:
+                    d = math.sqrt(ms * max_sizes[ms_i])
+                    boxes.append((d, d))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    boxes.append((bw * math.sqrt(ar), bh / math.sqrt(ar)))
+            else:
+                for ar in ars:
+                    boxes.append((bw * math.sqrt(ar), bh / math.sqrt(ar)))
+                if max_sizes:
+                    d = math.sqrt(ms * max_sizes[ms_i])
+                    boxes.append((d, d))
+        wh = jnp.asarray(boxes, jnp.float32)  # [P, 2]
+        cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+        x1 = (cxg[..., None] - wh[None, None, :, 0] / 2) / iw
+        y1 = (cyg[..., None] - wh[None, None, :, 1] / 2) / ih
+        x2 = (cxg[..., None] + wh[None, None, :, 0] / 2) / iw
+        y2 = (cyg[..., None] + wh[None, None, :, 1] / 2) / ih
+        out = jnp.stack([x1, y1, x2, y2], -1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               out.shape)
+        return out, var
+
+    return dispatch("prior_box", impl, (input, image))
+
+
+def box_coder(prior_box_t, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """reference: vision/ops.py box_coder — encode/decode boxes against
+    priors (R-CNN delta parameterization)."""
+    norm = 0.0 if box_normalized else 1.0
+
+    def impl(*arrs):
+        pb = arrs[0]
+        tb = arrs[-1]
+        pbv = arrs[1] if len(arrs) == 3 else None
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, None, 2] - tb[:, None, 0] + norm
+            th = tb[:, None, 3] - tb[:, None, 1] + norm
+            tcx = tb[:, None, 0] + tw / 2
+            tcy = tb[:, None, 1] + th / 2
+            dx = (tcx - pcx[None]) / pw[None]
+            dy = (tcy - pcy[None]) / ph[None]
+            dw = jnp.log(tw / pw[None])
+            dh = jnp.log(th / ph[None])
+            out = jnp.stack([dx, dy, dw, dh], -1)
+            if pbv is not None:
+                out = out / pbv[None]
+            return out
+        # decode_center_size
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+            v = pbv[None] if pbv is not None else 1.0
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+            v = pbv[:, None] if pbv is not None else 1.0
+        d = tb * v if pbv is not None else tb
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        bw = jnp.exp(d[..., 2]) * pw_
+        bh = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - norm, cy + bh / 2 - norm], -1)
+
+    args = (prior_box_t,) + ((prior_box_var,) if prior_box_var is not None
+                             else ()) + (target_box,)
+    return dispatch("box_coder", impl, args)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """reference: vision/ops.py distribute_fpn_proposals — route each RoI
+    to its FPN level by sqrt(area). Host-side (variable-size outputs)."""
+    rois = np.asarray(unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + off) * (rois[:, 3] - rois[:, 1] + off),
+        0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore, nums = [], [], []
+    order = []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        nums.append(Tensor(jnp.asarray(np.asarray([len(idx)], np.int32))))
+        order.append(idx)
+    concat_order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(concat_order)
+    restore[concat_order] = np.arange(len(concat_order))
+    res = (multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1))))
+    if rois_num is not None:
+        return res + (nums,)
+    return res
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """reference: vision/ops.py generate_proposals — RPN proposal
+    generation: decode anchors, top-k by score, clip, filter small, NMS.
+    Host-side per image."""
+    from .ops import nms as _nms
+
+    sc = np.asarray(unwrap(scores))
+    bd = np.asarray(unwrap(bbox_deltas))
+    ims = np.asarray(unwrap(img_size))
+    an = np.asarray(unwrap(anchors)).reshape(-1, 4)
+    va = np.asarray(unwrap(variances)).reshape(-1, 4)
+    n = sc.shape[0]
+    out_rois, out_probs, out_nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].transpose(1, 2, 0).reshape(-1, 4)
+        top = np.argsort(-s)[:pre_nms_top_n]
+        # anchors/variances repeat per spatial position when fewer than
+        # the flattened score count
+        a = an[top % len(an)]
+        v = va[top % len(va)]
+        s, d = s[top], d[top]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = d[:, 0] * v[:, 0] * aw + acx
+        cy = d[:, 1] * v[:, 1] * ah + acy
+        bw = np.exp(np.minimum(d[:, 2] * v[:, 2], 10)) * aw
+        bh = np.exp(np.minimum(d[:, 3] * v[:, 3], 10)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        h_i, w_i = ims[i, 0], ims[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_i - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_i - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = np.asarray(unwrap(_nms(
+                Tensor(jnp.asarray(boxes.astype(np.float32))),
+                iou_threshold=nms_thresh,
+                scores=Tensor(jnp.asarray(s.astype(np.float32))))))
+            kept = kept[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        out_rois.append(boxes)
+        out_probs.append(s)
+        out_nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(out_rois)
+                              if out_rois else np.zeros((0, 4))))
+    probs = Tensor(jnp.asarray(np.concatenate(out_probs)
+                               if out_probs else np.zeros((0,))))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(
+            np.asarray(out_nums, np.int32)))
+    return rois, probs
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference: vision/ops.py matrix_nms (SOLOv2) — parallel soft-NMS:
+    decay each box's score by its max-IoU overlap with higher-scored boxes
+    of the same class. Host-side."""
+    bb = np.asarray(unwrap(bboxes))
+    sc = np.asarray(unwrap(scores))
+    n, nc = sc.shape[0], sc.shape[1]
+    norm = 0.0 if normalized else 1.0
+    all_out, all_idx, nums = [], [], []
+    for i in range(n):
+        dets = []
+        for c in range(nc):
+            if c == background_label:
+                continue
+            s = sc[i, c]
+            keep = np.nonzero(s > score_threshold)[0]
+            if not len(keep):
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes = bb[i, order]
+            ss = s[order].copy()
+            x1, y1, x2, y2 = boxes.T
+            area = (x2 - x1 + norm) * (y2 - y1 + norm)
+            ix1 = np.maximum(x1[:, None], x1[None])
+            iy1 = np.maximum(y1[:, None], y1[None])
+            ix2 = np.minimum(x2[:, None], x2[None])
+            iy2 = np.minimum(y2[:, None], y2[None])
+            inter = np.maximum(ix2 - ix1 + norm, 0) \
+                * np.maximum(iy2 - iy1 + norm, 0)
+            iou = inter / np.maximum(area[:, None] + area[None] - inter,
+                                     1e-10)
+            iou = np.triu(iou, 1)  # overlap with higher-scored only
+            iou_cmax = iou.max(0)
+            # compensate by the SUPPRESSOR row's own max overlap (SOLOv2
+            # eq. 4): decay_j = min_i f(iou_ij, iou_cmax_i)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[:, None],
+                                                1e-10)).min(0)
+            ss = ss * decay
+            ok = ss > post_threshold
+            for j in np.nonzero(ok)[0]:
+                dets.append((c, ss[j], *boxes[j], order[j]))
+        dets.sort(key=lambda r: -r[1])
+        dets = dets[:keep_top_k]
+        boxes_per_image = bb.shape[1]
+        for d in dets:
+            all_out.append(d[:6])
+            all_idx.append(i * boxes_per_image + d[6])
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.asarray(all_out, np.float32).reshape(
+        -1, 6)))
+    res = (out,)
+    if return_index:
+        res = res + (Tensor(jnp.asarray(
+            np.asarray(all_idx, np.int64).reshape(-1, 1))),)
+    if return_rois_num:
+        res = res + (Tensor(jnp.asarray(np.asarray(nums, np.int32))),)
+    return res if len(res) > 1 else out
+
+
+def _psroi_pool_impl(x, boxes, boxes_num, output_size, spatial_scale):
+    k = output_size
+    xa = np.asarray(unwrap(x))
+    bx = np.asarray(unwrap(boxes))
+    bn = np.asarray(unwrap(boxes_num)).reshape(-1)
+    n, c, h, w = xa.shape
+    if c % (k * k):
+        raise ValueError(f"channels {c} not divisible by {k * k}")
+    oc = c // (k * k)
+    outs = np.zeros((len(bx), oc, k, k), np.float32)
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+    # reference layout: channel (c*k + i)*k + j -> (oc, k, k) groups
+    groups = xa.reshape(n, oc, k, k, h, w)
+    for r, box in enumerate(bx):
+        img = int(img_of_box[r])
+        x1, y1, x2, y2 = box * spatial_scale
+        rw = max(x2 - x1, 0.1) / k
+        rh = max(y2 - y1, 0.1) / k
+        for i in range(k):
+            for j in range(k):
+                ys = int(np.floor(y1 + i * rh))
+                ye = int(np.ceil(y1 + (i + 1) * rh))
+                xs = int(np.floor(x1 + j * rw))
+                xe = int(np.ceil(x1 + (j + 1) * rw))
+                ys, ye = np.clip([ys, ye], 0, h)
+                xs, xe = np.clip([xs, xe], 0, w)
+                if ye > ys and xe > xs:
+                    outs[r, :, i, j] = groups[
+                        img, :, i, j, ys:ye, xs:xe].mean((1, 2))
+    return Tensor(jnp.asarray(outs))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference: vision/ops.py psroi_pool — functional form of
+    PSRoIPool."""
+    k = output_size if isinstance(output_size, int) else output_size[0]
+    return _psroi_pool_impl(x, boxes, boxes_num, k, spatial_scale)
+
+
+def read_file(filename, name=None):
+    """reference: vision/ops.py read_file — raw bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg — JPEG bytes -> CHW uint8."""
+    from io import BytesIO
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(unwrap(x)).astype(np.uint8))
+    img = Image.open(BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

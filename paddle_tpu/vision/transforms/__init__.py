@@ -323,3 +323,79 @@ class Transpose(BaseTransform):
         if arr.ndim == 2:
             arr = arr[..., None]
         return np.transpose(arr, self.order)
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms/transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = functional._as_hwc(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        else:
+            tx = ty = 0.0
+        scale = random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is not None:
+            sh = self.shear if isinstance(self.shear, (list, tuple)) \
+                else (-self.shear, self.shear)
+            if len(sh) == 2:
+                shear = (random.uniform(sh[0], sh[1]), 0.0)
+            else:
+                shear = (random.uniform(sh[0], sh[1]),
+                         random.uniform(sh[2], sh[3]))
+        else:
+            shear = (0.0, 0.0)
+        return functional.affine(arr, angle, (tx, ty), scale, shear,
+                                 interpolation=self.interpolation,
+                                 fill=self.fill, center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms/transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = functional._as_hwc(img)
+        if random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(random.randint(0, max(half_w, 1)),
+                random.randint(0, max(half_h, 1))),
+               (w - 1 - random.randint(0, max(half_w, 1)),
+                random.randint(0, max(half_h, 1))),
+               (w - 1 - random.randint(0, max(half_w, 1)),
+                h - 1 - random.randint(0, max(half_h, 1))),
+               (random.randint(0, max(half_w, 1)),
+                h - 1 - random.randint(0, max(half_h, 1)))]
+        return functional.perspective(arr, start, end,
+                                      interpolation=self.interpolation,
+                                      fill=self.fill)
+
+
+affine = functional.affine
+perspective = functional.perspective

@@ -245,3 +245,93 @@ def erase(img, i, j, h, w, v, inplace=False):
     arr = np.array(img, copy=not inplace)
     arr[i:i + h, j:j + w] = v
     return arr
+
+
+def _inverse_sample(arr, y0, x0, interpolation, fill):
+    """Sample arr at (possibly fractional) source coords y0/x0 (shape of
+    the output grid); out-of-bounds filled."""
+    h, w = arr.shape[:2]
+    oob = (y0 < 0) | (y0 > h - 1) | (x0 < 0) | (x0 > w - 1)
+    if interpolation == "bilinear":
+        yf = np.clip(y0, 0, h - 1)
+        xf = np.clip(x0, 0, w - 1)
+        yl = np.floor(yf).astype(int)
+        xl = np.floor(xf).astype(int)
+        yh_ = np.minimum(yl + 1, h - 1)
+        xh_ = np.minimum(xl + 1, w - 1)
+        wy = (yf - yl)[..., None] if arr.ndim == 3 else (yf - yl)
+        wx = (xf - xl)[..., None] if arr.ndim == 3 else (xf - xl)
+        src = arr.astype(np.float32)
+        out = (src[yl, xl] * (1 - wy) * (1 - wx)
+               + src[yl, xh_] * (1 - wy) * wx
+               + src[yh_, xl] * wy * (1 - wx) + src[yh_, xh_] * wy * wx)
+    else:
+        yi = np.clip(np.round(y0).astype(int), 0, h - 1)
+        xi = np.clip(np.round(x0).astype(int), 0, w - 1)
+        out = arr[yi, xi].astype(np.float32)
+    out[oob] = fill
+    return out.astype(arr.dtype) if np.issubdtype(arr.dtype, np.integer) \
+        else out
+
+
+def _affine_inv_matrix(angle, translate, scale, shear, center):
+    """Inverse of the affine map T(translate) C R(angle) Sh(shear) S(scale)
+    C^-1 in (x, y) coordinates (the torchvision/paddle convention)."""
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix entries (inverse computed by np.linalg.inv)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]], float)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], float)
+    return np.linalg.inv(pre @ m @ post)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference: transforms/functional.py affine."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inv_matrix(angle, translate, scale, shear, center)
+    ys, xs = np.mgrid[0:h, 0:w]
+    x0 = inv[0, 0] * xs + inv[0, 1] * ys + inv[0, 2]
+    y0 = inv[1, 0] * xs + inv[1, 1] * ys + inv[1, 2]
+    return _inverse_sample(arr, y0, x0, interpolation, fill)
+
+
+def _find_homography(src_pts, dst_pts):
+    """Solve the 8-dof homography mapping src -> dst (4 point pairs)."""
+    A, b = [], []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        b.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b.append(v)
+    coeffs = np.linalg.solve(np.asarray(A, float), np.asarray(b, float))
+    return np.append(coeffs, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference: transforms/functional.py perspective — warp so that
+    startpoints map onto endpoints."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    # inverse map: output pixel -> source pixel
+    hm = _find_homography([tuple(p) for p in endpoints],
+                          [tuple(p) for p in startpoints])
+    ys, xs = np.mgrid[0:h, 0:w]
+    den = hm[2, 0] * xs + hm[2, 1] * ys + hm[2, 2]
+    x0 = (hm[0, 0] * xs + hm[0, 1] * ys + hm[0, 2]) / den
+    y0 = (hm[1, 0] * xs + hm[1, 1] * ys + hm[1, 2]) / den
+    return _inverse_sample(arr, y0, x0, interpolation, fill)
