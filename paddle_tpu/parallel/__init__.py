@@ -88,3 +88,14 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
             "single-controller JAX drives all devices from one process; "
             "running func inline once.")
     func(*args)
+from . import compat  # noqa: E402,F401
+from .compat import (  # noqa: E402,F401
+    CountFilterEntry, DistAttr, DistModel, InMemoryDataset, ParallelMode,
+    ProbabilityEntry, QueueDataset, ReduceType, ShardingStage1,
+    ShardingStage2, ShardingStage3, ShowClickEntry, Strategy, alltoall,
+    alltoall_single, broadcast_object_list, destroy_process_group,
+    get_backend, gloo_barrier, gloo_init_parallel_env, gloo_release,
+    is_available, is_initialized, scatter_object_list, shard_dataloader,
+    shard_scaler, split, to_static, wait)
+from . import launch  # noqa: E402,F401
+from . import checkpoint as io  # noqa: E402,F401

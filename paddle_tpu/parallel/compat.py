@@ -1,0 +1,354 @@
+"""Remaining paddle.distributed __all__ surface (reference:
+python/paddle/distributed/__init__.py): object collectives, gloo bootstrap
+facades, env/introspection helpers, model-parallel `split`, the
+semi-auto-parallel static API (Strategy / to_static / DistModel /
+shard_dataloader / shard_scaler / ShardingStage*), and loud refusals for
+the parameter-server dataset entries (non-goal, SURVEY §7.4).
+"""
+from __future__ import annotations
+
+import pickle
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+from . import collective as _coll
+from . import env as _env
+from .mesh import get_global_mesh
+
+__all__ = [
+    "alltoall", "alltoall_single", "wait", "scatter_object_list",
+    "broadcast_object_list", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "is_initialized", "destroy_process_group",
+    "is_available", "get_backend", "ParallelMode", "ReduceType",
+    "DistAttr", "split", "shard_dataloader", "shard_scaler",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3", "Strategy",
+    "to_static", "DistModel", "QueueDataset", "InMemoryDataset",
+    "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry",
+]
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op=True):
+    """reference: communication/all_to_all.py alltoall — paddle argument
+    order (inputs first); the local collective takes (out, in)."""
+    return _coll.all_to_all(out_tensor_list, in_tensor_list, group=group,
+                            sync_op=sync_op)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """reference: communication/all_to_all.py alltoall_single."""
+    return _coll.all_to_all_single(out_tensor, in_tensor,
+                                   out_split_sizes=out_split_sizes,
+                                   in_split_sizes=in_split_sizes,
+                                   group=group, sync_op=sync_op)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: communication/wait.py — XLA orders collectives per
+    device; block on the value for host-visible sync."""
+    jax.block_until_ready(unwrap(tensor))
+    return tensor
+
+
+def _object_to_tensor(obj):
+    data = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    return Tensor(jnp.asarray(data)), len(data)
+
+
+def _tensor_to_object(t, n):
+    return pickle.loads(bytes(np.asarray(unwrap(t))[:n]))
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list —
+    pickle over the byte-tensor broadcast path. Single-controller JAX has
+    one python process per host, so within-process this is identity; the
+    tensor hop keeps the comm path exercised."""
+    for i, obj in enumerate(object_list):
+        t, n = _object_to_tensor(obj)
+        t = _coll.broadcast(t, src=src, group=group)
+        object_list[i] = _tensor_to_object(t, n)
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: communication/scatter.py scatter_object_list — rank r
+    receives the r-th contiguous chunk; every object is assigned
+    (np.array_split semantics)."""
+    rank = _env.get_rank() if hasattr(_env, "get_rank") else 0
+    world = _env.get_world_size() if hasattr(_env, "get_world_size") else 1
+    if in_object_list is None:
+        in_object_list = []
+    chunks = np.array_split(np.asarray(in_object_list, dtype=object),
+                            max(world, 1))
+    mine = list(chunks[rank]) if rank < len(chunks) else []
+    out_object_list[:] = [pickle.loads(pickle.dumps(o)) for o in mine]
+    return out_object_list
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: parallel_with_gloo.py — CPU rendezvous is subsumed by
+    jax.distributed; accepted for API parity."""
+    return None
+
+
+def gloo_barrier():
+    _coll.barrier()
+
+
+def gloo_release():
+    return None
+
+
+def is_initialized():
+    """reference: collective.py is_initialized."""
+    return _env.is_initialized() if hasattr(_env, "is_initialized") \
+        else jax.device_count() > 0
+
+
+def destroy_process_group(group=None):
+    """reference: collective.py destroy_process_group — XLA groups are
+    compiled into programs; dropping the python handle is the analog."""
+    return None
+
+
+def is_available():
+    return True
+
+
+def get_backend(group=None):
+    """reference: collective.py get_backend — the TPU comm backend is XLA
+    collectives over ICI/DCN."""
+    return "XCCL" if jax.default_backend() == "tpu" else "GLOO"
+
+
+class ParallelMode:
+    """reference: parallel.py ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType(Enum):
+    """reference: auto_parallel/placement_type.py ReduceType."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """reference: auto_parallel/api.py DistAttr(mesh, sharding_specs) —
+    the mesh + per-dim sharding spec pair used by shard_tensor."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def placements(self):
+        from .placement import Replicate, Shard
+
+        out = []
+        for dim_name in getattr(self.process_mesh, "dim_names",
+                                list(getattr(self.process_mesh, "shape",
+                                             {}).keys())):
+            if dim_name in self.sharding_specs:
+                out.append(Shard(self.sharding_specs.index(dim_name)))
+            else:
+                out.append(Replicate())
+        return out
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: collective.py split — model-parallel embedding/linear
+    over the mp axis, realised by the mpu layer family."""
+    from .mpu import ColumnParallelLinear, RowParallelLinear, \
+        VocabParallelEmbedding
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1])
+        return layer(x)
+    if operation == "linear":
+        if axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(size[0], size[1],
+                                      input_is_parallel=False)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")
+
+
+class ShardingStage1:
+    """reference: auto_parallel/api.py ShardingStage1 — marker passed to
+    shard_optimizer: shard optimizer states over the mesh axis."""
+
+    def __init__(self, axis_name="sharding", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self.stage = 1
+
+
+class ShardingStage2(ShardingStage1):
+    def __init__(self, axis_name="sharding", mesh=None):
+        super().__init__(axis_name, mesh)
+        self.stage = 2
+
+
+class ShardingStage3(ShardingStage1):
+    def __init__(self, axis_name="sharding", mesh=None):
+        super().__init__(axis_name, mesh)
+        self.stage = 3
+
+
+def shard_dataloader(dataloader, meshes=None, input_keys=None,
+                     shard_dims="dp", is_dataset_splitted=False):
+    """reference: auto_parallel/api.py shard_dataloader — wrap a loader so
+    each batch lands sharded over the mesh's data axis."""
+    from .api import shard_tensor
+    from .placement import Replicate, Shard
+
+    mesh = meshes if meshes is not None else get_global_mesh()
+
+    class _ShardedLoader:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __iter__(self):
+            for batch in self._inner:
+                yield jax.tree.map(
+                    lambda t: shard_tensor(t, mesh, [Shard(0)])
+                    if isinstance(t, Tensor) and mesh is not None else t,
+                    batch,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+        def __len__(self):
+            return len(self._inner)
+
+    return _ShardedLoader(dataloader)
+
+
+def shard_scaler(scaler):
+    """reference: auto_parallel/api.py shard_scaler — GradScaler already
+    reduces found-inf over the mesh through the jitted step; identity."""
+    return scaler
+
+
+class Strategy:
+    """reference: auto_parallel/strategy.py Strategy — config bundle for
+    dist.to_static."""
+
+    class _Section(dict):
+        def __getattr__(self, k):
+            return self.get(k)
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = self._Section(cfg.get("sharding", {}))
+        self.fused_passes = self._Section(cfg.get("fused_passes", {}))
+        self.gradient_merge = self._Section(cfg.get("gradient_merge", {}))
+        self.pipeline = self._Section(cfg.get("pipeline", {}))
+        self.amp = self._Section(cfg.get("amp", {}))
+
+
+class DistModel:
+    """reference: auto_parallel/api.py DistModel — the trained static
+    engine handle returned by dist.to_static: call it for one train/eval
+    step; the jitted hybrid-parallel program is built by
+    parallel.trainer.make_train_step (completion -> partition -> compile
+    in one trace)."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        from .trainer import make_train_step
+
+        self._layer = layer
+        self._loader = loader
+        self._mode = "train" if optimizer is not None else "eval"
+        mesh = get_global_mesh()
+        self._train_step = None
+        self._opt = None
+        if optimizer is not None:
+            if type(optimizer).__name__ not in ("AdamW", "Adam"):
+                import warnings
+                warnings.warn(
+                    "DistModel's fused step applies AdamW semantics; "
+                    f"{type(optimizer).__name__}'s update rule is not "
+                    "carried over")
+            try:
+                lr = float(optimizer.get_lr())
+            except Exception:
+                lr = 1e-3
+            self._train_step, self._params, self._opt = make_train_step(
+                layer, loss, mesh, lr=lr)
+        else:
+            self._params = dict(layer.raw_state())
+        self._eval_step = self._build_eval(layer, loss)
+
+    @staticmethod
+    def _build_eval(layer, loss_fn):
+        from ..core import tape as _tape
+
+        def fwd(p, *batch):
+            with _tape.no_grad():
+                out = layer.func_call(p, Tensor(batch[0]))
+                if loss_fn is not None and len(batch) > 1:
+                    return unwrap(loss_fn(out, Tensor(batch[1])))
+                return unwrap(out)
+
+        return jax.jit(fwd)
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def __call__(self, *inputs):
+        arrs = [unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        if self._mode == "train" and self._train_step is not None:
+            loss, self._params, self._opt = self._train_step(
+                self._params, self._opt, *arrs)
+            return Tensor(loss)
+        out = self._eval_step(self._params, *arrs)
+        return Tensor(out) if not isinstance(out, tuple) else \
+            tuple(Tensor(o) for o in out)
+
+    def state_dict(self, mode="all"):
+        return {k: Tensor(v) for k, v in self._params.items()}
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None):
+    """reference: auto_parallel/api.py:2343 dist.to_static."""
+    return DistModel(layer, loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy, metrics=metrics)
+
+
+def _ps_refusal(name):
+    def ctor(*a, **k):
+        raise NotImplementedError(
+            f"{name} belongs to the parameter-server data stack "
+            "(non-goal, SURVEY §7.4); use paddle_tpu.io.DataLoader")
+    return ctor
+
+
+QueueDataset = _ps_refusal("QueueDataset")
+InMemoryDataset = _ps_refusal("InMemoryDataset")
+CountFilterEntry = _ps_refusal("CountFilterEntry")
+ShowClickEntry = _ps_refusal("ShowClickEntry")
+ProbabilityEntry = _ps_refusal("ProbabilityEntry")
