@@ -175,3 +175,79 @@ __all__ = (
      "in_dynamic_mode", "enable_static", "disable_static"]
     + list(_ops_all)
 )
+
+
+def _patch_remaining_tensor_methods():
+    """Bind the rest of the reference's tensor_method_func list
+    (python/paddle/tensor/__init__.py:282) onto Tensor. Like the
+    reference's monkey-patch, each method IS the namesake free function
+    with the tensor as first argument; names live in the top-level,
+    linalg, signal, or static namespaces."""
+    from .core.tensor import Tensor
+
+    names = [
+        "create_parameter", "create_tensor", "ormqr", "cholesky_inverse",
+        "histogram_bin_edges", "histogramdd", "householder_product",
+        "pca_lowrank", "svd_lowrank", "eigvalsh", "logit", "increment",
+        "multiplex", "sinc", "reduce_as", "multigammaln", "hypot",
+        "block_diag", "add_n", "isneginf", "isposinf", "isreal",
+        "broadcast_shape", "gammaincc", "gammainc", "is_empty",
+        "not_equal_", "is_tensor", "concat", "reverse", "scatter_nd",
+        "shard_index", "slice", "slice_scatter", "tensor_split", "hsplit",
+        "dsplit", "vsplit", "stack", "unstack", "top_p_sampling",
+        "is_complex", "is_integer", "rank", "real", "imag",
+        "is_floating_point", "gammaln", "broadcast_tensors", "multi_dot",
+        "lu_unpack", "cdist", "as_complex", "as_real", "select_scatter",
+        "put_along_axis_", "take", "sgn", "frexp", "ldexp", "trapezoid",
+        "cumulative_trapezoid", "polar", "vander", "nextafter",
+        "unflatten", "as_strided", "i0", "i0e", "i1", "i1e", "polygamma",
+        "multinomial", "renorm", "stft", "istft", "copysign",
+        "bitwise_left_shift", "bitwise_right_shift", "index_fill_",
+        "atleast_1d", "atleast_2d", "atleast_3d", "diagonal_scatter",
+        "signbit",
+    ]
+    namespaces = [globals(), vars(linalg), vars(signal), vars(fft),
+                  vars(static)]
+    for name in names:
+        if hasattr(Tensor, name):
+            continue
+        for ns in namespaces:
+            fn = ns.get(name)
+            if callable(fn):
+                setattr(Tensor, name, fn)
+                break
+
+
+def _define_tensor_method_stragglers():
+    """The five names with no existing free-function form."""
+    import jax.numpy as _jnp
+    import numpy as _np
+
+    from .core.tensor import Tensor
+
+    def create_tensor(self, dtype="float32", name=None, persistable=False):
+        # reference: tensor/creation.py create_tensor — an empty typed var
+        return Tensor(_jnp.zeros((0,), _np.dtype(dtype)))
+
+    def histogram_bin_edges(self, bins=100, min=0, max=0, name=None):
+        a = _np.asarray(self.numpy())
+        rng = None if (min == 0 and max == 0) else (min, max)
+        return Tensor(_jnp.asarray(
+            _np.histogram_bin_edges(a, bins=bins, range=rng)
+            .astype(_np.float32)))
+
+    def _inplace_of(fn_name):
+        def method(self, *a, **k):
+            out = getattr(__import__("paddle_tpu"), fn_name)(self, *a, **k)
+            return self._replace(out._array, out._node, out._out_idx)
+        return method
+
+    Tensor.create_tensor = create_tensor
+    Tensor.histogram_bin_edges = histogram_bin_edges
+    Tensor.not_equal_ = _inplace_of("not_equal")
+    Tensor.put_along_axis_ = _inplace_of("put_along_axis")
+    Tensor.index_fill_ = _inplace_of("index_fill")
+
+
+_patch_remaining_tensor_methods()
+_define_tensor_method_stragglers()

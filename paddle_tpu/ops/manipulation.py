@@ -6,6 +6,8 @@ scatter/gather HLOs).
 """
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -351,7 +353,7 @@ def index_select(x, index, axis=0, name=None):
 
 def index_add(x, index, axis, value, name=None):
     def impl(a, i, v):
-        idx = [slice(None)] * a.ndim
+        idx = [builtins.slice(None)] * a.ndim
         idx[axis] = i
         return a.at[tuple(idx)].add(v)
 
@@ -365,7 +367,7 @@ def index_add_(x, index, axis, value, name=None):
 
 def index_fill(x, index, axis, value, name=None):
     def impl(a, i):
-        idx = [slice(None)] * a.ndim
+        idx = [builtins.slice(None)] * a.ndim
         idx[axis] = i
         return a.at[tuple(idx)].set(unwrap(value))
 

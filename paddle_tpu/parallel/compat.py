@@ -84,9 +84,14 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     world = _env.get_world_size() if hasattr(_env, "get_world_size") else 1
     if in_object_list is None:
         in_object_list = []
-    chunks = np.array_split(np.asarray(in_object_list, dtype=object),
-                            max(world, 1))
-    mine = list(chunks[rank]) if rank < len(chunks) else []
+    # plain-list chunking (np.array_split would coerce nested sequences
+    # into object ndarrays); every object lands on exactly one rank
+    n = len(in_object_list)
+    w = max(world, 1)
+    base, extra = divmod(n, w)
+    start = rank * base + min(rank, extra)
+    end = start + base + (1 if rank < extra else 0)
+    mine = in_object_list[start:end]
     out_object_list[:] = [pickle.loads(pickle.dumps(o)) for o in mine]
     return out_object_list
 
@@ -219,6 +224,15 @@ def shard_dataloader(dataloader, meshes=None, input_keys=None,
     from .placement import Replicate, Shard
 
     mesh = meshes if meshes is not None else get_global_mesh()
+    placements = None
+    if mesh is not None:
+        axis_names = list(mesh.axis_names)
+        if shard_dims not in axis_names:
+            raise ValueError(
+                f"shard_dims {shard_dims!r} not in mesh axes {axis_names}")
+        # batch dim 0 shards over exactly the named mesh axis
+        placements = [Shard(0) if name == shard_dims else Replicate()
+                      for name in axis_names]
 
     class _ShardedLoader:
         def __init__(self, inner):
@@ -227,7 +241,7 @@ def shard_dataloader(dataloader, meshes=None, input_keys=None,
         def __iter__(self):
             for batch in self._inner:
                 yield jax.tree.map(
-                    lambda t: shard_tensor(t, mesh, [Shard(0)])
+                    lambda t: shard_tensor(t, mesh, placements)
                     if isinstance(t, Tensor) and mesh is not None else t,
                     batch,
                     is_leaf=lambda t: isinstance(t, Tensor))

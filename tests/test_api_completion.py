@@ -323,11 +323,8 @@ class TestDeviceStubs(unittest.TestCase):
         self.assertEqual(d.get_available_custom_device(), [])
 
 
-if __name__ == "__main__":
-    unittest.main()
-
-
 class TestDistributedCompat(unittest.TestCase):
+    @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
     def test_all_resolves(self):
         import paddle_tpu.distributed as dist
         names = _ref_all("python/paddle/distributed/__init__.py")
@@ -378,3 +375,37 @@ class TestDistributedCompat(unittest.TestCase):
             dist.InMemoryDataset()
         attr = dist.DistAttr(None, ["x", None])
         self.assertEqual(attr.sharding_specs, ["x", None])
+
+
+class TestTensorMethodParity(unittest.TestCase):
+    @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
+    def test_all_tensor_methods_resolve(self):
+        src = open(os.path.join(
+            REF, "python/paddle/tensor/__init__.py")).read()
+        m = re.search(r"tensor_method_func = \[(.*?)\]", src, re.S)
+        names = re.findall(r"'([A-Za-z_0-9]+)'", m.group(1))
+        t = paddle.to_tensor(np.ones((2, 2), np.float32))
+        missing = [n for n in names if not hasattr(t, n)]
+        self.assertEqual(missing, [])
+
+    def test_patched_methods_work(self):
+        t = paddle.to_tensor(np.array([0.5], np.float32))
+        np.testing.assert_allclose(t.sinc().numpy(),
+                                   np.sinc(0.5), rtol=1e-6)
+        a = paddle.to_tensor(np.array([3.0], np.float32))
+        b = paddle.to_tensor(np.array([4.0], np.float32))
+        np.testing.assert_allclose(a.hypot(b).numpy(), 5.0, rtol=1e-6)
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        x.index_fill_(paddle.to_tensor(np.array([1])), 0, -1.0)
+        np.testing.assert_allclose(x.numpy(), [0, -1, 2, 3])
+        y = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        y.put_along_axis_(paddle.to_tensor(np.array([0])),
+                          paddle.to_tensor(np.array([9.0], np.float32)), 0)
+        np.testing.assert_allclose(y.numpy(), [9, 1, 2, 3])
+        edges = paddle.to_tensor(np.ones((2, 2), np.float32)) \
+            .histogram_bin_edges(bins=4)
+        self.assertEqual(list(edges.shape), [5])
+
+
+if __name__ == "__main__":
+    unittest.main()
