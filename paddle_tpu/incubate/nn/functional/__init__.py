@@ -169,3 +169,258 @@ def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
         return fn(jnp.matmul(xa, wa) + ba)
 
     return dispatch("fused_linear_activation", impl, (x, y, bias))
+
+
+# functional forms of the fused layer family (reference:
+# incubate/nn/functional/{fused_matmul_bias,fused_transformer,
+# fused_ec_moe,fused_dropout_add,variable_length_memory_efficient_attention})
+from ....nn import functional as _NF
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: fused_matmul_bias.py — gemm + bias epilogue."""
+    def impl(*arrs):
+        xa, ya = arrs[0], arrs[1]
+        ba = arrs[2] if bias is not None else None
+        if transpose_x:
+            xa = jnp.swapaxes(xa, -1, -2)
+        if transpose_y:
+            ya = jnp.swapaxes(ya, -1, -2)
+        out = xa @ ya
+        return out + ba if ba is not None else out
+
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return dispatch("fused_matmul_bias", impl, args)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """reference: fused_dropout_add.py — dropout(x) + y."""
+    return _NF.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=None,
+        name=None):
+    """reference: fused_bias_dropout_residual_layer_norm — one residual
+    block tail: LN(residual + dropout(x + bias))."""
+    h = x if bias is None else x + bias
+    h = _NF.dropout(h, p=dropout_rate, training=training)
+    h = h + residual
+    d = h.shape[-1]
+    return _NF.layer_norm(h, (d,), weight=ln_scale, bias=ln_bias,
+                          epsilon=ln_epsilon)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """reference: fused_ec_moe.py — expert-choice MoE: every token runs
+    every expert's two gemms, outputs mix by the softmax gate (the
+    dense-compute form the CUDA kernel implements)."""
+    def impl(*arrs):
+        xa, ga, w0, b0, w1, b1 = arrs
+        probs = jax.nn.softmax(ga.astype(jnp.float32), axis=-1)
+        # experts: [E, D, H] x [B, S, D] -> [E, B, S, H]
+        h = jnp.einsum("bsd,edh->ebsh", xa, w0) + b0[:, None, None]
+        h = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type](h)
+        out = jnp.einsum("ebsh,ehd->ebsd", h, w1) + b1[:, None, None]
+        return jnp.einsum("ebsd,bse->bsd", out,
+                          probs.astype(out.dtype))
+
+    return dispatch("fused_ec_moe", impl,
+                    (x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                     bmm1_bias))
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """reference: variable_length_memory_efficient_attention.py — batched
+    attention where each sequence attends only to its first kv_seq_lens
+    keys. Layout [B, H, S, D]."""
+    def impl(*arrs):
+        it = iter(arrs)
+        q, k, v = next(it), next(it), next(it)
+        sl = next(it).reshape(-1)
+        kvl = next(it).reshape(-1)
+        m = next(it) if mask is not None else None
+        d = q.shape[-1]
+        sc = scale if scale is not None else 1.0 / jnp.sqrt(
+            jnp.asarray(d, jnp.float32))
+        logits = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sc
+        tpos = jnp.arange(k.shape[2])
+        valid = tpos[None, :] < kvl[:, None]  # [B, T]
+        logits = jnp.where(valid[:, None, None, :], logits, -jnp.inf)
+        if causal:
+            # align query positions to the END of each kv window so
+            # decode-shaped calls (q_len < kv_len, incl. pre-cache) see
+            # the whole past: global qpos = kv_len - q_len + s
+            spos = jnp.arange(q.shape[2])
+            offset = (kvl - q.shape[2] + pre_cache_length)[:, None, None]
+            qpos = offset + spos[None, :, None]  # [B, S, 1]
+            logits = jnp.where(
+                (tpos[None, None, :] <= qpos)[:, None],
+                logits, -jnp.inf)
+        if m is not None:
+            logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+        return jnp.einsum("bhst,bhtd->bhsd", probs,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    args = (query, key, value, seq_lens, kv_seq_lens) + \
+        ((mask,) if mask is not None else ())
+    return dispatch("variable_length_memory_efficient_attention", impl,
+                    args)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True, name=None,
+        num_heads=None, transpose_qkv_wb=False):
+    """reference: fused_transformer.py fused_multi_head_attention —
+    functional form over explicit weights (layout [3, H, D, E], or
+    [E, 3*E] with transpose_qkv_wb=True + num_heads). Dropout placement
+    matches the reference: probability dropout inside attention, branch
+    dropout before the residual; layer norms ride nn.functional."""
+    import paddle_tpu as _p
+
+    e = x.shape[-1]
+    residual = x
+    h = _NF.layer_norm(x, (e,), weight=pre_ln_scale, bias=pre_ln_bias,
+                       epsilon=pre_ln_epsilon) if pre_layer_norm else x
+    probs_mask = None
+    if training and attn_dropout_rate:
+        nh = num_heads if transpose_qkv_wb else qkv_weight.shape[1]
+        probs_mask = _p.rand([x.shape[0], nh, x.shape[1], x.shape[1]])
+
+    def impl(*arrs):
+        it = iter(arrs)
+        ha = next(it)
+        qkv_w = next(it)
+        lw = next(it)
+        qkv_b = next(it) if qkv_bias is not None else None
+        lb = next(it) if linear_bias is not None else None
+        m = next(it) if attn_mask is not None else None
+        u = next(it) if probs_mask is not None else None
+        if transpose_qkv_wb:
+            if num_heads is None:
+                raise ValueError("transpose_qkv_wb=True requires num_heads")
+            qkv = ha @ qkv_w  # [B, S, 3E]
+            if qkv_b is not None:
+                qkv = qkv + qkv_b.reshape(-1)
+            b_, s_ = qkv.shape[0], qkv.shape[1]
+            qkv = qkv.reshape(b_, s_, 3, num_heads,
+                              e // num_heads).transpose(2, 0, 1, 3, 4)
+        else:
+            qkv = jnp.einsum("bse,nhde->nbshd", ha, qkv_w)
+            if qkv_b is not None:
+                qkv = qkv + qkv_b[:, None, None]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        hd = q.shape[-1]
+        sc = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sc
+        if m is not None:
+            logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if u is not None:
+            keep = (u >= attn_dropout_rate).astype(probs.dtype)
+            probs = probs * keep / (1.0 - attn_dropout_rate)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs,
+                         v.astype(jnp.float32)).astype(ha.dtype)
+        out = ctx.reshape(*ctx.shape[:2], -1) @ lw
+        if lb is not None:
+            out = out + lb
+        return out
+
+    args = [a for a in (h, qkv_weight, linear_weight, qkv_bias,
+                        linear_bias, attn_mask, probs_mask)
+            if a is not None]
+    out = dispatch("fused_multi_head_attention_fn", impl, tuple(args))
+    out = _NF.dropout(out, p=dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _NF.layer_norm(out, (e,), weight=ln_scale, bias=ln_bias,
+                             epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=None,
+                      ring_id=-1, name=None):
+    """reference: fused_transformer.py fused_feedforward functional —
+    composed from nn.functional blocks (XLA fuses the region)."""
+    d = x.shape[-1]
+    residual = x
+    h = _NF.layer_norm(x, (d,), weight=ln1_scale, bias=ln1_bias,
+                       epsilon=ln1_epsilon) if pre_layer_norm else x
+    h = _NF.linear(h, linear1_weight, linear1_bias)
+    h = {"relu": _NF.relu, "gelu": _NF.gelu}[activation](h)
+    h = _NF.dropout(h, p=dropout1_rate, training=training)
+    out = _NF.linear(h, linear2_weight, linear2_bias)
+    out = _NF.dropout(out, p=dropout2_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = _NF.layer_norm(out, (d,), weight=ln2_scale, bias=ln2_bias,
+                             epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-05, cache_kvs=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu",
+                            training=False, mode=None, trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """reference: fused_transformer.py fused_multi_transformer functional
+    — stacked blocks over per-layer weight lists. Cached incremental
+    decode goes through the FusedMultiTransformer layer (decode_step) or
+    masked_multihead_attention directly."""
+    if cache_kvs is not None:
+        raise NotImplementedError(
+            "functional fused_multi_transformer does not implement cached "
+            "decode; use incubate.nn.FusedMultiTransformer(caches=...) or "
+            "masked_multihead_attention")
+    h = x
+    for i in range(len(qkv_weights)):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
+            pre_ln_bias=ln_biases[i], ln_scale=ln_scales[i],
+            ln_bias=ln_biases[i], pre_ln_epsilon=epsilon,
+            ln_epsilon=epsilon, qkv_bias=qkv_biases[i],
+            linear_bias=linear_biases[i], attn_mask=attn_mask,
+            dropout_rate=dropout_rate, attn_dropout_rate=dropout_rate,
+            training=training)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i], linear2_bias=ffn2_biases[i],
+            ln1_scale=ffn_ln_scales[i], ln1_bias=ffn_ln_biases[i],
+            ln2_scale=ffn_ln_scales[i], ln2_bias=ffn_ln_biases[i],
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            activation=activation, pre_layer_norm=pre_layer_norm,
+            training=training)
+    return h
+
+
+__all__ += ["fused_matmul_bias", "fused_dropout_add",
+            "fused_bias_dropout_residual_layer_norm", "fused_ec_moe",
+            "variable_length_memory_efficient_attention",
+            "fused_multi_head_attention", "fused_feedforward",
+            "fused_multi_transformer"]

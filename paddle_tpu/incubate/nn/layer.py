@@ -23,7 +23,8 @@ from . import functional as IF
 
 __all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedMultiTransformer",
-           "FusedDropoutAdd"]
+           "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+           "FusedEcMoe"]
 
 
 class FusedLinear(Layer):
@@ -308,3 +309,58 @@ class FusedMultiTransformer(Layer):
         for blk in self.layers:
             h = blk(h, src_mask=attn_mask)
         return h
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm — LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        from ...nn.initializer import Constant
+        self.linear_bias = self.create_parameter((embed_dim,),
+                                                 attr=bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        from . import functional as _F
+
+        return _F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedEcMoe(Layer):
+    """reference: layer/fused_ec_moe.py FusedEcMoe — expert-choice MoE
+    block over stacked expert gemms."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("act_type must be gelu or relu")
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr)
+        self.bmm0_bias = self.create_parameter(
+            (num_experts, inter_size), attr=bias_attr, is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr)
+        self.bmm1_bias = self.create_parameter(
+            (num_experts, hidden_size), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        from . import functional as _F
+
+        return _F.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
+                               self.bmm1_weight, self.bmm1_bias,
+                               act_type=self.act_type)

@@ -113,3 +113,6 @@ class ModelAverage(Optimizer):
                 if id(p) in self._backup:
                     p._array = self._backup[id(p)]
             self._backup = None
+
+
+from ..optimizer import LBFGS  # noqa: E402,F401  (reference re-exports it here)

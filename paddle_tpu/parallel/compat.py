@@ -225,13 +225,23 @@ def shard_dataloader(dataloader, meshes=None, input_keys=None,
 
     mesh = meshes if meshes is not None else get_global_mesh()
     placements = None
-    if mesh is not None:
-        axis_names = list(mesh.axis_names)
-        if shard_dims not in axis_names:
-            raise ValueError(
-                f"shard_dims {shard_dims!r} not in mesh axes {axis_names}")
+    if mesh is not None and shard_dims is not None:
+        # accept jax Mesh (axis_names) or ProcessMesh (dim_names)
+        axis_names = list(getattr(mesh, "axis_names", None)
+                          or getattr(mesh, "dim_names", []))
+        if isinstance(shard_dims, int):
+            if not 0 <= shard_dims < len(axis_names):
+                raise ValueError(
+                    f"shard_dims index {shard_dims} out of range for "
+                    f"mesh axes {axis_names}")
+            target = axis_names[shard_dims]
+        else:
+            target = shard_dims
+            if target not in axis_names:
+                raise ValueError(
+                    f"shard_dims {target!r} not in mesh axes {axis_names}")
         # batch dim 0 shards over exactly the named mesh axis
-        placements = [Shard(0) if name == shard_dims else Replicate()
+        placements = [Shard(0) if name == target else Replicate()
                       for name in axis_names]
 
     class _ShardedLoader:
@@ -242,7 +252,8 @@ def shard_dataloader(dataloader, meshes=None, input_keys=None,
             for batch in self._inner:
                 yield jax.tree.map(
                     lambda t: shard_tensor(t, mesh, placements)
-                    if isinstance(t, Tensor) and mesh is not None else t,
+                    if isinstance(t, Tensor) and placements is not None
+                    else t,
                     batch,
                     is_leaf=lambda t: isinstance(t, Tensor))
 

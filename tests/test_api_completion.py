@@ -41,6 +41,13 @@ class TestAuditClean(unittest.TestCase):
         ("python/paddle/profiler/__init__.py", "paddle_tpu.profiler"),
         ("python/paddle/metric/__init__.py", "paddle_tpu.metric"),
         ("python/paddle/autograd/__init__.py", "paddle_tpu.autograd"),
+        ("python/paddle/incubate/__init__.py", "paddle_tpu.incubate"),
+        ("python/paddle/incubate/nn/__init__.py",
+         "paddle_tpu.incubate.nn"),
+        ("python/paddle/incubate/nn/functional/__init__.py",
+         "paddle_tpu.incubate.nn.functional"),
+        ("python/paddle/incubate/optimizer/__init__.py",
+         "paddle_tpu.incubate.optimizer"),
     ]
 
     @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
@@ -405,6 +412,96 @@ class TestTensorMethodParity(unittest.TestCase):
         edges = paddle.to_tensor(np.ones((2, 2), np.float32)) \
             .histogram_bin_edges(bins=4)
         self.assertEqual(list(edges.shape), [5])
+
+
+class TestIncubateExtras(unittest.TestCase):
+    def test_softmax_mask_fuse_matches_causal(self):
+        import paddle_tpu.incubate as inc
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(1, 2, 4, 4))
+                             .astype(np.float32))
+        m = paddle.to_tensor(
+            np.where(np.tril(np.ones((4, 4), bool)), 0, -1e9)
+            .astype(np.float32)[None, None])
+        np.testing.assert_allclose(
+            inc.softmax_mask_fuse(x, m).numpy(),
+            inc.softmax_mask_fuse_upper_triangle(x).numpy(), rtol=1e-5)
+
+    def test_fused_ec_moe_single_expert_is_mlp(self):
+        import jax
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(1)
+        E, D, H = 1, 8, 16
+        x = paddle.to_tensor(rng.normal(size=(2, 3, D)).astype(np.float32))
+        gate = paddle.to_tensor(np.zeros((2, 3, E), np.float32))
+        w0 = rng.normal(size=(E, D, H)).astype(np.float32)
+        w1 = rng.normal(size=(E, H, D)).astype(np.float32)
+        out = IF.fused_ec_moe(
+            x, gate, paddle.to_tensor(w0),
+            paddle.to_tensor(np.zeros((E, H), np.float32)),
+            paddle.to_tensor(w1),
+            paddle.to_tensor(np.zeros((E, D), np.float32))).numpy()
+        ref = jax.nn.gelu(x.numpy() @ w0[0]) @ w1[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_varlen_attention_masks_keys(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(2)
+        q = paddle.to_tensor(rng.normal(size=(2, 2, 3, 8))
+                             .astype(np.float32))
+        k = paddle.to_tensor(rng.normal(size=(2, 2, 5, 8))
+                             .astype(np.float32))
+        v = paddle.to_tensor(rng.normal(size=(2, 2, 5, 8))
+                             .astype(np.float32))
+        o = IF.variable_length_memory_efficient_attention(
+            q, k, v, np.array([3, 3], np.int32),
+            np.array([5, 2], np.int32))
+        logits = np.einsum("hsd,htd->hst", q.numpy()[1],
+                           k.numpy()[1, :, :2]) / np.sqrt(8)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hst,htd->hsd", p, v.numpy()[1, :, :2])
+        np.testing.assert_allclose(o.numpy()[1], ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_functional_fused_transformer_matches_layer(self):
+        import paddle_tpu.incubate.nn as inn
+        import paddle_tpu.incubate.nn.functional as IF
+        paddle.seed(0)
+        attn = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                           attn_dropout_rate=0.0,
+                                           normalize_before=True)
+        attn.eval()
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.normal(size=(2, 5, 32))
+                             .astype(np.float32))
+        ref = attn(x)
+        out = IF.fused_multi_head_attention(
+            x, attn.qkv_weight, attn.linear_weight, pre_layer_norm=True,
+            pre_ln_scale=attn.pre_ln_scale, pre_ln_bias=attn.pre_ln_bias,
+            qkv_bias=attn.qkv_bias, linear_bias=attn.linear_bias,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_graph_aliases_and_khop(self):
+        import paddle_tpu.incubate as inc
+        row = np.array([1, 2, 2, 0, 1])
+        colptr = np.array([0, 2, 3, 5])
+        n, c = inc.graph_sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0])))
+        self.assertEqual(int(c.numpy()[0]), 2)
+        src_, dst, nodes, counts = inc.graph_khop_sampler(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0])), [2, 1])
+        self.assertGreater(len(src_.numpy()), 0)
+
+    def test_identity_loss(self):
+        import paddle_tpu.incubate as inc
+        x = paddle.to_tensor(np.array([1.0, 3.0], np.float32))
+        self.assertEqual(float(inc.identity_loss(x, "mean").numpy()), 2.0)
+        self.assertEqual(float(inc.identity_loss(x, 0).numpy()), 4.0)
 
 
 if __name__ == "__main__":
