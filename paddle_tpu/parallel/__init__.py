@@ -45,7 +45,10 @@ from .sequence_parallel import (  # noqa: F401
     split_seq, ulysses_alltoall,
 )
 from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate, moe_dispatch  # noqa: F401
-from .fleet import DistributedStrategy, fleet  # noqa: F401
+from .fleet import DistributedStrategy  # noqa: F401
+from . import fleet  # noqa: F401  (module; its own `fleet` instance plus
+#                      init/distributed_model are module-level, matching the
+#                      reference where paddle.distributed.fleet is a module)
 from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import cost_model  # noqa: F401

@@ -143,3 +143,178 @@ init = fleet.init
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+def is_initialized():
+    # the instance exposes a property; the module-level form is a callable
+    # evaluated at call time (a direct alias would freeze the import-time
+    # value)
+    return fleet.is_initialized
+
+
+# ---------------------------------------------------------------------------
+# remaining fleet __all__ classes (reference:
+# python/paddle/distributed/fleet/__init__.py);
+# HybridCommunicateGroup is already imported from .mesh above
+# ---------------------------------------------------------------------------
+Fleet = _Fleet  # reference: fleet/fleet.py Fleet
+
+
+class Role:
+    """reference: fleet/base/role_maker.py Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    """Environment discovery base (reference: role_maker.py:542
+    PaddleCloudRoleMaker reads the launcher's env). On TPU the launcher
+    exports the same PADDLE_* variables; single-controller JAX means one
+    python process per host and every process is a WORKER."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._world = len(eps.split(",")) if eps else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._world
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """reference: role_maker.py PaddleCloudRoleMaker."""
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """reference: role_maker.py UserDefinedRoleMaker — explicit rank/world
+    instead of env discovery."""
+
+    def __init__(self, is_collective=True, init_gloo=False, current_id=0,
+                 worker_num=1, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._rank = current_id
+        self._world = worker_num
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:68 CommunicateTopology — the
+    named cartesian rank topology backing HybridCommunicateGroup."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        import itertools as _it
+
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = 1
+        for d in self._dims:
+            self._world *= d
+        coords = list(_it.product(*[range(d) for d in self._dims]))
+        self._coord_of_rank = {i: c for i, c in enumerate(coords)}
+        self._rank_of_coord = {c: i for i, c in enumerate(coords)}
+
+    def get_hybrid_group_names(self):
+        return list(self._names)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._names)
+        return self._rank_of_coord[coord]
+
+    def get_coord(self, rank):
+        return self._coord_of_rank[rank]
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._names.index(axis_name)
+        return [r for r, c in self._coord_of_rank.items()
+                if c[ax] == index]
+
+    def get_comm_list(self, axis_name):
+        """All rank groups along `axis_name` (the NCCL-group sets the
+        reference builds; here they parameterise mesh axis groups)."""
+        ax = self._names.index(axis_name)
+        groups = {}
+        for r, c in self._coord_of_rank.items():
+            key = c[:ax] + c[ax + 1:]
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class UtilBase:
+    """reference: fleet/utils/fs.py-backed UtilBase — cross-rank helper
+    ops over the collective API."""
+
+    def all_reduce(self, input, mode="sum"):
+        from . import collective as _c
+        from ..core.tensor import Tensor
+        import numpy as _np
+
+        t = input if isinstance(input, Tensor) else Tensor(
+            _np.asarray(input))
+        op = {"sum": _c.ReduceOp.SUM, "max": _c.ReduceOp.MAX,
+              "min": _c.ReduceOp.MIN}[mode]
+        return _c.all_reduce(t, op=op)
+
+    def barrier(self, comm_world="worker"):
+        from .watchdog import barrier as _b
+
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        from . import collective as _c
+        from ..core.tensor import Tensor
+        import numpy as _np
+
+        t = input if isinstance(input, Tensor) else Tensor(
+            _np.asarray(input))
+        out = []
+        _c.all_gather(out, t)
+        return out
+
+
+def _ps_data_generator(name):
+    class _Refusal:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} belongs to the parameter-server data stack "
+                "(non-goal, SURVEY §7.4); use paddle_tpu.io.DataLoader")
+    _Refusal.__name__ = name
+    return _Refusal
+
+
+MultiSlotDataGenerator = _ps_data_generator("MultiSlotDataGenerator")
+MultiSlotStringDataGenerator = _ps_data_generator(
+    "MultiSlotStringDataGenerator")
+
+__all__ += ["Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+            "CommunicateTopology", "HybridCommunicateGroup", "UtilBase",
+            "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]

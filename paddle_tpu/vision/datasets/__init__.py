@@ -220,14 +220,30 @@ class Flowers(Dataset):
             if label_file is not None:
                 with open(label_file) as f:
                     labels = [int(ln.strip()) for ln in f if ln.strip()]
+                if len(labels) != len(files):
+                    raise ValueError(
+                        f"label_file has {len(labels)} labels for "
+                        f"{len(files)} images")
             else:
                 labels = [1] * len(files)
-            from PIL import Image
-            self.images = [np.asarray(Image.open(
-                os.path.join(data_file, f)).convert("RGB"))
-                for f in files]
-            self.labels = [np.array([l], np.int64) for l in labels]
+            idx = list(range(len(files)))
+            if setid_file is not None:
+                # one 1-based image id per line selecting this split
+                with open(setid_file) as f:
+                    idx = [int(ln.strip()) - 1 for ln in f if ln.strip()]
+            else:
+                # deterministic 80/10/10 split by position
+                n = len(files)
+                cut1, cut2 = int(n * 0.8), int(n * 0.9)
+                idx = {"train": idx[:cut1], "valid": idx[cut1:cut2],
+                       "test": idx[cut2:]}[mode] or idx
+            # lazy: store paths, decode per __getitem__ (same pattern as
+            # DatasetFolder)
+            self._paths = [os.path.join(data_file, files[i]) for i in idx]
+            self.images = None
+            self.labels = [np.array([labels[i]], np.int64) for i in idx]
         else:
+            self._paths = None
             rng = np.random.default_rng(71 if mode == "train" else 72)
             n = 60 if mode == "train" else 20
             self.images = [(rng.random((64, 64, 3)) * 255)
@@ -236,13 +252,18 @@ class Flowers(Dataset):
                            for l in rng.integers(1, 103, n)]
 
     def __getitem__(self, idx):
-        img = self.images[idx]
+        if self._paths is not None:
+            from PIL import Image
+            img = np.asarray(Image.open(self._paths[idx]).convert("RGB"))
+        else:
+            img = self.images[idx]
         if self.transform is not None:
             img = self.transform(img)
         return img, self.labels[idx]
 
     def __len__(self):
-        return len(self.images)
+        return len(self._paths) if self._paths is not None \
+            else len(self.images)
 
 
 class VOC2012(Dataset):

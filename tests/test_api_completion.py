@@ -423,6 +423,38 @@ class TestTensorMethodParity(unittest.TestCase):
         self.assertEqual(list(edges.shape), [5])
 
 
+class TestFleetSurface(unittest.TestCase):
+    @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
+    def test_fleet_all_resolves(self):
+        import paddle_tpu.distributed as dist
+        src = open(os.path.join(
+            REF, "python/paddle/distributed/fleet/__init__.py")).read()
+        m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+        names = re.findall(r'"([A-Za-z_0-9]+)"', m.group(1)) + \
+            re.findall(r"'([A-Za-z_0-9]+)'", m.group(1))
+        missing = [n for n in names if not hasattr(dist.fleet, n)]
+        self.assertEqual(missing, [])
+
+    def test_communicate_topology(self):
+        import paddle_tpu.distributed as dist
+        topo = dist.fleet.CommunicateTopology(("data", "model"), (2, 4))
+        self.assertEqual(topo.world_size(), 8)
+        self.assertEqual(topo.get_rank(data=1, model=2), 6)
+        self.assertEqual(topo.get_coord(6), (1, 2))
+        self.assertEqual(topo.get_axis_list("model", 0), [0, 4])
+        self.assertEqual(topo.get_comm_list("model"),
+                         [[0, 1, 2, 3], [4, 5, 6, 7]])
+
+    def test_role_makers_and_module_funcs(self):
+        import paddle_tpu.distributed as dist
+        u = dist.fleet.UserDefinedRoleMaker(current_id=3, worker_num=8)
+        self.assertEqual(u._worker_index(), 3)
+        self.assertTrue(callable(dist.fleet.init))
+        self.assertTrue(callable(dist.fleet.worker_index))
+        with self.assertRaises(NotImplementedError):
+            dist.fleet.MultiSlotDataGenerator()
+
+
 class TestIncubateExtras(unittest.TestCase):
     def test_softmax_mask_fuse_matches_causal(self):
         import paddle_tpu.incubate as inc
