@@ -318,3 +318,12 @@ MultiSlotStringDataGenerator = _ps_data_generator(
 __all__ += ["Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
             "CommunicateTopology", "HybridCommunicateGroup", "UtilBase",
             "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+
+from . import fleet_utils as utils  # noqa: E402,F401
+
+# register dotted import paths so `from ...fleet.utils import recompute`
+# works even though fleet is a module, not a package
+import sys as _sys
+
+_sys.modules[__name__ + ".utils"] = utils
+__all__ += ["utils"]

@@ -1,0 +1,148 @@
+"""paddle.distributed.fleet.utils equivalent (reference:
+fleet/utils/__init__.py — the public `recompute` activation-checkpointing
+entry (fleet/recompute/recompute.py:429), recompute_sequential, LocalFS,
+and the HDFS client).
+
+TPU-native form: recompute wraps the callable in `jax.checkpoint` over the
+raw arrays (the reference's RecomputeFunction PyLayer re-runs forward under
+saved RNG state; jax.checkpoint does the same via functional key threading),
+composing with the eager tape through dispatch. HDFS is out of scope —
+LocalFS covers the FS interface on one host.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+import jax
+
+from ..core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["recompute", "recompute_sequential", "LocalFS", "HDFSClient"]
+
+
+_TENSOR_SLOT = object()
+
+
+def recompute(function, *args, **kwargs):
+    """reference: fleet/recompute/recompute.py:429 — run `function` without
+    storing intermediates; recompute them in backward. Tensor positional
+    AND keyword arguments are threaded through the checkpoint (so their
+    gradients flow); plain-python arguments pass through untouched."""
+    kwargs.pop("use_reentrant", True)  # parity knob
+    kwargs.pop("preserve_rng_state", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    const_args = [_TENSOR_SLOT if isinstance(a, Tensor) else a
+                  for a in args]
+    kw_tensor_keys = sorted(k for k, v in kwargs.items()
+                            if isinstance(v, Tensor))
+    tensor_args += [kwargs[k] for k in kw_tensor_keys]
+    const_kwargs = {k: v for k, v in kwargs.items()
+                    if not isinstance(v, Tensor)}
+
+    def impl(*arrs):
+        def run(*xs):
+            it = iter(xs)
+            call = [Tensor(next(it)) if c is _TENSOR_SLOT else c
+                    for c in const_args]
+            kw = dict(const_kwargs)
+            for k in kw_tensor_keys:
+                kw[k] = Tensor(next(it))
+            out = function(*call, **kw)
+            return unwrap(out)
+
+        return jax.checkpoint(run)(*arrs)
+
+    return dispatch("fleet_recompute", impl, tuple(tensor_args))
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: fleet/recompute/recompute.py:593 — checkpoint a
+    Sequential in `segments` chunks. The first chunk receives *args; the
+    rest chain on the previous chunk's (single) output."""
+    segments = (ctx or {}).get("segments", 1)
+    fns = list(functions)
+    per = max(1, len(fns) // max(segments, 1))
+
+    def seg_runner(chunk):
+        def run(*xs):
+            h = xs[0] if len(xs) == 1 else xs
+            for f in chunk:
+                h = f(*h) if isinstance(h, tuple) else f(h)
+            return h
+        return run
+
+    out = args
+    for s in range(0, len(fns), per):
+        chunk = fns[s:s + per]
+        out = recompute(seg_runner(chunk),
+                        *(out if isinstance(out, tuple) else (out,)),
+                        **kwargs)
+    return out
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        os.rename(src, dst)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def cat(self, fs_path):
+        with open(fs_path) as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """reference: fleet/utils/fs.py HDFSClient — cluster FS is out of
+    scope on single-controller TPU deployments (checkpoints ride GCS /
+    local disks via parallel.checkpoint)."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **k):
+        raise NotImplementedError(
+            "HDFS is not available in the TPU build; use LocalFS or the "
+            "sharded checkpoint API (paddle_tpu.distributed.save_state_dict)")

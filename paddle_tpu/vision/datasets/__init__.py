@@ -236,7 +236,7 @@ class Flowers(Dataset):
                 n = len(files)
                 cut1, cut2 = int(n * 0.8), int(n * 0.9)
                 idx = {"train": idx[:cut1], "valid": idx[cut1:cut2],
-                       "test": idx[cut2:]}[mode] or idx
+                       "test": idx[cut2:]}[mode]
             # lazy: store paths, decode per __getitem__ (same pattern as
             # DatasetFolder)
             self._paths = [os.path.join(data_file, files[i]) for i in idx]

@@ -455,6 +455,46 @@ class TestFleetSurface(unittest.TestCase):
             dist.fleet.MultiSlotDataGenerator()
 
 
+class TestFleetUtils(unittest.TestCase):
+    def test_recompute_matches_direct(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.utils import recompute
+        paddle.seed(0)
+        blk = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .normal(size=(4, 8)).astype(np.float32),
+                             stop_gradient=False)
+        y1 = recompute(blk, x)
+        np.testing.assert_allclose(y1.numpy(), blk(x).numpy(), rtol=1e-5)
+        y1.sum().backward()
+        g1 = np.asarray(x.grad._array).copy()
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        blk(x2).sum().backward()
+        np.testing.assert_allclose(g1, np.asarray(x2.grad._array),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_recompute_sequential_and_fs(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.utils import (HDFSClient,
+                                                        LocalFS,
+                                                        recompute_sequential)
+        paddle.seed(1)
+        seq = [nn.Linear(8, 8) for _ in range(4)]
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        out = recompute_sequential({"segments": 2}, seq, x)
+        ref = x
+        for f in seq:
+            ref = f(ref)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        fs = LocalFS()
+        d = tempfile.mkdtemp()
+        fs.touch(os.path.join(d, "a.txt"))
+        fs.mkdirs(os.path.join(d, "sub"))
+        self.assertEqual(fs.ls_dir(d), (["sub"], ["a.txt"]))
+        with self.assertRaises(NotImplementedError):
+            HDFSClient()
+
+
 class TestIncubateExtras(unittest.TestCase):
     def test_softmax_mask_fuse_matches_causal(self):
         import paddle_tpu.incubate as inc
