@@ -102,3 +102,4 @@ from .compat import (  # noqa: E402,F401
     shard_scaler, split, to_static, wait)
 from . import launch  # noqa: E402,F401
 from . import checkpoint as io  # noqa: E402,F401
+from . import passes  # noqa: E402,F401

@@ -280,13 +280,15 @@ class Strategy:
         def __setattr__(self, k, v):
             self[k] = v
 
+    _KNOWN = ("sharding", "fused_passes", "gradient_merge", "pipeline",
+              "amp", "recompute", "fuse_all_reduce")
+
     def __init__(self, config=None):
         cfg = config or {}
-        self.sharding = self._Section(cfg.get("sharding", {}))
-        self.fused_passes = self._Section(cfg.get("fused_passes", {}))
-        self.gradient_merge = self._Section(cfg.get("gradient_merge", {}))
-        self.pipeline = self._Section(cfg.get("pipeline", {}))
-        self.amp = self._Section(cfg.get("amp", {}))
+        # every config section becomes an attribute; unknown sections are
+        # kept too so pass-produced configs round-trip losslessly
+        for name in set(self._KNOWN) | set(cfg):
+            setattr(self, name, self._Section(cfg.get(name, {})))
 
 
 class DistModel:

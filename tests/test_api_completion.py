@@ -495,6 +495,50 @@ class TestFleetUtils(unittest.TestCase):
             HDFSClient()
 
 
+class TestDistributedPasses(unittest.TestCase):
+    def test_pass_stack_builds_strategy_config(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+        cfg = {}
+        pm = PassManager([
+            new_pass("auto_parallel_amp", {"dtype": "bfloat16"}),
+            new_pass("auto_parallel_recompute", {"recompute_skip": 4}),
+            new_pass("auto_parallel_sharding", {"stage": 3, "degree": 8}),
+            new_pass("auto_parallel_gradient_merge", {"k_steps": 4}),
+            new_pass("pipeline_scheduler_1F1B", {"micro_batch_size": 2}),
+        ])
+        pm.apply(cfg)
+        self.assertEqual(cfg["sharding"]["stage"], 3)
+        self.assertEqual(cfg["pipeline"]["schedule_mode"], "1F1B")
+        # the pass-produced config IS a Strategy config
+        st = dist.Strategy(cfg)
+        self.assertEqual(st.sharding.stage, 3)
+        self.assertEqual(st.gradient_merge.k_steps, 4)
+
+    def test_conflicts_and_validation(self):
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+        with self.assertRaises(ValueError):
+            PassManager([new_pass("pipeline_scheduler_1F1B"),
+                         new_pass("pipeline_scheduler_FThenB")]).apply({})
+        with self.assertRaises(ValueError):
+            new_pass("auto_parallel_sharding", {"stage": 5}).apply({})
+        with self.assertRaises(ValueError):
+            new_pass("not_a_pass")
+
+    def test_custom_pass_registration(self):
+        from paddle_tpu.distributed.passes import (PassBase, new_pass,
+                                                   register_pass)
+
+        @register_pass("test_custom")
+        class _Custom(PassBase):
+            def _apply_single(self, config, context):
+                config["custom"] = self.get_attr("v", 1)
+
+        cfg = {}
+        new_pass("test_custom", {"v": 7}).apply(cfg)
+        self.assertEqual(cfg["custom"], 7)
+
+
 class TestIncubateExtras(unittest.TestCase):
     def test_softmax_mask_fuse_matches_causal(self):
         import paddle_tpu.incubate as inc
