@@ -285,10 +285,15 @@ class Strategy:
 
     def __init__(self, config=None):
         cfg = config or {}
-        # every config section becomes an attribute; unknown sections are
-        # kept too so pass-produced configs round-trip losslessly
+        # dict-valued config sections become Section attributes; scalar
+        # values (e.g. {"seed": 42}) attach as-is so pass-produced and
+        # hand-written configs both round-trip
         for name in set(self._KNOWN) | set(cfg):
-            setattr(self, name, self._Section(cfg.get(name, {})))
+            val = cfg.get(name)
+            if val is None or isinstance(val, dict):
+                setattr(self, name, self._Section(val or {}))
+            else:
+                setattr(self, name, val)
 
 
 class DistModel:

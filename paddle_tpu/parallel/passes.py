@@ -100,7 +100,8 @@ class PassBase:
             else [main_programs]
         for cfg in configs:
             self._apply_single(cfg, ctx)
-        ctx.passes.append(self)
+        if self not in ctx.passes:
+            ctx.passes.append(self)
         return ctx
 
     def _apply_single(self, config, context):
@@ -208,6 +209,7 @@ class FuseAllReducePass(PassBase):
 
     def _apply_single(self, config, context):
         config.setdefault("fuse_all_reduce", {})
+        config["fuse_all_reduce"]["enable"] = self.get_attr("enable", True)
         config["fuse_all_reduce"]["max_memory_size"] = self.get_attr(
             "max_memory_size", 32 << 20)
 

@@ -1,0 +1,146 @@
+"""Cross-framework oracle: our flagship Llama must reproduce the
+HuggingFace torch implementation's logits bit-for-bit (fp32, CPU) after a
+weight copy — validating attention (incl. GQA), RoPE convention, RMSNorm,
+SwiGLU, and the head in one shot. The reference validates parallel runs
+against single-card baselines (SURVEY §4); this is the analogous
+end-to-end numeric anchor for the model family itself."""
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+try:
+    import torch
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    HAVE_HF = True
+except Exception:  # pragma: no cover
+    HAVE_HF = False
+
+
+def _build_pair(num_kv_heads):
+    paddle.seed(0)
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      num_key_value_heads=num_kv_heads,
+                      max_position_embeddings=64)
+    ours = LlamaForCausalLM(cfg)
+    hf_cfg = HFConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      num_key_value_heads=num_kv_heads,
+                      max_position_embeddings=64,
+                      rope_theta=cfg.rope_theta, attention_bias=False,
+                      tie_word_embeddings=False)
+    hf = HFLlama(hf_cfg).eval()
+    hf_sd = hf.state_dict()
+    mapping = {}
+    for k, v in ours.state_dict().items():
+        hk = k.replace("llama.", "model.") if k.startswith("llama.") else k
+        t = hf_sd[hk].detach().numpy()
+        if t.ndim == 2 and "embed_tokens" not in hk:
+            t = t.T  # torch Linear stores [out, in]; ours [in, out]
+        mapping[k] = t.astype(np.float32)
+    ours.set_state_dict(mapping)
+    return ours, hf
+
+
+@unittest.skipUnless(HAVE_HF, "transformers/torch unavailable")
+class TestLlamaVsHuggingFace(unittest.TestCase):
+    def _check(self, num_kv_heads):
+        ours, hf = _build_pair(num_kv_heads)
+        ids = np.random.default_rng(0).integers(0, 256, (2, 16))
+        with torch.no_grad():
+            hf_logits = hf(torch.tensor(ids)).logits.numpy()
+        our_logits = ours(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(our_logits, hf_logits,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_mha_matches(self):
+        self._check(num_kv_heads=4)
+
+    def test_gqa_matches(self):
+        self._check(num_kv_heads=2)
+
+    def test_causality(self):
+        ours, _ = _build_pair(4)
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 256, (1, 12))
+        base = ours(paddle.to_tensor(ids)).numpy()
+        ids2 = ids.copy()
+        ids2[0, -1] = (ids2[0, -1] + 1) % 256  # perturb the LAST token
+        pert = ours(paddle.to_tensor(ids2)).numpy()
+        # all earlier positions unchanged (causal), last position changed
+        np.testing.assert_allclose(base[0, :-1], pert[0, :-1], rtol=1e-6)
+        self.assertGreater(np.abs(base[0, -1] - pert[0, -1]).max(), 1e-4)
+
+
+@unittest.skipUnless(HAVE_HF, "transformers/torch unavailable")
+class TestBertVsHuggingFace(unittest.TestCase):
+    @staticmethod
+    def _map_key(k):
+        import re
+        k2 = k.replace("embeddings.layer_norm", "embeddings.LayerNorm")
+        m = re.match(r"encoder\.(\d+)\.(.*)", k2)
+        if m:
+            i, rest = m.groups()
+            rest = (rest
+                    .replace("attention.query", "attention.self.query")
+                    .replace("attention.key", "attention.self.key")
+                    .replace("attention.value", "attention.self.value")
+                    .replace("attention.out.", "attention.output.dense.")
+                    .replace("attn_norm.", "attention.output.LayerNorm.")
+                    .replace("intermediate.", "intermediate.dense."))
+            # ffn out linear BEFORE renaming out_norm (name collision)
+            if rest.startswith("output."):
+                rest = rest.replace("output.", "output.dense.", 1)
+            rest = rest.replace("out_norm.", "output.LayerNorm.")
+            return f"encoder.layer.{i}.{rest}"
+        if k2.startswith("pooler"):
+            return k2.replace("pooler.", "pooler.dense.")
+        return k2
+
+    def test_encoder_matches(self):
+        import torch
+        from transformers import BertConfig as HFBertConfig
+        from transformers import BertModel as HFBert
+        from paddle_tpu.models import bert
+        paddle.seed(0)
+        torch.manual_seed(0)
+        cfg = bert.BertConfig(vocab_size=128, hidden_size=32,
+                              num_hidden_layers=2, num_attention_heads=2,
+                              intermediate_size=64,
+                              max_position_embeddings=32)
+        ours = bert.BertModel(cfg)
+        hf = HFBert(HFBertConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=32, type_vocab_size=2,
+            hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)).eval()
+        hf_sd = hf.state_dict()
+        mapping = {}
+        for k, v in ours.state_dict().items():
+            hk = self._map_key(k)
+            self.assertIn(hk, hf_sd, f"{k} -> {hk} unmapped")
+            t = hf_sd[hk].detach().numpy()
+            if t.ndim == 2 and "embeddings" not in hk:
+                t = t.T
+            self.assertEqual(tuple(t.shape), tuple(v.shape), k)
+            mapping[k] = t.astype(np.float32)
+        ours.set_state_dict(mapping)
+        ours.eval()
+        ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).last_hidden_state.numpy()
+        out = ours(paddle.to_tensor(ids))
+        out = out[0] if isinstance(out, tuple) else out
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+if __name__ == "__main__":
+    unittest.main()
