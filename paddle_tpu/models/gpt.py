@@ -82,7 +82,8 @@ class GPTBlock(Layer):
 
     def forward(self, x, mesh=None):
         x = x + self.attn(self.ln_1(x), mesh=mesh)
-        m = self.fc_out(F.gelu(self.fc_in(self.ln_2(x))))
+        # GPT-2 family convention: tanh-approximate GELU (HF gelu_new)
+        m = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
         x = x + self.dropout(m)
         return _constrain(x, mesh, BATCH_AXES, SEQ_AXIS, None)
 

@@ -20,6 +20,25 @@ except Exception:  # pragma: no cover
     HAVE_HF = False
 
 
+def _copy_weights(ours, hf_sd, map_key, transpose):
+    """Copy an HF torch state dict into our model. `map_key` renames our
+    key to the HF key; `transpose(hf_key, tensor)` says whether the torch
+    layout needs a .T (torch nn.Linear stores [out, in]; HF GPT2 Conv1D
+    and embeddings store [in, out] like our Linear)."""
+    mapping = {}
+    for k, v in ours.state_dict().items():
+        hk = map_key(k)
+        if hk not in hf_sd:
+            raise AssertionError(f"{k} -> {hk} unmapped")
+        t = hf_sd[hk].detach().numpy()
+        if transpose(hk, t):
+            t = t.T
+        if tuple(t.shape) != tuple(v.shape):
+            raise AssertionError((k, hk, t.shape, tuple(v.shape)))
+        mapping[k] = t.astype(np.float32)
+    ours.set_state_dict(mapping)
+
+
 def _build_pair(num_kv_heads):
     paddle.seed(0)
     torch.manual_seed(0)
@@ -37,15 +56,11 @@ def _build_pair(num_kv_heads):
                       rope_theta=cfg.rope_theta, attention_bias=False,
                       tie_word_embeddings=False)
     hf = HFLlama(hf_cfg).eval()
-    hf_sd = hf.state_dict()
-    mapping = {}
-    for k, v in ours.state_dict().items():
-        hk = k.replace("llama.", "model.") if k.startswith("llama.") else k
-        t = hf_sd[hk].detach().numpy()
-        if t.ndim == 2 and "embed_tokens" not in hk:
-            t = t.T  # torch Linear stores [out, in]; ours [in, out]
-        mapping[k] = t.astype(np.float32)
-    ours.set_state_dict(mapping)
+    _copy_weights(
+        ours, hf.state_dict(),
+        map_key=lambda k: k.replace("llama.", "model.", 1)
+        if k.startswith("llama.") else k,
+        transpose=lambda hk, t: t.ndim == 2 and "embed_tokens" not in hk)
     return ours, hf
 
 
@@ -122,21 +137,54 @@ class TestBertVsHuggingFace(unittest.TestCase):
             max_position_embeddings=32, type_vocab_size=2,
             hidden_dropout_prob=0.0,
             attention_probs_dropout_prob=0.0)).eval()
-        hf_sd = hf.state_dict()
-        mapping = {}
-        for k, v in ours.state_dict().items():
-            hk = self._map_key(k)
-            self.assertIn(hk, hf_sd, f"{k} -> {hk} unmapped")
-            t = hf_sd[hk].detach().numpy()
-            if t.ndim == 2 and "embeddings" not in hk:
-                t = t.T
-            self.assertEqual(tuple(t.shape), tuple(v.shape), k)
-            mapping[k] = t.astype(np.float32)
-        ours.set_state_dict(mapping)
+        _copy_weights(ours, hf.state_dict(), self._map_key,
+                      transpose=lambda hk, t: t.ndim == 2
+                      and "embeddings" not in hk)
         ours.eval()
         ids = np.random.default_rng(0).integers(0, 128, (2, 12))
         with torch.no_grad():
             ref = hf(torch.tensor(ids)).last_hidden_state.numpy()
+        out = ours(paddle.to_tensor(ids))
+        out = out[0] if isinstance(out, tuple) else out
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+@unittest.skipUnless(HAVE_HF, "transformers/torch unavailable")
+class TestGPTVsHuggingFace(unittest.TestCase):
+    def test_causal_lm_matches_gpt2(self):
+        import torch
+        from transformers import GPT2Config, GPT2LMHeadModel
+        from paddle_tpu.models import gpt
+        paddle.seed(0)
+        torch.manual_seed(0)
+        cfg = gpt.GPTConfig(vocab_size=128, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=2,
+                            intermediate_size=64,
+                            max_position_embeddings=32)
+        ours = gpt.GPTForCausalLM(cfg)
+        # HF default activation gelu_new (tanh approx) — the family
+        # convention our GPT block uses, so this comparison pins it down
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=128, n_embd=32, n_layer=2, n_head=2, n_inner=64,
+            n_positions=32, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0)).eval()
+        ren = {"attn.qkv_proj": "attn.c_attn",
+               "attn.out_proj": "attn.c_proj",
+               "fc_in": "mlp.c_fc", "fc_out": "mlp.c_proj"}
+
+        def map_key(k):
+            hk = k.replace("gpt.", "transformer.", 1)
+            for a, b in ren.items():
+                hk = hk.replace(a, b)
+            return hk
+
+        # HF GPT2 Conv1D stores [in, out] like our Linear: no transpose
+        _copy_weights(ours, hf.state_dict(), map_key,
+                      transpose=lambda hk, t: False)
+        ours.eval()
+        ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
         out = ours(paddle.to_tensor(ids))
         out = out[0] if isinstance(out, tuple) else out
         np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
