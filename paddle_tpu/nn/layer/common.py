@@ -5,7 +5,7 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 from .. import functional as F
-from ..initializer import Constant, Normal, Uniform, XavierNormal, _resolve_param_attr
+from ..initializer import Constant, XavierNormal, XavierUniform, _resolve_param_attr
 from .layers import Layer
 
 __all__ = [
@@ -105,8 +105,11 @@ class Embedding(Layer):
             else padding_idx if padding_idx >= 0
             else num_embeddings + padding_idx
         )
+        # reference default: the layer-helper Xavier initializer (an
+        # explicit Normal(0,1) here inflated logits ~8x on tied heads)
         self.weight = self.create_parameter(
-            (num_embeddings, embedding_dim), attr=weight_attr, default_initializer=Normal()
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=XavierUniform()
         )
         if self._padding_idx is not None:
             self.weight._array = self.weight._array.at[self._padding_idx].set(0.0)

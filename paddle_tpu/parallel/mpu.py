@@ -23,7 +23,7 @@ from ..core.tensor import Tensor, dispatch
 from ..nn import functional as F
 from ..nn.layer.layers import Layer
 from .. import ops
-from ..nn.initializer import XavierNormal
+from ..nn.initializer import XavierNormal, XavierUniform
 from . import mesh as mesh_mod
 from .api import shard_constraint, shard_tensor
 from .placement import Replicate, Shard
@@ -157,9 +157,11 @@ class VocabParallelEmbedding(Layer):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
+        # same default as nn.Embedding so TP and single-device builds
+        # initialize from the same distribution
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
-            default_initializer=XavierNormal())
+            default_initializer=XavierUniform())
         axis = _mp_axis()
         if axis is not None:
             mesh = mesh_mod.get_global_mesh()
