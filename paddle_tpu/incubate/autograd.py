@@ -11,7 +11,6 @@ primitives before differentiation.
 """
 from __future__ import annotations
 
-from ..autograd import Jacobian, hessian as Hessian  # noqa: F401
 from ..autograd import jvp, vjp  # noqa: F401
 from ..framework import flags as _flags
 
@@ -49,3 +48,111 @@ def grad(func, xs, v=None):
     of ones."""
     outs, grads = vjp(func, xs, v)
     return grads
+
+
+def _flatten_inputs(arrs, is_batched):
+    """Concatenate inputs after flattening (keeping the batch dim when
+    batched) — the reference's input canonicalisation."""
+    import jax.numpy as _jnp
+
+    if is_batched:
+        return _jnp.concatenate(
+            [a.reshape(a.shape[0], -1) for a in arrs], axis=-1)
+    return _jnp.concatenate([a.reshape(-1) for a in arrs])
+
+
+def _split_inputs(flat, arrs, is_batched):
+    """Inverse of _flatten_inputs: rebuild each input's full shape (the
+    whole batch — func always sees the true batch size)."""
+    import jax.numpy as _jnp
+
+    parts, off = [], 0
+    for a in arrs:
+        n = int(_jnp.size(a[0]) if is_batched else _jnp.size(a))
+        seg = flat[..., off:off + n]
+        parts.append(seg.reshape(a.shape))
+        off += n
+    return parts
+
+
+class Jacobian:
+    """reference: incubate/autograd/functional.py:215 — Jacobian of func
+    at xs: inputs are flattened and concatenated (batch dim kept when
+    is_batched), J sliceable like a tensor. The full matrix is computed
+    eagerly at construction (XLA makes the whole-matrix jacrev the fast
+    path, replacing the reference's row-lazy evaluation). For is_batched,
+    func is evaluated ONCE on the full batch and the per-row Jacobian is
+    the batch-diagonal block, matching the reference's independence
+    convention."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from ..core.tensor import Tensor, unwrap
+
+        xs_t = (xs,) if isinstance(xs, Tensor) else tuple(xs)
+        arrs = [unwrap(x) for x in xs_t]
+        flat_in = _flatten_inputs(arrs, is_batched)
+
+        def full_func(flat):
+            parts = _split_inputs(flat, arrs, is_batched)
+            out = unwrap(func(*[Tensor(p) for p in parts]))
+            return out.reshape(out.shape[0], -1) if is_batched \
+                else out.reshape(-1)
+
+        jac = _jax.jacrev(full_func)(flat_in)
+        if is_batched:
+            b = flat_in.shape[0]
+            idx = _jnp.arange(b)
+            jac = jac[idx, :, idx, :]  # [B, M, D] batch-diagonal blocks
+        self._jac = jac
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+    def __getitem__(self, idx):
+        from ..core.tensor import Tensor
+
+        return Tensor(self._jac[idx])
+
+
+class Hessian:
+    """reference: incubate/autograd/functional.py:307 — Hessian of a
+    scalar-output func at xs, sliceable like a tensor; computed eagerly at
+    construction. For is_batched, func sees the full batch (per-row
+    scalar outputs) and H holds the batch-diagonal blocks."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from ..core.tensor import Tensor, unwrap
+
+        xs_t = (xs,) if isinstance(xs, Tensor) else tuple(xs)
+        arrs = [unwrap(x) for x in xs_t]
+        flat_in = _flatten_inputs(arrs, is_batched)
+
+        def scalar_func(flat):
+            parts = _split_inputs(flat, arrs, is_batched)
+            out = unwrap(func(*[Tensor(p) for p in parts]))
+            # batched: per-row scalars; the batch sum's diagonal blocks
+            # equal each row's own Hessian under batch independence
+            return out.sum() if is_batched else out.reshape(())
+
+        hess = _jax.hessian(scalar_func)(flat_in)
+        if is_batched:
+            b = flat_in.shape[0]
+            idx = _jnp.arange(b)
+            hess = hess[idx, :, idx, :]  # [B, D, D]
+        self._hess = hess
+
+    @property
+    def shape(self):
+        return list(self._hess.shape)
+
+    def __getitem__(self, idx):
+        from ..core.tensor import Tensor
+
+        return Tensor(self._hess[idx])

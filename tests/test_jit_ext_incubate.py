@@ -191,6 +191,26 @@ class TestIncubateAutograd(unittest.TestCase):
         tg = iag.forward_grad(lambda t: t * 3.0, x)
         np.testing.assert_allclose(tg.numpy(), 3 * np.ones(4), rtol=1e-6)
 
+    def test_jacobian_hessian_classes(self):
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = Jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(J[:].numpy(), np.diag([2., 4., 6.]),
+                                   rtol=1e-6)
+        self.assertEqual(J.shape, [3, 3])
+        H = Hessian(lambda t: (t * t * t).sum(), x)
+        np.testing.assert_allclose(H[:].numpy(), np.diag([6., 12., 18.]),
+                                   rtol=1e-6)
+        xb = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        Jb = Jacobian(lambda t: t * t, xb, is_batched=True)
+        np.testing.assert_allclose(Jb[:].numpy()[1],
+                                   np.diag([6., 8., 10.]), rtol=1e-6)
+        y = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        J2 = Jacobian(lambda a, b: (a.sum() + b.sum()).reshape([1]),
+                      [x, y])
+        np.testing.assert_allclose(J2[:].numpy(), np.ones((1, 5)),
+                                   rtol=1e-6)
+
     def test_prim_switch(self):
         from paddle_tpu.incubate import autograd as iag
         self.assertTrue(iag.prim_enabled())
