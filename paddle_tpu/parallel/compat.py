@@ -313,19 +313,13 @@ class DistModel:
         mesh = get_global_mesh()
         self._train_step = None
         self._opt = None
+        self._strategy = strategy
         if optimizer is not None:
-            if type(optimizer).__name__ not in ("AdamW", "Adam"):
-                import warnings
-                warnings.warn(
-                    "DistModel's fused step applies AdamW semantics; "
-                    f"{type(optimizer).__name__}'s update rule is not "
-                    "carried over")
-            try:
-                lr = float(optimizer.get_lr())
-            except Exception:
-                lr = 1e-3
+            # the actual optimizer's update rule, decay groups, clip and LR
+            # schedule run inside the jitted step; strategy sections (amp/
+            # recompute/gradient_merge/sharding) are consumed at trace time
             self._train_step, self._params, self._opt = make_train_step(
-                layer, loss, mesh, lr=lr)
+                layer, loss, mesh, optimizer=optimizer, strategy=strategy)
         else:
             self._params = dict(layer.raw_state())
         self._eval_step = self._build_eval(layer, loss)

@@ -1,0 +1,165 @@
+"""Functional bridge from eager Optimizers to the jitted train step.
+
+Reference analog: the static auto-parallel Engine building an optimizer into
+the compiled program (python/paddle/distributed/auto_parallel/static/engine.py:69,
+python/paddle/optimizer/optimizer.py:125 _apply_optimize). TPU-native form:
+every eager optimizer already defines a pure per-array update rule
+(`_update_rule_arr`), so a FusedOptimizer lifts one Optimizer instance into
+
+    init_state(params)                    -> state pytree
+    update(params, grads, state, lr)      -> (params', state')
+
+usable inside a single jitted, buffer-donating SPMD step. Per-group weight
+decay, L1Decay, apply_decay_param_fun / exclude_from_weight_decay_fn, grad
+clip objects, and multi_precision master weights all carry over because the
+same host-side metadata that drives Optimizer.step() is resolved statically
+at trace time.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer.layers import Layer
+from ..optimizer.lr import LRScheduler
+from ..optimizer.optimizer import Optimizer
+
+
+class _ParamProxy:
+    """Just enough of a Parameter for _create_accumulators / _apply_decay."""
+
+    __slots__ = ("_array", "name", "stop_gradient")
+
+    def __init__(self, array, name):
+        self._array = array
+        self.name = name
+        self.stop_gradient = False
+
+
+def _sharding_of(arr) -> Optional[NamedSharding]:
+    s = getattr(arr, "sharding", None)
+    return s if isinstance(s, NamedSharding) else None
+
+
+def _inherit_sharding(state_arr, param_arr):
+    """Lay a state array out like its parameter (ZeRO stage 1/2: states
+    follow the param's TP/FSDP spec). Shape-mismatched leaves (e.g. ASGD's
+    history stack) stay wherever they were created."""
+    s = _sharding_of(param_arr)
+    if s is None or getattr(state_arr, "shape", None) != param_arr.shape:
+        return state_arr
+    return jax.device_put(state_arr, s)
+
+
+class FusedOptimizer:
+    """Lift `optimizer` (built over `model`'s parameters) into pure fns."""
+
+    def __init__(self, optimizer: Optimizer, model: Layer):
+        if not hasattr(type(optimizer), "_update_rule_arr") or \
+                type(optimizer)._update_rule_arr is Optimizer._update_rule_arr:
+            raise NotImplementedError(
+                f"{type(optimizer).__name__} has no pure update rule and "
+                "cannot run inside the fused train step (use eager "
+                "loss.backward() + optimizer.step())")
+        self._opt = optimizer
+        named = dict(model.named_parameters())
+        by_id = {id(p): n for n, p in named.items()}
+        self._proxies: Dict[str, _ParamProxy] = {}
+        self._params_by_name: Dict[str, Any] = {}
+        self._wd: Dict[str, float] = {}
+        self._l1: Dict[str, float] = {}
+
+        from ..regularizer import L1Decay
+
+        for group in optimizer._param_groups:
+            raw = group.get("weight_decay", optimizer._weight_decay)
+            is_l1 = isinstance(raw, L1Decay)
+            wd = 0.0 if is_l1 else optimizer._weight_decay_value(group)
+            l1 = float(raw) if is_l1 else 0.0
+            for p in group["params"]:
+                name = by_id.get(id(p))
+                if name is None or p.stop_gradient:
+                    continue
+                decay = optimizer._apply_decay(p)
+                self._wd[name] = wd if decay else 0.0
+                self._l1[name] = l1 if decay else 0.0
+                self._proxies[name] = _ParamProxy(p._array, p.name)
+                self._params_by_name[name] = p
+        # raw_state entries NOT in the optimizer (frozen params, buffers)
+        # pass through the update untouched
+        self.trainable = frozenset(self._proxies)
+        # checkpointing bridge: optimizer.state_dict() must see the fused
+        # accumulators; sync lazily (export_to blocks on device values)
+        self.latest_state = None
+        orig_state_dict = optimizer.state_dict
+
+        def synced_state_dict():
+            if self.latest_state is not None:
+                self.export_to(self.latest_state)
+            return orig_state_dict()
+
+        optimizer.state_dict = synced_state_dict
+
+    # ------------------------------------------------------------------
+    def init_state(self, params: Dict[str, jax.Array]):
+        acc = {}
+        for name in self.trainable:
+            proxy = self._proxies[name]
+            proxy._array = params[name]  # current (possibly resharded) value
+            # resume: accumulators already loaded via set_state_dict win
+            existing = self._opt._accumulators.get(
+                id(self._params_by_name[name]))
+            st = dict(existing) if existing else \
+                self._opt._create_accumulators(proxy)
+            acc[name] = {k: _inherit_sharding(v, params[name])
+                         for k, v in st.items()}
+        return {"step": jnp.asarray(self._opt._global_step, jnp.int32),
+                "acc": acc}
+
+    def update(self, params, grads, state, lr):
+        """Pure: one optimizer step over the whole tree. `lr` is a traced
+        scalar so LR schedules tick without recompilation."""
+        opt = self._opt
+        step = state["step"] + 1
+        stepf = step.astype(jnp.float32)
+        names = sorted(self.trainable)
+        gs = [grads[n] for n in names]
+        if opt._grad_clip is not None:
+            gs = opt._grad_clip.apply(gs)
+        new_params = dict(params)
+        new_acc = {}
+        for n, g in zip(names, gs):
+            l1 = self._l1.get(n, 0.0)
+            if l1:
+                g = g + l1 * jnp.sign(params[n].astype(g.dtype))
+            new_p, new_st = opt._update_rule_arr(
+                params[n], g, state["acc"][n], lr, self._wd.get(n, 0.0),
+                stepf)
+            new_params[n] = new_p
+            new_acc[n] = new_st
+        return new_params, {"step": step, "acc": new_acc}
+
+    # ------------------------------------------------------------------
+    def host_lr(self) -> float:
+        return self._opt.get_lr()
+
+    def host_tick(self):
+        """Advance host-side bookkeeping after a fused step: the global step
+        counter and the LR scheduler (reference: Engine calls
+        optimizer._learning_rate.step() once per iteration)."""
+        self._opt._global_step += 1
+        sched = self._opt._learning_rate
+        if isinstance(sched, LRScheduler):
+            sched.step()
+
+    def export_to(self, state) -> None:
+        """Write fused accumulator state back into the eager Optimizer view
+        so optimizer.state_dict()/checkpointing sees the trained values
+        (params themselves are synced by Layer.load_raw_state)."""
+        self._opt._global_step = int(state["step"])
+        for name, p in self._params_by_name.items():
+            if name in state["acc"]:
+                self._opt._accumulators[id(p)] = dict(state["acc"][name])

@@ -1,0 +1,334 @@
+"""Strategy-driven fused train step (reference: the static auto-parallel
+Engine compiling optimizer + strategy into the program —
+auto_parallel/static/engine.py:69, passes/auto_parallel_gradient_merge.py,
+python/paddle/optimizer/{sgd,momentum,adam,adamw,lamb}.py).
+
+Bar: fused-vs-eager numerical equivalence per optimizer; gradient-merge
+k_steps equivalence with the full-batch step; strategy toggles changing the
+compiled program (recompute -> peak memory); LR schedules advancing through
+dist.to_static.
+"""
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.parallel import make_train_step
+from paddle_tpu.parallel.mesh import build_mesh, set_global_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_global_mesh(None)
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.SiLU(), nn.Linear(16, 4))
+
+
+def _data(b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(b, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (b,)))
+    return x, y
+
+
+def _train_eager(model, optimizer, batches):
+    loss_fn = nn.CrossEntropyLoss()
+    losses = []
+    for x, y in batches:
+        loss = loss_fn(model(Tensor(x)), Tensor(y))
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _train_fused(model, optimizer, batches, strategy=None):
+    loss_fn = nn.CrossEntropyLoss()
+    step, params, state = make_train_step(
+        model, lambda out, yb: loss_fn(out, yb), mesh=None,
+        optimizer=optimizer, strategy=strategy)
+    losses = []
+    for x, y in batches:
+        loss, params, state = step(params, state, x, y)
+        losses.append(float(loss))
+    return losses, params, state
+
+
+OPTIMIZERS = {
+    "sgd": lambda ps: opt.SGD(learning_rate=0.05, parameters=ps),
+    "momentum": lambda ps: opt.Momentum(learning_rate=0.05, momentum=0.9,
+                                        use_nesterov=True, parameters=ps),
+    "adam": lambda ps: opt.Adam(learning_rate=0.01, parameters=ps,
+                                weight_decay=0.01),
+    "adamw": lambda ps: opt.AdamW(learning_rate=0.01, parameters=ps,
+                                  weight_decay=0.1),
+    "adamw_nodecay": lambda ps: opt.AdamW(
+        learning_rate=0.01, parameters=ps, weight_decay=0.1,
+        apply_decay_param_fun=lambda n: "bias" not in n),
+    "lamb": lambda ps: opt.Lamb(learning_rate=0.01, lamb_weight_decay=0.02,
+                                parameters=ps),
+    "rmsprop": lambda ps: opt.RMSProp(learning_rate=0.01, parameters=ps),
+    "clipped_adam": lambda ps: opt.Adam(
+        learning_rate=0.01, parameters=ps,
+        grad_clip=nn.ClipGradByGlobalNorm(0.1)),
+}
+
+
+class TestFusedMatchesEager:
+    @pytest.mark.parametrize("name", sorted(OPTIMIZERS))
+    def test_three_steps_match(self, name):
+        batches = [_data(seed=s) for s in range(3)]
+        m1 = _mlp()
+        m2 = _mlp()
+        for (k1, p1), (k2, p2) in zip(sorted(m1.raw_state().items()),
+                                      sorted(m2.raw_state().items())):
+            np.testing.assert_array_equal(p1, p2)
+        l_eager = _train_eager(m1, OPTIMIZERS[name](m1.parameters()), batches)
+        l_fused, params, _ = _train_fused(
+            m2, OPTIMIZERS[name](m2.parameters()), batches)
+        np.testing.assert_allclose(l_eager, l_fused, rtol=2e-5, atol=1e-6)
+        for k, v in m1.raw_state().items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(params[k]), rtol=2e-4, atol=2e-6,
+                err_msg=f"{name}: param {k} diverged")
+
+    def test_apply_decay_param_fun_excludes(self):
+        """With zero-ish grads, decayed params shrink; excluded ones don't."""
+        m = _mlp()
+        optimizer = opt.AdamW(
+            learning_rate=0.1, parameters=m.parameters(), weight_decay=0.5,
+            apply_decay_param_fun=lambda n: "bias" not in n)
+        loss_fn = nn.CrossEntropyLoss()
+        step, params, state = make_train_step(
+            m, lambda out, yb: loss_fn(out, yb), mesh=None,
+            optimizer=optimizer)
+        before = {k: np.asarray(v) for k, v in params.items()}
+        x, y = _data()
+        _, params, state = step(params, state, x, jnp.zeros_like(y))
+        # weights must have moved strictly more than decay-excluded biases
+        # would from grads alone: check the bias trajectory has no decay term
+        # by re-running eager with the same settings
+        m2 = _mlp()
+        m2.load_raw_state({k: jnp.asarray(v) for k, v in before.items()})
+        opt2 = opt.AdamW(
+            learning_rate=0.1, parameters=m2.parameters(), weight_decay=0.5,
+            apply_decay_param_fun=lambda n: "bias" not in n)
+        loss = loss_fn(m2(Tensor(x)), Tensor(jnp.zeros_like(y)))
+        loss.backward()
+        opt2.step()
+        for k, v in m2.raw_state().items():
+            np.testing.assert_allclose(np.asarray(v), np.asarray(params[k]),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_state_dict_sees_fused_accumulators(self):
+        m = _mlp()
+        optimizer = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+        _train_fused(m, optimizer, [_data()])
+        sd = optimizer.state_dict()
+        assert sd["global_step"] == 1
+        moments = [k for k in sd if k.endswith("_moment1")]
+        assert moments, f"no fused moments exported: {sorted(sd)}"
+
+    def test_resume_from_loaded_state(self):
+        """set_state_dict + a fresh fused step must continue the trajectory,
+        not restart moments from zero (reference: Engine resuming from
+        optimizer checkpoints)."""
+        batches = [_data(seed=s) for s in range(4)]
+        # uninterrupted run
+        m1 = _mlp()
+        o1 = opt.Adam(learning_rate=0.01, parameters=m1.parameters())
+        _, p1, _ = _train_fused(m1, o1, batches)
+        # interrupted at step 2: checkpoint, rebuild, resume
+        m2 = _mlp()
+        o2 = opt.Adam(learning_rate=0.01, parameters=m2.parameters())
+        _, p_mid, _ = _train_fused(m2, o2, batches[:2])
+        ckpt = o2.state_dict()
+        m3 = _mlp()
+        m3.load_raw_state(p_mid)
+        o3 = opt.Adam(learning_rate=0.01, parameters=m3.parameters())
+        o3.set_state_dict(ckpt)
+        _, p3, _ = _train_fused(m3, o3, batches[2:])
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p3[k]), rtol=2e-5, atol=2e-6,
+                err_msg=f"resume diverged on {k}")
+
+    def test_strategy_recompute_does_not_leak_into_model(self):
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       LlamaPretrainingCriterion)
+
+        cfg = LlamaConfig.tiny()
+        assert cfg.recompute is False
+        model = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step, params, state = make_train_step(
+            model, lambda lg, lb: crit(lg, lb), mesh=None,
+            optimizer=optimizer,
+            strategy={"recompute": {"enable": True}})
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)))
+        y = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)))
+        step(params, state, x, y)
+        assert model.config.recompute is False, (
+            "strategy recompute leaked into the shared model config")
+
+    def test_lbfgs_refused(self):
+        m = _mlp()
+        lb = opt.LBFGS(parameters=m.parameters())
+        with pytest.raises(NotImplementedError):
+            make_train_step(m, lambda o, y: o.sum(), optimizer=lb)
+
+
+class TestLRSchedule:
+    def test_scheduler_ticks_inside_fused_step(self):
+        batches = [_data(seed=s) for s in range(4)]
+        m1, m2 = _mlp(), _mlp()
+        s1 = opt.lr.StepDecay(learning_rate=0.05, step_size=2, gamma=0.1)
+        s2 = opt.lr.StepDecay(learning_rate=0.05, step_size=2, gamma=0.1)
+        o1 = opt.SGD(learning_rate=s1, parameters=m1.parameters())
+        o2 = opt.SGD(learning_rate=s2, parameters=m2.parameters())
+
+        def eager():
+            loss_fn = nn.CrossEntropyLoss()
+            for x, y in batches:
+                loss = loss_fn(m1(Tensor(x)), Tensor(y))
+                loss.backward()
+                o1.step()
+                o1.clear_grad()
+                s1.step()
+
+        eager()
+        _, params, _ = _train_fused(m2, o2, batches)
+        assert s2.last_epoch == s1.last_epoch  # scheduler advanced
+        assert abs(o2.get_lr() - o1.get_lr()) < 1e-12
+        for k, v in m1.raw_state().items():
+            np.testing.assert_allclose(np.asarray(v), np.asarray(params[k]),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_to_static_lr_advances(self):
+        import paddle_tpu.distributed as dist
+
+        m = _mlp()
+        sched = opt.lr.NoamDecay(d_model=64, warmup_steps=10,
+                                 learning_rate=1.0)
+        optimizer = opt.Adam(learning_rate=sched, parameters=m.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        dm = dist.to_static(m, None, loss=loss_fn, optimizer=optimizer)
+        lr0 = optimizer.get_lr()
+        x, y = _data()
+        dm(x, y)
+        dm(x, y)
+        assert optimizer.get_lr() != lr0, "LR scheduler froze through to_static"
+
+
+class TestStrategy:
+    def test_gradient_merge_matches_full_batch(self):
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+
+        batches = [_data(b=8, seed=s) for s in range(3)]
+        m1, m2 = _mlp(), _mlp()
+        o1 = opt.AdamW(learning_rate=0.01, parameters=m1.parameters())
+        o2 = opt.AdamW(learning_rate=0.01, parameters=m2.parameters())
+        l_full, p_full, _ = _train_fused(m1, o1, batches)
+
+        config = {}
+        PassManager([new_pass("auto_parallel_gradient_merge",
+                              {"k_steps": 4})]).apply(config)
+        assert config["gradient_merge"]["k_steps"] == 4
+        l_gm, p_gm, _ = _train_fused(m2, o2, batches, strategy=config)
+        np.testing.assert_allclose(l_full, l_gm, rtol=1e-5, atol=1e-6)
+        for k in p_full:
+            np.testing.assert_allclose(
+                np.asarray(p_full[k]), np.asarray(p_gm[k]), rtol=2e-5,
+                atol=2e-6, err_msg=f"gradient-merge diverged on {k}")
+
+    def test_recompute_pass_changes_compiled_memory(self):
+        """Toggling the recompute pass must change the compiled program:
+        peak temp memory drops (the backward recomputes instead of saving)."""
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       LlamaPretrainingCriterion)
+
+        cfg = LlamaConfig.tiny()
+        crit = LlamaPretrainingCriterion(cfg)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 128)))
+        y = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 128)))
+
+        losses = {}
+
+        def build(strategy):
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            optimizer = opt.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+            step, params, state = make_train_step(
+                model, lambda lg, lb: crit(lg, lb), mesh=None,
+                optimizer=optimizer, strategy=strategy, donate=False)
+            lowered = step.jitted.lower(
+                params, state, jnp.float32(1e-3), x, y)
+            temp = lowered.compile().memory_analysis().temp_size_in_bytes
+            loss, _, _ = step(params, state, x, y)
+            return temp, float(loss)
+
+        config = {}
+        PassManager([new_pass("auto_parallel_recompute")]).apply(config)
+        assert config["recompute"]["enable"] is True
+        temp_base, loss_base = build(None)
+        temp_remat, loss_remat = build(config)
+        np.testing.assert_allclose(loss_base, loss_remat, rtol=1e-5)
+        assert temp_remat < temp_base, (
+            f"recompute did not reduce peak temp memory: "
+            f"{temp_remat} vs {temp_base}")
+
+    def test_amp_strategy_runs_bf16(self):
+        m = _mlp()
+        optimizer = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+        strategy = {"amp": {"enable": True, "dtype": "bfloat16"}}
+        loss_fn = nn.CrossEntropyLoss()
+        step, params, state = make_train_step(
+            m, lambda o, yb: loss_fn(o, yb), mesh=None, optimizer=optimizer,
+            strategy=strategy)
+        x, y = _data()
+        loss0, params, state = step(params, state, x, y)
+        loss1, params, state = step(params, state, x, y)
+        assert np.isfinite(loss0) and float(loss1) < float(loss0)
+        # master params stay fp32
+        assert all(v.dtype == jnp.float32 for v in params.values())
+
+    def test_sharding_strategy_shards_states(self):
+        mesh = build_mesh({"dp": 2, "sharding": 4})
+        set_global_mesh(mesh)
+        m = _mlp()
+        optimizer = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+        strategy = {"sharding": {"enable": True, "stage": 1,
+                                 "axis": "sharding"}}
+        loss_fn = nn.CrossEntropyLoss()
+        step, params, state = make_train_step(
+            m, lambda o, yb: loss_fn(o, yb), mesh=mesh, optimizer=optimizer,
+            strategy=strategy, batch_spec=(("dp",),))
+        # moment accumulators of the 16-row linear weight are Shard(0)
+        from jax.sharding import NamedSharding
+        sharded = [
+            k for k, st in state["acc"].items()
+            for arr in st.values()
+            if isinstance(arr.sharding, NamedSharding)
+            and arr.sharding.spec and arr.sharding.spec[0] == "sharding"
+        ]
+        assert sharded, "no optimizer accumulator picked up Shard(0)"
+        x, y = _data()
+        loss, params, state = step(params, state, x, y)
+        assert np.isfinite(float(loss))
