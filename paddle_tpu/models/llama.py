@@ -137,7 +137,8 @@ def _constrain(x, mesh, *dims):
 # batch dim is data-parallel over both dp and the ZeRO axis; seq dim is
 # context-parallel over sep (reference: 5-D topo [data,pipe,sharding,sep,model],
 # fleet/base/topology.py:188)
-BATCH_AXES = ("dp", "sharding")
+from ..parallel.mesh import BATCH_AXES  # noqa: E402  (single topology source)
+
 SEQ_AXIS = "sep"
 MP_AXIS = "mp"
 
@@ -206,17 +207,52 @@ class LlamaAttention(Layer):
                     qa, ka, va, mesh=mesh, axis=SEQ_AXIS, causal=causal),
                 (q, k, v))
         else:
-            # heads sharded over mp AND sep (Ulysses: the seq->head
-            # all-to-all falls out of re-constraining seq-sharded
-            # activations to head-sharded here; reference analog:
-            # SegmentParallel sep axis, fleet/base/topology.py:224)
-            q = _constrain(q, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS),
-                           None)
-            k = _constrain(k, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS),
-                           None)
-            v = _constrain(v, mesh, BATCH_AXES, None, (MP_AXIS, SEQ_AXIS),
-                           None)
+            from ..parallel.ulysses import seq_to_head, ulysses_available
+
+            ulysses = (cache is None and mesh is not None
+                       and ulysses_available(mesh, self.num_heads, s))
+            if ulysses:
+                # Ulysses: explicit all-to-all over the sep group swaps seq
+                # shards for head shards (GSPMD's re-constraint lowering of
+                # this swap replicates — "involuntary full remat" — so the
+                # swap is a shard_map'd lax.all_to_all riding ICI; reference
+                # analog: SegmentParallel sep groups,
+                # fleet/base/topology.py:224)
+                a2a = lambda a: seq_to_head(a, mesh)
+                q = dispatch("ulysses_a2a", a2a, (q,))
+                if ulysses_available(mesh, self.num_kv_heads, s):
+                    k = dispatch("ulysses_a2a", a2a, (k,))
+                    v = dispatch("ulysses_a2a", a2a, (v,))
+                else:
+                    # GQA with too few kv heads to split over mp*sep:
+                    # replicate kv groups just enough to split evenly —
+                    # the repeat multiplies a2a bytes, so use the minimal
+                    # factor whose result still block-aligns with q's
+                    # contiguous (mp, sep) head shards (kv'[j] = kv[j//r]
+                    # puts q head t with kv group t*nkv/nh on each device)
+                    from ..parallel.ulysses import minimal_kv_repeat
+
+                    rep = minimal_kv_repeat(mesh, self.num_heads,
+                                            self.num_kv_heads)
+                    grow = lambda a: seq_to_head(
+                        jnp.repeat(a, rep, axis=2), mesh)
+                    k = dispatch("ulysses_a2a", grow, (k,))
+                    v = dispatch("ulysses_a2a", grow, (v,))
+            else:
+                # heads sharded over mp (and sep when divisible): GSPMD
+                # inserts the reshard from the constraint
+                q = _constrain(q, mesh, BATCH_AXES, None,
+                               (MP_AXIS, SEQ_AXIS), None)
+                k = _constrain(k, mesh, BATCH_AXES, None,
+                               (MP_AXIS, SEQ_AXIS), None)
+                v = _constrain(v, mesh, BATCH_AXES, None,
+                               (MP_AXIS, SEQ_AXIS), None)
             out, _ = F.flash_attention(q, k, v, causal=causal)
+            if ulysses:
+                from ..parallel.ulysses import head_to_seq
+
+                out = dispatch("ulysses_a2a_back",
+                               lambda a: head_to_seq(a, mesh), (out,))
         if self.config.remat_policy == "save_attn":
             from jax.ad_checkpoint import checkpoint_name
 
@@ -392,7 +428,11 @@ def llama_sharding_rules():
     """(param-name-suffix, partition dims) table. Weight layout is
     [in, out] (nn.Linear convention)."""
     return [
-        ("embed_tokens.weight", (MP_AXIS, "sharding")),     # [vocab, h]
+        # vocab over the ZeRO axis, h over mp: the lookup's gather output
+        # then lands h-sharded-over-mp, which GSPMD reshards cleanly to the
+        # (batch, sep)-sharded activation layout (vocab-over-mp made it log
+        # "involuntary full rematerialization" on every embedding lookup)
+        ("embed_tokens.weight", ("sharding", MP_AXIS)),     # [vocab, h]
         ("q_proj.weight", ("sharding", MP_AXIS)),           # [h, nh*dh]
         ("k_proj.weight", ("sharding", MP_AXIS)),
         ("v_proj.weight", ("sharding", MP_AXIS)),

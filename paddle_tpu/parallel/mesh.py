@@ -21,6 +21,9 @@ from jax.sharding import Mesh
 # canonical axis order mirrors the reference's
 # ["data", "pipe", "sharding", "sep", "model"] (topology.py:188)
 HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+# the axes a data batch shards over (dp + the ZeRO axis); the single source
+# for model activation specs and the Ulysses shard_map specs
+BATCH_AXES = ("dp", "sharding")
 
 _global_mesh: Optional[Mesh] = None
 

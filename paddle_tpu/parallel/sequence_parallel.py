@@ -16,10 +16,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-
 from ..core.tensor import Tensor, dispatch
 from ..nn.layer.layers import Layer
 from . import mesh as mesh_mod
@@ -93,28 +89,30 @@ def mark_as_sequence_parallel_parameter(param):
 def ulysses_alltoall(x, scatter_dim: int, gather_dim: int, axis: str = "sep"):
     """DeepSpeed-Ulysses all-to-all: swap which of (heads, seq) is sharded.
 
-    x inside shard_map: local [.., seq_local, heads, ..]; all_to_all over
-    `axis` re-shards from gather_dim to scatter_dim. Outside a trace this is
-    a sharding re-annotation (XLA emits the all-to-all).
-    Reference analog: the `sep` topology axis + alltoall in
-    distributed/utils/moe_utils.py / segment_parallel.py."""
+    Backed by the shard_map + lax.all_to_all implementation in
+    parallel/ulysses.py (GSPMD lowers the equivalent re-constraint as a
+    replicate-then-partition — "involuntary full rematerialization").
+    For the canonical [b, s, h, d] layouts (scatter/gather dims {1, 2})
+    the explicit collective is used; other dim pairs fall back to a
+    sharding re-annotation. Reference analog: the `sep` topology axis +
+    alltoall in distributed/utils/moe_utils.py / segment_parallel.py."""
     mesh = mesh_mod.get_global_mesh()
     if mesh is None or axis not in mesh.axis_names or int(mesh.shape[axis]) == 1:
         return x
 
-    def impl(a):
-        try:
-            return lax.all_to_all(a, axis, split_axis=scatter_dim,
-                                  concat_axis=gather_dim, tiled=True)
-        except NameError:
-            return a
+    from .ulysses import head_to_seq, seq_to_head, ulysses_available
 
-    if isinstance(x, Tensor) and isinstance(x._array, jax.core.Tracer):
-        try:
-            return dispatch("ulysses_alltoall", impl, (x,))
-        except Exception:
-            pass
-    # global view: re-annotate shardings
+    arr = x._array if isinstance(x, Tensor) else x
+    # [b, s, h, d] layout: dim 1 is sequence, dim 2 is heads either way
+    if arr.ndim == 4 and {scatter_dim, gather_dim} == {1, 2} and \
+            ulysses_available(mesh, arr.shape[2], arr.shape[1],
+                              seq_axis=axis):
+        impl = (seq_to_head if scatter_dim == 2 else head_to_seq)
+        fn = lambda a: impl(a, mesh, seq_axis=axis)
+        if isinstance(x, Tensor):
+            return dispatch("ulysses_alltoall", fn, (x,))
+        return fn(x)
+    # fallback: re-annotate shardings and let GSPMD move the data
     pl = [Shard(scatter_dim) if a == axis else Replicate()
           for a in mesh.axis_names]
     return shard_constraint(x, pl, mesh)

@@ -3,6 +3,8 @@ parallel-vs-serial loss alignment (reference strategy:
 test/auto_parallel/hybrid_strategy/semi_auto_llama_acc_align.py — parallel
 losses must match single-device losses).
 """
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -157,6 +159,48 @@ class TestLlama:
                            caches=caches)
         nxt2 = np.argmax(lg.numpy()[:, -1], axis=-1)
         np.testing.assert_array_equal(nxt, nxt2)
+
+    def test_sep_matches_serial(self):
+        """Ulysses SEP must be numerically equivalent to serial training,
+        same bar as TP/DP/sharding (reference:
+        semi_auto_llama_acc_align.py). Covers the divisible-kv a2a path
+        (mp=1, sep=2: nkv=2 splits evenly), the kv-repeat GQA path
+        (mp*sep=4 > nkv), the mp*sep composition, and the minimal-repeat
+        case (nh=8, nkv=2, mp*sep=4: kv repeats 2x not 4x)."""
+        cases = [
+            ({"dp": 4, "sharding": 1, "mp": 1, "sep": 2}, {}),
+            ({"dp": 2, "sharding": 1, "mp": 1, "sep": 4}, {}),
+            ({"dp": 2, "sharding": 1, "mp": 2, "sep": 2}, {}),
+            ({"dp": 2, "sharding": 1, "mp": 2, "sep": 2},
+             dict(num_attention_heads=8, num_key_value_heads=2)),
+        ]
+        for axes, over in cases:
+            set_global_mesh(None)
+            cfg = dataclasses.replace(LlamaConfig.tiny(), **over)
+            crit = LlamaPretrainingCriterion(cfg)
+            x, y = _data(cfg)
+
+            paddle.seed(11)
+            m1 = LlamaForCausalLM(cfg)
+            s1, p, o = make_train_step(m1, lambda lg, lb: crit(lg, lb),
+                                       None, lr=1e-3)
+            serial = []
+            for _ in range(3):
+                l, p, o = s1(p, o, x, y)
+                serial.append(float(l))
+
+            mesh = build_mesh(axes)
+            set_global_mesh(mesh)
+            paddle.seed(11)
+            m2 = shard_llama(LlamaForCausalLM(cfg), mesh)
+            s2, p, o = make_train_step(m2, lambda lg, lb: crit(lg, lb),
+                                       mesh, lr=1e-3)
+            par = []
+            for _ in range(3):
+                l, p, o = s2(p, o, x, y)
+                par.append(float(l))
+            np.testing.assert_allclose(serial, par, atol=2e-3,
+                                       err_msg=f"SEP diverged on {axes}")
 
     def test_sep_context_parallel_runs(self):
         mesh = build_mesh({"dp": 2, "sharding": 1, "mp": 2, "sep": 2})
