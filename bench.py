@@ -28,8 +28,12 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     n_dev = jax.device_count()
     if on_tpu:
+        # GQA config (4 kv heads, llama-2-70B/llama-3 class ratio) so the
+        # gate measures the grouped-attention fast path — the config class
+        # that matters for real deployments
         cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=True,
                                    recompute_skip=4,
+                                   num_key_value_heads=4,
                                    max_position_embeddings=2048)
         batch, seq, iters = 8, 2048, 10
     else:  # CPU smoke config so the harness always yields a number

@@ -23,6 +23,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_splash_warned = False
 
 
 def _on_tpu() -> bool:
@@ -385,10 +386,43 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 # ---------------------------------------------------------------------------
 
 def _bundled_ok(sq, sk, hq, hk, dh) -> bool:
-    """Shapes the vendored jax pallas kernel handles well (MHA, long
-    block-divisible sequences)."""
+    """Shapes the bundled jax pallas MHA kernel handles well (equal heads,
+    long block-divisible sequences)."""
     return (_on_tpu() and hq == hk and dh % 128 == 0
             and sq % 512 == 0 and sk % 512 == 0 and sq == sk)
+
+
+def _splash_ok(sq, sk, hq, hk, dh) -> bool:
+    """GQA shapes for the splash kernel (grouped heads natively — the fast
+    path for Llama-2-70B/Llama-3-class configs where hk < hq)."""
+    return (_on_tpu() and hq != hk and hq % hk == 0 and dh % 128 == 0
+            and sq % 512 == 0 and sk % 512 == 0 and sq == sk)
+
+
+@functools.lru_cache(maxsize=16)
+def _splash_kernel(sq, sk, hq, causal: bool):
+    """Build (and cache) a splash GQA kernel.
+
+    Block sizes tuned on v5e at b8/s2048/hq16/hkv4/d128: fwd 20.1 TF/s,
+    fwd+bwd 34.3 TF/s (vs 19.8/30.7 for the in-repo kernel and 16.5/26.7
+    for kv-repeat through the bundled MHA kernel). Callers must construct
+    under jax.ensure_compile_time_eval(): built inside a jit trace, the
+    kernel's mask-info arrays become trace-local constants and poison the
+    cache for later traces (UnexpectedTracerError)."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk, splash_attention_mask as _sm)
+
+    mk = (_sm.CausalMask((sq, sk)) if causal else _sm.FullMask((sq, sk)))
+    mask = _sm.MultiHeadMask([mk for _ in range(hq)])
+    bq = min(1024, sq)
+    bkv = min(1024, sk)
+    bc = min(512, sk)
+    blocks = _sk.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=bc,
+        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bc,
+        block_q_dq=bq, block_kv_dq=bkv)
+    return _sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                               block_sizes=blocks)
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -407,6 +441,27 @@ def flash_attention(q, k, v, causal: bool = False,
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
+    if _splash_ok(sq, sk, hq, hk, dh):
+        try:
+            with jax.ensure_compile_time_eval():
+                kernel = _splash_kernel(sq, sk, hq, bool(causal))
+            # splash takes pre-scaled q, per-example [h, s, d] layout
+            qs = jnp.swapaxes(q, 1, 2) * jnp.asarray(scale, q.dtype)
+            out = jax.vmap(kernel)(qs, jnp.swapaxes(k, 1, 2),
+                                   jnp.swapaxes(v, 1, 2))
+            return jnp.swapaxes(out, 1, 2)
+        except (ImportError, TypeError, ValueError, NotImplementedError) as e:
+            # trace-time API/shape failures only; Mosaic compile errors
+            # surface after tracing and abort anyway. Warn once so a silent
+            # downgrade of the GQA fast path is visible in perf triage.
+            global _splash_warned
+            if not _splash_warned:
+                _splash_warned = True
+                import warnings
+
+                warnings.warn(
+                    f"splash GQA fast path unavailable ({type(e).__name__}: "
+                    f"{e}); falling back to the in-repo kernel pack")
     if _bundled_ok(sq, sk, hq, hk, dh):
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (

@@ -105,24 +105,16 @@ def _act_spec(mesh: Optional[Mesh], shape, *dims) -> Optional[NamedSharding]:
     axis names."""
     if mesh is None:
         return None
+    from ..parallel.mesh import divisible_prefix
+
     out = []
     for i, d in enumerate(dims):
         if d is None:
             out.append(None)
             continue
         names = (d,) if isinstance(d, str) else d
-        names = tuple(n for n in names if n in mesh.axis_names)
-        # keep the longest prefix whose PRODUCT divides the dim (partial
-        # sharding beats full replication on non-divisible dims)
-        kept = []
-        size = 1
-        for n in names:
-            if shape[i] % (size * int(mesh.shape[n])) == 0:
-                kept.append(n)
-                size *= int(mesh.shape[n])
-            else:
-                break
-        out.append(tuple(kept) if kept else None)
+        kept = divisible_prefix(mesh, shape[i], names)
+        out.append(kept if kept else None)
     return NamedSharding(mesh, P(*out))
 
 

@@ -25,6 +25,24 @@ HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
 # for model activation specs and the Ulysses shard_map specs
 BATCH_AXES = ("dp", "sharding")
 
+
+def divisible_prefix(mesh, dim: int, names) -> tuple:
+    """Longest prefix of `names` (those present in `mesh`) whose PRODUCT
+    divides `dim` — the one pruning rule behind activation sharding specs
+    (partial sharding beats full replication on non-divisible dims) and the
+    Ulysses shard_map in_specs, which must agree with them."""
+    kept = []
+    size = 1
+    for n in names:
+        if n not in mesh.axis_names:
+            continue
+        if dim % (size * int(mesh.shape[n])) == 0:
+            kept.append(n)
+            size *= int(mesh.shape[n])
+        else:
+            break
+    return tuple(kept)
+
 _global_mesh: Optional[Mesh] = None
 
 

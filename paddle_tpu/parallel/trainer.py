@@ -39,18 +39,8 @@ def batch_sharding(mesh: Mesh, shape, batch_spec=None) -> NamedSharding:
     for i in range(len(shape)):
         d = dims[i] if i < len(dims) else None
         names = (d,) if isinstance(d, str) else (d or ())
-        names = tuple(n for n in names if n in mesh.axis_names)
-        # keep the longest prefix of the axis group whose PRODUCT divides
-        # the dim (partial sharding beats full replication on uneven dims)
-        kept = []
-        size = 1
-        for n in names:
-            if shape[i] % (size * int(mesh.shape[n])) == 0:
-                kept.append(n)
-                size *= int(mesh.shape[n])
-            else:
-                break
-        spec.append(tuple(kept) if kept else None)
+        kept = mesh_mod.divisible_prefix(mesh, shape[i], names)
+        spec.append(kept if kept else None)
     return NamedSharding(mesh, P(*spec))
 
 

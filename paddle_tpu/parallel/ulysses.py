@@ -19,23 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import BATCH_AXES
-
-
-def _divisible_prefix(mesh: Mesh, dim: int, names) -> Tuple[str, ...]:
-    """Longest prefix of `names` (present in mesh) whose product divides
-    `dim` — same pruning rule as the model's activation specs."""
-    kept = []
-    size = 1
-    for n in names:
-        if n not in mesh.axis_names:
-            continue
-        if dim % (size * int(mesh.shape[n])) == 0:
-            kept.append(n)
-            size *= int(mesh.shape[n])
-        else:
-            break
-    return tuple(kept)
+from .mesh import BATCH_AXES, divisible_prefix as _divisible_prefix
 
 
 def _axes_size(mesh: Mesh, names) -> int:

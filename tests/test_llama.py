@@ -49,6 +49,29 @@ class TestFlashAttentionKernel:
             ref = jnp.swapaxes(ref.reshape(B, H, S, D), 1, 2)
             np.testing.assert_allclose(out, ref, atol=2e-5)
 
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="splash kernel is TPU-only")
+    def test_splash_gqa_matches_reference(self):
+        """The GQA fast path (splash) must match the jnp oracle on a
+        bench-shaped config."""
+        from paddle_tpu.kernels.flash_attention import (_fwd_ref,
+                                                        flash_attention)
+
+        rng = np.random.default_rng(2)
+        B, S, HQ, HK, D = 2, 1024, 8, 2, 128
+        q = jnp.asarray(rng.normal(size=(B, S, HQ, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, HK, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, HK, D)), jnp.float32)
+        out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))(
+            q, k, v)
+        qc = jnp.swapaxes(q, 1, 2).reshape(B * HQ, S, D)
+        kc = jnp.swapaxes(k, 1, 2).reshape(B * HK, S, D)
+        vc = jnp.swapaxes(v, 1, 2).reshape(B * HK, S, D)
+        ref = _fwd_ref(qc, kc, vc, True, 1.0 / np.sqrt(D))
+        ref = jnp.swapaxes(ref.reshape(B, HQ, S, D), 1, 2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2)
+
     def test_gradients_match_reference(self):
         from paddle_tpu.kernels.flash_attention import (_fwd_ref,
                                                         flash_attention)
