@@ -24,7 +24,8 @@ from ..core.tensor import Tensor, unwrap
 from ..core import tape as _tape
 from ..kernels.rope import rope_freqs
 from ..parallel import mesh as mesh_mod
-from ..parallel.pipeline_spmd import pipeline_forward, stack_stage_params
+from ..parallel.pipeline_spmd import (pipeline_1f1b, pipeline_forward,
+                                      stack_stage_params)
 from ..parallel.trainer import adamw_update, batch_sharding, \
     init_adamw_state
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
@@ -84,10 +85,20 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
                              mesh: Optional[Mesh] = None,
                              n_micro: Optional[int] = None,
                              lr: float = 1e-4, weight_decay: float = 0.01,
-                             grad_clip_norm: Optional[float] = 1.0):
+                             grad_clip_norm: Optional[float] = 1.0,
+                             schedule: str = "1F1B"):
     """Build (step_fn, params, opt_state) where params =
     {"outer": ..., "stages": ...} and step_fn runs embed -> pp pipeline of
-    decoder stages -> norm -> head -> CE loss -> AdamW, fully jitted."""
+    decoder stages -> norm -> head -> CE loss -> AdamW, fully jitted.
+
+    schedule (reference: pipeline_scheduler passes):
+      - "1F1B" (default): one-pass fwd+bwd schedule, loss inside the last
+        stage, activations bounded at ~2*n_stages microbatch inputs
+        (pipeline_spmd.pipeline_1f1b).
+      - "FThenB": forward pipeline + autodiff (GPipe memory profile).
+    """
+    if schedule not in ("1F1B", "FThenB"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     mesh = mesh or mesh_mod.get_global_mesh()
     cfg = model.config
     n_stages = int(mesh.shape["pp"]) if (mesh is not None
@@ -113,31 +124,70 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
                                               mesh=None))
         return h
 
+    def head_fn(hp, hidden, y_mb):
+        """Final norm + LM head + shifted-CE for one microbatch — the last
+        pipeline stage's tail (reference: shared embedding / LMHead stage
+        in fleet pp_layers)."""
+        from ..kernels.rms_norm import rms_norm as _k_rms
+
+        with _tape.no_grad():
+            hidden = _k_rms(hidden, hp["llama.norm.weight"],
+                            cfg.rms_norm_eps)
+            if cfg.tie_word_embeddings:
+                logits = hidden @ hp["llama.embed_tokens.weight"].T
+            else:
+                logits = hidden @ hp["lm_head.weight"]
+            loss = crit(Tensor(logits), Tensor(y_mb))
+        return unwrap(loss).astype(jnp.float32)
+
+    def embed(p, x):
+        with _tape.no_grad():
+            return unwrap(model.llama.embed_tokens.func_call(
+                {"weight": p["outer"]["llama.embed_tokens.weight"]},
+                Tensor(x)))
+
     def compute_loss(p, x, y):
+        hidden = embed(p, x)
+        hidden = pipeline_forward(stage_fn, p["stages"], hidden,
+                                  mesh=mesh, axis="pp", n_micro=n_micro)
+        return head_fn(p["outer"], hidden, y)
+
+    def loss_and_grads(p, x, y):
         if mesh is not None:
             x = jax.lax.with_sharding_constraint(
                 x, batch_sharding(mesh, x.shape, (("dp", "sharding"),)))
-        with _tape.no_grad():
-            hidden = unwrap(model.llama.embed_tokens.func_call(
-                {"weight": p["outer"]["llama.embed_tokens.weight"]},
-                Tensor(x)))
-        hidden = pipeline_forward(stage_fn, p["stages"], hidden,
-                                  mesh=mesh, axis="pp", n_micro=n_micro)
-        with _tape.no_grad():
-            from ..kernels.rms_norm import rms_norm as _k_rms
-
-            hidden = _k_rms(hidden, p["outer"]["llama.norm.weight"],
-                            cfg.rms_norm_eps)
-            if cfg.tie_word_embeddings:
-                logits = hidden @ p["outer"][
-                    "llama.embed_tokens.weight"].T
-            else:
-                logits = hidden @ p["outer"]["lm_head.weight"]
-            loss = crit(Tensor(logits), Tensor(y))
-        return unwrap(loss).astype(jnp.float32)
+        if schedule == "FThenB" or n_stages == 1:
+            return jax.value_and_grad(compute_loss)(p, x, y)
+        emb_w = p["outer"]["llama.embed_tokens.weight"]
+        # the manual scatter-add below implements plain-gather embedding
+        # semantics; a padding_idx would need its rows masked here
+        assert getattr(model.llama.embed_tokens, "_padding_idx", None) \
+            is None, "1F1B embed-grad closure assumes padding_idx=None"
+        hidden = embed(p, x)
+        # hand the pipeline only the params head_fn reads — every other
+        # outer leaf would be carried (and psummed) as an f32 zero
+        # accumulator through the whole scan
+        head_keys = {"llama.norm.weight"}
+        head_keys.add("llama.embed_tokens.weight"
+                      if cfg.tie_word_embeddings else "lm_head.weight")
+        head_p = {k: p["outer"][k] for k in head_keys}
+        loss, d_st, d_head, d_hid = pipeline_1f1b(
+            stage_fn, head_fn, p["stages"], head_p, hidden, y,
+            mesh=mesh, axis="pp", n_micro=n_micro)
+        # close the embedding lookup's gradient manually: d_emb[v] =
+        # sum of d_hidden rows where input token == v (+ the tied-head
+        # cotangent already present in d_head when tied)
+        d_emb = jnp.zeros(emb_w.shape, jnp.float32).at[
+            x.reshape(-1)].add(d_hid.reshape(-1, emb_w.shape[1]))
+        d_outer = {k: jnp.zeros_like(v) for k, v in p["outer"].items()}
+        d_outer.update(d_head)
+        d_outer["llama.embed_tokens.weight"] = (
+            d_outer["llama.embed_tokens.weight"]
+            + d_emb.astype(emb_w.dtype))
+        return loss, {"outer": d_outer, "stages": d_st}
 
     def step(p, s, x, y):
-        loss, grads = jax.value_and_grad(compute_loss)(p, x, y)
+        loss, grads = loss_and_grads(p, x, y)
         new_p, new_s = adamw_update(
             p, grads, s, jnp.asarray(lr, jnp.float32),
             weight_decay=weight_decay, grad_clip_norm=grad_clip_norm)

@@ -12,9 +12,9 @@ T = n_micro + n_stages - 1 ticks; each tick every stage processes one
 microbatch slot and the boundary activation moves to the next stage with
 `lax.ppermute` — the classic collective-permute pipeline from the public
 scaling playbook. Autodiff through scan+ppermute gives the backward
-schedule for free (fwd-then-bwd, GPipe-equivalent bubble profile; the
-1F1B/ZB memory refinements are schedule *passes* in the reference and are
-future work here).
+schedule for free (fwd-then-bwd, GPipe-equivalent bubble profile);
+`pipeline_1f1b` below implements the memory-bounded 1F1B schedule
+manually (one fwd + one bwd per tick, loss inside the last stage).
 
 Because everything is one XLA program, this composes with dp/mp/sharding
 axes of the same mesh: the non-pp axes partition the per-stage math.
@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_mod
 
-__all__ = ["pipeline_forward", "stack_stage_params", "unstack_stage_params"]
+__all__ = ["pipeline_forward", "pipeline_1f1b", "stack_stage_params",
+           "unstack_stage_params"]
 
 
 def _to_varying(x, axis):
@@ -145,3 +146,160 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, *,
         return jax.lax.psum(out * mask, axis)
 
     return run(stacked_params, x)
+
+
+def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
+                  head_params, x, labels, *, mesh: Optional[Mesh] = None,
+                  axis: str = "pp", n_micro: Optional[int] = None):
+    """One-pass fwd+bwd pipeline with the (eager-)1F1B memory profile.
+
+    Reference: fleet/meta_parallel/pipeline_parallel.py:459
+    forward_backward_pipeline (1F1B) and the pipeline_scheduler passes.
+    There the schedule is a list of p2p send/recv + fwd/bwd calls per rank;
+    here it is ONE scan under shard_map where every tick runs one stage
+    forward AND one stage backward:
+
+        fwd of microbatch i at stage s happens at tick  s + i
+        bwd of microbatch i at stage s happens at tick  2S - 1 - s + i
+
+    so the backward of microbatch 0 starts at tick S (while forwards of
+    later microbatches are still streaming in) and a stage holds at most
+    2S-1 in-flight microbatch INPUTS — the backward recomputes the stage
+    from its saved input (recompute is how the reference runs 1F1B at scale
+    too), so peak activation memory is O(n_stages * microbatch) instead of
+    the O(n_micro * stage_residuals) that autodiff-of-scan (GPipe) keeps.
+
+    stage_fn(stage_params, h) -> h
+    head_fn(head_params, h, labels_mb) -> scalar mean loss of the microbatch
+       (the last stage's norm/head/criterion — running the loss inside the
+       pipeline is what makes an early backward possible)
+
+    Returns (loss, d_stacked, d_head_params, d_x): mean loss over
+    microbatches and gradients w.r.t. the stacked stage params, the head
+    params, and the pipeline input activations.
+
+    Known cost: every rank evaluates head_fn's fwd+vjp each tick and keeps
+    the masked last-rank result, so head FLOPs scale by ~n_stages relative
+    to a once-per-microbatch head. Pass ONLY the params head_fn reads (each
+    leaf is carried as an f32 accumulator in the scan), and for
+    head-dominated configs (huge vocab, few layers) prefer
+    schedule="FThenB" or a cooperative vocab-parallel head (each rank
+    takes vocab/n_stages — requires all ranks to process the SAME
+    microbatch per tick, a different schedule).
+    """
+    mesh = mesh or mesh_mod.get_global_mesh()
+    n_stages = int(mesh.shape[axis]) if (
+        mesh is not None and axis in mesh.axis_names) else 1
+    if n_stages == 1:
+        n_all = jax.tree.leaves(stacked_params)[0].shape[0]
+
+        def full_loss(stacked, hp, xx):
+            h = xx
+            for i in range(n_all):
+                p_i = jax.tree.map(lambda t, i=i: t[i], stacked)
+                h = stage_fn(p_i, h)
+            return head_fn(hp, h, labels)
+
+        loss, (d_st, d_hp, d_x) = jax.value_and_grad(
+            full_loss, argnums=(0, 1, 2))(stacked_params, head_params, x)
+        return loss, d_st, d_hp, d_x
+
+    stacked_n = int(jax.tree.leaves(stacked_params)[0].shape[0])
+    if stacked_n != n_stages:
+        raise ValueError(
+            f"stacked stage dim {stacked_n} != pp axis size {n_stages}")
+    batch = x.shape[0]
+    n_micro = n_micro or n_stages
+    if batch % n_micro != 0:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    mb = batch // n_micro
+    buf_n = 2 * n_stages          # > max in-flight (2S-1): no slot reuse
+    inv_m = 1.0 / n_micro
+
+    @partial(jax.shard_map, mesh=mesh, axis_names={axis},
+             in_specs=(P(axis), P(), P(), P()),
+             out_specs=(P(), P(axis), P(), P()))
+    def run(params_local, head_p, xg, lbg):
+        p_stage = jax.tree.map(lambda t: t[0], params_local)
+        # make the replicated head params VARYING before differentiating:
+        # the cotangent of an unvaried input gets an automatic psum over
+        # the manual axis, which would leak every rank's (masked-garbage)
+        # head gradients into the last stage's accumulation
+        head_p = jax.tree.map(lambda a: _to_varying(a, axis), head_p)
+        sid = jax.lax.axis_index(axis)
+        is_first = sid == 0
+        is_last = sid == n_stages - 1
+        micro_x = xg.reshape((n_micro, mb) + xg.shape[1:])
+        micro_lb = lbg.reshape((n_micro, mb) + lbg.shape[1:])
+        t_total = n_micro + 2 * n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def masked_add(acc, g, active):
+            return jax.tree.map(
+                lambda a, gg: a + jnp.where(active, gg, 0).astype(a.dtype),
+                acc, g)
+
+        def tick(carry, t):
+            fwd_bnd, bwd_bnd, in_buf, dp, dhp, dx_buf, loss = carry
+
+            # ---- forward slot: stage `sid` forwards microbatch i_f ----
+            i_f = t - sid
+            act_f = (i_f >= 0) & (i_f < n_micro)
+            if_c = jnp.clip(i_f, 0, n_micro - 1)
+            x_in = jnp.where(is_first, micro_x[if_c], fwd_bnd)
+            y = stage_fn(p_stage, x_in)
+            y = jnp.where(act_f, y, jnp.zeros_like(y))
+            slot_f = if_c % buf_n
+            in_buf = in_buf.at[slot_f].set(
+                jnp.where(act_f, x_in, in_buf[slot_f]))
+
+            # ---- backward slot: stage `sid` backwards microbatch i_b ----
+            i_b = t - (2 * n_stages - 1 - sid)
+            act_b = (i_b >= 0) & (i_b < n_micro)
+            ib_c = jnp.clip(i_b, 0, n_micro - 1)
+            x_sv = in_buf[ib_c % buf_n]
+            y2, vjp_stage = jax.vjp(stage_fn, p_stage, x_sv)
+            lb_mb = micro_lb[ib_c]
+            loss_i, vjp_head = jax.vjp(
+                lambda hp, yy: head_fn(hp, yy, lb_mb), head_p, y2)
+            dhp_i, dy_head = vjp_head(
+                _to_varying(jnp.asarray(inv_m, loss_i.dtype), axis))
+            dy_in = jnp.where(is_last, dy_head.astype(bwd_bnd.dtype),
+                              bwd_bnd)
+            dp_i, dx = vjp_stage(dy_in)
+            dp = masked_add(dp, dp_i, act_b)
+            dhp = masked_add(dhp, dhp_i, act_b & is_last)
+            loss = loss + jnp.where(act_b & is_last,
+                                    loss_i.astype(loss.dtype) * inv_m, 0.0)
+            dx_buf = dx_buf.at[ib_c].set(
+                jnp.where(act_b & is_first, dx.astype(dx_buf.dtype),
+                          dx_buf[ib_c]))
+
+            # ---- boundary exchange for the next tick ----
+            fwd_bnd = jax.lax.ppermute(y, axis, fwd_perm)
+            bwd_bnd = jax.lax.ppermute(
+                jnp.where(act_b, dx, jnp.zeros_like(dx)), axis, bwd_perm)
+            return (fwd_bnd, bwd_bnd, in_buf, dp, dhp, dx_buf, loss), None
+
+        act_shape = (mb,) + xg.shape[1:]
+        vary = lambda z: _to_varying(z, axis)
+        carry0 = (
+            vary(jnp.zeros(act_shape, xg.dtype)),               # fwd_bnd
+            vary(jnp.zeros(act_shape, xg.dtype)),               # bwd_bnd
+            vary(jnp.zeros((buf_n,) + act_shape, xg.dtype)),    # in_buf
+            jax.tree.map(
+                lambda a: vary(jnp.zeros(a.shape, jnp.float32)), p_stage),
+            jax.tree.map(
+                lambda a: vary(jnp.zeros(a.shape, jnp.float32)), head_p),
+            vary(jnp.zeros((n_micro,) + act_shape, jnp.float32)),  # dx
+            vary(jnp.zeros((), jnp.float32)),                   # loss
+        )
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(t_total))
+        _, _, _, dp, dhp, dx_buf, loss = carry
+        d_stacked = jax.tree.map(lambda a: a[None], dp)
+        d_head = jax.tree.map(lambda a: jax.lax.psum(a, axis), dhp)
+        d_x = jax.lax.psum(dx_buf, axis).reshape((batch,) + xg.shape[1:])
+        return jax.lax.psum(loss, axis), d_stacked, d_head, d_x
+
+    return run(stacked_params, head_params, x, labels)
