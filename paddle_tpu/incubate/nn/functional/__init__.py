@@ -383,19 +383,29 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             linear_weights, linear_biases, ffn_ln_scales,
                             ffn_ln_biases, ffn1_weights, ffn1_biases,
                             ffn2_weights, ffn2_biases, pre_layer_norm=True,
-                            epsilon=1e-05, cache_kvs=None, attn_mask=None,
-                            dropout_rate=0.0, activation="gelu",
-                            training=False, mode=None, trans_qkvw=True,
-                            ring_id=-1, name=None):
+                            epsilon=1e-05, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False, mode=None,
+                            trans_qkvw=True, ring_id=-1, name=None):
     """reference: fused_transformer.py fused_multi_transformer functional
-    — stacked blocks over per-layer weight lists. Cached incremental
-    decode goes through the FusedMultiTransformer layer (decode_step) or
-    masked_multihead_attention directly."""
+    — stacked blocks over per-layer weight lists.
+
+    With `cache_kvs` (per-layer [2, B, H, max_seq, D]) the call runs the
+    cached serving path: `time_step=None` is prefill (tokens written at
+    positions [0, S)), an int `time_step` is incremental decode (tokens
+    written at [time_step, time_step+S), attending over everything
+    cached). Being functional, updated caches are RETURNED —
+    (out, new_cache_kvs) — instead of mutated in place like the
+    reference's static-graph op. Single-token decode rides the Pallas
+    decode kernel on TPU (kernels/decode_attention.py, the analog of
+    masked_multihead_attention_kernel.cu)."""
     if cache_kvs is not None:
-        raise NotImplementedError(
-            "functional fused_multi_transformer does not implement cached "
-            "decode; use incubate.nn.FusedMultiTransformer(caches=...) or "
-            "masked_multihead_attention")
+        return _fused_multi_transformer_cached(
+            x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+            linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
+            ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+            cache_kvs, time_step, attn_mask, pre_layer_norm, epsilon,
+            activation, trans_qkvw)
     h = x
     for i in range(len(qkv_weights)):
         h = fused_multi_head_attention(
@@ -417,6 +427,129 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             activation=activation, pre_layer_norm=pre_layer_norm,
             training=training)
     return h
+
+
+def _fused_multi_transformer_cached(x, ln_scales, ln_biases, qkv_weights,
+                                    qkv_biases, linear_weights,
+                                    linear_biases, ffn_ln_scales,
+                                    ffn_ln_biases, ffn1_weights, ffn1_biases,
+                                    ffn2_weights, ffn2_biases, cache_kvs,
+                                    time_step, attn_mask, pre_layer_norm,
+                                    epsilon, activation, trans_qkvw):
+    """Prefill/decode over contiguous per-layer KV caches (see
+    fused_multi_transformer docstring)."""
+    from ....core.tensor import unwrap
+
+    def arr(v):
+        return unwrap(v) if isinstance(v, Tensor) else jnp.asarray(v)
+
+    act = {"relu": _NF.relu, "gelu": _NF.gelu}[activation]
+    xa = arr(x)
+    b, s, e = xa.shape
+    # a Python-int (or None) time_step keeps static shapes so prefill can
+    # slice the cache; a Tensor/traced time_step stays traced (jit-able
+    # serving step, reference passes a Tensor) and masks the full cache
+    if time_step is None:
+        offset, offset_static = 0, True
+    else:
+        off_raw = arr(time_step) if isinstance(time_step, Tensor) \
+            else time_step
+        if isinstance(off_raw, int):
+            offset, offset_static = off_raw, True
+        else:
+            offset = jnp.reshape(off_raw, ()).astype(jnp.int32)
+            offset_static = False
+    mask_a = arr(attn_mask) if attn_mask is not None else None
+
+    h = xa
+    new_caches = []
+    for i in range(len(qkv_weights)):
+        residual = h
+        if pre_layer_norm:
+            ln = arr(_NF.layer_norm(Tensor(h), (e,), weight=ln_scales[i],
+                                    bias=ln_biases[i], epsilon=epsilon))
+        else:
+            ln = h
+        qkv_w = arr(qkv_weights[i])
+        if trans_qkvw:                       # [3, H, D, E]
+            qkv = jnp.einsum("bse,nhde->nbshd", ln, qkv_w)
+        else:                                # [E, 3, H, D]
+            qkv = jnp.einsum("bse,enhd->nbshd", ln, qkv_w)
+        nh, hd = qkv.shape[3], qkv.shape[4]
+        if qkv_biases and qkv_biases[i] is not None:
+            qkv = qkv + arr(qkv_biases[i]).reshape(3, nh, hd)[:, None, None]
+        q, k, v = qkv[0], qkv[1], qkv[2]     # [B, S, H, D]
+
+        cache = arr(cache_kvs[i])            # [2, B, H, max_seq, D]
+        max_seq = cache.shape[3]
+        k_t = jnp.swapaxes(k, 1, 2)          # [B, H, S, D]
+        v_t = jnp.swapaxes(v, 1, 2)
+        new_k = jax.lax.dynamic_update_slice(
+            cache[0], k_t.astype(cache.dtype), (0, 0, offset, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            cache[1], v_t.astype(cache.dtype), (0, 0, offset, 0))
+        new_caches.append(Tensor(jnp.stack([new_k, new_v])))
+
+        from ....kernels.decode_attention import _on_tpu, decode_attention
+
+        use_kernel = (s == 1 and mask_a is None and _on_tpu()
+                      and max_seq % min(512, max_seq) == 0)
+        if use_kernel:
+            # single-token decode: one fused pass over the cache
+            lens = jnp.full((b,), offset, jnp.int32)
+            ctx = decode_attention(q[:, 0].astype(new_k.dtype), new_k,
+                                   new_v, lens)[:, None]  # [B, 1, H, D]
+            ctx = ctx.astype(h.dtype)
+        else:
+            # slice the cache only when the offset is static; a traced
+            # offset masks the full cache instead (shapes stay static)
+            lim = offset + s if offset_static else max_seq
+            scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            logits = jnp.einsum(
+                "bshd,bhtd->bhst", q.astype(jnp.float32),
+                new_k[:, :, :lim].astype(jnp.float32)) * scale
+            qpos = offset + jnp.arange(s)
+            causal = jnp.arange(lim)[None, :] <= qpos[:, None]
+            logits = jnp.where(causal[None, None], logits, -1e30)
+            if mask_a is not None:
+                m = mask_a.astype(jnp.float32)
+                logits = logits + m[..., :lim]
+            probs = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("bhst,bhtd->bshd", probs,
+                             new_v[:, :, :lim].astype(jnp.float32))
+            ctx = ctx.astype(h.dtype)
+        out = ctx.reshape(b, s, nh * hd) @ arr(linear_weights[i])
+        if linear_biases and linear_biases[i] is not None:
+            out = out + arr(linear_biases[i])
+        if pre_layer_norm:
+            h = residual + out
+        else:
+            # post-norm: LN(residual + attn_out), reference
+            # fused_multi_head_attention normalize_before=False semantics
+            h = arr(_NF.layer_norm(Tensor(residual + out), (e,),
+                                   weight=ln_scales[i], bias=ln_biases[i],
+                                   epsilon=epsilon))
+
+        residual = h
+        f_in = h
+        if pre_layer_norm:
+            f_in = arr(_NF.layer_norm(
+                Tensor(h), (e,), weight=ffn_ln_scales[i],
+                bias=ffn_ln_biases[i], epsilon=epsilon))
+        f = f_in @ arr(ffn1_weights[i])
+        if ffn1_biases and ffn1_biases[i] is not None:
+            f = f + arr(ffn1_biases[i])
+        f = arr(act(Tensor(f)))
+        f = f @ arr(ffn2_weights[i])
+        if ffn2_biases and ffn2_biases[i] is not None:
+            f = f + arr(ffn2_biases[i])
+        if pre_layer_norm:
+            h = residual + f
+        else:
+            h = arr(_NF.layer_norm(Tensor(residual + f), (e,),
+                                   weight=ffn_ln_scales[i],
+                                   bias=ffn_ln_biases[i], epsilon=epsilon))
+    return Tensor(h), new_caches
 
 
 __all__ += ["fused_matmul_bias", "fused_dropout_add",

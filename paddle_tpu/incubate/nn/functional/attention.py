@@ -102,17 +102,26 @@ def masked_multihead_attention(
             v[:, :, None, :] * onehot[:, None, :, None]
         new_cache = jnp.stack([upd_k, upd_v])
 
-        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
-                            upd_k.astype(jnp.float32)) * scale
-        valid = jnp.arange(max_seq)[None, :] <= pos[:, None]  # [B, S]
-        logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
-        if mask is not None:
-            m = mask.reshape(b, 1, -1)[..., :max_seq]
-            logits = logits + m.astype(jnp.float32)
-        probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bhs,bhsd->bhd", probs,
-                         upd_v.astype(jnp.float32))
+        from ....kernels.decode_attention import _on_tpu, decode_attention
+
+        if mask is None and _on_tpu() and \
+                max_seq % min(512, max_seq) == 0:
+            # fused one-pass decode kernel (the analog of the reference's
+            # masked_multihead_attention_kernel.cu)
+            out = decode_attention(q.astype(upd_k.dtype), upd_k, upd_v,
+                                   pos)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+            logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                                upd_k.astype(jnp.float32)) * scale
+            valid = jnp.arange(max_seq)[None, :] <= pos[:, None]  # [B, S]
+            logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
+            if mask is not None:
+                m = mask.reshape(b, 1, -1)[..., :max_seq]
+                logits = logits + m.astype(jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhs,bhsd->bhd", probs,
+                             upd_v.astype(jnp.float32))
         out = out.astype(xa.dtype).reshape(b, h * d)
         return out, new_cache
 

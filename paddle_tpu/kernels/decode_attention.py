@@ -1,0 +1,212 @@
+"""Single-token decode attention as Pallas TPU kernels.
+
+TPU-native counterpart of the reference's serving decode kernels
+(paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu for the
+contiguous cache, block_attn.h for the paged cache). Decode is
+bandwidth-bound: the whole KV cache streams through once per token, so the
+win is fusing mask + online softmax + weighted sum into one pass instead of
+XLA's materialized [B, H, S] logits round-trip.
+
+Layouts match the incubate serving API:
+  contiguous: cache [B, H, max_seq, D], q [B, H, D], lens [B]
+  paged:      cache [max_pages, H, block_size, D], block_tables [B, n_blk]
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, block_s: int, scale: float):
+    """Grid (B, S // block_s). Blocks: q [H, D], k/v [H, block_s, D].
+    Online softmax over seq blocks; rows masked at positions > len."""
+    b = pl.program_id(0)
+    si = pl.program_id(1)
+    ns = pl.num_programs(1)
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # this step's token sits at position len; positions > len are invalid
+    valid_until = len_ref[b]
+
+    @pl.when(si * block_s <= valid_until)
+    def _compute():
+        q = q_ref[0]                                   # [H, D]
+        k = k_ref[0]                                   # [H, block_s, D]
+        # decode is bandwidth-bound (intensity ~1): VPU mul+reduce, not
+        # MXU (Mosaic also cannot lower a batched matvec dot_general)
+        s = jnp.sum(q[:, None, :].astype(jnp.float32)
+                    * k.astype(jnp.float32), axis=-1) * scale  # [H, block_s]
+        pos = si * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= valid_until, s, _NEG_INF)
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.sum(p[:, :, None] * v_ref[0].astype(jnp.float32),
+                     axis=1)                           # [H, D]
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == ns - 1)
+    def _final():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     lens: jax.Array, *, block_s: int = 512,
+                     scale: float | None = None) -> jax.Array:
+    """One decode step over a contiguous cache.
+
+    q: [B, H, D] (the current token's queries, k/v already written to the
+    cache at position lens[b]); k_cache/v_cache: [B, H, max_seq, D];
+    lens: [B] int32, number of PREVIOUS tokens (the current token is at
+    position lens[b]). Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    max_seq = k_cache.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    block_s = min(block_s, max_seq)
+    if max_seq % block_s:
+        raise ValueError(f"max_seq {max_seq} % block_s {block_s} != 0")
+    grid = (b, max_seq // block_s)
+    kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, h, d), lambda b, j, lens: (b, 0, 0)),
+                pl.BlockSpec((1, h, block_s, d),
+                             lambda b, j, lens: (b, 0, j, 0)),
+                pl.BlockSpec((1, h, block_s, d),
+                             lambda b, j, lens: (b, 0, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, d), lambda b, j, lens: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, 128), jnp.float32),
+                pltpu.VMEM((h, 128), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(lens.astype(jnp.int32), q, k_cache, v_cache)
+
+
+def _paged_decode_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, block_size: int,
+                         scale: float):
+    """Grid (B, n_blocks_per_seq). k/v blocks are whole PAGES selected via
+    the block-table scalar prefetch; otherwise identical online softmax."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    valid_until = len_ref[b]
+
+    @pl.when(j * block_size <= valid_until)
+    def _compute():
+        q = q_ref[0]                                   # [H, D]
+        k = k_ref[0]                                   # [H, block_size, D]
+        s = jnp.sum(q[:, None, :].astype(jnp.float32)
+                    * k.astype(jnp.float32), axis=-1) * scale
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= valid_until, s, _NEG_INF)
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.sum(p[:, :, None] * v_ref[0].astype(jnp.float32),
+                     axis=1)
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nb - 1)
+    def _final():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
+                           value_cache: jax.Array, block_tables: jax.Array,
+                           lens: jax.Array,
+                           scale: float | None = None) -> jax.Array:
+    """One decode step over a paged cache (reference: block_attn.h).
+
+    q: [B, H, D]; key_cache/value_cache: [max_pages, H, block_size, D];
+    block_tables: [B, n_blocks] page ids covering positions
+    [0, n_blocks*block_size); lens: [B] previous-token counts (current
+    token already written at position lens[b]). Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    block_size = key_cache.shape[2]
+    n_blocks = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_paged_decode_kernel, block_size=block_size,
+                               scale=scale)
+    # page selection: the k/v BlockSpec index maps read the prefetched
+    # block table — each grid step streams exactly one page of one sequence
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, h, d), lambda b, j, tbl, lens: (b, 0, 0)),
+                pl.BlockSpec((1, h, block_size, d),
+                             lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+                pl.BlockSpec((1, h, block_size, d),
+                             lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, h, d), lambda b, j, tbl, lens: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, 128), jnp.float32),
+                pltpu.VMEM((h, 128), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),
+      q, key_cache, value_cache)
