@@ -90,10 +90,21 @@ def save_state_dict(state_dict: Dict, path: str,
             })
         meta[name] = entry
     np.savez(os.path.join(path, f"{rank}.npz"), **payload)
+    # every process writes metadata for ITS addressable shards; the loader
+    # merges the per-rank metas (a coordinator cannot describe shards it
+    # does not own in true multi-host — reference: each worker writing its
+    # own local_state_dict in save_state_dict.py:94). The world size tags
+    # each meta so a re-save into the same directory from a SMALLER world
+    # (elastic rescale) does not leave stale higher-rank metas to be
+    # merged with current data.
+    try:
+        world = jax.process_count()
+    except Exception:
+        world = 1
+    with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
+        json.dump({"world": world, "entries": meta}, f)
     if rank == coordinator_rank:
-        # single-controller: this process sees every addressable shard; in
-        # multi-host each process writes its own npz and the coordinator
-        # merges metadata via the jax global view (same offsets).
+        # legacy single-file metadata kept for single-process checkpoints
         with open(os.path.join(path, _META), "w") as f:
             json.dump(meta, f)
 
@@ -105,8 +116,40 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     resharding to each tensor's CURRENT sharding (reference:
     load_state_dict.py:394 — overlap computation between saved and target
     shards)."""
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
+    import glob as _glob
+
+    by_rank = {}
+    for fn in sorted(_glob.glob(os.path.join(path, "meta.*.json"))):
+        r = int(os.path.basename(fn).split(".")[1])
+        with open(fn) as f:
+            by_rank[r] = json.load(f)
+    if by_rank:
+        if isinstance(by_rank.get(0), dict) and "entries" in by_rank.get(
+                0, {}):
+            # world-tagged metas: only ranks of the LATEST save generation
+            # (rank < world recorded by rank 0, same world tag) are valid;
+            # higher-rank files are stale leftovers of a larger world
+            world = by_rank[0]["world"]
+            metas = [m["entries"] for r, m in sorted(by_rank.items())
+                     if r < world and isinstance(m, dict)
+                     and m.get("world") == world]
+        else:  # untagged per-rank metas (transitional)
+            metas = [m for _, m in sorted(by_rank.items())]
+    else:  # legacy checkpoints: coordinator-only metadata
+        with open(os.path.join(path, _META)) as f:
+            metas = [json.load(f)]
+    # merge per-rank metadata: union of chunks, deduped by offset
+    meta: Dict[str, dict] = {}
+    for m in metas:
+        for name, entry in m.items():
+            if name not in meta:
+                meta[name] = {"shape": entry["shape"],
+                              "dtype": entry["dtype"], "chunks": []}
+            seen = {tuple(c["offset"]) for c in meta[name]["chunks"]}
+            for ch in entry["chunks"]:
+                if tuple(ch["offset"]) not in seen:
+                    seen.add(tuple(ch["offset"]))
+                    meta[name]["chunks"].append(ch)
     files = {}
 
     def _file(fn):

@@ -15,11 +15,15 @@ mocked-etcd unit strategy (test_fleet_elastic_manager.py).
 """
 from __future__ import annotations
 
+import json
+import os
+import tempfile
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+from urllib.parse import quote, unquote
 
-__all__ = ["ElasticManager", "ElasticStatus", "DictStore"]
+__all__ = ["ElasticManager", "ElasticStatus", "DictStore", "FileStore"]
 
 
 class ElasticStatus:
@@ -68,6 +72,64 @@ class DictStore:
             return out
 
 
+class FileStore:
+    """File-backed KV store with TTL, shared ACROSS PROCESSES through a
+    directory (the etcd stand-in the launcher's elastic path uses;
+    reference: ElasticManager's etcd registry, manager.py:124). One file
+    per key (name URL-quoted), values written atomically via
+    tempfile+rename so concurrent readers never see partial writes."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, quote(key, safe="") + ".json")
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None):
+        payload = {"v": value, "exp": time.time() + ttl if ttl else None}
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(key))
+
+    def _read(self, path: str):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if payload["exp"] is not None and payload["exp"] < time.time():
+            # do NOT unlink: between our read and an unlink the owner may
+            # have atomically renewed the file, and we would delete the
+            # fresh heartbeat (spurious membership flap). Expired files
+            # are simply skipped; the owner's delete() cleans up.
+            return None
+        return payload["v"]
+
+    def get(self, key: str):
+        return self._read(self._path(key))
+
+    def delete(self, key: str):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def prefix(self, pre: str) -> Dict[str, str]:
+        out = {}
+        for fn in os.listdir(self.root):
+            if not fn.endswith(".json"):
+                continue
+            key = unquote(fn[:-len(".json")])
+            if not key.startswith(pre):
+                continue
+            v = self._read(os.path.join(self.root, fn))
+            if v is not None:
+                out[key] = v
+        return out
+
+
 class ElasticManager:
     """reference: ElasticManager(manager.py:124)."""
 
@@ -104,7 +166,10 @@ class ElasticManager:
 
     def watch(self, poll_interval: float = 1.0):
         """Watch membership; trigger on_change / need_restart on deltas
-        (reference: manager.py :247,308)."""
+        (reference: manager.py :247,308). The baseline membership is
+        snapshotted BEFORE this returns, so any change after the call is
+        guaranteed to be observed (no thread-startup race)."""
+        self._last_members = self.members()
         t = threading.Thread(target=self._watch_loop,
                              args=(poll_interval,), daemon=True)
         t.start()
@@ -112,7 +177,6 @@ class ElasticManager:
         return self
 
     def _watch_loop(self, interval):
-        self._last_members = self.members()
         while not self._stop.is_set():
             cur = self.members()
             if cur != self._last_members:

@@ -68,8 +68,21 @@ def init_distributed(coordinator_address=None, num_processes=None,
             return  # already initialized
     except Exception:
         pass
-    if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):
+    addr = (coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if addr:
+        env_np = os.environ.get("JAX_NUM_PROCESSES") or None
+        env_pid = os.environ.get("JAX_PROCESS_ID") or None
+        if num_processes is None and env_np:
+            num_processes = int(env_np)
+        if process_id is None and env_pid:
+            process_id = int(env_pid)
+        if (num_processes is None) != (process_id is None):
+            raise ValueError(
+                "init_distributed needs BOTH num_processes and process_id "
+                "(args or JAX_NUM_PROCESSES/JAX_PROCESS_ID env), or "
+                f"neither; got num_processes={num_processes} "
+                f"process_id={process_id}")
         jax.distributed.initialize(
-            coordinator_address=coordinator_address
-            or os.environ.get("COORDINATOR_ADDRESS"),
+            coordinator_address=addr,
             num_processes=num_processes, process_id=process_id)

@@ -37,6 +37,15 @@ def _parse(argv):
     p.add_argument("--devices", default=None,
                    help="accepted for API parity (device visibility is the "
                         "TPU runtime's job)")
+    p.add_argument("--elastic_store", default=None,
+                   help="shared FileStore directory enabling elastic "
+                        "membership (etcd stand-in; reference: "
+                        "--elastic_server)")
+    p.add_argument("--job_id", default="default",
+                   help="elastic job id (membership namespace)")
+    p.add_argument("--host_id", default=None,
+                   help="this node's registration name (default: "
+                        "node-{rank})")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -69,12 +78,17 @@ class _Supervisor:
     ElasticManager signal kill, fleet/elastic/manager.py:66-83)."""
 
     def __init__(self, cmd: List[str], envs: List[dict],
-                 log_dir: Optional[str], max_restart: int):
+                 log_dir: Optional[str], max_restart: int,
+                 elastic=None, rebuild_envs=None):
         self.cmd = cmd
         self.envs = envs
         self.log_dir = log_dir
         self.max_restart = max_restart
         self.procs: List[subprocess.Popen] = []
+        # elastic: an ElasticManager watching membership; rebuild_envs maps
+        # the new member list to fresh worker envs (re-ranked world)
+        self.elastic = elastic
+        self.rebuild_envs = rebuild_envs
 
     def _spawn(self):
         self.procs = []
@@ -103,7 +117,11 @@ class _Supervisor:
         while True:
             self._spawn()
             failed = None
-            while failed is None:
+            rescale = False
+            while failed is None and not rescale:
+                if self.elastic is not None and self.elastic.need_restart:
+                    rescale = True
+                    break
                 alive = 0
                 for p in self.procs:
                     rc = p.poll()
@@ -116,6 +134,29 @@ class _Supervisor:
                     return 0  # clean exit everywhere
                 time.sleep(0.2)
             self._kill_all()
+            if rescale:
+                # membership change: re-rank and respawn with the new
+                # world (does not count against max_restart; reference:
+                # manager.py watch -> signal kill -> launcher relaunch).
+                # Wait for membership to SETTLE (unchanged for a window)
+                # and for min_np quorum before respawning — this is
+                # best-effort convergence, not consensus: nodes observing
+                # different snapshots at the same instant is still
+                # possible on a slow shared store (the reference's etcd
+                # watch has the same property).
+                self.elastic.need_restart = False
+                members = self.elastic.members()
+                while True:
+                    time.sleep(1.0)
+                    cur = self.elastic.members()
+                    if cur == members and len(cur) >= self.elastic.min_np:
+                        break
+                    members = cur
+                self.elastic.need_restart = False
+                self.envs = self.rebuild_envs(members)
+                print(f"[launch] elastic rescale -> members={members}",
+                      file=sys.stderr)
+                continue
             restarts += 1
             if restarts > self.max_restart:
                 return failed
@@ -134,7 +175,39 @@ def launch(argv=None) -> int:
                     lr, total)
         for lr in range(args.nproc_per_node)
     ]
-    return _Supervisor(cmd, envs, args.log_dir, args.max_restart).run()
+    elastic = rebuild = None
+    if args.elastic_store:
+        from ..elastic import ElasticManager, FileStore
+
+        parts = str(args.nnodes).split(":")
+        np_range = (int(parts[0]), int(parts[-1]))
+        host_id = args.host_id or f"node-{args.rank}"
+        elastic = ElasticManager(
+            FileStore(args.elastic_store), job_id=args.job_id,
+            np_range=np_range, host=host_id).register().watch(
+                poll_interval=0.5)
+
+        def rebuild(members):
+            if host_id not in members:
+                # our own heartbeat lapsed (stall / slow shared fs):
+                # re-register instead of crashing — this node is healthy
+                elastic.store.put(elastic._prefix + host_id, "alive",
+                                  ttl=elastic.ttl)
+                members = sorted(set(members) | {host_id})
+            node_rank = members.index(host_id)
+            new_total = len(members) * args.nproc_per_node
+            return [
+                _worker_env(os.environ, master, args.nproc_per_node,
+                            node_rank, lr, new_total)
+                for lr in range(args.nproc_per_node)
+            ]
+
+    try:
+        return _Supervisor(cmd, envs, args.log_dir, args.max_restart,
+                           elastic=elastic, rebuild_envs=rebuild).run()
+    finally:
+        if elastic is not None:
+            elastic.exit()
 
 
 if __name__ == "__main__":

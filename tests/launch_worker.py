@@ -1,0 +1,72 @@
+"""Two-process multi-host smoke worker (driven by test_launch.py through
+parallel.launch — reference strategy: test/collective launching real
+worker processes, launch/controllers/master.py:73).
+
+Each process: jax.distributed.initialize against the peer (CPU backend),
+one cross-process sharded reduction, and a sharded checkpoint save +
+reshard-on-load across the process boundary. Writes ok-marker files the
+test asserts on.
+"""
+import os
+import sys
+
+import jax
+
+# the launcher sets JAX_PLATFORMS=cpu for emulated multi-host, but the env
+# var alone can be overridden by site config — jax.config wins
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main(out_dir: str) -> None:
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    assert nproc == 2, f"expected 2 processes, got {nproc}"
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    # --- cross-process psum: each process contributes rank+1 ---
+    from jax.experimental import multihost_utils
+
+    local = np.full((1, 4), rank + 1, np.float32)
+    garr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, P("dp"))
+    total = jax.jit(
+        jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
+    expected = 4 * (1 + 2)
+    assert float(total) == expected, f"psum {float(total)} != {expected}"
+    with open(os.path.join(out_dir, f"psum_ok.{rank}"), "w") as f:
+        f.write(str(float(total)))
+
+    # --- sharded checkpoint across the process boundary ---
+    from paddle_tpu.parallel.checkpoint import (load_state_dict,
+                                                save_state_dict)
+
+    val = np.arange(8, dtype=np.float32).reshape(2, 4)
+    gval = multihost_utils.host_local_array_to_global_array(
+        val[rank:rank + 1], mesh, P("dp"))
+    ckpt = os.path.join(out_dir, "ckpt")
+    save_state_dict({"w": gval}, ckpt)
+    multihost_utils.sync_global_devices("ckpt_saved")
+
+    # load into a REPLICATED target: needs both ranks' chunks
+    target = jnp.zeros((2, 4), jnp.float32)
+    target = jax.device_put(target, NamedSharding(mesh, P()))
+    state = {"w": target}
+    load_state_dict(state, ckpt)
+    got = np.asarray(state["w"])
+    np.testing.assert_array_equal(got, val)
+    with open(os.path.join(out_dir, f"ckpt_ok.{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
