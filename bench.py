@@ -18,6 +18,109 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _op_bench():
+    """Per-op latency table (reference: tools/ci_op_benchmark.sh +
+    check_op_benchmark_result.py — the regression gate over op kernels).
+    Each op loops inside ONE jitted call (per-dispatch tunnel latency would
+    otherwise dominate); results land in OPBENCH.json and regress >10%
+    against the previous run's numbers with a stderr warning."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    ops = {}
+
+    ITERS = 30
+
+    def timed(name, make_fn, iters=ITERS):
+        # the loop AND the final scalar reduction live inside one jitted
+        # call: one tunnel dispatch, one 4-byte fetch (an eager post-hoc
+        # jnp.sum would itself be a ~35 ms tunneled op)
+        f = jax.jit(make_fn())
+        float(f())
+        t0 = time.perf_counter()
+        float(f())
+        ops[name] = round((time.perf_counter() - t0) / iters * 1e3, 4)
+
+    def chain(body, x0, iters=ITERS):
+        def run():
+            out = jax.lax.fori_loop(0, iters, lambda i, x: body(x), x0)
+            return jnp.sum(out.astype(jnp.float32))
+        return run
+
+    # matmul 4096^3 bf16 (MXU headline)
+    a = jnp.asarray(rng.normal(size=(4096, 4096)), jnp.bfloat16)
+    timed("matmul_4096_bf16", lambda: chain(lambda x: (x @ a), a))
+
+    # flash attention fwd and fwd+bwd on the bench GQA shape
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    B, S, HQ, HK, D = 8, 2048, 16, 4, 128
+    q = jnp.asarray(rng.normal(size=(B, S, HQ, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, S, HK, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, S, HK, D)), jnp.bfloat16)
+    timed("flash_attn_fwd_gqa", lambda: chain(
+        lambda x: flash_attention(x, k, v, causal=True), q))
+
+    def fa_grad(x):
+        return jax.grad(lambda qq: jnp.sum(
+            flash_attention(qq, k, v, causal=True).astype(jnp.float32)))(x)
+
+    timed("flash_attn_fwdbwd_gqa", lambda: chain(fa_grad, q))
+
+    # rms_norm on the model's hidden shape
+    from paddle_tpu.kernels.rms_norm import rms_norm
+
+    h = jnp.asarray(rng.normal(size=(8, 2048, 2048)), jnp.bfloat16)
+    w = jnp.ones((2048,), jnp.bfloat16)
+    timed("rms_norm", lambda: chain(lambda x: rms_norm(x, w, 1e-6), h))
+
+    # single-token decode attention over a full cache
+    from paddle_tpu.kernels.decode_attention import decode_attention
+
+    kc = jnp.asarray(rng.normal(size=(B, HQ, S, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(B, HQ, S, D)), jnp.bfloat16)
+    lens = jnp.full((B,), S - 1, jnp.int32)
+    qd = jnp.asarray(rng.normal(size=(B, HQ, D)), jnp.bfloat16)
+    timed("decode_attention", lambda: chain(
+        lambda x: decode_attention(x, kc, vc, lens), qd))
+
+    # all_reduce across the visible devices (1 chip: measures the floor)
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh1 = Mesh(np.array(jax.devices()), ("i",))
+    # out_specs P("i") keeps the global carry shape stable on n>1 devices
+    # (P() would shrink it to one shard's worth and break the fori_loop)
+    psum = jax.shard_map(lambda x: jax.lax.psum(x, "i"), mesh=mesh1,
+                         in_specs=P("i"), out_specs=P("i"))
+    g = jnp.asarray(rng.normal(size=(1024, 1024)), jnp.float32)
+    timed("all_reduce_4mb", lambda: chain(psum, g))
+    return ops
+
+
+def _op_regressions(ops, path="OPBENCH.json", threshold=0.10):
+    prev = None
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev = json.load(f).get("ops")
+        except Exception:
+            prev = None
+    warned = []
+    if prev:
+        for name, ms in ops.items():
+            old = prev.get(name)
+            if old and ms > old * (1 + threshold):
+                warned.append(f"{name}: {old:.3f} -> {ms:.3f} ms "
+                              f"(+{(ms / old - 1) * 100:.0f}%)")
+    with open(path, "w") as f:
+        json.dump({"ops": ops, "prev": prev}, f, indent=1)
+    if warned:
+        import sys
+        print("OP REGRESSION WARNING (>10% vs previous run):\n  "
+              + "\n  ".join(warned), file=sys.stderr)
+    return warned
+
+
 def main():
     import paddle_tpu as paddle
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
@@ -83,6 +186,16 @@ def main():
     kind = jax.devices()[0].device_kind.lower()
     peak = 918e12 if "v6" in kind else 197e12
     mfu = achieved / (peak * n_dev) if on_tpu else 0.0
+
+    if on_tpu:
+        # per-op regression gate (stderr + OPBENCH.json; stdout stays the
+        # single JSON line the driver parses)
+        try:
+            _op_regressions(_op_bench())
+        except Exception as e:
+            import sys
+            print(f"op bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec",

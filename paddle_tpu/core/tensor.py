@@ -246,6 +246,11 @@ def unwrap(x):
     return x._array if isinstance(x, Tensor) else x
 
 
+# active static Program capture (set by paddle_tpu.static.program_guard);
+# a 1-slot list so the static module can flip it without an import cycle
+_static_capture = [None]
+
+
 def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Optional[int] = None):
     """Run `fn(*arrays)` where `tensor_args` may contain Tensors, arrays or
     None. Records a TapeNode when grad is required.
@@ -282,6 +287,9 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
     if not need_grad:
         out = fn(*arrs)
         _maybe_check_nan_inf(name, out)
+        if _static_capture[0] is not None:
+            _static_capture[0]._record(
+                fn, arrs, out if isinstance(out, (tuple, list)) else (out,))
         return _wrap_outputs(out, None)
 
     diff_idx = [
@@ -298,6 +306,9 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
 
     out, vjp_fn = jax.vjp(g, *[arrs[i] for i in diff_idx])
     _maybe_check_nan_inf(name, out)
+    if _static_capture[0] is not None:
+        _static_capture[0]._record(
+            fn, arrs, out if isinstance(out, (tuple, list)) else (out,))
     node = _tape.TapeNode(name, vjp_fn, [tensor_args[i] for i in diff_idx], 1)
     return _wrap_outputs(out, node)
 

@@ -96,7 +96,17 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
         stage, activations bounded at ~2*n_stages microbatch inputs
         (pipeline_spmd.pipeline_1f1b).
       - "FThenB": forward pipeline + autodiff (GPipe memory profile).
+      - "VPP"/"ZBH1" are per-rank divergent schedules: in the
+        single-program SPMD model every rank executes the same tick
+        program, so interleaved virtual stages would pay V masked compute
+        slots per tick — reserved until a multi-program executor exists.
     """
+    if schedule in ("VPP", "ZBH1"):
+        raise NotImplementedError(
+            f"{schedule} needs per-rank divergent tick programs; the "
+            "single-program SPMD pipeline supports FThenB and 1F1B "
+            "(pipeline_spmd.py) — 1F1B already bounds activations at "
+            "O(n_stages)")
     if schedule not in ("1F1B", "FThenB"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     mesh = mesh or mesh_mod.get_global_mesh()

@@ -25,6 +25,10 @@ from .api import (  # noqa: F401
     dtensor_from_fn, get_placements, reshard, shard_constraint, shard_layer,
     shard_optimizer, shard_tensor, unshard_dtensor,
 )
+from .spmd_rules import (  # noqa: F401
+    get_spmd_rule, register_spmd_rule, shard_parameters,
+    with_spmd_constraint,
+)
 from .collective import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
     all_to_all_single, barrier, broadcast, gather, get_group, irecv, isend,

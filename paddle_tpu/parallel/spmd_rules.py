@@ -1,0 +1,482 @@
+"""Per-op SPMD rules for semi-auto sharding propagation.
+
+Reference: paddle/phi/infermeta/spmd_rules/*.cc (46 rules) — each op infers
+output TensorDistAttrs from input dist attrs via einsum-like axis notation
+(matmul.cc FillMatmulOperandNotation + ShardingMergeForTensors), so a
+partially annotated program can be completed op by op.
+
+TPU-native form: GSPMD already propagates shardings through the compiled
+program, so these rules serve the USER-facing layer the reference exposes —
+inspecting/deriving shardings before execution and constraining activations
+inside custom models:
+
+    rule = get_spmd_rule("matmul")
+    ins, outs = rule.infer_forward((x_spec, x.shape), (w_spec, w.shape))
+    y = with_spmd_constraint("matmul", y, x, w)   # apply inferred spec
+
+A "spec" is a tuple with one entry per tensor dim: a mesh-axis name, a
+tuple of axis names, or None (replicated) — the axis-name analog of the
+reference's dims_mapping. Outputs may carry `partial` axes (contracted
+dims that were sharded), the analog of the reference's partial status.
+"""
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["SpmdRule", "get_spmd_rule", "register_spmd_rule",
+           "with_spmd_constraint", "shard_parameters"]
+
+
+Spec = Tuple  # per-dim: None | str | tuple of str
+
+
+def _norm(spec, ndim: int) -> List:
+    spec = [s[0] if isinstance(s, tuple) and len(s) == 1 else s
+            for s in (spec or ())]
+    spec += [None] * (ndim - len(spec))
+    return spec[:ndim]
+
+
+def _merge_axis(candidates: List) -> Optional[Any]:
+    """Merge one notation letter's proposals from several inputs: first
+    non-None wins; conflicts resolve to the first (the reference merges by
+    shard count — first-wins matches its common path)."""
+    for c in candidates:
+        if c is not None:
+            return c
+    return None
+
+
+def infer_einsum(notation: str, *in_specs_shapes):
+    """Core engine (reference: ShardingMergeForTensors + the per-op
+    notations). notation: e.g. "mk,kn->mn"; each input is (spec, shape).
+    Returns (new_in_specs, out_spec, partial_axes)."""
+    lhs, out_axes = notation.split("->")
+    in_axes = lhs.split(",")
+    if len(in_axes) != len(in_specs_shapes):
+        raise ValueError(f"{notation}: expected {len(in_axes)} inputs")
+    letter_map: Dict[str, List] = {}
+    for axes, (spec, shape) in zip(in_axes, in_specs_shapes):
+        spec = _norm(spec, len(axes))
+        for i, letter in enumerate(axes):
+            # size-1 dims never propagate sharding (broadcast semantics)
+            if shape is not None and i < len(shape) and shape[i] == 1:
+                continue
+            letter_map.setdefault(letter, []).append(spec[i])
+    merged = {k: _merge_axis(v) for k, v in letter_map.items()}
+    new_ins = []
+    for axes, (spec, shape) in zip(in_axes, in_specs_shapes):
+        new_ins.append(tuple(
+            None if (shape is not None and i < len(shape)
+                     and shape[i] == 1) else merged.get(letter)
+            for i, letter in enumerate(axes)))
+    out = tuple(merged.get(letter) for letter in out_axes)
+    # contracted letters that were sharded -> output is partial over them
+    partial = []
+    for letter, ax in merged.items():
+        if letter not in out_axes and ax is not None:
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                partial.append(a)
+    return new_ins, out, tuple(partial)
+
+
+class SpmdRule:
+    """reference: phi::distributed::SpmdRule — infer_forward maps input
+    dist attrs to (inferred input attrs, output attrs)."""
+
+    def __init__(self, name: str, fn: Callable):
+        self.name = name
+        self._fn = fn
+
+    def infer_forward(self, *inputs, **attrs):
+        """inputs: (spec, shape) pairs. Returns (in_specs, out_specs,
+        partial_axes) — out_specs a single spec or list of specs."""
+        return self._fn(*inputs, **attrs)
+
+
+_RULES: Dict[str, SpmdRule] = {}
+
+
+def register_spmd_rule(name: str):
+    """reference: PD_REGISTER_SPMD_RULE."""
+
+    def deco(fn):
+        _RULES[name] = SpmdRule(name, fn)
+        return fn
+
+    return deco
+
+
+def get_spmd_rule(name: str) -> SpmdRule:
+    """reference: phi.get_spmd_rule (used throughout
+    test/auto_parallel/spmd_rules/)."""
+    if name not in _RULES:
+        raise ValueError(
+            f"no SPMD rule for {name!r}; registered: {sorted(_RULES)}")
+    return _RULES[name]
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _letters(n: int, reserved: str = "") -> str:
+    return "".join(c for c in string.ascii_lowercase
+                   if c not in reserved)[:n]
+
+
+@register_spmd_rule("matmul")
+def _matmul(x, y, trans_x: bool = False, trans_y: bool = False):
+    """reference: matmul.cc — mk,kn->mn with batched broadcasting."""
+    (xs, xsh), (ys, ysh) = x, y
+    xnd, ynd = len(xsh), len(ysh)
+    if trans_x:
+        xs = _norm(xs, xnd)
+        xs[-2], xs[-1] = xs[-1], xs[-2]
+        xsh = list(xsh)
+        xsh[-2], xsh[-1] = xsh[-1], xsh[-2]
+    if trans_y:
+        ys = _norm(ys, ynd)
+        ys[-2], ys[-1] = ys[-1], ys[-2]
+        ysh = list(ysh)
+        ysh[-2], ysh[-1] = ysh[-1], ysh[-2]
+    batch = _letters(max(xnd, ynd) - 2, reserved="kmn")
+    x_axes = batch[len(batch) - (xnd - 2):] + "mk" if xnd >= 2 else "k"
+    y_axes = batch[len(batch) - (ynd - 2):] + "kn" if ynd >= 2 else "k"
+    out_axes = batch + ("m" if xnd >= 2 else "") + ("n" if ynd >= 2 else "")
+    ins, out, partial = infer_einsum(
+        f"{x_axes},{y_axes}->{out_axes}", (xs, xsh), (ys, ysh))
+    return ins, out, partial
+
+
+@register_spmd_rule("elementwise")
+def _elementwise(*inputs):
+    """reference: elementwise.cc — right-aligned broadcast."""
+    nd = max(len(sh) for _, sh in inputs)
+    axes = _letters(nd)
+    notated = []
+    for spec, sh in inputs:
+        notated.append((spec, sh))
+    notation = ",".join(axes[nd - len(sh):] for _, sh in inputs) \
+        + "->" + axes
+    return infer_einsum(notation, *notated)
+
+
+@register_spmd_rule("embedding")
+def _embedding(ids, table):
+    """reference: embedding.cc — out[b.., h] from ids[b..] + w[v, h];
+    vocab sharding makes the output partial over those axes."""
+    (ispec, ish), (tspec, tsh) = ids, table
+    axes = _letters(len(ish), reserved="vh")
+    notation = f"{axes},vh->{axes}h"
+    return infer_einsum(notation, (ispec, ish), (tspec, tsh))
+
+
+def _norm_rule(x, scale, bias=None, begin_norm_axis: int = -1):
+    """layer_norm.cc / rms_norm.cc: normalized trailing dims must be
+    replicated; leading dims keep their sharding; scale/bias replicated."""
+    (xs, xsh) = x
+    nd = len(xsh)
+    if begin_norm_axis < 0:
+        begin_norm_axis += nd
+    xs = _norm(xs, nd)
+    new_x = tuple(xs[i] if i < begin_norm_axis else None for i in range(nd))
+    ins = [new_x, (None,) * len(scale[1])]
+    if bias is not None:
+        ins.append((None,) * len(bias[1]))
+    return ins, new_x, ()
+
+
+register_spmd_rule("layer_norm")(_norm_rule)
+register_spmd_rule("rms_norm")(_norm_rule)
+
+
+@register_spmd_rule("reduction")
+def _reduction(x, axis=None, keepdim: bool = False):
+    """reference: reduction.cc — reduced dims drop from the output; their
+    sharding becomes partial."""
+    (xs, xsh) = x
+    nd = len(xsh)
+    xs = _norm(xs, nd)
+    if axis is None:
+        axis = list(range(nd))
+    axis = [a % nd for a in (axis if isinstance(axis, (list, tuple))
+                             else [axis])]
+    out = []
+    partial = []
+    for i in range(nd):
+        if i in axis:
+            if xs[i] is not None:
+                ax = xs[i]
+                partial += list(ax if isinstance(ax, tuple) else (ax,))
+            if keepdim:
+                out.append(None)
+        else:
+            out.append(xs[i])
+    return [tuple(xs)], tuple(out), tuple(partial)
+
+
+@register_spmd_rule("softmax")
+def _softmax(x, axis: int = -1):
+    """reference: softmax.cc — the softmax axis must be replicated."""
+    (xs, xsh) = x
+    nd = len(xsh)
+    axis %= nd
+    xs = _norm(xs, nd)
+    new = tuple(None if i == axis else xs[i] for i in range(nd))
+    return [new], new, ()
+
+
+@register_spmd_rule("cross_entropy_with_softmax")
+def _ce(logits, labels, axis: int = -1):
+    """reference: cross_entropy_with_softmax.cc — softmax axis replicated
+    (the mp-sharded-vocab fast path is ParallelCrossEntropy, mpu.py)."""
+    (ls, lsh) = logits
+    nd = len(lsh)
+    axis %= nd
+    ls = _norm(ls, nd)
+    new_l = tuple(None if i == axis else ls[i] for i in range(nd))
+    out = tuple(s for i, s in enumerate(new_l) if i != axis)
+    return [new_l, out], out, ()
+
+
+@register_spmd_rule("transpose")
+def _transpose(x, perm: Sequence[int]):
+    """reference: transpose.cc."""
+    (xs, xsh) = x
+    xs = _norm(xs, len(xsh))
+    out = tuple(xs[p] for p in perm)
+    return [tuple(xs)], out, ()
+
+
+@register_spmd_rule("reshape")
+def _reshape(x, shape: Sequence[int]):
+    """reference: reshape.cc via dim_trans.cc — sharding survives when a
+    sharded input dim maps to an output dim group whose FIRST factor is
+    that dim's size multiple (the common merge/split cases)."""
+    (xs, xsh) = x
+    xs = _norm(xs, len(xsh))
+    shape = list(shape)
+    # resolve a single -1
+    import numpy as np
+
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = int(np.prod(xsh)) // max(known, 1)
+    out = [None] * len(shape)
+    ii = oi = 0
+    while ii < len(xsh) and oi < len(shape):
+        isz, osz = xsh[ii], shape[oi]
+        if isz == osz:
+            out[oi] = xs[ii]
+            ii += 1
+            oi += 1
+        elif isz > osz:
+            # split: the sharded input dim lands on the FIRST output
+            # factor when divisible
+            if xs[ii] is not None and osz % _axes_len(xs[ii]) == 0:
+                out[oi] = xs[ii]
+            group = osz
+            oi += 1
+            while oi < len(shape) and group < isz:
+                group *= shape[oi]
+                oi += 1
+            ii += 1
+        else:
+            # merge: first input factor's sharding carries to the output
+            if out[oi] is None:
+                out[oi] = xs[ii]
+            group = isz
+            ii += 1
+            while ii < len(xsh) and group < osz:
+                group *= xsh[ii]
+                ii += 1
+            oi += 1
+    return [tuple(xs)], tuple(out), ()
+
+
+def _axes_len(ax) -> int:
+    return len(ax) if isinstance(ax, tuple) else 1
+
+
+@register_spmd_rule("flash_attention")
+def _flash(q, k, v):
+    """reference: flash_attention.cc — [b, s, h, d]: batch/head shardings
+    merge; seq of kv + head dim stay replicated inside the kernel."""
+    (qs, qsh), (ks, ksh), (vs, vsh) = q, k, v
+    ins, out, partial = infer_einsum(
+        "bshd,bthd,bthd->bshd", (qs, qsh), (ks, ksh), (vs, vsh))
+    # d must be replicated; t (kv seq) must be gathered for the kernel
+    ins = [tuple((s[0], s[1], s[2], None)) for s in ins]
+    ins[1] = (ins[1][0], None, ins[1][2], None)
+    ins[2] = (ins[2][0], None, ins[2][2], None)
+    out = (out[0], out[1], out[2], None)
+    return ins, out, partial
+
+
+@register_spmd_rule("fused_rope")
+def _rope(q, *rest):
+    specs = [q] + list(rest)
+    ins = []
+    for spec, sh in specs:
+        s = _norm(spec, len(sh))
+        # rotate mixes the last dim: keep it replicated
+        s[-1] = None
+        ins.append(tuple(s))
+    return ins, list(ins[:max(1, len(ins))]), ()
+
+
+@register_spmd_rule("concat")
+def _concat(*inputs, axis: int = 0):
+    nd = len(inputs[0][1])
+    axis %= nd
+    merged = []
+    for i in range(nd):
+        if i == axis:
+            merged.append(None)  # concat dim cannot stay sharded
+        else:
+            merged.append(_merge_axis(
+                [_norm(s, nd)[i] for s, _ in inputs]))
+    spec = tuple(merged)
+    return [spec] * len(inputs), spec, ()
+
+
+@register_spmd_rule("split")
+def _split(x, num_or_sections=None, axis: int = 0):
+    (xs, xsh) = x
+    nd = len(xsh)
+    axis %= nd
+    xs = _norm(xs, nd)
+    new = tuple(None if i == axis else xs[i] for i in range(nd))
+    n = num_or_sections if isinstance(num_or_sections, int) \
+        else len(num_or_sections or [1])
+    return [new], [new] * n, ()
+
+
+@register_spmd_rule("slice")
+def _slice(x, axes: Sequence[int] = ()):
+    (xs, xsh) = x
+    nd = len(xsh)
+    xs = _norm(xs, nd)
+    new = tuple(None if i in [a % nd for a in axes] else xs[i]
+                for i in range(nd))
+    return [new], new, ()
+
+
+@register_spmd_rule("default_data_parallel")
+def _ddp(*inputs):
+    """reference: default_data_parallel.cc — shard dim 0 like the first
+    input everywhere, replicate the rest."""
+    lead = _norm(inputs[0][0], len(inputs[0][1]))[0]
+    ins = [tuple([lead] + [None] * (len(sh) - 1)) for _, sh in inputs]
+    return ins, ins[0] if len(ins) == 1 else list(ins), ()
+
+
+@register_spmd_rule("replicated")
+def _replicated(*inputs):
+    """reference: replicated.cc — the conservative fallback."""
+    ins = [(None,) * len(sh) for _, sh in inputs]
+    return ins, ins[0] if len(ins) == 1 else list(ins), ()
+
+
+# share rule bodies the way the reference maps many ops onto a few Infer
+# functions: shape-preserving ops -> elementwise; scan/axis ops -> the
+# axis-replicated rule; dim-count changers -> reshape; the rest fall back
+# to the conservative replicated rule
+for _name in ("cast", "scale", "pow", "full_like", "where", "triu",
+              "add_n", "swiglu"):
+    _RULES[_name] = SpmdRule(_name, _elementwise)
+for _name in ("cumsum",):
+    _RULES[_name] = SpmdRule(_name, _softmax)
+for _name in ("argmax", "numel", "squared_l2_norm"):
+    _RULES[_name] = SpmdRule(_name, _reduction)
+for _name in ("flatten", "squeeze", "unsqueeze"):
+    _RULES[_name] = SpmdRule(_name, _reshape)
+for _name in ("gather", "gather_nd", "one_hot", "tile", "expand_as",
+              "stack", "scatter", "unbind", "dim_trans", "amp_ops",
+              "optimizer"):
+    _RULES[_name] = SpmdRule(_name, _replicated)
+
+
+# ---------------------------------------------------------------------------
+# application helpers
+# ---------------------------------------------------------------------------
+
+def _spec_of(arr, mesh) -> Tuple:
+    s = getattr(arr, "sharding", None)
+    if isinstance(s, NamedSharding):
+        return tuple(_norm(tuple(s.spec), arr.ndim))
+    return (None,) * arr.ndim
+
+
+def with_spmd_constraint(op_name: str, out, *inputs, mesh=None,
+                         in_specs: Optional[Sequence] = None, **attrs):
+    """Constrain `out` to the sharding the op's rule infers from the
+    shardings of `inputs` — the user-facing hook for custom models (GSPMD
+    then materializes any needed reshard/psum).
+
+    Input shardings are read from the arrays when they are concrete;
+    under jit, tracers carry no sharding, so pass `in_specs` (one spec
+    per input) explicitly there."""
+    from ..core.tensor import Tensor, dispatch, unwrap
+    from . import mesh as mesh_mod
+
+    mesh = mesh or mesh_mod.get_global_mesh()
+    if mesh is None:
+        return out
+    arrs = [unwrap(a) if isinstance(a, Tensor) else a for a in inputs]
+    if in_specs is None:
+        in_specs = [_spec_of(a, mesh) for a in arrs]
+    rule = get_spmd_rule(op_name)
+    _, out_spec, _ = rule.infer_forward(
+        *[(s, a.shape) for s, a in zip(in_specs, arrs)], **attrs)
+    if not isinstance(out_spec, tuple):
+        return out
+    keep = tuple(a if (a is None or _axes_in_mesh(a, mesh)) else None
+                 for a in out_spec)
+    sh = NamedSharding(mesh, P(*keep))
+
+    def constrain(o):
+        return jax.lax.with_sharding_constraint(o, sh)
+
+    if isinstance(out, Tensor):
+        return dispatch("spmd_constraint", constrain, (out,))
+    return constrain(out)
+
+
+def _axes_in_mesh(ax, mesh) -> bool:
+    names = (ax,) if isinstance(ax, str) else tuple(ax)
+    return all(n in mesh.axis_names for n in names)
+
+
+def shard_parameters(model, mesh, rules: Sequence[Tuple[str, Tuple]],
+                     default: Optional[Tuple] = None):
+    """Lay a model's parameters out from a (name-suffix, dims) table — the
+    generic form of shard_llama's logical-axis rules usable on ANY Layer
+    (reference analog: the dist attrs the fleet wrappers assign to their
+    own parameters)."""
+    from .mesh import divisible_prefix
+
+    for name, p in model.named_parameters():
+        dims = default
+        for suffix, d in rules:
+            if name.endswith(suffix):
+                dims = d
+                break
+        if dims is None:
+            continue
+        spec = []
+        for i in range(p.ndim):
+            d = dims[i] if i < len(dims) else None
+            if d is None:
+                spec.append(None)
+                continue
+            names = (d,) if isinstance(d, str) else tuple(d)
+            kept = divisible_prefix(mesh, p.shape[i], names)
+            spec.append(kept if kept else None)
+        p._array = jax.device_put(p._array, NamedSharding(mesh, P(*spec)))
+    return model

@@ -41,16 +41,64 @@ class InputSpec:
 
 
 class Program:
-    """Minimal Program facade (reference: python/paddle/base/framework.py:5840)."""
+    """Recorded forward graph (reference: python/paddle/base/framework.py:5840
+    Program/ProgramDesc).
+
+    TPU-native: while a program_guard is active, every dispatch()-routed op
+    ALSO records (pure_fn, input slots, output slots) here as it executes
+    eagerly. Executor.run then replays the ancestors of the fetches as one
+    jitted function of the feeds — define-by-run capture, jit-compiled
+    re-execution, the role ProgramDesc + the new executor play in the
+    reference. Ops that bypass dispatch (plain numpy on host) are
+    capture-time constants."""
 
     def __init__(self):
-        self._ops = []
+        self._nodes = []          # (fn, in_keys, out_keys)
+        self._placeholders = {}   # name -> slot key
+        self._literals = {}       # key -> array (captured constants)
+        self._key_of = {}         # id(array) -> key
+        self._keepalive = []      # arrays must outlive the capture
+        self._next_key = 0
+        self._exec_cache = {}
 
+    # -- capture ----------------------------------------------------------
+    def _new_key(self, arr) -> int:
+        k = self._next_key
+        self._next_key += 1
+        self._key_of[id(arr)] = k
+        self._keepalive.append(arr)
+        return k
+
+    def _key_for_input(self, arr) -> int:
+        k = self._key_of.get(id(arr))
+        if k is None:
+            k = self._new_key(arr)
+            self._literals[k] = arr   # first seen as an input: a constant
+        return k
+
+    def _record(self, fn, in_arrs, out_arrs):
+        in_keys = [None if a is None else self._key_for_input(a)
+                   for a in in_arrs]
+        out_keys = [self._new_key(o) for o in out_arrs]
+        self._nodes.append((fn, in_keys, out_keys))
+        self._exec_cache.clear()
+
+    def _register_placeholder(self, name, arr):
+        self._placeholders[name] = self._new_key(arr)
+
+    def key_of(self, arr):
+        return self._key_of.get(id(arr))
+
+    # -- facade -----------------------------------------------------------
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
         return self
+
+    @property
+    def _ops(self):
+        return self._nodes
 
 
 def default_main_program():
@@ -66,19 +114,96 @@ _STARTUP = Program()
 
 
 class Executor:
-    """Eager-executing stand-in for paddle.static.Executor
-    (python/paddle/base/executor.py:1172)."""
+    """paddle.static.Executor (reference:
+    python/paddle/base/executor.py:1172 + the new executor's program
+    interpretation): replays the recorded Program for the requested
+    fetches as ONE jitted function of the feed values (cached per
+    feed-shape signature, so each batch shape compiles once)."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        import jax
+
+        from ..core.tensor import Tensor, unwrap
+
+        prog = program if isinstance(program, Program) else _MAIN
+        feed = feed or {}
+        fetch_list = fetch_list or []
         outs = []
-        for f in fetch_list or []:
-            if callable(f):
-                outs.append(np.asarray(f(**(feed or {}))))
-            else:
-                outs.append(f)
+        jit_jobs = []   # (out_index, fetch_key)
+        for i, f in enumerate(fetch_list):
+            if callable(f) and not isinstance(f, Tensor):
+                outs.append(np.asarray(f(**feed)))  # legacy callable path
+                continue
+            arr = unwrap(f) if isinstance(f, Tensor) else f
+            key = prog.key_of(arr)
+            if key is None:
+                outs.append(np.asarray(arr))  # not captured: a constant
+                continue
+            outs.append(None)
+            jit_jobs.append((i, key))
+        if not jit_jobs:
+            return outs
+
+        feed_keys = {}
+        feed_vals = []
+        for name, val in feed.items():
+            if name in prog._placeholders:
+                feed_keys[prog._placeholders[name]] = len(feed_vals)
+                feed_vals.append(np.asarray(val))
+        fetch_keys = tuple(k for _, k in jit_jobs)
+        # the key->position mapping must be part of the cache signature: a
+        # different feed-dict ordering with identical shapes would
+        # otherwise reuse a runner that swaps the feeds
+        sig = (fetch_keys, tuple(sorted(feed_keys.items())),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals))
+        runner = prog._exec_cache.get(sig)
+        if runner is None:
+            # prune to the ancestors of the fetches
+            needed = set(fetch_keys)
+            chosen = []
+            for fn, in_keys, out_keys in reversed(prog._nodes):
+                if any(k in needed for k in out_keys):
+                    chosen.append((fn, in_keys, out_keys))
+                    needed.update(k for k in in_keys if k is not None)
+            chosen.reverse()
+            # every needed placeholder must be fed (actionable error
+            # instead of an integer KeyError from inside the jit trace)
+            reachable = set(prog._literals) | set(feed_keys)
+            for fn, in_keys, out_keys in chosen:
+                reachable.update(out_keys)
+            missing_keys = set()
+            for fn, in_keys, _ in chosen:
+                missing_keys.update(
+                    k for k in in_keys
+                    if k is not None and k not in reachable)
+            if missing_keys:
+                names = [n for n, k in prog._placeholders.items()
+                         if k in missing_keys]
+                raise ValueError(
+                    f"Executor.run: missing feed for placeholder(s) "
+                    f"{names or sorted(missing_keys)}")
+
+            def replay(*vals):
+                env = {k: v for k, v in prog._literals.items()}
+                for key, idx in feed_keys.items():
+                    env[key] = vals[idx]
+                for fn, in_keys, out_keys in chosen:
+                    res = fn(*[None if k is None else env[k]
+                               for k in in_keys])
+                    if not isinstance(res, tuple):
+                        res = (res,)
+                    for k, o in zip(out_keys, res):
+                        env[k] = o
+                return tuple(env[k] for k in fetch_keys)
+
+            runner = jax.jit(replay)
+            prog._exec_cache[sig] = runner
+        results = runner(*feed_vals)
+        for (i, _), r in zip(jit_jobs, results):
+            outs[i] = np.asarray(r)
         return outs
 
 
@@ -105,25 +230,34 @@ import contextlib as _contextlib
 @_contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
     """reference: static/program.py program_guard — scopes the default
-    programs."""
+    programs AND activates op capture: every dispatch()-routed op executed
+    inside the guard is recorded into `main_program` for Executor.run's
+    jitted replay."""
+    from ..core import tensor as _ct
+
     global _MAIN, _STARTUP
     prev = (_MAIN, _STARTUP)
+    prev_cap = _ct._static_capture[0]
     _MAIN = main_program
     if startup_program is not None:
         _STARTUP = startup_program
+    _ct._static_capture[0] = main_program \
+        if isinstance(main_program, Program) else None
     try:
         yield
     finally:
         _MAIN, _STARTUP = prev
+        _ct._static_capture[0] = prev_cap
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """reference: static/input.py data — a named placeholder. This facade
-    executes eagerly: the returned zero Tensor (None dims -> 1) feeds
-    static.nn builders immediately, giving shape/dtype checking and layer
-    construction. Deferred feed/fetch execution is to_static's job — wrap
-    the model body in paddle.jit.to_static (or pass callables in
-    Executor.run's fetch_list) to run against real batches."""
+    """reference: static/input.py data — a named placeholder.
+
+    The returned zero Tensor (None dims -> 1) feeds static.nn builders
+    immediately (define-by-run capture); inside a program_guard it is also
+    registered as a FEEDABLE slot, so Executor.run(feed={name: batch})
+    replays the captured graph against real batches (each new feed shape
+    compiles once)."""
     import numpy as _np
 
     from ..core.tensor import Tensor
@@ -133,6 +267,12 @@ def data(name, shape, dtype="float32", lod_level=0):
                          else _np.float32))
     t.name = name
     t.stop_gradient = False
+    from ..core import tensor as _ct
+
+    prog = _ct._static_capture[0] or (_MAIN if isinstance(_MAIN, Program)
+                                      else None)
+    if prog is not None:
+        prog._register_placeholder(name, t._array)
     return t
 
 

@@ -1,0 +1,35 @@
+"""Weight-file resolution (reference: python/paddle/utils/download.py
+get_weights_path_from_url / get_path_from_url).
+
+This deployment has no network egress, so resolution is CACHE-ONLY: a url
+maps to $PADDLE_TPU_HOME/weights/<basename> (default ~/.cache/paddle_tpu).
+Users place files there (scp, bake into the image, ...) and every
+`pretrained=True` path finds them; a missing file raises an actionable
+error instead of attempting a download.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "weights_home"]
+
+
+def weights_home() -> str:
+    root = os.environ.get(
+        "PADDLE_TPU_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+    return os.path.join(root, "weights")
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    """reference: download.py get_weights_path_from_url — resolves into the
+    local weights cache; offline, so the file must already be there."""
+    fname = os.path.basename(url)
+    path = os.path.join(weights_home(), fname)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"weight file {fname!r} not found in {weights_home()!r} and "
+            "this environment has no network egress — place the file "
+            "there manually (torch-format .pth checkpoints are converted "
+            "automatically by paddle_tpu.vision.models.load_pretrained)")
+    return path

@@ -1,0 +1,93 @@
+"""Pretrained-weight loading for the vision zoo.
+
+Reference: each model's `pretrained=True` path calls
+get_weights_path_from_url(model_urls[arch]) then set_state_dict
+(e.g. python/paddle/vision/models/resnet.py _resnet). Offline TPU twist:
+weights resolve from the local cache only (utils/download.py), and
+torch-format checkpoints (torchvision naming) are converted on the fly —
+our vision modules intentionally mirror torchvision naming, so conversion
+is BN-stat renames plus linear-weight transposes.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from ...utils.download import weights_home
+
+__all__ = ["load_pretrained", "convert_torch_state_dict",
+           "maybe_pretrained"]
+
+
+def maybe_pretrained(model, pretrained, arch: str):
+    """The one construct-then-load step every zoo entry point shares."""
+    if pretrained:
+        load_pretrained(model, arch)
+    return model
+
+
+def convert_torch_state_dict(model, torch_sd: Dict) -> Dict:
+    """Map a torch/torchvision-style state dict onto `model`'s names:
+    running_mean/var -> _mean/_variance, drop num_batches_tracked, and
+    transpose Linear weights (torch stores [out, in], ours are [in, out]).
+    The transpose is decided by the TARGET layer type, not by shape — a
+    square classifier weight would otherwise load untransposed."""
+    from ...nn import Linear
+
+    linear_weights = {
+        (prefix + ".weight" if prefix else "weight")
+        for prefix, layer in model.named_sublayers(include_self=True)
+        if isinstance(layer, Linear)
+    }
+    out = {}
+    for k, v in torch_sd.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        name = k.replace("running_mean", "_mean") \
+                .replace("running_var", "_variance")
+        arr = np.asarray(
+            v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+        if name in linear_weights and arr.ndim == 2:
+            arr = arr.T
+        out[name] = arr
+    return out
+
+
+def load_pretrained(model, arch: str):
+    """Fill `model` from the cached weight file for `arch`: looks for
+    {arch}.pdparams (native) then {arch}.pth / {arch}.pt (torch format,
+    converted). Raises with the expected path when nothing is cached."""
+    home = weights_home()
+
+    def _strict(missing):
+        if missing:
+            raise ValueError(
+                f"{arch}: checkpoint is missing params "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+
+    native = os.path.join(home, f"{arch}.pdparams")
+    if os.path.exists(native):
+        from ...framework.io import load
+
+        missing, _ = model.set_state_dict(load(native))
+        _strict(missing)
+        return model
+    for ext in (".pth", ".pt"):
+        p = os.path.join(home, arch + ext)
+        if os.path.exists(p):
+            import torch
+
+            sd = torch.load(p, map_location="cpu", weights_only=True)
+            if isinstance(sd, dict) and "state_dict" in sd:
+                sd = sd["state_dict"]
+            missing, _ = model.set_state_dict(
+                convert_torch_state_dict(model, sd))
+            _strict(missing)
+            return model
+    raise FileNotFoundError(
+        f"no pretrained weights for {arch!r}: expected "
+        f"{native} or {os.path.join(home, arch + '.pth')} — this "
+        "environment has no network egress, so place the file there "
+        "(torch-format checkpoints are converted automatically)")

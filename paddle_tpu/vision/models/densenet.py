@@ -83,9 +83,10 @@ class DenseNet(nn.Layer):
 
 
 def _densenet(layers, pretrained, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return DenseNet(layers=layers, **kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(DenseNet(layers=layers, **kwargs), pretrained,
+                            f"densenet{layers}")
 
 
 def densenet121(pretrained=False, **kwargs):
@@ -202,9 +203,12 @@ class ShuffleNetV2(nn.Layer):
 
 
 def _shufflenet(scale, act, pretrained, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    from ._weights import maybe_pretrained
+
+    tag = str(scale).replace(".", "_")
+    return maybe_pretrained(
+        ShuffleNetV2(scale=scale, act=act, **kwargs), pretrained,
+        f"shufflenet_v2_x{tag}" + ("_swish" if act == "swish" else ""))
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):

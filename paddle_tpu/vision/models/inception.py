@@ -104,9 +104,9 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return GoogLeNet(**kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(GoogLeNet(**kwargs), pretrained, "googlenet")
 
 
 # ------------------------------------------------------------ InceptionV3
@@ -230,6 +230,7 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return InceptionV3(**kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(InceptionV3(**kwargs), pretrained,
+                            "inception_v3")

@@ -256,26 +256,24 @@ class MobileNetV3Small(MobileNetV3):
                          num_classes, with_pool)
 
 
-def _no_pretrained(pretrained):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
+from ._weights import maybe_pretrained as _maybe_pretrained
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV1(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV1(scale=scale, **kwargs),
+                             pretrained, "mobilenet_v1")
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV2(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV2(scale=scale, **kwargs),
+                             pretrained, "mobilenet_v2")
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV3Large(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV3Large(scale=scale, **kwargs),
+                             pretrained, "mobilenet_v3_large")
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV3Small(scale=scale, **kwargs)
+    return _maybe_pretrained(MobileNetV3Small(scale=scale, **kwargs),
+                             pretrained, "mobilenet_v3_small")

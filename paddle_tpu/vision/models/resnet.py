@@ -132,11 +132,10 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a checkpoint with "
-            "set_state_dict instead")
-    return ResNet(block, depth, **kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(ResNet(block, depth, **kwargs), pretrained,
+                            f"resnet{depth}")
 
 
 def resnet18(pretrained=False, **kwargs):

@@ -116,18 +116,20 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return SqueezeNet("1.0", **kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(SqueezeNet("1.0", **kwargs), pretrained,
+                            "squeezenet1_0")
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return SqueezeNet("1.1", **kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(SqueezeNet("1.1", **kwargs), pretrained,
+                            "squeezenet1_1")
 
 
 def alexnet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return AlexNet(**kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(AlexNet(**kwargs), pretrained, "alexnet")

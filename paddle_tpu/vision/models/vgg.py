@@ -58,9 +58,11 @@ class VGG(nn.Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
+    from ._weights import maybe_pretrained
+
+    return maybe_pretrained(
+        VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs),
+        pretrained, arch + ("_bn" if batch_norm else ""))
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

@@ -317,3 +317,93 @@ class TestPPYOLOE:
         res = m.predict(x, score_threshold=0.0, top_k=10)
         assert res[0]["boxes"].shape[-1] == 4
         assert len(res) == 2
+
+
+class TestPretrainedWeights:
+    """Vision zoo pretrained loading (reference: vision models'
+    get_weights_path_from_url + set_state_dict path; offline cache-only
+    here, with on-the-fly torch-format conversion)."""
+
+    def _fake_torch_sd(self, model):
+        # reverse of convert_torch_state_dict: torchvision-style names
+        import numpy as np
+
+        sd = {}
+        rng = np.random.default_rng(0)
+        for k, t in model.state_dict().items():
+            name = k.replace("_mean", "running_mean") \
+                    .replace("_variance", "running_var")
+            arr = rng.normal(size=tuple(t.shape), scale=0.02).astype(
+                np.float32)
+            if k == "fc.weight":
+                arr = arr.T.copy()  # torch Linear stores [out, in]
+            sd[name] = arr
+        sd["bn1.num_batches_tracked"] = np.asarray(0)
+        return sd
+
+    def test_torch_checkpoint_roundtrip(self, tmp_path, monkeypatch):
+        import numpy as np
+        import torch
+
+        from paddle_tpu.vision.models import resnet18
+
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        ref = resnet18()
+        sd = self._fake_torch_sd(ref)
+        wdir = tmp_path / "weights"
+        wdir.mkdir()
+        torch.save({k: torch.from_numpy(np.asarray(v))
+                    for k, v in sd.items()}, wdir / "resnet18.pth")
+        m = resnet18(pretrained=True)
+        got = dict(m.state_dict())
+        np.testing.assert_allclose(
+            np.asarray(got["conv1.weight"]), sd["conv1.weight"], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(got["fc.weight"]), sd["fc.weight"].T, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(got["bn1._mean"]), sd["bn1.running_mean"],
+            atol=1e-6)
+
+    def test_square_linear_weight_transposed(self):
+        """Torch Linear weights must transpose by TARGET LAYER TYPE — a
+        square classifier matrix loads wrong if decided by shape."""
+        import numpy as np
+
+        import paddle_tpu.nn as nn
+        from paddle_tpu.vision.models._weights import \
+            convert_torch_state_dict
+
+        m = nn.Sequential(nn.Linear(8, 8))
+        w = np.arange(64, dtype=np.float32).reshape(8, 8)  # torch [out,in]
+        sd = convert_torch_state_dict(m, {"0.weight": w,
+                                          "0.bias": np.zeros(8)})
+        np.testing.assert_array_equal(sd["0.weight"], w.T)
+
+    def test_native_pdparams_roundtrip(self, tmp_path, monkeypatch):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import mobilenet_v2
+
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        paddle.seed(5)
+        src = mobilenet_v2()
+        wdir = tmp_path / "weights"
+        wdir.mkdir()
+        paddle.save(src.state_dict(), str(wdir / "mobilenet_v2.pdparams"))
+        paddle.seed(6)
+        m = mobilenet_v2(pretrained=True)
+        a = dict(src.state_dict())
+        b = dict(m.state_dict())
+        for k in a:
+            np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                       atol=1e-6, err_msg=k)
+
+    def test_missing_weights_actionable_error(self, tmp_path, monkeypatch):
+        import pytest
+
+        from paddle_tpu.vision.models import vgg16
+
+        monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path))
+        with pytest.raises(FileNotFoundError, match="vgg16"):
+            vgg16(pretrained=True)

@@ -1,0 +1,35 @@
+"""Op-latency regression gate logic (reference:
+tools/check_op_benchmark_result.py — compare current vs baseline op
+latencies, flag >threshold regressions)."""
+import json
+import sys
+
+
+def test_regression_detection(tmp_path, capsys):
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    path = str(tmp_path / "OPBENCH.json")
+    # first run: records, no warnings
+    warned = bench._op_regressions({"matmul": 1.0, "rms": 2.0}, path=path)
+    assert warned == []
+    with open(path) as f:
+        assert json.load(f)["ops"]["matmul"] == 1.0
+    # second run: 50% slower matmul flags; 5% slower rms does not
+    warned = bench._op_regressions({"matmul": 1.5, "rms": 2.1}, path=path)
+    assert len(warned) == 1 and "matmul" in warned[0]
+    err = capsys.readouterr().err
+    assert "OP REGRESSION WARNING" in err
+    # third run compares against the SECOND run's numbers
+    warned = bench._op_regressions({"matmul": 1.55, "rms": 2.1}, path=path)
+    assert warned == []
+
+
+def test_corrupt_previous_file_tolerated(tmp_path):
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    path = str(tmp_path / "OPBENCH.json")
+    with open(path, "w") as f:
+        f.write("not json")
+    assert bench._op_regressions({"matmul": 1.0}, path=path) == []
