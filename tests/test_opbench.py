@@ -11,18 +11,26 @@ def test_regression_detection(tmp_path, capsys):
 
     path = str(tmp_path / "OPBENCH.json")
     # first run: records, no warnings
-    warned = bench._op_regressions({"matmul": 1.0, "rms": 2.0}, path=path)
+    warned = bench._op_regressions({"matmul": 10.0, "rms": 2.0}, path=path)
     assert warned == []
     with open(path) as f:
-        assert json.load(f)["ops"]["matmul"] == 1.0
+        assert json.load(f)["ops"]["matmul"] == 10.0
     # second run: 50% slower matmul flags; 5% slower rms does not
-    warned = bench._op_regressions({"matmul": 1.5, "rms": 2.1}, path=path)
+    warned = bench._op_regressions({"matmul": 15.0, "rms": 2.1}, path=path)
     assert len(warned) == 1 and "matmul" in warned[0]
     err = capsys.readouterr().err
     assert "OP REGRESSION WARNING" in err
     # third run compares against the SECOND run's numbers
-    warned = bench._op_regressions({"matmul": 1.55, "rms": 2.1}, path=path)
+    warned = bench._op_regressions({"matmul": 15.5, "rms": 2.1}, path=path)
     assert warned == []
+    # the absolute floor: >10% relative but <=0.3 ms delta is jitter on a
+    # short op, not a regression
+    warned = bench._op_regressions({"matmul": 15.5, "rms": 2.35},
+                                   path=path)
+    assert warned == []
+    # and a short op crossing BOTH thresholds still trips the gate
+    warned = bench._op_regressions({"matmul": 15.5, "rms": 2.8}, path=path)
+    assert len(warned) == 1 and "rms" in warned[0]
 
 
 def test_corrupt_previous_file_tolerated(tmp_path):
