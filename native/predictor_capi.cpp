@@ -1,0 +1,168 @@
+// C-ABI inference predictor over paddle_tpu deployment artifacts.
+//
+// Reference analog: the C++ inference API (paddle_inference_api.h
+// CreatePredictor/Run) wrapping the compiled program. TPU-native twist:
+// the TPU runtime (libtpu/PJRT) is driven through JAX, so the native shell
+// embeds CPython and drives paddle_tpu.jit.load's StableHLO artifact —
+// the same layering the reference uses (C++ shell -> libpaddle), with the
+// Python interpreter playing libpaddle's role. No Python types cross the
+// ABI: callers exchange plain float32 buffers and shapes.
+//
+// Build (see tests/test_io_native.py::TestNativePredictor):
+//   g++ -O2 -shared -fPIC predictor_capi.cpp -o libptpu_predictor.so \
+//       $(python3-config --includes) $(python3-config --ldflags --embed)
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+static std::string g_err;
+
+static void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_err = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+extern "C" {
+
+const char* ptpu_last_error() { return g_err.c_str(); }
+
+// Load an artifact saved by paddle_tpu.jit.save(layer, path, input_spec=...).
+// Returns an opaque handle, or nullptr (see ptpu_last_error).
+void* ptpu_create(const char* artifact_path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL the initializing thread holds, or any OTHER thread
+    // calling into this library would deadlock in PyGILState_Ensure
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gs = PyGILState_Ensure();
+  void* handle = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.jit");
+  if (mod == nullptr) {
+    set_err_from_python();
+  } else {
+    PyObject* layer =
+        PyObject_CallMethod(mod, "load", "s", artifact_path);
+    if (layer == nullptr) {
+      set_err_from_python();
+    } else {
+      handle = layer;  // owned reference held by the handle
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gs);
+  return handle;
+}
+
+// Run one float32 input through the model. `out` must hold out_capacity
+// floats; the produced shape lands in out_shape/out_ndim (out_ndim also
+// caps the writable dims). Returns 0 on success.
+int ptpu_run(void* handle, const float* data, const int64_t* shape,
+             int ndim, float* out, int64_t* out_shape, int* out_ndim,
+             int64_t out_capacity) {
+  if (handle == nullptr) {
+    g_err = "null predictor handle";
+    return 1;
+  }
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = 1;
+  PyObject* np = nullptr;
+  PyObject* arr = nullptr;
+  PyObject* result = nullptr;
+  PyObject* res_np = nullptr;
+  PyObject* bytes = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (np == nullptr) break;
+    int64_t n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    PyObject* mem = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(data)),
+        n * static_cast<int64_t>(sizeof(float)), PyBUF_READ);
+    if (mem == nullptr) break;
+    PyObject* flat =
+        PyObject_CallMethod(np, "frombuffer", "Os", mem, "float32");
+    Py_DECREF(mem);
+    if (flat == nullptr) break;
+    PyObject* pyshape = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i) {
+      PyTuple_SET_ITEM(pyshape, i, PyLong_FromLongLong(shape[i]));
+    }
+    arr = PyObject_CallMethod(flat, "reshape", "O", pyshape);
+    Py_DECREF(flat);
+    Py_DECREF(pyshape);
+    if (arr == nullptr) break;
+    result = PyObject_CallFunctionObjArgs(
+        static_cast<PyObject*>(handle), arr, nullptr);
+    if (result == nullptr) break;
+    if (PyTuple_Check(result) || PyList_Check(result)) {
+      g_err = "multi-output models are not supported by this ABI; wrap "
+              "the model to return a single tensor";
+      break;
+    }
+    // Tensor or array -> contiguous float32 numpy
+    PyObject* asnum = PyObject_HasAttrString(result, "numpy")
+                          ? PyObject_CallMethod(result, "numpy", nullptr)
+                          : (Py_INCREF(result), result);
+    if (asnum == nullptr) break;
+    res_np = PyObject_CallMethod(np, "ascontiguousarray", "Os", asnum,
+                                 "float32");
+    Py_DECREF(asnum);
+    if (res_np == nullptr) break;
+    PyObject* rshape = PyObject_GetAttrString(res_np, "shape");
+    if (rshape == nullptr) break;
+    Py_ssize_t rnd = PyTuple_Size(rshape);
+    if (rnd > *out_ndim) {
+      Py_DECREF(rshape);
+      g_err = "output rank exceeds caller's out_shape capacity";
+      break;
+    }
+    int64_t total = 1;
+    for (Py_ssize_t i = 0; i < rnd; ++i) {
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(rshape, i));
+      total *= out_shape[i];
+    }
+    Py_DECREF(rshape);
+    *out_ndim = static_cast<int>(rnd);
+    if (total > out_capacity) {
+      g_err = "output larger than caller's buffer";
+      break;
+    }
+    bytes = PyObject_CallMethod(res_np, "tobytes", nullptr);
+    if (bytes == nullptr) break;
+    std::memcpy(out, PyBytes_AsString(bytes),
+                total * static_cast<int64_t>(sizeof(float)));
+    rc = 0;
+  } while (false);
+  if (rc != 0 && PyErr_Occurred()) set_err_from_python();
+  Py_XDECREF(bytes);
+  Py_XDECREF(res_np);
+  Py_XDECREF(result);
+  Py_XDECREF(arr);
+  Py_XDECREF(np);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+void ptpu_destroy(void* handle) {
+  if (handle == nullptr || !Py_IsInitialized()) return;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gs);
+}
+
+}  // extern "C"

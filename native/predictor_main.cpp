@@ -1,0 +1,65 @@
+// Demo deployment CLI over the C-ABI predictor (reference analog:
+// the inference demo mains under paddle/fluid/inference/api/demo_ci).
+//
+//   predictor_main <artifact_path> <d0> [d1 ...]
+//
+// Feeds an all-ones float32 tensor of the given shape and prints the
+// output shape and checksum — the end-to-end "C++ app serves the model"
+// path with no Python in the caller's code.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+void* ptpu_create(const char* artifact_path);
+int ptpu_run(void* handle, const float* data, const int64_t* shape,
+             int ndim, float* out, int64_t* out_shape, int* out_ndim,
+             int64_t out_capacity);
+void ptpu_destroy(void* handle);
+const char* ptpu_last_error();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <artifact> <d0> [d1 ...]\n", argv[0]);
+    return 2;
+  }
+  void* pred = ptpu_create(argv[1]);
+  if (pred == nullptr) {
+    std::fprintf(stderr, "create failed: %s\n", ptpu_last_error());
+    return 1;
+  }
+  std::vector<int64_t> shape;
+  int64_t n = 1;
+  for (int i = 2; i < argc; ++i) {
+    shape.push_back(std::atoll(argv[i]));
+    n *= shape.back();
+  }
+  std::vector<float> input(n, 1.0f);
+  std::vector<float> output(1 << 22);
+  std::vector<int64_t> out_shape(8);
+  int out_ndim = 8;
+  int rc = ptpu_run(pred, input.data(), shape.data(),
+                    static_cast<int>(shape.size()), output.data(),
+                    out_shape.data(), &out_ndim,
+                    static_cast<int64_t>(output.size()));
+  if (rc != 0) {
+    std::fprintf(stderr, "run failed: %s\n", ptpu_last_error());
+    ptpu_destroy(pred);
+    return 1;
+  }
+  double sum = 0.0;
+  int64_t total = 1;
+  std::printf("output shape: (");
+  for (int i = 0; i < out_ndim; ++i) {
+    std::printf(i ? ", %lld" : "%lld",
+                static_cast<long long>(out_shape[i]));
+    total *= out_shape[i];
+  }
+  std::printf(")\n");
+  for (int64_t i = 0; i < total; ++i) sum += output[i];
+  std::printf("output sum: %.6f\n", sum);
+  ptpu_destroy(pred);
+  return 0;
+}

@@ -291,7 +291,11 @@ def save(layer, path, input_spec=None, **configs):
             specs = [
                 jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in input_spec
             ]
-            exported = jexport.export(jax.jit(pure))(
+            # multi-platform artifact: the deployment shell (native/
+            # predictor_capi.cpp) may serve on a different backend than
+            # the one that exported
+            exported = jexport.export(
+                jax.jit(pure), platforms=("cpu", "tpu"))(
                 [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params], *specs
             )
             with open(path + ".pdmodel", "wb") as f:

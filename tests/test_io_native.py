@@ -79,3 +79,69 @@ class TestMultiprocessDataLoader:
                         shuffle=False)
         for _ in range(2):
             assert sum(1 for _ in dl) == 4
+
+
+class TestNativePredictor:
+    """C-ABI deployment shell (native/predictor_capi.cpp — the reference's
+    C++ inference API analog): build it, save an artifact, serve it from
+    the compiled CLI with no Python in the caller, compare with eager."""
+
+    def test_cpp_predictor_serves_artifact(self, tmp_path):
+        import os
+        import shutil
+        import subprocess
+
+        if shutil.which("g++") is None:
+            import pytest
+
+            pytest.skip("no g++")
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        native = os.path.join(root, "native")
+        lib = tmp_path / "libptpu_predictor.so"
+        exe = tmp_path / "predictor_main"
+        # derive embed flags from THIS interpreter (a PATH python3-config
+        # may describe a different CPython and link the wrong libpython)
+        import sysconfig
+
+        ver = sysconfig.get_config_var("LDVERSION")
+        libdir = sysconfig.get_config_var("LIBDIR")
+        if not ver or not libdir:
+            import pytest
+
+            pytest.skip("no embeddable libpython for this interpreter")
+        inc = [f"-I{sysconfig.get_paths()['include']}"]
+        ld = [f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm"]
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC",
+             os.path.join(native, "predictor_capi.cpp"), "-o", str(lib)]
+            + inc + ld, check=True)
+        subprocess.run(
+            ["g++", "-O2", os.path.join(native, "predictor_main.cpp"),
+             "-o", str(exe), f"-L{tmp_path}", "-lptpu_predictor",
+             f"-Wl,-rpath,{tmp_path}"] + ld, check=True)
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                              nn.Linear(16, 3))
+        artifact = str(tmp_path / "model")
+        paddle.jit.save(model, artifact,
+                        input_spec=[InputSpec([2, 8], "float32")])
+        ref = float(model(paddle.to_tensor(
+            np.ones((2, 8), np.float32))).sum())
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root
+        env["JAX_PLATFORMS"] = ""  # embedded interpreter picks a backend
+        r = subprocess.run([str(exe), artifact, "2", "8"],
+                           capture_output=True, text=True, env=env,
+                           timeout=240)
+        assert r.returncode == 0, f"stderr: {r.stderr[-1500:]}"
+        assert "output shape: (2, 3)" in r.stdout
+        got = float(r.stdout.split("output sum:")[1].strip())
+        assert abs(got - ref) < max(0.05, abs(ref) * 0.02), (got, ref)
