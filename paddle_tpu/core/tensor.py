@@ -124,29 +124,49 @@ class Tensor:
         return ops.manipulation.transpose(self, perm)
 
     # ---------------- conversion ----------------
+    # When a static Program capture is active, host reads are reported to
+    # it: scalar reads become guarded CONTROL values (the SOT value-guard
+    # analog), bulk exports mark the capture impure (the values escape to
+    # host code the recorder cannot see, so the path must not be cached).
     def numpy(self) -> np.ndarray:
+        if _static_capture[0] is not None:
+            _static_capture[0]._mark_impure("numpy()")
         return np.asarray(self._array)
 
     def item(self, *args):
+        if _static_capture[0] is not None:
+            _static_capture[0]._control_read(self._array)
         return self._array.item(*args)
 
     def tolist(self):
+        if _static_capture[0] is not None:
+            _static_capture[0]._mark_impure("tolist()")
         return self._array.tolist()
 
     def __array__(self, dtype=None):
+        if _static_capture[0] is not None:
+            _static_capture[0]._mark_impure("__array__")
         a = np.asarray(self._array)
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
+        if _static_capture[0] is not None:
+            _static_capture[0]._control_read(self._array)
         return float(self._array)
 
     def __int__(self):
+        if _static_capture[0] is not None:
+            _static_capture[0]._control_read(self._array)
         return int(self._array)
 
     def __bool__(self):
+        if _static_capture[0] is not None:
+            _static_capture[0]._control_read(self._array)
         return bool(self._array)
 
     def __index__(self):
+        if _static_capture[0] is not None:
+            _static_capture[0]._control_read(self._array)
         return int(self._array)
 
     def __len__(self):
@@ -266,6 +286,10 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
 
     if _amp.amp_state() is not None:
         arrs = _amp.maybe_cast_inputs(name, arrs)
+        if _static_capture[0] is not None:
+            # cast copies break the array-identity tracking the capture's
+            # live-feeding relies on (frozen weights, zero grads)
+            _static_capture[0]._mark_impure("amp autocast during capture")
     from ..amp import debugging as _amp_dbg
 
     if _amp_dbg._op_stats is not None:
@@ -289,7 +313,8 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
         _maybe_check_nan_inf(name, out)
         if _static_capture[0] is not None:
             _static_capture[0]._record(
-                fn, arrs, out if isinstance(out, (tuple, list)) else (out,))
+                fn, arrs, out if isinstance(out, (tuple, list)) else (out,),
+                tensor_args)
         return _wrap_outputs(out, None)
 
     diff_idx = [
@@ -308,7 +333,8 @@ def dispatch(name: str, fn: Callable, tensor_args: Sequence[Any], n_outs: Option
     _maybe_check_nan_inf(name, out)
     if _static_capture[0] is not None:
         _static_capture[0]._record(
-            fn, arrs, out if isinstance(out, (tuple, list)) else (out,))
+            fn, arrs, out if isinstance(out, (tuple, list)) else (out,),
+            tensor_args)
     node = _tape.TapeNode(name, vjp_fn, [tensor_args[i] for i in diff_idx], 1)
     return _wrap_outputs(out, node)
 

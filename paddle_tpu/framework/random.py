@@ -96,6 +96,11 @@ def rng_scope(key):
 def next_key():
     """Fresh subkey: from the active rng_scope if present, else the global
     generator."""
+    from ..core import tensor as _ct
+
+    if _ct._static_capture[0] is not None:
+        # a replayed capture would freeze this randomness as a constant
+        _ct._static_capture[0]._mark_impure("rng consumed during capture")
     key = getattr(_state, "scope_key", None)
     if key is not None:
         n = getattr(_state, "scope_n", 0)

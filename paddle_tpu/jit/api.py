@@ -69,9 +69,17 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
-        self._fallback_keys = set()  # guard keys that graph-broke
+        self._fallback_keys = set()  # guard keys that stay eager
+        self._break_keys = set()     # guard keys that cannot trace whole
         self._cache = {}  # guard key -> (jitted, n_params, n_buffers, out_treedef)
+        # guard key -> list of compiled PATHS (SOT sub-graph analog):
+        # each entry replays one recorded control-flow path with value
+        # guards re-checked on device outputs
+        self._paths = {}
+        self._capture_counts = {}
         functools.update_wrapper(self, fn)
+
+    _MAX_PATHS = 8
 
     @property
     def layer(self):
@@ -100,6 +108,9 @@ class StaticFunction:
         key = _guard_key(args, kwargs)
         if key in self._fallback_keys:
             return self._fn(*args, **kwargs)
+        if key in self._break_keys:
+            return self._path_call(key, params, buffers, args, kwargs,
+                                   None)
         entry = self._cache.get(key)
         if entry is None:
             try:
@@ -108,18 +119,16 @@ class StaticFunction:
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError,
                     jax.errors.TracerIntegerConversionError) as e:
-                # SOT graph-break contract: untraceable python (data-
-                # dependent control flow, .numpy() mid-graph) falls back
-                # to eager for this guard instead of erroring
+                # SOT graph-break contract: data-dependent control flow
+                # can't trace whole. Instead of staying eager, compile
+                # per-PATH: record the executed op sequence + the scalar
+                # values that steered python, replay it jitted, and
+                # re-validate those values on every call (value guards).
                 if self._full_graph:
                     raise
-                import warnings
-                warnings.warn(
-                    f"to_static: graph break in {self._fn.__name__} "
-                    f"({type(e).__name__}); running this specialisation "
-                    "eagerly")
-                self._fallback_keys.add(key)
-                return self._fn(*args, **kwargs)
+                self._break_keys.add(key)
+                return self._path_call(key, params, buffers, args, kwargs,
+                                       e)
             self._cache[key] = entry
         jitted, out_treedef, n_out = entry
 
@@ -159,6 +168,238 @@ class StaticFunction:
             b._replace(nb._array)
         result = jax.tree.unflatten(out_treedef, list(outs[:n_out]))
         return result
+
+    # ------------------------------------------------------------------
+    # path specialisation (the SOT sub-graph analog): one compiled replay
+    # per executed control-flow path, guarded by the scalar values that
+    # steered python during capture
+    # ------------------------------------------------------------------
+    def _flat_feed(self, params, buffers, args, kwargs):
+        """Tensor leaves of the call, in stable order. Raw ndarray leaves
+        are rejected (None): the capture keys placeholders by array object
+        identity, which dispatch only preserves for Tensor._array."""
+        flat_args, _ = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensors = []
+        for a in flat_args:
+            if isinstance(a, Tensor):
+                tensors.append(a)
+            elif isinstance(a, (jax.Array, np.ndarray)):
+                return None
+        return tensors + list(params) + list(buffers)
+
+    def _run_entry(self, entry, feed, buffers):
+        """Run one compiled path; returns the unflattened result when its
+        value guards hold, else None."""
+        (replay, ctrl_vals, out_treedef, n_out, n_buf, extra_refs, _,
+         mut_spec) = entry
+        extra = []
+        for ref in extra_refs:
+            t = ref()
+            if t is None:
+                return None  # a closure tensor died; path unusable
+            extra.append(t)
+        try:
+            outs = dispatch(f"to_static_path:{self._fn.__name__}", replay,
+                            tuple(feed) + tuple(extra))
+        except Exception:
+            return None  # backend rejected the replay; falls to capture
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        n_mut = len(mut_spec)
+        got = [np.asarray(unwrap(o)).reshape(()).item()
+               for o in outs[n_out + n_buf + n_mut:]]
+        if got != ctrl_vals:
+            return None
+        for b, nb in zip(buffers, outs[n_out:n_out + n_buf]):
+            b._replace(unwrap(nb))
+        for (kind, idx), nv in zip(mut_spec,
+                                   outs[n_out + n_buf:
+                                        n_out + n_buf + n_mut]):
+            tgt = feed[idx] if kind == "feed" else extra[idx]
+            tgt._replace(unwrap(nv))
+        return (jax.tree.unflatten(out_treedef, list(outs[:n_out])),)
+
+    def _path_call(self, key, params, buffers, args, kwargs, err=None):
+        if key in self._fallback_keys:
+            return self._fn(*args, **kwargs)
+        feed = self._flat_feed(params, buffers, args, kwargs)
+        if feed is None:
+            self._fallback_keys.add(key)
+            return self._fn(*args, **kwargs)
+        paths = self._paths.setdefault(key, [])
+        # speculative replay, most-recently-hit first: run the compiled
+        # path, then check its recorded control values still hold
+        for i, entry in enumerate(paths):
+            hit = self._run_entry(entry, feed, buffers)
+            if hit is not None:
+                if i:
+                    paths.insert(0, paths.pop(i))
+                return hit[0]
+        # re-capture churn cap: exact-value guards (item()/float() reads
+        # that change every batch, e.g. loss logging) would otherwise pay
+        # capture + compile on EVERY call
+        n_cap = self._capture_counts.get(key, 0)
+        if n_cap >= self._MAX_PATHS:
+            import warnings
+
+            warnings.warn(
+                f"to_static: {self._fn.__name__} keeps taking new paths "
+                "(value guards never stabilize); this specialisation "
+                "stays eager")
+            self._fallback_keys.add(key)
+            self._paths.pop(key, None)
+            return self._fn(*args, **kwargs)
+        self._capture_counts[key] = n_cap + 1
+        # snapshot feed arrays: the capture run applies any in-place
+        # effects, and the replay below must start from PRE-call state or
+        # those effects double-apply on this call
+        pre = [t._array for t in feed]
+        entry, result = self._capture_path(key, params, buffers, args,
+                                           kwargs, feed)
+        if entry is None:
+            # impure capture: the capture run itself was a valid eager
+            # execution (with tape) — return it, do NOT run fn twice
+            return result
+        for t, a in zip(feed, pre):
+            t._array = a
+        for ref, a in zip(entry[5], entry[6]):
+            if ref() is not None:
+                ref()._array = a
+        paths.insert(0, entry)
+        if len(paths) > self._MAX_PATHS:
+            paths.pop()
+        hit = self._run_entry(entry, feed, buffers)
+        if hit is None:  # pragma: no cover — replay must match itself
+            self._fallback_keys.add(key)
+            return result
+        return hit[0]
+
+    def _capture_path(self, key, params, buffers, args, kwargs, feed):
+        """Run the fn eagerly under a Program capture; build a jitted
+        replay of (outputs, new buffers, control scalars). Returns
+        (path entry or None, this run's result) — the capture run keeps
+        the tape, so when the capture turns out impure its result is a
+        full eager execution the caller can return directly."""
+        from ..core import tensor as _ct
+        from ..static import Program
+
+        prog = Program()
+        pre_feed = [t._array for t in feed]  # pre-capture values
+        for i, t in enumerate(feed):
+            prog._register_placeholder(f"in{i}", t._array)
+        prev = _ct._static_capture[0]
+        _ct._static_capture[0] = prog
+        try:
+            result = self._fn(*args, **kwargs)
+        finally:
+            _ct._static_capture[0] = prev
+
+        out_leaves, out_treedef = jax.tree.flatten(
+            result, is_leaf=lambda x: isinstance(x, Tensor))
+        out_keys = []
+        for leaf in out_leaves:
+            arr = unwrap(leaf) if isinstance(leaf, Tensor) else leaf
+            k = prog.key_of(arr) if hasattr(arr, "shape") else None
+            if k is None:
+                prog._mark_impure("output produced outside dispatch")
+                break
+            out_keys.append(k)
+        buf_keys = [prog.key_of(b._array) for b in buffers]
+        if any(k is None for k in buf_keys):
+            prog._mark_impure("buffer updated outside dispatch")
+        if prog._impure is not None:
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in {self._fn.__name__} is not "
+                f"path-compilable ({prog._impure}); this specialisation "
+                "stays eager")
+            self._fallback_keys.add(key)
+            return None, result
+
+        ctrl_keys = [k for k, _ in prog._controls]
+        feed_keys = [prog._placeholders[f"in{i}"] for i in range(len(feed))]
+        nodes = list(prog._nodes)
+        literals = dict(prog._literals)
+        # promote Tensor-owned literals (closure-layer params/buffers the
+        # guard's layer introspection didn't see) to LIVE-fed inputs:
+        # frozen copies would go stale after optimizer updates and block
+        # autograd
+        extra_refs = []
+        extra_pre = []
+        for k, ref in prog._literal_owner.items():
+            if ref() is not None and k in literals:
+                extra_pre.append(literals.pop(k))
+                feed_keys.append(k)
+                extra_refs.append(ref)
+        # in-place mutations: any fed/closure tensor whose array changed
+        # during the capture must have its NEW value among the replay
+        # outputs, written back per call (counter.add_() and friends)
+        mut_spec = []
+        mut_keys = []
+        for i, (t, a) in enumerate(zip(feed, pre_feed)):
+            if t._array is not a:
+                k = prog.key_of(t._array)
+                if k is None:
+                    prog._mark_impure("input mutated outside dispatch")
+                    break
+                mut_spec.append(("feed", i))
+                mut_keys.append(k)
+        for j, (ref, a) in enumerate(zip(extra_refs, extra_pre)):
+            t = ref()
+            if t is not None and t._array is not a:
+                k = prog.key_of(t._array)
+                if k is None:
+                    prog._mark_impure("closure tensor mutated outside "
+                                      "dispatch")
+                    break
+                mut_spec.append(("extra", j))
+                mut_keys.append(k)
+        if prog._impure is not None:
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in {self._fn.__name__} is not "
+                f"path-compilable ({prog._impure}); this specialisation "
+                "stays eager")
+            self._fallback_keys.add(key)
+            return None, result
+        all_out = out_keys + buf_keys + mut_keys + ctrl_keys
+
+        def replay(*vals):
+            env = dict(literals)
+            for k, v in zip(feed_keys, vals):
+                env[k] = v
+            for fn_, in_keys, out_ks in nodes:
+                res = fn_(*[None if k is None else env[k]
+                            for k in in_keys])
+                if not isinstance(res, tuple):
+                    res = (res,)
+                for k, o in zip(out_ks, res):
+                    env[k] = o
+            return tuple(env[k] for k in all_out)
+
+        replay = jax.jit(replay)
+        # guard values must come from the COMPILED replay (fusion can
+        # shift float scalars a ulp vs the eager capture; an eager-valued
+        # guard would miss forever and re-capture every call). The feed
+        # uses PRE-capture arrays: the capture run may have mutated them.
+        try:
+            outs0 = replay(*(pre_feed + extra_pre))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"to_static: compiled path for {self._fn.__name__} failed "
+                f"({type(e).__name__}: {str(e)[:120]}); this "
+                "specialisation stays eager")
+            self._fallback_keys.add(key)
+            return None, result
+        ctrl_vals = [np.asarray(o).reshape(()).item()
+                     for o in outs0[len(out_keys) + len(buf_keys)
+                                    + len(mut_keys):]]
+        return (replay, ctrl_vals, out_treedef, len(out_keys),
+                len(buf_keys), extra_refs, extra_pre, mut_spec), result
 
     def _trace(self, params, buffers, args, kwargs):
         fn = self._fn

@@ -60,6 +60,16 @@ class Program:
         self._keepalive = []      # arrays must outlive the capture
         self._next_key = 0
         self._exec_cache = {}
+        # host-read bookkeeping (SOT value-guard analog; consumed by
+        # jit.api's path specialisation): scalar reads that steered python
+        # control flow, and exports that make the capture unreplayable
+        self._controls = []       # (slot key, concrete value at capture)
+        self._impure = None       # reason string, or None
+        # literal slot -> weakref of the owning Tensor, when known: lets
+        # the path replay feed LIVE values for closure params/buffers
+        # instead of baking capture-time arrays (stale after optimizer
+        # steps, and opaque to autograd)
+        self._literal_owner = {}
 
     # -- capture ----------------------------------------------------------
     def _new_key(self, arr) -> int:
@@ -69,16 +79,34 @@ class Program:
         self._keepalive.append(arr)
         return k
 
-    def _key_for_input(self, arr) -> int:
+    def _key_for_input(self, arr, owner=None) -> int:
         k = self._key_of.get(id(arr))
         if k is None:
             k = self._new_key(arr)
             self._literals[k] = arr   # first seen as an input: a constant
+            if owner is not None:
+                import weakref
+
+                try:
+                    self._literal_owner[k] = weakref.ref(owner)
+                except TypeError:
+                    pass
         return k
 
-    def _record(self, fn, in_arrs, out_arrs):
-        in_keys = [None if a is None else self._key_for_input(a)
-                   for a in in_arrs]
+    def _record(self, fn, in_arrs, out_arrs, tensor_args=None):
+        from ..core.tensor import Tensor
+
+        in_keys = []
+        for i, a in enumerate(in_arrs):
+            if a is None:
+                in_keys.append(None)
+                continue
+            owner = None
+            if tensor_args is not None and i < len(tensor_args) \
+                    and isinstance(tensor_args[i], Tensor) \
+                    and tensor_args[i]._array is a:  # not an AMP cast copy
+                owner = tensor_args[i]
+            in_keys.append(self._key_for_input(a, owner))
         out_keys = [self._new_key(o) for o in out_arrs]
         self._nodes.append((fn, in_keys, out_keys))
         self._exec_cache.clear()
@@ -88,6 +116,30 @@ class Program:
 
     def key_of(self, arr):
         return self._key_of.get(id(arr))
+
+    def _mark_impure(self, why: str):
+        if self._impure is None:
+            self._impure = why
+
+    def _control_read(self, arr):
+        """A scalar left the device to steer host control flow: remember
+        which slot and what value it had, so a replay can re-check the
+        decision (an array never seen by capture registers as a literal —
+        safe, because any host-derived data would have tripped
+        _mark_impure on its way out)."""
+        a = np.asarray(arr)
+        if a.size != 1:
+            self._mark_impure("non-scalar host read")
+            return
+        if len(self._controls) >= 4096:
+            # a long-lived guard logging scalars every step would grow
+            # this list (and pin arrays) without bound
+            self._mark_impure("too many host scalar reads")
+            return
+        key = self._key_of.get(id(arr))
+        if key is None:
+            key = self._key_for_input(arr)
+        self._controls.append((key, a.reshape(()).item()))
 
     # -- facade -----------------------------------------------------------
     def global_block(self):

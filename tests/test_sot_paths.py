@@ -1,0 +1,158 @@
+"""Path specialisation on to_static graph breaks (the SOT sub-graph
+analog — reference: python/paddle/jit/sot/: guard-based compiled subgraphs
+around untraceable python). Here a graph break compiles ONE replay per
+executed control-flow path, guarded by the scalar values that steered
+python; guards are re-validated on device outputs each call."""
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _sf(fn):
+    wrapped = paddle.jit.to_static(fn, full_graph=False)
+    return wrapped
+
+
+class TestPathSpecialisation:
+    def test_data_dependent_branch_compiles_per_path(self):
+        calls = {"n": 0}
+
+        def fn(x):
+            calls["n"] += 1
+            if x.sum() > 0:  # graph break: bool() on a device value
+                return x * 2.0
+            return x - 1.0
+
+        sf = _sf(fn)
+        pos = paddle.to_tensor(np.ones((2, 3), np.float32))
+        neg = paddle.to_tensor(-np.ones((2, 3), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            np.testing.assert_allclose(sf(pos).numpy(), 2 * np.ones((2, 3)))
+            np.testing.assert_allclose(sf(neg).numpy(), -2 * np.ones((2, 3)))
+            eager_calls = calls["n"]
+            # both paths are now compiled: more calls must NOT re-run the
+            # python body
+            np.testing.assert_allclose(
+                sf(pos * 3).numpy(), 6 * np.ones((2, 3)))
+            np.testing.assert_allclose(
+                sf(neg * 3).numpy(), -3 * np.ones((2, 3)) - 1)
+        assert calls["n"] == eager_calls, \
+            "python body re-ran despite compiled paths"
+        (key,) = sf._paths.keys()
+        assert len(sf._paths[key]) == 2
+
+    def test_gradients_flow_through_replayed_path(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+
+        def fn(x):
+            h = lin(x)
+            if h.sum() > 1e9:  # never taken; still a break
+                return h * 0.0
+            return (h * h).sum()
+
+        sf = _sf(fn)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            loss = sf(x)
+            loss.backward()
+        g = lin.weight.grad
+        assert g is not None and float(np.abs(np.asarray(g)).sum()) > 0
+        # oracle: eager
+        lin.clear_gradients()
+        h = lin(x)
+        (h * h).sum().backward()
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.asarray(lin.weight.grad), atol=1e-5)
+
+    def test_numpy_export_stays_eager(self):
+        def fn(x):
+            host = x.numpy()  # bulk export: unreplayable
+            return paddle.to_tensor(host * 2.0) + x.sum() * 0
+
+        sf = _sf(fn)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # force the graph-break route by a host read first
+            def fn2(x):
+                if x.sum() > 0:
+                    return paddle.to_tensor(x.numpy() * 2.0)
+                return x
+
+            sf2 = _sf(fn2)
+            out = sf2(x)
+            np.testing.assert_allclose(out.numpy(), 2 * np.ones((2, 2)))
+            # impure: key must be eager, not cached as a path
+            (key,) = (sf2._fallback_keys or {None})
+            assert key is not None
+            assert not any(sf2._paths.values())
+            # still correct on new values (would be wrong if the numpy()
+            # round-trip had been baked as a constant)
+            out2 = sf2(paddle.to_tensor(3 * np.ones((2, 2), np.float32)))
+            np.testing.assert_allclose(out2.numpy(), 6 * np.ones((2, 2)))
+
+    def test_value_guard_churn_falls_back_eager(self):
+        """item() reads that change every call (loss logging) must not
+        pay capture+compile forever — after _MAX_PATHS captures the key
+        goes eager."""
+        logged = []
+
+        def fn(x):
+            s = (x * x).sum()
+            logged.append(s.item())  # value guard that never stabilizes
+            return s * 2.0
+
+        sf = _sf(fn)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i in range(1, 15):
+                out = sf(paddle.to_tensor(
+                    np.full((3,), float(i), np.float32)))
+                np.testing.assert_allclose(float(out), 6.0 * i * i,
+                                           rtol=1e-5)
+        assert sf._fallback_keys, "churny guards never fell back to eager"
+
+    def test_inplace_buffer_not_double_applied_on_capture(self):
+        """The capture call must not apply in-place effects twice (once
+        eagerly during capture, once via the replay write-back)."""
+        counter = paddle.to_tensor(np.zeros((1,), np.float32))
+
+        def fn(x):
+            if x.sum() > 0:
+                counter.add_(paddle.to_tensor(np.ones((1,), np.float32)))
+            return x * 1.0
+
+        sf = _sf(fn)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for expect in (1.0, 2.0, 3.0):
+                sf(x)
+                assert float(counter.numpy()[0]) == expect, \
+                    (float(counter.numpy()[0]), expect)
+
+    def test_rng_inside_break_stays_eager(self):
+        import paddle_tpu.nn.functional as F
+
+        def fn(x):
+            if x.sum() > 0:
+                return F.dropout(x, p=0.5, training=True)
+            return x
+
+        sf = _sf(fn)
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            a = sf(x).numpy()
+            b = sf(x).numpy()
+        assert not np.allclose(a, b), \
+            "dropout mask frozen — rng capture must stay eager"
