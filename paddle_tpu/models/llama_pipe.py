@@ -86,7 +86,7 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
                              n_micro: Optional[int] = None,
                              lr: float = 1e-4, weight_decay: float = 0.01,
                              grad_clip_norm: Optional[float] = 1.0,
-                             schedule: str = "1F1B"):
+                             schedule: Optional[str] = None, strategy=None):
     """Build (step_fn, params, opt_state) where params =
     {"outer": ..., "stages": ...} and step_fn runs embed -> pp pipeline of
     decoder stages -> norm -> head -> CE loss -> AdamW, fully jitted.
@@ -100,7 +100,26 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
         single-program SPMD model every rank executes the same tick
         program, so interleaved virtual stages would pay V masked compute
         slots per tick — reserved until a multi-program executor exists.
+
+    `strategy`: a pipeline-scheduler pass output / Strategy whose
+    `pipeline` section supplies schedule_mode and accumulate_steps
+    (reference: distributed/passes/pipeline_scheduler_pass) — explicit
+    `schedule`/`n_micro` arguments win over the strategy.
     """
+    if strategy is not None:
+        from ..parallel.trainer import _resolve_strategy
+
+        pipe_cfg = _resolve_strategy(strategy)["pipeline"]
+        if pipe_cfg.get("enable", True):
+            if pipe_cfg.get("schedule_mode") and schedule is None:
+                schedule = pipe_cfg["schedule_mode"]
+            # accumulate_steps <= 1 is the pass's own default, not a
+            # request for a degenerate one-microbatch pipeline
+            if n_micro is None and int(
+                    pipe_cfg.get("accumulate_steps") or 0) > 1:
+                n_micro = int(pipe_cfg["accumulate_steps"])
+    if schedule is None:
+        schedule = "1F1B"
     if schedule in ("VPP", "ZBH1"):
         raise NotImplementedError(
             f"{schedule} needs per-rank divergent tick programs; the "

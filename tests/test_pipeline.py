@@ -198,6 +198,39 @@ class TestLlamaPipeline:
             f"1F1B did not reduce peak temp memory: "
             f"{results['1F1B'][1]} vs {results['FThenB'][1]}")
 
+    def test_scheduler_pass_drives_pp_step(self):
+        """A pipeline-scheduler pass output must select the schedule and
+        microbatching of the pp train step (reference:
+        distributed/passes/pipeline_scheduler_pass)."""
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_pipe import make_llama_pp_train_step
+
+        config = {}
+        PassManager([new_pass("pipeline_scheduler_1F1B",
+                              {"accumulate_steps": 4})]).apply(config)
+        assert config["pipeline"]["schedule_mode"] == "1F1B"
+        mesh = build_mesh({"dp": 2, "pp": 2, "mp": 2})
+        set_global_mesh(mesh)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)))
+        y = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)))
+        step, p, o = make_llama_pp_train_step(model, mesh, lr=1e-3,
+                                              strategy=config)
+        l1, p, o = step(p, o, x, y)
+        l2, p, o = step(p, o, x, y)
+        assert float(l2) < float(l1)
+        # VPP selection through the pass surfaces the documented refusal
+        config2 = {}
+        PassManager([new_pass("pipeline_scheduler_VPP")]).apply(config2)
+        paddle.seed(0)
+        with pytest.raises(NotImplementedError):
+            make_llama_pp_train_step(LlamaForCausalLM(cfg), mesh,
+                                     strategy=config2)
+
     def test_state_split_merge_roundtrip(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.models.llama_pipe import (merge_llama_state,
