@@ -71,9 +71,17 @@ def apply_rotary_emb(q, k=None, v=None, sin=None, cos=None,
         cos, sin = rope_freqs(seq, dh, base=base, position_ids=position_ids)
     else:
         # paddle passes [1, S, 1, D] tables with values duplicated over the
-        # two halves; reduce to [S, D/2]
-        cos = jnp.squeeze(cos)
-        sin = jnp.squeeze(sin)
+        # two halves; reduce to [S, D/2]. Reduce by EXPLICIT dims — a blind
+        # squeeze collapses the seq dim at S == 1 (single-token decode) and
+        # mis-broadcasts the rotation across frequencies.
+        cos = jnp.asarray(cos)
+        sin = jnp.asarray(sin)
+        if cos.ndim == 4:            # [1, S, 1, D]
+            cos = cos[0, :, 0, :]
+            sin = sin[0, :, 0, :]
+        elif cos.ndim == 1:          # a bare frequency row: one position
+            cos = cos[None, :]
+            sin = sin[None, :]
         if cos.shape[-1] == dh:
             cos = cos[..., : dh // 2]
             sin = sin[..., : dh // 2]

@@ -171,17 +171,46 @@ class TestLlama:
         cfg = LlamaConfig.tiny()
         paddle.seed(3)
         model = LlamaForCausalLM(cfg)
-        x, _ = _data(cfg, b=1, s=8)
+        x, _ = _data(cfg, b=2, s=8)
         out = model.generate(paddle.to_tensor(x), max_new_tokens=4)
-        assert out.shape == [1, 12]
-        # incremental logits must match a full forward pass
-        full_logits = model(paddle.to_tensor(out.numpy()[:, :-1]))
-        nxt = np.argmax(full_logits.numpy()[:, -1], axis=-1)
+        assert out.shape == [2, 12]
+        # single-token incremental LOGITS must match the full forward (an
+        # argmax-only check once hid a decode-position rope bug)
         caches = [(None, None)] * cfg.num_hidden_layers
         lg, caches = model(paddle.to_tensor(out.numpy()[:, :-1]),
                            caches=caches)
-        nxt2 = np.argmax(lg.numpy()[:, -1], axis=-1)
-        np.testing.assert_array_equal(nxt, nxt2)
+        last = out.numpy()[:, -1:]
+        lg_inc, _ = model(paddle.to_tensor(last), caches=caches,
+                          position_offset=11)
+        full = model(paddle.to_tensor(out.numpy()))
+        np.testing.assert_allclose(lg_inc.numpy()[:, -1],
+                                   full.numpy()[:, -1], atol=2e-5)
+
+    def test_jit_generate_matches_eager(self):
+        """The single-program decode loop (prefill + lax.scan over the
+        fixed cache) must reproduce eager generate token for token."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(5)
+        model = LlamaForCausalLM(cfg)
+        x, _ = _data(cfg, b=2, s=8)
+        a = model.generate(paddle.to_tensor(x), max_new_tokens=6)
+        b = model.jit_generate(paddle.to_tensor(x), max_new_tokens=6)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        # eos: single row whose SECOND generated token is declared eos —
+        # the output must trim right after it, and the finished tail is
+        # eos-padded up to the cut
+        row = x[:1]
+        a1 = model.generate(paddle.to_tensor(row), max_new_tokens=6)
+        gen = a1.numpy()[0, 8:]
+        eos = int(gen[1])  # 2nd generated token declared eos
+        first_hit = int(np.argmax(gen == eos))  # may also be token 0
+        c = model.jit_generate(paddle.to_tensor(row), max_new_tokens=6,
+                               eos_token_id=eos)
+        assert c.shape[1] == 8 + first_hit + 1, (c.shape, first_hit)
+        assert int(c.numpy()[0, -1]) == eos
+        # max_new_tokens=0 returns the prompt unchanged, like generate()
+        z = model.jit_generate(paddle.to_tensor(row), max_new_tokens=0)
+        np.testing.assert_array_equal(z.numpy(), row)
 
     def test_sep_matches_serial(self):
         """Ulysses SEP must be numerically equivalent to serial training,
