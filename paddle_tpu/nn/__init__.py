@@ -1,6 +1,8 @@
 """paddle.nn namespace (reference: python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from .layer.layers import Layer  # noqa: F401
 from .layer.container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
 from .layer.common import *  # noqa: F401,F403

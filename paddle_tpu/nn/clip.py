@@ -50,24 +50,6 @@ class ClipGradByValue(ClipGradBase):
         return [jnp.clip(g, self.min, self.max) for g in grads]
 
 
-def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    """paddle.nn.utils.clip_grad_norm_."""
-    from ..core.tensor import Tensor
-
-    params = [p for p in parameters if p._grad is not None]
-    if not params:
-        return Tensor(jnp.zeros(()))
-    if norm_type == float("inf"):
-        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad)) for p in params]))
-    else:
-        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(p._grad) ** norm_type) for p in params])) ** (1.0 / norm_type)
-    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
-    for p in params:
-        p._grad = p._grad * scale
-    return Tensor(total)
-
-
-def clip_grad_value_(parameters, clip_value):
-    for p in parameters:
-        if p._grad is not None:
-            p._grad = jnp.clip(p._grad, -clip_value, clip_value)
+# canonical implementations live in paddle.nn.utils; keep these names
+# importable from nn.clip for reference parity (python/paddle/nn/clip.py)
+from .utils import clip_grad_norm_, clip_grad_value_  # noqa: E402,F401

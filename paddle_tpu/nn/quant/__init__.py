@@ -1,0 +1,42 @@
+"""paddle.nn.quant — weight-only quant serving ops + QAT layers.
+
+Reference: python/paddle/nn/quant/__init__.py.
+"""
+from . import qat  # noqa: F401
+from .functional_layers import (  # noqa: F401
+    FloatFunctionalLayer,
+    add,
+    concat,
+    divide,
+    flatten,
+    matmul,
+    multiply,
+    reshape,
+    subtract,
+    transpose,
+)
+from .quant_layers import (  # noqa: F401
+    FakeQuantAbsMax,
+    FakeQuantChannelWiseAbsMax,
+    FakeQuantMovingAverageAbsMax,
+    MovingAverageAbsMaxScale,
+    QuantizedConv2D,
+    QuantizedLinear,
+    QuantStub,
+)
+from .quantized_linear import (  # noqa: F401
+    apply_per_channel_scale,
+    llm_int8_linear,
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+from .stub import Stub  # noqa: F401
+
+__all__ = [
+    "Stub",
+    "weight_only_linear",
+    "llm_int8_linear",
+    "weight_quantize",
+    "weight_dequantize",
+]

@@ -1,0 +1,65 @@
+"""Functional-op wrapper layers so tensor ops can be quantized like layers.
+
+Reference: python/paddle/nn/quant/functional_layers.py:21.
+"""
+from __future__ import annotations
+
+from ...core import tensor as _ct
+from ...ops import manipulation as _manip
+from ...ops import math as _math
+from ..layer.layers import Layer
+
+__all__ = [
+    "FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
+    "reshape", "transpose", "concat", "flatten", "matmul",
+]
+
+
+class FloatFunctionalLayer(Layer):
+    def __init__(self):
+        super().__init__()
+
+
+class add(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return _math.add(x, y)
+
+
+class subtract(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return _math.subtract(x, y)
+
+
+class multiply(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return _math.multiply(x, y)
+
+
+class divide(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return _math.divide(x, y)
+
+
+class reshape(FloatFunctionalLayer):
+    def forward(self, x, shape, name=None):
+        return _manip.reshape(x, shape)
+
+
+class transpose(FloatFunctionalLayer):
+    def forward(self, x, perm, name=None):
+        return _manip.transpose(x, perm)
+
+
+class concat(FloatFunctionalLayer):
+    def forward(self, x, axis=0, name=None):
+        return _manip.concat(x, axis)
+
+
+class flatten(FloatFunctionalLayer):
+    def forward(self, x, start_axis=0, stop_axis=-1, name=None):
+        return _manip.flatten(x, start_axis, stop_axis)
+
+
+class matmul(FloatFunctionalLayer):
+    def forward(self, x, y, transpose_x=False, transpose_y=False, name=None):
+        return _math.matmul(x, y, transpose_x, transpose_y)

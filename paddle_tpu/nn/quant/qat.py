@@ -1,0 +1,80 @@
+"""QAT layer wrappers (reference: python/paddle/nn/quant/qat/{linear,conv}.py)."""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+
+__all__ = ["QuantedLinear", "QuantedConv2D", "ConvertibleQuantedLayer"]
+
+
+class ConvertibleQuantedLayer(Layer):
+    """Base for QAT layers that can convert to deploy (quant/dequant) form."""
+
+    def weights_to_quanters(self):
+        raise NotImplementedError
+
+    def activation_quanters(self):
+        raise NotImplementedError
+
+
+def _instance(factory, layer):
+    if factory is None:
+        return None
+    if hasattr(factory, "_instance"):
+        return factory._instance(layer)
+    if hasattr(factory, "instance"):
+        return factory.instance(layer)
+    return factory
+
+
+class QuantedLinear(ConvertibleQuantedLayer):
+    """Linear with fake-quantized input/weight (reference qat/linear.py:22)."""
+
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self.name = getattr(layer, "name", None)
+        self.weight_quanter = _instance(getattr(q_config, "weight", None), layer)
+        self.activation_quanter = _instance(getattr(q_config, "activation", None), layer)
+
+    def forward(self, input):
+        from .. import functional as F
+
+        q_in = self.activation_quanter(input) if self.activation_quanter else input
+        q_w = self.weight_quanter(self.weight) if self.weight_quanter else self.weight
+        return F.linear(q_in, q_w, self.bias)
+
+    def weights_to_quanters(self):
+        return [("weight", "weight_quanter")]
+
+    def activation_quanters(self):
+        return ["activation_quanter"]
+
+
+class QuantedConv2D(ConvertibleQuantedLayer):
+    """Conv2D with fake-quantized input/weight (reference qat/conv.py:23)."""
+
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self._conv_args = dict(
+            stride=layer._stride, padding=layer._padding,
+            dilation=layer._dilation, groups=layer._groups,
+            data_format=getattr(layer, "_data_format", "NCHW"),
+        )
+        self.weight_quanter = _instance(getattr(q_config, "weight", None), layer)
+        self.activation_quanter = _instance(getattr(q_config, "activation", None), layer)
+
+    def forward(self, input):
+        from .. import functional as F
+
+        q_in = self.activation_quanter(input) if self.activation_quanter else input
+        q_w = self.weight_quanter(self.weight) if self.weight_quanter else self.weight
+        return F.conv2d(q_in, q_w, self.bias, **self._conv_args)
+
+    def weights_to_quanters(self):
+        return [("weight", "weight_quanter")]
+
+    def activation_quanters(self):
+        return ["activation_quanter"]

@@ -1,0 +1,192 @@
+"""Fake-quant layers for QAT/PTQ simulation.
+
+Reference: python/paddle/nn/quant/quant_layers.py (FakeQuantAbsMax:69,
+FakeQuantMovingAverageAbsMax:172, FakeQuantChannelWiseAbsMax:310,
+MovingAverageAbsMaxScale:424, QuantizedLinear:769, QuantizedConv2D:544,
+QuantStub via stub.py). Quant math is simulated in float (fake quant) —
+real int8 execution lives in quantized_linear.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ..layer.layers import Layer
+
+__all__ = [
+    "FakeQuantAbsMax",
+    "FakeQuantMovingAverageAbsMax",
+    "FakeQuantChannelWiseAbsMax",
+    "MovingAverageAbsMaxScale",
+    "QuantizedLinear",
+    "QuantizedConv2D",
+    "QuantStub",
+]
+
+
+def _fake_quant(a, scale, qmax):
+    import jax
+
+    s = jnp.maximum(scale, 1e-8)
+    out = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+    # straight-through estimator: quantization noise is constant w.r.t. a,
+    # so QAT gradients pass through unchanged
+    return a + jax.lax.stop_gradient(out - a)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor absmax fake quant (scale recomputed every forward)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32", reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, input):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+
+        def impl(a):
+            return _fake_quant(a, jnp.max(jnp.abs(a)), qmax)
+
+        return dispatch("fake_quant_abs_max", impl, (input,))
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """EMA-absmax fake quant; scale is a buffer updated in training mode."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8, dtype="float32",
+                 reduce_type=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, input):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        if self.training:
+            cur = jnp.max(jnp.abs(unwrap(input))).astype(jnp.float32)
+            r = self._moving_rate
+            state = unwrap(self.state) * r + 1.0
+            accum = unwrap(self.scale) * unwrap(self.state) * r + cur
+            scale = accum / state
+            self.scale = Tensor(scale)
+            self.state = Tensor(state)
+        scale = unwrap(self.scale)
+        return dispatch("fake_quant_ma_abs_max", lambda a: _fake_quant(a, scale, qmax), (input,))
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel absmax fake quant along ``quant_axis``."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8, quant_axis=0,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+
+    def forward(self, input):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        axis = self._quant_axis
+
+        def impl(a):
+            axes = tuple(i for i in range(a.ndim) if i != axis)
+            scale = jnp.max(jnp.abs(a), axis=axes, keepdims=True)
+            return _fake_quant(a, scale, qmax)
+
+        return dispatch("fake_quant_cw_abs_max", impl, (input,))
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Track an EMA output scale without altering the tensor."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32", reduce_type=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, input):
+        if self.training:
+            cur = jnp.max(jnp.abs(unwrap(input))).astype(jnp.float32)
+            r = self._moving_rate
+            state = unwrap(self.state) * r + 1.0
+            accum = unwrap(self.scale) * unwrap(self.state) * r + cur
+            self.scale = Tensor(accum / state)
+            self.state = Tensor(state)
+        return input
+
+
+def _get_fake_quant_type(quant_type: str, **kwargs):
+    table = {
+        "abs_max": FakeQuantAbsMax,
+        "moving_average_abs_max": FakeQuantMovingAverageAbsMax,
+        "channel_wise_abs_max": FakeQuantChannelWiseAbsMax,
+    }
+    if quant_type not in table:
+        raise ValueError(f"unsupported weight quantize type {quant_type}")
+    cls = table[quant_type]
+    import inspect
+
+    allowed = set(inspect.signature(cls.__init__).parameters)
+    return cls(**{k: v for k, v in kwargs.items() if k in allowed})
+
+
+class QuantizedLinear(Layer):
+    """Simulated-quant Linear: fake-quant weight (+ activation), then linear."""
+
+    def __init__(self, layer, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self.name = getattr(layer, "name", None)
+        self._fake_quant_weight = _get_fake_quant_type(
+            weight_quantize_type, quant_bits=weight_bits, quant_axis=1)
+        self._fake_quant_input = _get_fake_quant_type(
+            activation_quantize_type, quant_bits=activation_bits, moving_rate=moving_rate)
+
+    def forward(self, input):
+        from .. import functional as F
+
+        q_input = self._fake_quant_input(input)
+        q_weight = self._fake_quant_weight(self.weight)
+        return F.linear(q_input, q_weight, self.bias)
+
+
+class QuantizedConv2D(Layer):
+    """Simulated-quant Conv2D."""
+
+    def __init__(self, layer, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self._conv_args = dict(
+            stride=layer._stride, padding=layer._padding,
+            dilation=layer._dilation, groups=layer._groups,
+            data_format=getattr(layer, "_data_format", "NCHW"),
+        )
+        self._fake_quant_weight = _get_fake_quant_type(
+            weight_quantize_type, quant_bits=weight_bits, quant_axis=0)
+        self._fake_quant_input = _get_fake_quant_type(
+            activation_quantize_type, quant_bits=activation_bits, moving_rate=moving_rate)
+
+    def forward(self, input):
+        from .. import functional as F
+
+        q_input = self._fake_quant_input(input)
+        q_weight = self._fake_quant_weight(self.weight)
+        return F.conv2d(q_input, q_weight, self.bias, **self._conv_args)
+
+
+class QuantStub(Layer):
+    """Marks a quantization boundary; identity until converted."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, input):
+        return input

@@ -1,0 +1,211 @@
+"""Weight-only quantized linear algebra for LLM serving.
+
+TPU-native re-implementation of the reference weight-only-quant family
+(reference: python/paddle/nn/quant/quantized_linear.py:54 weight_quantize,
+:120 weight_dequantize, :180 weight_only_linear, :273 llm_int8_linear,
+:339 apply_per_channel_scale).
+
+Layouts (TPU convention, documented here because it differs from the CUDA
+kernels' tile-swizzled layouts):
+
+- ``weight_quantize(x[K, N])`` returns ``(w_q, scale)`` with ``w_q`` stored
+  **transposed** ``[N, K]`` like the reference. int8 keeps one value per
+  byte; int4 packs two adjacent K-values per int8 byte → ``[N, K//2]``
+  (low nibble = even k, high nibble = odd k).
+- Per-channel (``group_size=-1``): ``scale`` is ``[N]`` float32.
+  Grouped (``group_size ∈ {64, 128}``): ``scale`` is ``[ceil(K/g), N]``.
+
+The matmul keeps weights int8 in HBM and lets XLA fuse the dequantize
+convert into the dot — that is the entire win on a memory-bound decode:
+half (int8) or quarter (int4) the weight bytes per step. ``arch`` is
+accepted for API parity and ignored (no SM versions on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...framework import dtype as dtypes
+
+__all__ = [
+    "weight_quantize",
+    "weight_dequantize",
+    "weight_only_linear",
+    "llm_int8_linear",
+    "apply_per_channel_scale",
+]
+
+_ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
+
+
+def _check(algo, group_size):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size only supports -1/64/128, got {group_size}")
+
+
+def _pack_int4(q):
+    """[N, K] int8 values in [-8, 7] → [N, K//2] packed bytes."""
+    n, k = q.shape
+    if k % 2:
+        q = jnp.pad(q, ((0, 0), (0, 1)))
+        k += 1
+    lo = q[:, 0::2] & 0x0F
+    hi = (q[:, 1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def _unpack_int4(w, k):
+    """[N, K//2] packed bytes → [N, K] int8 values in [-8, 7]."""
+    lo = (w.astype(jnp.int32) & 0x0F).astype(jnp.int8)
+    hi = ((w.astype(jnp.int32) >> 4) & 0x0F).astype(jnp.int8)
+    # sign-extend nibbles
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1).reshape(w.shape[0], -1)
+    return out[:, :k]
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", arch=None, group_size: int = -1):
+    """Quantize a [K, N] weight to int8/int4 with per-channel or grouped scales.
+
+    Returns (w_q [N, K] int8, scale float32). Reference:
+    python/paddle/nn/quant/quantized_linear.py:54.
+    """
+    _check(algo, group_size)
+    a = jnp.asarray(unwrap(x))
+    if a.ndim != 2:
+        raise ValueError(f"weight_quantize expects a 2-D weight, got shape {a.shape}")
+    k, n = a.shape
+    wt = a.T.astype(jnp.float32)  # [N, K]
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    if group_size == -1:
+        scale = jnp.max(jnp.abs(wt), axis=1) / qmax  # [N]
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(wt / scale[:, None]), -qmax - 1, qmax)
+    else:
+        g = -(-k // group_size)
+        pad = g * group_size - k
+        wp = jnp.pad(wt, ((0, 0), (0, pad))).reshape(n, g, group_size)
+        scale = jnp.max(jnp.abs(wp), axis=2) / qmax  # [N, G]
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(wp / scale[:, :, None]), -qmax - 1, qmax)
+        q = q.reshape(n, g * group_size)[:, :k]
+        scale = scale.T  # [G, N] — reference group-scale layout
+    q = q.astype(jnp.int8)
+    if algo == "weight_only_int4":
+        q = _pack_int4(q)
+    return Tensor(q), Tensor(scale.astype(jnp.float32))
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype="float16", group_size: int = -1):
+    """Invert :func:`weight_quantize` → [K, N] float. Reference :120."""
+    _check(algo, group_size)
+    w = jnp.asarray(unwrap(x))
+    s = jnp.asarray(unwrap(scale))
+    out_dtype = dtypes.convert_dtype(out_dtype) or "float16"
+    if algo == "weight_only_int4":
+        k = s.shape[0] * group_size if group_size != -1 else w.shape[1] * 2
+        w = _unpack_int4(w, k)
+    n, k = w.shape
+    if group_size == -1:
+        deq = w.astype(jnp.float32) * s[:, None]
+    else:
+        g = s.shape[0]
+        pad = g * group_size - k
+        wp = jnp.pad(w, ((0, 0), (0, pad))).reshape(n, g, group_size)
+        deq = (wp.astype(jnp.float32) * s.T[:, :, None]).reshape(n, g * group_size)[:, :k]
+    return Tensor(deq.T.astype(out_dtype))
+
+
+def _weight_only_matmul(xa, w, s, weight_dtype, group_size):
+    """out[..., N] = xa[..., K] @ dequant(w).T with int8/int4 weights in HBM."""
+    if weight_dtype == "int4":
+        k = xa.shape[-1]
+        w = _unpack_int4(w, k)
+    n, k = w.shape
+    if group_size == -1:
+        # per-channel scale commutes with the K-contraction → scale the output;
+        # the int8→bf16 convert fuses into the dot, weights stay int8 in HBM
+        out = jnp.einsum("...k,nk->...n", xa, w.astype(xa.dtype),
+                         preferred_element_type=jnp.float32)
+        out = out * s.astype(jnp.float32)
+    else:
+        g = s.shape[0]
+        pad = g * group_size - k
+        xp = jnp.pad(xa, [(0, 0)] * (xa.ndim - 1) + [(0, pad)])
+        xg = xp.reshape(*xa.shape[:-1], g, group_size)
+        wp = jnp.pad(w, ((0, 0), (0, pad))).reshape(n, g, group_size)
+        # contract within each group, then apply the [G, N] scales
+        out = jnp.einsum("...gk,ngk->...gn", xg, wp.astype(xa.dtype),
+                         preferred_element_type=jnp.float32)
+        out = jnp.einsum("...gn,gn->...n", out, s.astype(jnp.float32))
+    return out.astype(xa.dtype)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None, group_size: int = -1):
+    """y = x @ dequant(weight).T + bias with int8/int4 [N, K] weights.
+
+    Reference: python/paddle/nn/quant/quantized_linear.py:180.
+    """
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be 'int8' or 'int4', got {weight_dtype!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size only supports -1/64/128, got {group_size}")
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale")
+
+    def impl(xa, w, s, *rest):
+        out = _weight_only_matmul(xa, w, s, weight_dtype, group_size)
+        if rest:
+            out = out + rest[0].astype(out.dtype)
+        return out
+
+    args = (x, weight, weight_scale) + (() if bias is None else (bias,))
+    return dispatch("weight_only_linear", impl, args)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold: float = 6.0):
+    """LLM.int8() linear: int8×int8 matmul with fp outlier decomposition.
+
+    Activation columns whose absmax ≥ ``threshold`` stay in x.dtype and hit a
+    dequantized matmul; the rest are dynamically quantized per-token to int8
+    so the main GEMM runs int8×int8 (int32 accumulate on the MXU). Masking
+    keeps shapes static for jit. Reference:
+    python/paddle/nn/quant/quantized_linear.py:273.
+    """
+    if weight_scale is None:
+        raise ValueError("llm_int8_linear requires weight_scale")
+
+    def impl(xa, w, s, *rest):
+        xf = xa.astype(jnp.float32)
+        col_amax = jnp.max(jnp.abs(xf), axis=tuple(range(xa.ndim - 1)))  # [K]
+        outlier = col_amax >= threshold
+        x_in = jnp.where(outlier, 0.0, xf)
+        x_out = jnp.where(outlier, xf, 0.0)
+        # per-token dynamic quantization of the inlier block
+        tok_scale = jnp.maximum(jnp.max(jnp.abs(x_in), axis=-1, keepdims=True), 1e-8) / 127.0
+        xq = jnp.clip(jnp.round(x_in / tok_scale), -128, 127).astype(jnp.int8)
+        main = jax.lax.dot_general(
+            xq, w,
+            (((xq.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        main = main * tok_scale * s.astype(jnp.float32)
+        outliers = jnp.einsum("...k,nk->...n", x_out, w.astype(jnp.float32) * s[:, None])
+        out = (main + outliers).astype(xa.dtype)
+        if rest:
+            out = out + rest[0].astype(out.dtype)
+        return out
+
+    args = (x, weight, weight_scale) + (() if bias is None else (bias,))
+    return dispatch("llm_int8_linear", impl, args)
+
+
+def apply_per_channel_scale(x, scales):
+    """Pre-scale activations per channel (smooth-quant style). Reference :339."""
+    return dispatch("apply_per_channel_scale", lambda a, s: a * s.astype(a.dtype), (x, scales))

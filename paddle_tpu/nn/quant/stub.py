@@ -1,0 +1,37 @@
+"""Quantization stubs (reference: python/paddle/nn/quant/stub.py:29,86)."""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+
+__all__ = ["Stub", "QuanterStub"]
+
+
+class Stub(Layer):
+    """Placeholder marking where an activation quanter should be inserted.
+
+    Carries an optional observer/quanter factory; ``QuantConfig`` replaces it
+    with a :class:`QuanterStub` during ``QAT.quantize``.
+    """
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, input):
+        return input
+
+
+class QuanterStub(Layer):
+    """A Stub converted for QAT: applies the configured quanter in forward."""
+
+    def __init__(self, layer: Stub, q_config=None):
+        super().__init__()
+        self._quanter = None
+        factory = layer._observer
+        if factory is None and q_config is not None:
+            factory = getattr(q_config, "activation", None)
+        if factory is not None:
+            self._quanter = factory.instance(layer) if hasattr(factory, "instance") else factory
+
+    def forward(self, input):
+        return self._quanter(input) if self._quanter is not None else input

@@ -1,0 +1,321 @@
+"""paddle.nn.utils — re-parameterization hooks and gradient utilities.
+
+TPU-native re-implementation of the reference nn.utils package:
+
+- weight_norm / remove_weight_norm
+  (reference: python/paddle/nn/utils/weight_norm_hook.py:178,224)
+- spectral_norm
+  (reference: python/paddle/nn/utils/spectral_norm_hook.py:163)
+- clip_grad_norm_ / clip_grad_value_
+  (reference: python/paddle/nn/utils/clip_grad_norm_.py:28,
+   clip_grad_value_.py:28)
+- parameters_to_vector / vector_to_parameters
+  (reference: python/paddle/nn/utils/transform_parameters.py:85,138)
+
+The hooks follow the reference design — the named parameter is replaced by
+derived parameters (`weight_g`/`weight_v`, or `weight_orig` + `u`/`v`
+buffers) and a forward-pre-hook recomputes the effective weight through
+dispatch ops, so eager autograd reaches the derived parameters.  All math is
+jnp closed forms; there is no translated kernel code.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor, dispatch, unwrap
+from ...core import tape as _tape
+
+__all__ = [
+    "weight_norm",
+    "remove_weight_norm",
+    "spectral_norm",
+    "parameters_to_vector",
+    "vector_to_parameters",
+    "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+# ---------------------------------------------------------------------------
+# weight_norm
+# ---------------------------------------------------------------------------
+def _norm_except_dim_arr(p, dim):
+    """||p|| reduced over every axis except `dim` (dim=-1 → full norm)."""
+    if dim == -1:
+        return jnp.sqrt(jnp.sum(jnp.square(p)) + 1e-12)
+    axes = tuple(a for a in range(p.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(p), axis=axes) + 1e-12)
+
+
+def norm_except_dim(p, dim: int) -> Tensor:
+    return dispatch("norm_except_dim", lambda a: _norm_except_dim_arr(a, dim), (p,))
+
+
+def _weight_norm_arr(v, g, dim):
+    """w = g * v / ||v||_{except dim}, broadcasting g over the kept axis."""
+    if dim == -1:
+        return v * (g / jnp.sqrt(jnp.sum(jnp.square(v)) + 1e-12))
+    norm = _norm_except_dim_arr(v, dim)
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return v * (g / norm).reshape(shape)
+
+
+class WeightNorm:
+    """Forward-pre-hook: recompute `name` from `name_g` / `name_v`."""
+
+    def __init__(self, name: str, dim: int):
+        self.name = name
+        self.dim = -1 if dim is None else dim
+
+    def compute_weight(self, layer) -> Tensor:
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        return dispatch(
+            "weight_norm", lambda va, ga: _weight_norm_arr(va, ga, self.dim), (v, g)
+        )
+
+    @staticmethod
+    def apply(layer, name: str, dim) -> "WeightNorm":
+        for hook in layer._forward_pre_hooks.values():
+            if isinstance(hook, WeightNorm) and hook.name == name:
+                raise RuntimeError(
+                    f"Cannot register two weight_norm hooks on the same parameter {name}"
+                )
+        if dim is None:
+            dim = -1
+        w = layer._parameters[name]
+        ndim = len(w.shape)
+        if not (-ndim <= dim < ndim):
+            raise AssertionError(
+                "dim must set between [-R, R), R means the dimension of weight."
+            )
+        if dim != -1:
+            dim = dim % ndim
+
+        fn = WeightNorm(name, dim)
+        del layer._parameters[name]
+        w_arr = unwrap(w)
+        layer.add_parameter(name + "_v", Parameter(w_arr))
+        layer.add_parameter(
+            name + "_g", Parameter(_norm_except_dim_arr(w_arr, dim))
+        )
+        setattr(layer, name, fn.compute_weight(layer).detach())
+        layer.register_forward_pre_hook(fn)
+        return fn
+
+    def remove(self, layer):
+        w = self.compute_weight(layer).detach()
+        delattr(layer, self.name)
+        del layer._parameters[self.name + "_g"]
+        del layer._parameters[self.name + "_v"]
+        layer.add_parameter(self.name, Parameter(unwrap(w)))
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name, self.compute_weight(layer))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """w = g * v/||v||; replaces `name` with `name_g` + `name_v` parameters.
+
+    Reference: python/paddle/nn/utils/weight_norm_hook.py:178.
+    """
+    WeightNorm.apply(layer, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Reference: python/paddle/nn/utils/weight_norm_hook.py:224."""
+    for k, hook in list(layer._forward_pre_hooks.items()):
+        if isinstance(hook, WeightNorm) and hook.name == name:
+            hook.remove(layer)
+            del layer._forward_pre_hooks[k]
+            return layer
+    raise ValueError(f"weight_norm of '{name}' not found in {type(layer).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# spectral_norm
+# ---------------------------------------------------------------------------
+def _l2n(x, eps):
+    return x / jnp.maximum(jnp.linalg.norm(x), eps)
+
+
+class SpectralNorm:
+    """Forward-pre-hook: w / sigma_max(w) via power iteration on u/v buffers.
+
+    Reference: python/paddle/nn/utils/spectral_norm_hook.py:40.
+    """
+
+    def __init__(self, name="weight", n_power_iterations=1, dim=0, eps=1e-12):
+        if n_power_iterations <= 0:
+            raise ValueError(
+                "Expected n_power_iterations to be positive, but "
+                f"got n_power_iterations={n_power_iterations}"
+            )
+        self.name = name
+        self.dim = dim
+        self.n_power_iterations = n_power_iterations
+        self.eps = eps
+
+    def _to_matrix(self, w):
+        if self.dim != 0:
+            perm = [self.dim] + [d for d in range(w.ndim) if d != self.dim]
+            w = jnp.transpose(w, perm)
+        return w.reshape(w.shape[0], -1)
+
+    def compute_weight(self, layer, do_power_iteration: bool) -> Tensor:
+        weight = getattr(layer, self.name + "_orig")
+        u_t = getattr(layer, self.name + "_u")
+        v_t = getattr(layer, self.name + "_v")
+        if do_power_iteration:
+            w_mat = self._to_matrix(unwrap(weight))
+            u, v = unwrap(u_t), unwrap(v_t)
+            for _ in range(self.n_power_iterations):
+                v = _l2n(w_mat.T @ u, self.eps)
+                u = _l2n(w_mat @ v, self.eps)
+            # persist the iterated vectors (buffers are state, not autograd)
+            setattr(layer, self.name + "_u", Tensor(u))
+            setattr(layer, self.name + "_v", Tensor(v))
+            u_t, v_t = getattr(layer, self.name + "_u"), getattr(layer, self.name + "_v")
+
+        def impl(w, u, v):
+            sigma = u @ (self._to_matrix(w) @ v)
+            return w / sigma
+
+        return dispatch("spectral_norm", impl, (weight, u_t, v_t))
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name, self.compute_weight(layer, layer.training))
+
+    @staticmethod
+    def apply(layer, name, n_power_iterations, dim, eps) -> "SpectralNorm":
+        for hook in layer._forward_pre_hooks.values():
+            if isinstance(hook, SpectralNorm) and hook.name == name:
+                raise RuntimeError(
+                    f"Cannot register two spectral_norm hooks on the same parameter {name}"
+                )
+        fn = SpectralNorm(name, n_power_iterations, dim, eps)
+        weight = layer._parameters[name]
+        w_mat = fn._to_matrix(unwrap(weight))
+        h, w = w_mat.shape
+        from ...framework.random import next_key
+        import jax
+
+        ku, kv = jax.random.split(next_key())
+        u = _l2n(jax.random.normal(ku, (h,), dtype=w_mat.dtype), eps)
+        v = _l2n(jax.random.normal(kv, (w,), dtype=w_mat.dtype), eps)
+
+        del layer._parameters[name]
+        layer.add_parameter(name + "_orig", weight)
+        # plain attribute so inits that poke `name` keep working
+        object.__setattr__(layer, name, Tensor(unwrap(weight)))
+        layer.register_buffer(name + "_u", Tensor(u))
+        layer.register_buffer(name + "_v", Tensor(v))
+        layer.register_forward_pre_hook(fn)
+        return fn
+
+
+def spectral_norm(
+    layer, name: str = "weight", n_power_iterations: int = 1, eps: float = 1e-12, dim=None
+):
+    """Reference: python/paddle/nn/utils/spectral_norm_hook.py:163."""
+    if dim is None:
+        # Linear-style weights normalize over axis 0; conv-transpose over 1
+        dim = 1 if type(layer).__name__ in (
+            "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose", "Linear"
+        ) else 0
+    SpectralNorm.apply(layer, name, n_power_iterations, dim, eps)
+    return layer
+
+
+# ---------------------------------------------------------------------------
+# parameter <-> vector
+# ---------------------------------------------------------------------------
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten parameters into one 1-D tensor.
+
+    Reference: python/paddle/nn/utils/transform_parameters.py:85.
+    """
+    parameters = list(parameters)
+    if not parameters:
+        raise ValueError("parameters_to_vector got an empty parameter list")
+    vec = jnp.concatenate([unwrap(p).reshape(-1) for p in parameters])
+    return Tensor(vec, stop_gradient=False)
+
+
+def vector_to_parameters(vec, parameters, name=None) -> None:
+    """Slice a 1-D tensor back into the parameters, in place.
+
+    Reference: python/paddle/nn/utils/transform_parameters.py:138.
+    """
+    parameters = list(parameters)
+    arr = unwrap(vec)
+    sizes = [int(math.prod(p.shape)) if p.shape else 1 for p in parameters]
+    if sum(sizes) != arr.shape[0]:
+        raise ValueError(
+            f"vector has {arr.shape[0]} elements but parameters need {sum(sizes)}"
+        )
+    offset = 0
+    for p, n in zip(parameters, sizes):
+        chunk = arr[offset : offset + n].reshape(tuple(p.shape))
+        p._array = jnp.asarray(chunk, dtype=unwrap(p).dtype)
+        offset += n
+
+
+# ---------------------------------------------------------------------------
+# gradient clipping (in place on .grad)
+# ---------------------------------------------------------------------------
+def clip_grad_norm_(
+    parameters, max_norm, norm_type: float = 2.0, error_if_nonfinite: bool = False
+) -> Tensor:
+    """Clip the global norm of the parameters' gradients, in place.
+
+    Reference: python/paddle/nn/utils/clip_grad_norm_.py:28.
+    """
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    parameters = list(parameters)
+    if norm_type not in (float("inf"), 0, 1, 2):
+        raise ValueError("norm_type only support [inf, 0, 1, 2]")
+    max_norm = float(max_norm)
+    norm_type = float(norm_type)
+    with _tape.no_grad():
+        grads = [p._grad for p in parameters if p._grad is not None]
+        if not grads:
+            return Tensor(jnp.asarray(0.0))
+        if norm_type == float("inf"):
+            total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+        else:
+            per = jnp.stack(
+                [jnp.linalg.norm(g.reshape(-1), ord=norm_type) for g in grads]
+            )
+            total = jnp.linalg.norm(per, ord=norm_type)
+        if error_if_nonfinite and not bool(jnp.isfinite(total)):
+            raise RuntimeError(
+                f"The total norm of {norm_type} order of the gradients from "
+                "`parameters` is non-finite, so it cannot be clipped. To disable "
+                "this error and scale the gradient by the non-finite norm, "
+                "set `error_if_nonfinite=False`"
+            )
+        coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+        for p in parameters:
+            if p._grad is not None:
+                p._grad = p._grad * coef
+        return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value) -> None:
+    """Clamp every gradient element into [-clip_value, clip_value], in place.
+
+    Reference: python/paddle/nn/utils/clip_grad_value_.py:28.
+    """
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    clip_value = float(clip_value)
+    with _tape.no_grad():
+        for p in parameters:
+            if p._grad is not None:
+                p._grad = jnp.clip(p._grad, -clip_value, clip_value)

@@ -1,0 +1,287 @@
+"""Tests for paddle.nn.utils (re-parameterization hooks, grad clipping,
+parameter<->vector) and paddle.nn.quant (weight-only quant serving family).
+
+Oracle style follows tests/test_nn.py: numpy closed forms, plus torch-free
+reference math. Reference APIs: python/paddle/nn/utils/*.py,
+python/paddle/nn/quant/quantized_linear.py.
+"""
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestWeightNorm(unittest.TestCase):
+    def test_reparam_and_identity_at_init(self):
+        lin = nn.Linear(6, 4)
+        w0 = np.asarray(lin.weight._array)
+        nn.utils.weight_norm(lin, dim=0)
+        self.assertIn("weight_g", lin._parameters)
+        self.assertIn("weight_v", lin._parameters)
+        self.assertNotIn("weight", lin._parameters)
+        # g has one entry per kept-axis slice
+        self.assertEqual(tuple(lin.weight_g.shape), (6,))
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal((3, 6)).astype("float32"))
+        y = lin(x)
+        np.testing.assert_allclose(np.asarray(lin.weight._array), w0, rtol=1e-5, atol=1e-6)
+        ref = np.asarray(x._array) @ w0 + np.asarray(lin.bias._array)
+        np.testing.assert_allclose(np.asarray(y._array), ref, rtol=1e-5, atol=1e-5)
+
+    def test_grads_flow_to_g_and_v(self):
+        lin = nn.Linear(5, 3)
+        nn.utils.weight_norm(lin)
+        x = paddle.to_tensor(np.ones((2, 5), "float32"))
+        lin(x).sum().backward()
+        self.assertIsNotNone(lin.weight_g.grad)
+        self.assertIsNotNone(lin.weight_v.grad)
+        self.assertTrue(np.abs(np.asarray(lin.weight_v.grad._array)).sum() > 0)
+
+    def test_dim_none_full_norm(self):
+        lin = nn.Linear(4, 4)
+        nn.utils.weight_norm(lin, dim=None)
+        self.assertEqual(tuple(lin.weight_g.shape), ())
+
+    def test_remove_restores_parameter(self):
+        lin = nn.Linear(6, 4)
+        w0 = np.asarray(lin.weight._array)
+        nn.utils.weight_norm(lin)
+        nn.utils.remove_weight_norm(lin)
+        self.assertIn("weight", lin._parameters)
+        self.assertNotIn("weight_g", lin._parameters)
+        np.testing.assert_allclose(np.asarray(lin.weight._array), w0, rtol=1e-5, atol=1e-6)
+        # hook gone: forward works and double-remove raises
+        lin(paddle.to_tensor(np.zeros((1, 6), "float32")))
+        with self.assertRaises(ValueError):
+            nn.utils.remove_weight_norm(lin)
+
+    def test_double_apply_raises(self):
+        lin = nn.Linear(3, 3)
+        nn.utils.weight_norm(lin)
+        with self.assertRaises(RuntimeError):
+            nn.utils.weight_norm(lin)
+
+
+class TestSpectralNorm(unittest.TestCase):
+    def test_unit_top_singular_value(self):
+        lin = nn.Linear(8, 8)
+        nn.utils.spectral_norm(lin, n_power_iterations=30)
+        x = paddle.to_tensor(np.zeros((1, 8), "float32"))
+        for _ in range(3):
+            lin(x)
+        s = np.linalg.svd(np.asarray(lin.weight._array), compute_uv=False)[0]
+        self.assertLess(abs(s - 1.0), 0.05)
+
+    def test_eval_mode_no_power_iteration(self):
+        lin = nn.Linear(6, 6)
+        nn.utils.spectral_norm(lin)
+        lin.eval()
+        u0 = np.asarray(lin.weight_u._array).copy()
+        lin(paddle.to_tensor(np.zeros((1, 6), "float32")))
+        np.testing.assert_array_equal(np.asarray(lin.weight_u._array), u0)
+
+    def test_orig_param_trainable(self):
+        lin = nn.Linear(4, 4)
+        nn.utils.spectral_norm(lin)
+        self.assertIn("weight_orig", lin._parameters)
+        lin(paddle.to_tensor(np.ones((2, 4), "float32"))).sum().backward()
+        self.assertIsNotNone(lin.weight_orig.grad)
+
+
+class TestGradClipping(unittest.TestCase):
+    def _param_with_grad(self, g):
+        p = paddle.to_tensor(np.zeros_like(g), stop_gradient=False)
+        p.grad = paddle.to_tensor(g)
+        return p
+
+    def test_clip_grad_norm_global(self):
+        g1 = np.full((4,), 3.0, "float32")
+        g2 = np.full((2, 2), 4.0, "float32")
+        p1, p2 = self._param_with_grad(g1), self._param_with_grad(g2)
+        total = nn.utils.clip_grad_norm_([p1, p2], max_norm=5.0)
+        expect_total = np.sqrt((g1**2).sum() + (g2**2).sum())
+        self.assertAlmostEqual(float(total._array), expect_total, places=4)
+        new_norm = np.sqrt((np.asarray(p1.grad._array)**2).sum() +
+                           (np.asarray(p2.grad._array)**2).sum())
+        self.assertAlmostEqual(new_norm, 5.0, places=3)
+
+    def test_clip_grad_norm_noop_below_max(self):
+        p = self._param_with_grad(np.array([0.3, 0.4], "float32"))
+        nn.utils.clip_grad_norm_([p], max_norm=10.0)
+        np.testing.assert_allclose(np.asarray(p.grad._array), [0.3, 0.4], rtol=1e-5)
+
+    def test_clip_grad_norm_inf(self):
+        p = self._param_with_grad(np.array([-7.0, 2.0], "float32"))
+        total = nn.utils.clip_grad_norm_([p], 3.0, norm_type=float("inf"))
+        self.assertAlmostEqual(float(total._array), 7.0, places=5)
+
+    def test_error_if_nonfinite(self):
+        p = self._param_with_grad(np.array([np.nan, 1.0], "float32"))
+        with self.assertRaises(RuntimeError):
+            nn.utils.clip_grad_norm_([p], 1.0, error_if_nonfinite=True)
+
+    def test_clip_grad_value(self):
+        p = self._param_with_grad(np.array([-5.0, 0.5, 9.0], "float32"))
+        nn.utils.clip_grad_value_([p], 2.0)
+        np.testing.assert_allclose(np.asarray(p.grad._array), [-2.0, 0.5, 2.0])
+
+
+class TestParametersVector(unittest.TestCase):
+    def test_roundtrip(self):
+        l1, l2 = nn.Linear(3, 5), nn.Linear(3, 5)
+        vec = nn.utils.parameters_to_vector(l1.parameters())
+        self.assertEqual(tuple(vec.shape), (3 * 5 + 5,))
+        nn.utils.vector_to_parameters(vec, l2.parameters())
+        np.testing.assert_allclose(np.asarray(l1.weight._array), np.asarray(l2.weight._array))
+        np.testing.assert_allclose(np.asarray(l1.bias._array), np.asarray(l2.bias._array))
+
+    def test_size_mismatch_raises(self):
+        l1 = nn.Linear(3, 5)
+        vec = nn.utils.parameters_to_vector(l1.parameters())
+        with self.assertRaises(Exception):
+            nn.utils.vector_to_parameters(vec, nn.Linear(4, 5).parameters())
+
+
+class TestWeightQuantize(unittest.TestCase):
+    def setUp(self):
+        self.rng = np.random.default_rng(7)
+        self.K, self.N = 64, 48
+        self.w = self.rng.standard_normal((self.K, self.N)).astype("float32")
+        self.x = self.rng.standard_normal((2, 5, self.K)).astype("float32")
+
+    def test_shapes_match_reference_convention(self):
+        wq, sc = nn.quant.weight_quantize(paddle.to_tensor(self.w))
+        self.assertEqual(tuple(wq.shape), (self.N, self.K))  # transposed
+        self.assertEqual(tuple(sc.shape), (self.N,))
+        self.assertEqual(str(wq.dtype).split(".")[-1], "int8")
+
+    def test_int8_roundtrip_halfstep_bound(self):
+        for gs in (-1, 64):
+            wq, sc = nn.quant.weight_quantize(paddle.to_tensor(self.w), group_size=gs)
+            wd = nn.quant.weight_dequantize(wq, sc, out_dtype="float32", group_size=gs)
+            err = np.abs(np.asarray(wd._array) - self.w).max()
+            self.assertLess(err, np.abs(self.w).max() / 127.0 * 0.51, f"gs={gs}")
+
+    def test_int4_roundtrip_halfstep_bound(self):
+        for gs in (-1, 64):
+            wq, sc = nn.quant.weight_quantize(
+                paddle.to_tensor(self.w), algo="weight_only_int4", group_size=gs)
+            self.assertEqual(tuple(wq.shape), (self.N, self.K // 2))  # packed
+            wd = nn.quant.weight_dequantize(
+                wq, sc, algo="weight_only_int4", out_dtype="float32", group_size=gs)
+            err = np.abs(np.asarray(wd._array) - self.w).max()
+            self.assertLess(err, np.abs(self.w).max() / 7.0 * 0.51, f"gs={gs}")
+
+    def test_weight_only_linear_matches_dequant_matmul(self):
+        for algo, wd_dtype in (("weight_only_int8", "int8"), ("weight_only_int4", "int4")):
+            for gs in (-1, 128):
+                wq, sc = nn.quant.weight_quantize(
+                    paddle.to_tensor(self.w), algo=algo, group_size=gs)
+                y = nn.quant.weight_only_linear(
+                    paddle.to_tensor(self.x), wq, weight_scale=sc,
+                    weight_dtype=wd_dtype, group_size=gs)
+                wd = nn.quant.weight_dequantize(
+                    wq, sc, algo=algo, out_dtype="float32", group_size=gs)
+                ref = self.x @ np.asarray(wd._array)
+                rel = np.abs(np.asarray(y._array) - ref).max() / (np.abs(ref).max() + 1e-9)
+                self.assertLess(rel, 1e-3, f"{algo} gs={gs}")
+
+    def test_weight_only_linear_bias(self):
+        b = self.rng.standard_normal(self.N).astype("float32")
+        wq, sc = nn.quant.weight_quantize(paddle.to_tensor(self.w))
+        y = nn.quant.weight_only_linear(
+            paddle.to_tensor(self.x), wq, bias=paddle.to_tensor(b), weight_scale=sc)
+        wd = np.asarray(nn.quant.weight_dequantize(wq, sc, out_dtype="float32")._array)
+        np.testing.assert_allclose(np.asarray(y._array), self.x @ wd + b, rtol=1e-4, atol=1e-4)
+
+    def test_llm_int8_outlier_decomposition(self):
+        x2 = self.x.copy()
+        x2[..., 3] *= 20.0  # force an outlier channel past the threshold
+        wq, sc = nn.quant.weight_quantize(paddle.to_tensor(self.w), algo="llm.int8")
+        y = nn.quant.llm_int8_linear(
+            paddle.to_tensor(x2), wq, weight_scale=sc, threshold=6.0)
+        ref = x2 @ self.w
+        rel = np.abs(np.asarray(y._array) - ref).max() / np.abs(ref).max()
+        self.assertLess(rel, 3e-2)
+
+    def test_apply_per_channel_scale(self):
+        s = self.rng.standard_normal(self.K).astype("float32")
+        y = nn.quant.apply_per_channel_scale(paddle.to_tensor(self.x), paddle.to_tensor(s))
+        np.testing.assert_allclose(np.asarray(y._array), self.x * s, rtol=1e-6)
+
+    def test_validation(self):
+        with self.assertRaises(ValueError):
+            nn.quant.weight_quantize(paddle.to_tensor(self.w), algo="bogus")
+        with self.assertRaises(ValueError):
+            nn.quant.weight_quantize(paddle.to_tensor(self.w), group_size=32)
+        wq, sc = nn.quant.weight_quantize(paddle.to_tensor(self.w))
+        with self.assertRaises(ValueError):
+            nn.quant.weight_only_linear(paddle.to_tensor(self.x), wq, weight_scale=None)
+
+
+class TestQuantLayers(unittest.TestCase):
+    def test_fake_quant_abs_max_small_error(self):
+        fq = nn.quant.FakeQuantAbsMax(quant_bits=8)
+        x = paddle.to_tensor(np.array([1.0, -2.0, 0.5], "float32"))
+        out = np.asarray(fq(x)._array)
+        self.assertLess(np.abs(out - [1.0, -2.0, 0.5]).max(), 2.0 / 127 + 1e-6)
+
+    def test_channel_wise_fake_quant(self):
+        fq = nn.quant.FakeQuantChannelWiseAbsMax(quant_axis=0)
+        w = np.stack([np.full(4, 0.1, "float32"), np.full(4, 100.0, "float32")])
+        out = np.asarray(fq(paddle.to_tensor(w))._array)
+        # per-channel scales: small channel keeps fine resolution
+        self.assertLess(np.abs(out[0] - 0.1).max(), 0.1 / 127 + 1e-6)
+
+    def test_moving_average_updates_in_train_only(self):
+        fq = nn.quant.FakeQuantMovingAverageAbsMax()
+        x = paddle.to_tensor(np.full(3, 2.0, "float32"))
+        fq(x)
+        s1 = float(fq.scale._array)
+        self.assertGreater(s1, 0.0)
+        fq.eval()
+        fq(paddle.to_tensor(np.full(3, 100.0, "float32")))
+        self.assertEqual(float(fq.scale._array), s1)
+
+    def test_quantized_linear_close_to_float(self):
+        lin = nn.Linear(8, 4)
+        ql = nn.quant.QuantizedLinear(lin)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal((3, 8)).astype("float32"))
+        y, yq = lin(x), ql(x)
+        rel = np.abs(np.asarray(y._array) - np.asarray(yq._array)).max() / np.abs(np.asarray(y._array)).max()
+        self.assertLess(rel, 0.1)
+
+    def test_fake_quant_straight_through_gradient(self):
+        # STE: gradients must flow densely through the fake-quant round
+        lin = nn.Linear(8, 4)
+        ql = nn.quant.QuantizedLinear(lin)
+        x = paddle.to_tensor(np.random.default_rng(3).standard_normal((3, 8)).astype("float32"))
+        ql(x).sum().backward()
+        g = np.asarray(lin.weight.grad._array)
+        self.assertGreater((np.abs(g) > 0).mean(), 0.9)
+
+    def test_stub_identity(self):
+        s = nn.quant.Stub()
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        np.testing.assert_array_equal(np.asarray(s(x)._array), np.ones(3))
+
+    def test_qat_quanted_linear(self):
+        from paddle_tpu.nn.quant import qat
+        from paddle_tpu.quantization import QuantConfig, QuanterFactory, FakeQuanterWithAbsMaxObserver
+
+        lin = nn.Linear(6, 3)
+        cfg = QuantConfig(activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+                          weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
+        qlin = qat.QuantedLinear(lin, cfg)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal((2, 6)).astype("float32"))
+        for _ in range(60):  # let the EMA absmax scales converge
+            qlin(x)
+        y, yq = lin(x), qlin(x)
+        rel = np.abs(np.asarray(y._array) - np.asarray(yq._array)).max() / (np.abs(np.asarray(y._array)).max() + 1e-9)
+        self.assertLess(rel, 0.1)
+        self.assertEqual(qlin.weights_to_quanters(), [("weight", "weight_quanter")])
+
+
+if __name__ == "__main__":
+    unittest.main()
