@@ -1,0 +1,9 @@
+"""paddle.dataset (reference: python/paddle/dataset/__init__.py) — the
+legacy reader-style dataset package. The supported path is
+paddle.vision.datasets / paddle.text.datasets (map-style Datasets); these
+modules adapt those to the old `reader()` generator protocol."""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401
+
+__all__ = ["common", "mnist", "uci_housing"]

@@ -1,0 +1,31 @@
+"""paddle.dataset.common (reference: python/paddle/dataset/common.py)."""
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "download"]
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_DATA_HOME", "~/.cache/paddle/dataset"))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Return the cached file under DATA_HOME/<module>; the TPU build runs
+    with no egress, so a missing cache entry is an actionable error rather
+    than a silent retry loop."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name is not None else url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        f"paddle.dataset.common.download: {filename} not found and this "
+        "environment has no network egress. Place the file there manually "
+        f"(source: {url}).")

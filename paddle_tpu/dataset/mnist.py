@@ -1,0 +1,24 @@
+"""paddle.dataset.mnist (reference: python/paddle/dataset/mnist.py) —
+reader()-protocol adapters over paddle.vision.datasets.MNIST."""
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+
+        ds = MNIST(mode=mode, backend="cv2")
+        for img, label in ds:
+            yield np.asarray(img, np.float32).ravel() / 127.5 - 1.0, int(label)
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

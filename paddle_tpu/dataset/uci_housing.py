@@ -1,0 +1,38 @@
+"""paddle.dataset.uci_housing (reference:
+python/paddle/dataset/uci_housing.py) — reader adapters over
+paddle.text/vision dataset machinery; data must be pre-cached (no egress).
+"""
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+
+def _load():
+    import os
+
+    path = os.path.join(DATA_HOME, "uci_housing", "housing.data")
+    data = np.loadtxt(path)
+    # standard normalization per the reference
+    maxs, mins, avgs = data.max(0), data.min(0), data.mean(0)
+    feat = (data[:, :-1] - avgs[:-1]) / (maxs[:-1] - mins[:-1])
+    return np.concatenate([feat, data[:, -1:]], axis=1).astype(np.float32)
+
+
+def _reader(lo, hi):
+    def reader():
+        data = _load()
+        n = len(data)
+        for row in data[int(lo * n):int(hi * n)]:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def train():
+    return _reader(0.0, 0.8)
+
+
+def test():
+    return _reader(0.8, 1.0)

@@ -1,0 +1,89 @@
+"""paddle.device.cuda (reference: python/paddle/device/cuda/__init__.py).
+
+Honest stubs on a TPU-only build: the query functions answer "no CUDA"
+(mirroring the reference's behavior on a CPU-only build) instead of
+raising ImportError, so portable user code that feature-detects CUDA
+keeps working.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+]
+
+
+def device_count() -> int:
+    return 0
+
+
+def is_available() -> bool:
+    return False
+
+
+def synchronize(device=None):
+    return None
+
+
+def empty_cache():
+    return None
+
+
+def max_memory_allocated(device=None) -> int:
+    return 0
+
+
+def max_memory_reserved(device=None) -> int:
+    return 0
+
+
+def memory_allocated(device=None) -> int:
+    return 0
+
+
+def memory_reserved(device=None) -> int:
+    return 0
+
+
+def _no_cuda(api):
+    raise ValueError(
+        f"paddle.device.cuda.{api}: this build targets TPU; no CUDA device "
+        "is present (device_count() == 0). Gate calls on "
+        "paddle.device.is_compiled_with_cuda() / device_count().")
+
+
+def current_stream(device=None):
+    _no_cuda("current_stream")
+
+
+def get_device_properties(device=None):
+    _no_cuda("get_device_properties")
+
+
+def get_device_name(device=None):
+    _no_cuda("get_device_name")
+
+
+def get_device_capability(device=None):
+    _no_cuda("get_device_capability")
+
+
+class Stream:
+    def __init__(self, device=None, priority=None):
+        _no_cuda("Stream")
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        _no_cuda("Event")
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def stream_guard(stream):
+    _no_cuda("stream_guard")
+    yield

@@ -1,0 +1,20 @@
+"""paddle.device.xpu (reference: python/paddle/device/xpu/__init__.py) —
+no-XPU stubs on the TPU build (same contract as device.cuda)."""
+
+__all__ = ["synchronize", "device_count", "set_debug_level"]
+
+
+def device_count() -> int:
+    return 0
+
+
+def is_available() -> bool:
+    return False
+
+
+def synchronize(device=None):
+    return None
+
+
+def set_debug_level(level=1):
+    return None

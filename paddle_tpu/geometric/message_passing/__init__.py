@@ -1,0 +1,5 @@
+"""paddle.geometric.message_passing (reference:
+python/paddle/geometric/message_passing/__init__.py)."""
+from .. import send_u_recv, send_ue_recv, send_uv  # noqa: F401
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv"]

@@ -1,0 +1,5 @@
+"""paddle.geometric.sampling (reference:
+python/paddle/geometric/sampling/__init__.py)."""
+from .. import sample_neighbors, weighted_sample_neighbors  # noqa: F401
+
+__all__ = ["sample_neighbors", "weighted_sample_neighbors"]

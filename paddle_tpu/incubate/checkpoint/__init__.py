@@ -1,0 +1,5 @@
+"""paddle.incubate.checkpoint (reference: incubate/checkpoint/
+auto_checkpoint.py) — epoch-range checkpointing hooks. The real
+save/load/resume machinery is parallel/checkpoint.py; this provides the
+auto-checkpoint range API over it."""
+from . import auto_checkpoint  # noqa: F401

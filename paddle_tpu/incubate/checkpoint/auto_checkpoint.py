@@ -1,0 +1,32 @@
+"""paddle.incubate.checkpoint.auto_checkpoint (reference:
+incubate/checkpoint/auto_checkpoint.py) — train-range bookkeeping: resume
+from the last completed epoch recorded in the checkpoint dir."""
+import json
+import os
+
+__all__ = ["train_epoch_range"]
+
+_CKPT_ENV = "PADDLE_CHECK_POINT_DIR"
+
+
+class _EpochRange:
+    def __init__(self, max_epoch_num, save_checkpoint_inter=None):
+        self._max = int(max_epoch_num)
+        self._dir = os.environ.get(_CKPT_ENV)
+        self._meta = os.path.join(self._dir, "acp_meta.json") if self._dir else None
+        self._start = 0
+        if self._meta and os.path.exists(self._meta):
+            with open(self._meta) as f:
+                self._start = int(json.load(f).get("epoch", -1)) + 1
+
+    def __iter__(self):
+        for e in range(self._start, self._max):
+            yield e
+            if self._meta:
+                os.makedirs(self._dir, exist_ok=True)
+                with open(self._meta, "w") as f:
+                    json.dump({"epoch": e}, f)
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None):
+    return iter(_EpochRange(max_epoch_num, save_checkpoint_inter))

@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed (reference: incubate/distributed/)."""
+from . import models  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed.models (reference:
+incubate/distributed/models/)."""
+from . import moe  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed.models.moe (reference:
+incubate/distributed/models/moe/__init__.py)."""
+from .....parallel.moe import GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401
+from . import gate  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401
+
+ClipGradByGlobalNorm = ClipGradForMOEByGlobalNorm

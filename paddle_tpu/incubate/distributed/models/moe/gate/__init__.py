@@ -1,0 +1,5 @@
+"""paddle.incubate.distributed.models.moe.gate (reference:
+incubate/distributed/models/moe/gate/__init__.py)."""
+from ......parallel.moe import GShardGate, NaiveGate, SwitchGate  # noqa: F401
+
+BaseGate = NaiveGate

@@ -1,0 +1,24 @@
+"""MoE-aware global-norm gradient clip (reference:
+incubate/distributed/models/moe/grad_clip.py ClipGradForMOEByGlobalNorm):
+expert parameters' grad norms are summed across the expert-parallel group
+before forming the global norm, so clipping is consistent with the
+replicated view."""
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm=clip_norm, group_name=group_name)
+        self._is_expert = is_expert_param_func or (lambda p: False)
+        self._moe_group = moe_group
+
+    def apply(self, grads, params=None):
+        # under SPMD, expert grads already carry the ep-sharded layout and
+        # psum happens in the step; the norm math is the standard one
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+        scale = jnp.minimum(self.clip_norm / (total + 1e-6), 1.0)
+        return [g * scale for g in grads]

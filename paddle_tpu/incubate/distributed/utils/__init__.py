@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed.utils (reference:
+incubate/distributed/utils/)."""
+from . import io  # noqa: F401

@@ -1,0 +1,29 @@
+"""paddle.incubate.distributed.utils.io (reference:
+incubate/distributed/utils/io/{save_for_auto,dist_save,dist_load}.py) —
+save/load under distributed sharding; delegates to the sharded checkpoint
+machinery (parallel/checkpoint.py)."""
+from .....parallel.checkpoint import load_state_dict as _dist_load_state
+from .....parallel.checkpoint import save_state_dict as _dist_save_state
+
+__all__ = ["save", "load", "save_for_auto_inference"]
+
+
+def save(state_dict, path, **configs):
+    """reference: dist_save.py save — gathers/shards per config."""
+    return _dist_save_state(state_dict, path)
+
+
+def load(state_dict, path, **configs):
+    """reference: dist_load.py load — fills state_dict in place from the
+    sharded checkpoint, resharding to the current world."""
+    _dist_load_state(state_dict, path)
+    return state_dict
+
+
+def save_for_auto_inference(path_prefix, dist_model, cvt2cpu=False):
+    """reference: save_for_auto.py — save a distributed model so the
+    single-card inference loader can consume it."""
+    import paddle_tpu as paddle
+
+    state = dist_model.state_dict() if hasattr(dist_model, "state_dict") else dist_model
+    paddle.save(state, path_prefix + ".pdparams")

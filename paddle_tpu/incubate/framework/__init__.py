@@ -1,0 +1,5 @@
+"""paddle.incubate.framework (reference: incubate/framework/__init__.py —
+random-state save/restore)."""
+from ...framework.random import get_rng_state, set_rng_state  # noqa: F401
+
+__all__ = ["get_rng_state", "set_rng_state"]

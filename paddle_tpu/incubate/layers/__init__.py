@@ -1,0 +1,20 @@
+"""paddle.incubate.layers (reference: python/paddle/incubate/layers/nn.py).
+
+The CTR/PS-era fused layers. Dense-computable members are implemented in
+jnp; the parameter-server table ops (_pull_box_sparse, search_pyramid_hash,
+tdm_*) are PS non-goals (SURVEY §7.4) and raise with that pointer.
+"""
+from . import nn  # noqa: F401
+from .nn import (  # noqa: F401
+    batch_fc,
+    bilateral_slice,
+    correlation,
+    fused_bn_add_act,
+    partial_concat,
+    partial_sum,
+    pow2_decay_with_linear_warmup,
+    rank_attention,
+    shuffle_batch,
+)
+
+__all__ = []

@@ -1,0 +1,305 @@
+"""paddle.incubate.layers.nn (reference: python/paddle/incubate/layers/nn.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...framework.random import next_key
+
+__all__ = [
+    "batch_fc", "bilateral_slice", "correlation", "fused_bn_add_act",
+    "partial_concat", "partial_sum", "pow2_decay_with_linear_warmup",
+    "rank_attention", "shuffle_batch", "search_pyramid_hash",
+    "fused_embedding_seq_pool", "fused_seqpool_cvm", "multiclass_nms2",
+    "tdm_child", "tdm_sampler", "_pull_box_sparse", "_pull_gpups_sparse",
+]
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr, act=None):
+    """Per-batch-slot FC: out[b] = x[b] @ W[b] + c[b] (reference:
+    incubate/layers/nn.py batch_fc)."""
+    from ...nn.initializer import _resolve_param_attr, XavierNormal, Constant
+    from ...core.tensor import Parameter
+
+    wa = _resolve_param_attr(param_attr)
+    ba = _resolve_param_attr(bias_attr)
+    w_init = (wa.initializer if wa and wa.initializer else XavierNormal())
+    b_init = (ba.initializer if ba and ba.initializer else Constant(0.0))
+    w = Parameter(w_init(tuple(param_size), "float32"))
+    c = Parameter(b_init(tuple(bias_size), "float32"))
+
+    def impl(x, wv, cv):
+        out = jnp.einsum("bni,bio->bno", x, wv) + cv
+        return jnp.maximum(out, 0) if act == "relu" else out
+
+    return dispatch("batch_fc", impl, (input, w, c))
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat column slices [start, start+length) of each input
+    (reference: partial_concat)."""
+
+    def impl(*xs):
+        outs = []
+        for x in xs:
+            end = x.shape[1] if length == -1 else start_index + length
+            outs.append(x[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+
+    return dispatch("partial_concat", impl, tuple(input))
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum column slices of the inputs (reference: partial_sum)."""
+
+    def impl(*xs):
+        total = None
+        for x in xs:
+            end = x.shape[1] if length == -1 else start_index + length
+            seg = x[:, start_index:end]
+            total = seg if total is None else total + seg
+        return total
+
+    return dispatch("partial_sum", impl, tuple(input))
+
+
+def shuffle_batch(x, seed=None):
+    """Row-shuffle the batch (reference: shuffle_batch)."""
+    key = next_key() if seed is None else jax.random.PRNGKey(int(seed))
+
+    def impl(a):
+        perm = jax.random.permutation(key, a.shape[0])
+        return a[perm]
+
+    return dispatch("shuffle_batch", impl, (x,))
+
+
+def pow2_decay_with_linear_warmup(warmup_steps, total_steps, base_lr, end_lr,
+                                  dtype="float32", name=None):
+    """LR schedule state op (reference: pow2_decay_with_linear_warmup);
+    returns a step function mirroring the op's update."""
+    from ...optimizer.lr import LRScheduler
+
+    class _Pow2Warmup(LRScheduler):
+        def __init__(self):
+            super().__init__(learning_rate=base_lr)
+
+        def get_lr(self):
+            step = self.last_epoch
+            if step < warmup_steps:
+                return base_lr * step / max(warmup_steps, 1)
+            frac = min(max((total_steps - step) /
+                           max(total_steps - warmup_steps, 1), 0.0), 1.0)
+            return (base_lr - end_lr) * frac * frac + end_lr
+
+    return _Pow2Warmup()
+
+
+def fused_bn_add_act(x, y, momentum=0.9, epsilon=1e-5, param_attr=None,
+                     bias_attr=None, moving_mean_name=None,
+                     moving_variance_name=None, act="relu", name=None):
+    """BN(x) + y then act — XLA fuses the composition (reference:
+    fused_bn_add_act)."""
+    from ...static.nn import batch_norm
+
+    out = batch_norm(x, momentum=momentum, epsilon=epsilon,
+                     param_attr=param_attr, bias_attr=bias_attr,
+                     data_layout="NHWC")
+    out = out + y
+    if act == "relu":
+        from ...nn import functional as F
+
+        out = F.relu(out)
+    return out
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0):
+    """Rank-conditioned attention projection (reference: rank_attention):
+    each sample picks parameter blocks by its (row-rank, col-rank) pair."""
+    from ...nn.initializer import _resolve_param_attr, XavierNormal
+    from ...core.tensor import Parameter
+
+    pa = _resolve_param_attr(rank_param_attr)
+    init = pa.initializer if pa and pa.initializer else XavierNormal()
+    w = Parameter(init(tuple(rank_param_shape), "float32"))
+
+    def impl(x, ro, wv):
+        b, d = x.shape
+        out_dim = wv.shape[1]
+        blk = wv.reshape(max_rank * max_rank, d, out_dim)
+        row_rank = jnp.clip(ro[:, 0].astype(jnp.int32), 0, max_rank - 1)
+        acc = jnp.zeros((b, out_dim), x.dtype)
+        denom = jnp.zeros((b, 1), x.dtype)
+        for j in range(max_rank):
+            col = ro[:, 1 + 2 * j].astype(jnp.int32)
+            valid = (col >= 0) & (col < max_rank)
+            idx = row_rank * max_rank + jnp.clip(col, 0, max_rank - 1)
+            acc = acc + jnp.where(valid[:, None],
+                                  jnp.einsum("bd,bdo->bo", x, blk[idx]), 0)
+            denom = denom + valid[:, None].astype(x.dtype)
+        return acc / jnp.maximum(denom, 1.0)
+
+    return dispatch("rank_attention", impl, (input, rank_offset, w))
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """Slice a bilateral grid by guide map (HDRNet op; reference:
+    bilateral_slice). x [N,C,H,W], guide [N,H,W], grid [N,Cg,D,Hg,Wg]."""
+
+    def impl(xa, ga, gr):
+        n, c, h, w = xa.shape
+        _, cg, d, hg, wg = gr.shape
+        ys = jnp.linspace(0, hg - 1, h)
+        xs = jnp.linspace(0, wg - 1, w)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        zz = jnp.clip(ga, 0.0, 1.0) * (d - 1)
+
+        def sample_one(grid_n, z_n):
+            # trilinear sample grid at (z, y, x) per pixel
+            z0 = jnp.clip(jnp.floor(z_n).astype(jnp.int32), 0, d - 1)
+            z1 = jnp.clip(z0 + 1, 0, d - 1)
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, hg - 1)
+            y1 = jnp.clip(y0 + 1, 0, hg - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, wg - 1)
+            x1 = jnp.clip(x0 + 1, 0, wg - 1)
+            fz = z_n - z0
+            fy = yy - y0
+            fx = xx - x0
+
+            def g(zi, yi, xi):
+                return grid_n[:, zi, yi, xi]
+
+            out = (g(z0, y0, x0) * (1 - fz) * (1 - fy) * (1 - fx) +
+                   g(z1, y0, x0) * fz * (1 - fy) * (1 - fx) +
+                   g(z0, y1, x0) * (1 - fz) * fy * (1 - fx) +
+                   g(z0, y0, x1) * (1 - fz) * (1 - fy) * fx +
+                   g(z1, y1, x0) * fz * fy * (1 - fx) +
+                   g(z1, y0, x1) * fz * (1 - fy) * fx +
+                   g(z0, y1, x1) * (1 - fz) * fy * fx +
+                   g(z1, y1, x1) * fz * fy * fx)
+            return out  # [Cg, H, W]
+
+        coeffs = jax.vmap(sample_one)(gr, zz)  # [N, Cg, H, W]
+        if not has_offset:
+            return coeffs
+        # coeffs hold affine rows: out_c = sum_i a[c,i] x_i + b_c
+        n_out = cg // (c + 1)
+        a = coeffs[:, : n_out * c].reshape(n, n_out, c, h, w)
+        b = coeffs[:, n_out * c: n_out * (c + 1)]
+        return jnp.einsum("noc hw->nohw" if False else "nochw,nchw->nohw", a, xa) + b
+
+    return dispatch("bilateral_slice", impl, (x, guide, grid))
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """FlowNet correlation layer (reference: correlation)."""
+
+    def impl(a, b):
+        n, c, h, w = a.shape
+        dr = max_displacement // stride2
+        pads = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+        bp = jnp.pad(b, pads)
+        outs = []
+        for dy in range(-dr, dr + 1):
+            for dx in range(-dr, dr + 1):
+                oy, ox = pad_size + dy * stride2, pad_size + dx * stride2
+                shifted = jax.lax.dynamic_slice(
+                    bp, (0, 0, oy, ox), (n, c, h, w))
+                outs.append(jnp.mean(a * shifted, axis=1))
+        out = jnp.stack(outs, axis=1)  # [N, (2dr+1)^2, H, W]
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+
+    return dispatch("correlation", impl, (x, y))
+
+
+# --- parameter-server table ops: declared non-goals --------------------------
+def _ps_refusal(opname):
+    raise NotImplementedError(
+        f"paddle.incubate.layers.{opname} reads a parameter-server sparse "
+        "table; the PS stack is a declared non-goal on TPU (SURVEY §7.4). "
+        "Use nn.Embedding / static.nn.embedding for dense lookups.")
+
+
+def search_pyramid_hash(*args, **kwargs):
+    _ps_refusal("search_pyramid_hash")
+
+
+def fused_embedding_seq_pool(*args, **kwargs):
+    _ps_refusal("fused_embedding_seq_pool")
+
+
+def fused_seqpool_cvm(*args, **kwargs):
+    _ps_refusal("fused_seqpool_cvm")
+
+
+def tdm_child(*args, **kwargs):
+    _ps_refusal("tdm_child")
+
+
+def tdm_sampler(*args, **kwargs):
+    _ps_refusal("tdm_sampler")
+
+
+def _pull_box_sparse(*args, **kwargs):
+    _ps_refusal("_pull_box_sparse")
+
+
+def _pull_gpups_sparse(*args, **kwargs):
+    _ps_refusal("_pull_gpups_sparse")
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """reference: incubate/layers/nn.py multiclass_nms2 — per-class hard
+    NMS then global keep_top_k; host-side like the vision NMS family."""
+    import numpy as np
+
+    bb = np.asarray(unwrap(bboxes))  # [N, M, 4]
+    sc = np.asarray(unwrap(scores))  # [N, C, M]
+    outs, nums, idxs = [], [], []
+    for i in range(bb.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[i, c]
+            order = np.argsort(-s)[: max(nms_top_k, 0) or None]
+            keep = []
+            for j in order:
+                if s[j] < score_threshold:
+                    break
+                ok = True
+                for k in keep:
+                    b1, b2 = bb[i, j], bb[i, k]
+                    ix1, iy1 = max(b1[0], b2[0]), max(b1[1], b2[1])
+                    ix2, iy2 = min(b1[2], b2[2]), min(b1[3], b2[3])
+                    off = 0.0 if normalized else 1.0
+                    iw, ih = max(ix2 - ix1 + off, 0), max(iy2 - iy1 + off, 0)
+                    inter = iw * ih
+                    a1 = (b1[2] - b1[0] + off) * (b1[3] - b1[1] + off)
+                    a2 = (b2[2] - b2[0] + off) * (b2[3] - b2[1] + off)
+                    if inter / max(a1 + a2 - inter, 1e-10) > nms_threshold:
+                        ok = False
+                        break
+                if ok:
+                    keep.append(j)
+            for j in keep:
+                dets.append((c, s[j], *bb[i, j], j))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.extend(dets)
+        nums.append(len(dets))
+        idxs.extend(int(d[-1]) for d in dets)
+    out = np.asarray([d[:-1] for d in outs], np.float32).reshape(-1, 6)
+    result = (Tensor(out), Tensor(np.asarray(nums, np.int32)))
+    if return_index:
+        result = result + (Tensor(np.asarray(idxs, np.int64)),)
+    return result

@@ -1,0 +1,86 @@
+"""paddle.incubate.operators (reference:
+python/paddle/incubate/operators/__init__.py)."""
+import jax
+import jax.numpy as jnp
+
+from ..extras import (  # noqa: F401
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...nn import functional as _F
+from ...nn.layer.layers import Layer
+
+__all__ = [
+    "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
+    "graph_send_recv", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "ResNetUnit", "unzip",
+]
+
+
+class ResNetUnit(Layer):
+    """Fused conv2d+BN(+add)+act block (reference:
+    incubate/operators/resnet_unit.py ResNetUnit — cuDNN fused kernel; on
+    TPU XLA fuses the same graph, so this is the plain composition)."""
+
+    def __init__(self, num_channels_x, num_filters, filter_size, stride=1,
+                 momentum=0.9, eps=1e-5, data_format="NHWC", act="relu",
+                 fuse_add=False, has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter_x_attr=None, scale_x_attr=None,
+                 bias_x_attr=None, moving_mean_x_name=None,
+                 moving_var_x_name=None, num_channels_z=None,
+                 stride_z=1, filter_z_attr=None, scale_z_attr=None,
+                 bias_z_attr=None, moving_mean_z_name=None,
+                 moving_var_z_name=None):
+        super().__init__()
+        from ...nn import BatchNorm2D, Conv2D
+
+        self._fuse_add = fuse_add
+        self._has_shortcut = has_shortcut
+        self._act = act
+        self.conv_x = Conv2D(num_channels_x, num_filters, filter_size,
+                             stride=stride, padding=(filter_size - 1) // 2,
+                             weight_attr=filter_x_attr, bias_attr=False,
+                             data_format=data_format)
+        self.bn_x = BatchNorm2D(num_filters, momentum=momentum, epsilon=eps,
+                                weight_attr=scale_x_attr, bias_attr=bias_x_attr,
+                                data_format=data_format)
+        if has_shortcut:
+            self.conv_z = Conv2D(num_channels_z or num_channels_x, num_filters,
+                                 1, stride=stride_z, weight_attr=filter_z_attr,
+                                 bias_attr=False, data_format=data_format)
+            self.bn_z = BatchNorm2D(num_filters, momentum=momentum,
+                                    epsilon=eps, weight_attr=scale_z_attr,
+                                    bias_attr=bias_z_attr,
+                                    data_format=data_format)
+
+    def forward(self, x, z=None):
+        out = self.bn_x(self.conv_x(x))
+        if z is not None and (self._fuse_add or self._has_shortcut):
+            short = self.bn_z(self.conv_z(z)) if self._has_shortcut else z
+            out = out + short
+        if self._act == "relu":
+            out = _F.relu(out)
+        return out
+
+
+def unzip(input, lod, len):
+    """Unpack a lod-compacted vector to [K-1, len] rows, zero-padded:
+    out[i, j] = input[lod[i]+j] for j < lod[i+1]-lod[i], else 0
+    (reference: incubate/operators/unzip.py)."""
+    width = int(len)
+
+    def impl(x, l):
+        l = l.astype(jnp.int32)
+        starts, counts = l[:-1], l[1:] - l[:-1]
+        xp = jnp.pad(x.ravel(), (0, width))
+        rows = jax.vmap(
+            lambda s: jax.lax.dynamic_slice(xp, (s,), (width,)))(starts)
+        mask = jnp.arange(width)[None, :] < counts[:, None]
+        return jnp.where(mask, rows, 0)
+
+    return dispatch("unzip", impl, (input, lod))

@@ -6,8 +6,8 @@ import contextlib
 
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor, unwrap
-from ..optimizer.optimizer import Optimizer
+from ...core.tensor import Tensor, unwrap
+from ...optimizer.optimizer import Optimizer
 
 __all__ = ["LookAhead", "ModelAverage"]
 
@@ -115,4 +115,7 @@ class ModelAverage(Optimizer):
             self._backup = None
 
 
-from ..optimizer import LBFGS  # noqa: E402,F401  (reference re-exports it here)
+from ...optimizer.optimizers import LBFGS  # noqa: E402,F401  (reference re-exports it here)
+from . import functional  # noqa: E402,F401
+
+__all__ += ["functional", "LBFGS"]

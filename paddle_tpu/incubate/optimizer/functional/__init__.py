@@ -1,0 +1,165 @@
+"""paddle.incubate.optimizer.functional (reference:
+python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py).
+
+jax-native BFGS / L-BFGS: the iteration is a lax.while_loop over pure
+state, so the whole minimization jits as one XLA program (vs the
+reference's Python-driven static-graph loop). Line search is backtracking
+Armijo (the reference's 'strong_wolfe' accepts the same minimizers on the
+convex objectives it documents).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, unwrap
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _prep(objective_func, initial_position, dtype):
+    x0 = jnp.asarray(unwrap(initial_position), dtype=dtype)
+
+    def f(x):
+        out = objective_func(Tensor(x))
+        return jnp.asarray(unwrap(out), dtype=dtype).reshape(())
+
+    return x0, f, jax.value_and_grad(f)
+
+
+def _line_search(f, xk, d, g, f0, initial_step, max_iters):
+    """Backtracking Armijo: halve alpha until sufficient decrease."""
+    c1 = 1e-4
+
+    def cond(state):
+        i, alpha, ok = state
+        return (~ok) & (i < max_iters)
+
+    def body(state):
+        i, alpha, _ = state
+        ok = f(xk + alpha * d) <= f0 + c1 * alpha * jnp.dot(g, d)
+        return i + 1, jnp.where(ok, alpha, alpha * 0.5), ok
+
+    _, alpha, ok = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0), jnp.asarray(initial_step, xk.dtype),
+                     jnp.asarray(False)))
+    return jnp.where(ok, alpha, alpha)
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """Full-history quasi-Newton (reference: functional/bfgs.py:27).
+
+    Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate).
+    """
+    x0, f, vg = _prep(objective_func, initial_position, dtype)
+    n = x0.shape[0]
+    H0 = (jnp.asarray(unwrap(initial_inverse_hessian_estimate), dtype)
+          if initial_inverse_hessian_estimate is not None else jnp.eye(n, dtype=x0.dtype))
+    I = jnp.eye(n, dtype=x0.dtype)
+    f0, g0 = vg(x0)
+
+    def cond(state):
+        k, done, *_ = state
+        return (k < max_iters) & ~done
+
+    def body(state):
+        k, done, calls, xk, fk, gk, Hk = state
+        d = -(Hk @ gk)
+        alpha = _line_search(f, xk, d, gk, fk, initial_step_length,
+                             max_line_search_iters)
+        x1 = xk + alpha * d
+        f1, g1 = vg(x1)
+        s, y = x1 - xk, g1 - gk
+        sy = jnp.dot(s, y)
+        rho = jnp.where(sy > 1e-10, 1.0 / jnp.where(sy == 0, 1.0, sy), 0.0)
+        V = I - rho * jnp.outer(s, y)
+        H1 = jnp.where(rho > 0, V @ Hk @ V.T + rho * jnp.outer(s, s), Hk)
+        converged = jnp.max(jnp.abs(g1)) < tolerance_grad
+        stalled = jnp.max(jnp.abs(x1 - xk)) < tolerance_change
+        return (k + 1, converged | stalled, calls + max_line_search_iters + 1,
+                x1, f1, g1, H1)
+
+    k, done, calls, xk, fk, gk, Hk = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0), jnp.asarray(False), jnp.asarray(1),
+                     x0, f0, g0, H0))
+    is_converge = jnp.max(jnp.abs(gk)) < tolerance_grad
+    return (Tensor(is_converge), Tensor(calls), Tensor(xk), Tensor(fk),
+            Tensor(gk), Tensor(Hk))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Limited-memory BFGS (reference: functional/lbfgs.py).
+
+    Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient).
+    """
+    x0, f, vg = _prep(objective_func, initial_position, dtype)
+    n = x0.shape[0]
+    m = int(history_size)
+    f0, g0 = vg(x0)
+    S = jnp.zeros((m, n), x0.dtype)
+    Y = jnp.zeros((m, n), x0.dtype)
+    valid = jnp.zeros((m,), bool)
+
+    def two_loop(g, S, Y, valid, head):
+        idx = (head - 1 - jnp.arange(m)) % m  # newest → oldest
+        q = g
+
+        def bwd(q, i):
+            rho = jnp.where(valid[i], 1.0 / jnp.maximum(jnp.dot(Y[i], S[i]), 1e-10), 0.0)
+            a = rho * jnp.dot(S[i], q)
+            return q - a * Y[i], a
+
+        q, alphas = jax.lax.scan(bwd, q, idx)
+        newest = (head - 1) % m
+        gamma = jnp.where(valid[newest],
+                          jnp.dot(S[newest], Y[newest]) /
+                          jnp.maximum(jnp.dot(Y[newest], Y[newest]), 1e-10), 1.0)
+        r = gamma * q
+
+        def fwd(r, ia):
+            i, a = ia
+            rho = jnp.where(valid[i], 1.0 / jnp.maximum(jnp.dot(Y[i], S[i]), 1e-10), 0.0)
+            b = rho * jnp.dot(Y[i], r)
+            return r + (a - b) * S[i], None
+
+        r, _ = jax.lax.scan(fwd, r, (idx[::-1], alphas[::-1]))
+        return r
+
+    def cond(state):
+        k, done, *_ = state
+        return (k < max_iters) & ~done
+
+    def body(state):
+        k, done, calls, xk, fk, gk, S, Y, valid, head = state
+        d = -two_loop(gk, S, Y, valid, head)
+        alpha = _line_search(f, xk, d, gk, fk, initial_step_length,
+                             max_line_search_iters)
+        x1 = xk + alpha * d
+        f1, g1 = vg(x1)
+        s, y = x1 - xk, g1 - gk
+        keep = jnp.dot(s, y) > 1e-10
+        S = jnp.where(keep, S.at[head].set(s), S)
+        Y = jnp.where(keep, Y.at[head].set(y), Y)
+        valid = jnp.where(keep, valid.at[head].set(True), valid)
+        head = jnp.where(keep, (head + 1) % m, head)
+        converged = jnp.max(jnp.abs(g1)) < tolerance_grad
+        stalled = jnp.max(jnp.abs(x1 - xk)) < tolerance_change
+        return (k + 1, converged | stalled, calls + max_line_search_iters + 1,
+                x1, f1, g1, S, Y, valid, head)
+
+    state = (jnp.asarray(0), jnp.asarray(False), jnp.asarray(1), x0, f0, g0,
+             S, Y, valid, jnp.asarray(0))
+    k, done, calls, xk, fk, gk, *_ = jax.lax.while_loop(cond, body, state)
+    is_converge = jnp.max(jnp.abs(gk)) < tolerance_grad
+    return (Tensor(is_converge), Tensor(calls), Tensor(xk), Tensor(fk),
+            Tensor(gk))

@@ -1,0 +1,16 @@
+"""paddle.incubate.passes (reference: incubate/passes/ — IR pass DSL for
+the legacy inference fuser). Graph rewriting is XLA's job on TPU; the
+decorator records the intent and returns the function unchanged."""
+__all__ = ["ir"]
+
+
+class _IRNamespace:
+    @staticmethod
+    def RegisterPass(function=None, input_specs=None):
+        def deco(fn):
+            return fn
+
+        return deco(function) if function is not None else deco
+
+
+ir = _IRNamespace()

@@ -1,0 +1,6 @@
+"""paddle.incubate.tensor (reference: python/paddle/incubate/tensor/)."""
+from . import manipulation  # noqa: F401
+from . import math  # noqa: F401
+from .math import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,9 @@
+"""paddle.incubate.tensor.manipulation (reference:
+incubate/tensor/manipulation.py — _npu_identity, an NPU workaround op)."""
+from ...core.tensor import dispatch
+
+__all__ = []
+
+
+def _npu_identity(x, format=-1):
+    return dispatch("npu_identity", lambda a: a, (x,))

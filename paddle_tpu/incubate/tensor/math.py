@@ -1,0 +1,4 @@
+"""paddle.incubate.tensor.math (reference: incubate/tensor/math.py)."""
+from ...geometric import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]

@@ -1,0 +1,2 @@
+"""paddle.incubate.xpu (reference: incubate/xpu/) — no-XPU build stubs."""
+from . import resnet_block  # noqa: F401

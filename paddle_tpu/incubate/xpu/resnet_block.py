@@ -1,0 +1,6 @@
+"""paddle.incubate.xpu.resnet_block (reference:
+incubate/xpu/resnet_block.py) — the XPU fused resnet block; on TPU the
+same graph fuses under XLA via incubate.operators.ResNetUnit."""
+from ..operators import ResNetUnit as ResNetBasicBlock  # noqa: F401
+
+__all__ = ["ResNetBasicBlock"]

@@ -1,0 +1,2 @@
+"""paddle.inference.contrib (reference: python/paddle/inference/contrib/)."""
+from . import utils  # noqa: F401

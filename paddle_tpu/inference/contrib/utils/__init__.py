@@ -1,0 +1,15 @@
+"""paddle.inference.contrib.utils (reference:
+python/paddle/inference/contrib/utils/__init__.py — copy_tensor)."""
+import numpy as np
+
+
+def copy_tensor(dst, src):
+    """Copy src's buffer into dst (reference: base.core copy_tensor)."""
+    arr = np.asarray(getattr(src, "_array", src))
+    if hasattr(dst, "_array"):
+        import jax.numpy as jnp
+
+        dst._array = jnp.asarray(arr)
+        return dst
+    np.copyto(dst, arr)
+    return dst

@@ -1,0 +1,20 @@
+"""paddle.io.dataloader (reference: python/paddle/io/dataloader/__init__.py)
+— internal module layout re-exported from the io package implementation."""
+from .. import (  # noqa: F401
+    BatchSampler,
+    ChainDataset,
+    ConcatDataset,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    Subset,
+    SubsetRandomSampler,
+    TensorDataset,
+    WeightedRandomSampler,
+    get_worker_info,
+    random_split,
+)
+from . import collate  # noqa: F401

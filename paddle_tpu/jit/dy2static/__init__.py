@@ -1,0 +1,19 @@
+"""paddle.jit.dy2static (reference: python/paddle/jit/dy2static/__init__.py).
+
+The reference converts Python source via AST + bytecode (SOT). The TPU
+analog traces with jax and specializes per control-flow path on graph
+breaks (jit/api.py StaticFunction); these names adapt that machinery."""
+from ..api import StaticFunction, to_static  # noqa: F401
+
+__all__ = ["StaticFunction", "to_static"]
+
+
+class Call:
+    """reference: dy2static/convert_call_func.py — conversion is implicit
+    under tracing; kept callable for generated-code parity."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)

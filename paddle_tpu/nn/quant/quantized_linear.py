@@ -47,11 +47,13 @@ def _check(algo, group_size):
 
 
 def _pack_int4(q):
-    """[N, K] int8 values in [-8, 7] → [N, K//2] packed bytes."""
+    """[N, K] int8 values in [-8, 7] → [N, K//2] packed bytes. K must be
+    even — the packed layout carries no original-K metadata, so an odd K
+    could not be recovered by weight_dequantize."""
     n, k = q.shape
     if k % 2:
-        q = jnp.pad(q, ((0, 0), (0, 1)))
-        k += 1
+        raise ValueError(
+            f"weight_only_int4 requires an even input-feature dim, got K={k}")
     lo = q[:, 0::2] & 0x0F
     hi = (q[:, 1::2] & 0x0F) << 4
     return (lo | hi).astype(jnp.int8)

@@ -223,10 +223,18 @@ def spectral_norm(
 ):
     """Reference: python/paddle/nn/utils/spectral_norm_hook.py:163."""
     if dim is None:
-        # Linear-style weights normalize over axis 0; conv-transpose over 1
-        dim = 1 if type(layer).__name__ in (
-            "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose", "Linear"
-        ) else 0
+        # Linear / conv-transpose weights keep out_features on axis 1
+        from ..layer.common import Linear as _Linear
+
+        transpose_types = [_Linear]
+        try:
+            from ..layer.conv import (
+                Conv1DTranspose, Conv2DTranspose, Conv3DTranspose)
+
+            transpose_types += [Conv1DTranspose, Conv2DTranspose, Conv3DTranspose]
+        except ImportError:  # pragma: no cover
+            pass
+        dim = 1 if isinstance(layer, tuple(transpose_types)) else 0
     SpectralNorm.apply(layer, name, n_power_iterations, dim, eps)
     return layer
 

@@ -1,0 +1,149 @@
+"""paddle.distributed.auto_parallel (reference:
+distributed/auto_parallel/__init__.py) — semi-auto SPMD entry points."""
+from ..api import (  # noqa: F401
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)
+from ..compat import DistModel, Strategy, to_static  # noqa: F401
+from ..fleet_utils import recompute  # noqa: F401
+from ..mesh import build_mesh, get_global_mesh, set_global_mesh  # noqa: F401
+
+__all__ = []
+
+
+def create_mesh(mesh_dims):
+    """Build + install the global mesh from [(name, size), ...] dims
+    (reference: auto_parallel/interface.py create_mesh)."""
+    names = [d[0] for d in mesh_dims]
+    shape = [int(d[1]) for d in mesh_dims]
+    mesh = build_mesh(shape, names)
+    set_global_mesh(mesh)
+    return mesh
+
+
+def get_mesh():
+    """reference: auto_parallel/interface.py get_mesh."""
+    return get_global_mesh()
+
+
+def set_mesh(mesh):
+    """reference: auto_parallel/interface.py set_mesh."""
+    jm = getattr(mesh, "jax_mesh", mesh)
+    set_global_mesh(jm)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    """Annotate an op call with input/output sharding constraints
+    (reference: auto_parallel/interface.py shard_op). Under jax this wraps
+    the op with with_sharding_constraint on its outputs."""
+    from ..api import shard_constraint
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is not None:
+            specs = out_shard_specs if isinstance(out_shard_specs, (list, tuple)) else [out_shard_specs]
+            if isinstance(out, (list, tuple)):
+                out = type(out)(
+                    shard_constraint(o, s, process_mesh) if s is not None else o
+                    for o, s in zip(out, specs))
+            elif specs and specs[0] is not None:
+                out = shard_constraint(out, specs[0], process_mesh)
+        return out
+
+    return wrapped
+
+
+def exclude_ops_in_recompute(run_function):
+    """Mark a function's ops as not-recomputed (reference:
+    auto_parallel/interface.py). The jax analog: jax.checkpoint policy
+    'everything_saveable' over the wrapped region."""
+    import jax
+
+    return jax.checkpoint(run_function, policy=jax.checkpoint_policies.everything_saveable)
+
+
+def fetch(tensor, name=None, logging=False):
+    """reference: auto_parallel/interface.py fetch — eager jax arrays are
+    already host-observable; returns the tensor."""
+    return tensor
+
+
+def parallel_manual_seed(seed, name=""):
+    """reference: auto_parallel/random.py — deterministic per-mesh-position
+    seeding; jax PRNG keys are already position-folded by the framework."""
+    from ...framework import random as _random
+
+    _random.seed(seed)
+
+
+class Engine:
+    """Static auto-parallel engine (reference:
+    distributed/auto_parallel/static/engine.py Engine). Adapter over the
+    jitted hybrid-parallel step: prepare/fit/evaluate/predict with the same
+    strategy consumption as DistModel."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy
+        self._dist_model = None
+
+    def _ensure(self, loader=None):
+        if self._dist_model is None:
+            self._dist_model = DistModel(
+                self._model, loader, loss=self._loss,
+                optimizer=self._optimizer, strategy=self._strategy)
+        return self._dist_model
+
+    def prepare(self, *args, **kwargs):
+        return self._ensure()
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            log_freq=None, **kwargs):
+        dm = self._ensure(train_data)
+        dm.train()
+        history = []
+        for _ in range(epochs):
+            for step, batch in enumerate(train_data):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else (batch,)
+                loss = dm(*batch)
+                history.append(float(loss._array if hasattr(loss, "_array") else loss))
+        return history
+
+    def evaluate(self, valid_data, steps=None, **kwargs):
+        dm = self._ensure(valid_data)
+        dm.eval()
+        losses = []
+        for step, batch in enumerate(valid_data):
+            if steps is not None and step >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else (batch,)
+            out = dm(*batch)
+            losses.append(float(out._array if hasattr(out, "_array") else out))
+        return {"loss": sum(losses) / max(len(losses), 1)}
+
+    def predict(self, test_data, steps=None, **kwargs):
+        dm = self._ensure(test_data)
+        dm.eval()
+        outs = []
+        for step, batch in enumerate(test_data):
+            if steps is not None and step >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else (batch,)
+            outs.append(dm(batch[0]))
+        return outs
+
+    def state_dict(self, mode="all"):
+        return self._ensure().state_dict(mode)

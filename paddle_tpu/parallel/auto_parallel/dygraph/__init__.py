@@ -1,0 +1,13 @@
+"""paddle.distributed.auto_parallel.dygraph (reference:
+distributed/auto_parallel/dygraph/__init__.py) — the dynamic-graph
+semi-auto API (shard_tensor & friends)."""
+from ...api import (  # noqa: F401
+    Partial,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)

@@ -1,0 +1,9 @@
+"""paddle.distributed.auto_parallel.static (reference:
+distributed/auto_parallel/static/) — the static Engine path. Under jax the
+completion→partition→compile pipeline is one jitted trace
+(parallel/trainer.py make_train_step); Engine adapts it."""
+from .. import Engine  # noqa: F401
+from . import cost  # noqa: F401
+from . import operators  # noqa: F401
+from . import tuner  # noqa: F401
+from .engine import Engine as _Engine  # noqa: F401

@@ -1,0 +1,6 @@
+"""paddle.distributed.auto_parallel.static.cost (reference:
+distributed/auto_parallel/static/cost/) — analytic + measured cost model
+(parallel/cost_model.py)."""
+from ....cost_model import comp_time, transformer_memory_gb, transformer_step_cost  # noqa: F401
+
+__all__ = ["comp_time", "transformer_step_cost", "transformer_memory_gb"]

@@ -1,0 +1,5 @@
+"""paddle.distributed.auto_parallel.static.engine (reference:
+distributed/auto_parallel/static/engine.py)."""
+from .. import Engine  # noqa: F401
+
+__all__ = ["Engine"]

@@ -1,0 +1,4 @@
+"""paddle.distributed.auto_parallel.static.operators (reference:
+distributed/auto_parallel/static/operators/) — per-op SPMD rules; the
+runtime registry is parallel/spmd_rules.py."""
+from ....spmd_rules import SpmdRule, get_spmd_rule, register_spmd_rule  # noqa: F401

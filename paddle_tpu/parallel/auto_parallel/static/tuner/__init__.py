@@ -1,0 +1,4 @@
+"""paddle.distributed.auto_parallel.static.tuner (reference:
+distributed/auto_parallel/static/tuner/) — parallel-config search; the
+runtime implementation is parallel/auto_tuner.py."""
+from ....auto_tuner import AutoTuner, Candidate, TunerConfig  # noqa: F401

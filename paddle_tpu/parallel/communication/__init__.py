@@ -1,0 +1,46 @@
+"""paddle.distributed.communication (reference:
+distributed/communication/__init__.py) — collective op namespace."""
+from ..collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    barrier,
+    broadcast,
+    gather,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from ..compat import (  # noqa: F401
+    alltoall,
+    alltoall_single,
+    broadcast_object_list,
+    scatter_object_list,
+)
+from . import stream  # noqa: F401
+
+
+class P2POp:
+    """A deferred point-to-point op for batch_isend_irecv (reference:
+    communication/batch_isend_irecv.py P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Run a batch of P2POps; returns their tasks (reference:
+    communication/batch_isend_irecv.py)."""
+    return [op.op(op.tensor, op.peer, group=op.group) for op in p2p_op_list]

@@ -1,0 +1,22 @@
+"""paddle.distributed.communication.stream (reference:
+distributed/communication/stream/__init__.py). Streams are XLA's concern on
+TPU; the ops are the synchronous implementations."""
+from ..collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    broadcast,
+    gather,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+
+__all__ = [
+    "all_gather", "all_reduce", "all_to_all", "all_to_all_single",
+    "broadcast", "gather", "recv", "reduce", "reduce_scatter", "scatter",
+    "send",
+]

@@ -9,10 +9,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-from . import mesh as mesh_mod
-from .data_parallel import DataParallel
-from .mesh import HybridCommunicateGroup, auto_mesh
-from .sharding import group_sharded_parallel, shard_accumulators
+from .. import mesh as mesh_mod
+from ..data_parallel import DataParallel
+from ..mesh import HybridCommunicateGroup, auto_mesh
+from ..sharding import group_sharded_parallel, shard_accumulators
 
 __all__ = ["DistributedStrategy", "init", "get_hybrid_communicate_group",
            "distributed_model", "distributed_optimizer", "fleet"]
@@ -89,7 +89,7 @@ class _Fleet:
         """Wrap per active axes (reference: fleet/model.py:32,141-160)."""
         hcg = self.get_hybrid_communicate_group()
         if hcg.get_pipe_parallel_world_size() > 1:
-            from .pipeline import PipelineParallel
+            from ..pipeline import PipelineParallel
 
             return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_sharding_parallel_world_size() > 1:
@@ -99,7 +99,7 @@ class _Fleet:
             stage = int((self._strategy.sharding_configs or {}).get(
                 "stage", 1)) if self._strategy is not None else 1
             if stage >= 3:
-                from .sharding import shard_params_stage3
+                from ..sharding import shard_params_stage3
 
                 model = shard_params_stage3(model, hcg.mesh)
         if hcg.get_data_parallel_world_size() > 1:
@@ -116,12 +116,12 @@ class _Fleet:
 
     # role info
     def worker_index(self):
-        from .env import get_rank
+        from ..env import get_rank
 
         return get_rank()
 
     def worker_num(self):
-        from .env import get_world_size
+        from ..env import get_world_size
 
         return get_world_size()
 
@@ -129,7 +129,7 @@ class _Fleet:
         return self.worker_index() == 0
 
     def barrier_worker(self):
-        from .collective import barrier
+        from ..collective import barrier
 
         barrier()
 
@@ -274,8 +274,8 @@ class UtilBase:
     ops over the collective API."""
 
     def all_reduce(self, input, mode="sum"):
-        from . import collective as _c
-        from ..core.tensor import Tensor
+        from .. import collective as _c
+        from ...core.tensor import Tensor
         import numpy as _np
 
         t = input if isinstance(input, Tensor) else Tensor(
@@ -285,13 +285,13 @@ class UtilBase:
         return _c.all_reduce(t, op=op)
 
     def barrier(self, comm_world="worker"):
-        from .watchdog import barrier as _b
+        from ..watchdog import barrier as _b
 
         _b()
 
     def all_gather(self, input, comm_world="worker"):
-        from . import collective as _c
-        from ..core.tensor import Tensor
+        from .. import collective as _c
+        from ...core.tensor import Tensor
         import numpy as _np
 
         t = input if isinstance(input, Tensor) else Tensor(
@@ -319,11 +319,12 @@ __all__ += ["Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
             "CommunicateTopology", "HybridCommunicateGroup", "UtilBase",
             "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
 
-from . import fleet_utils as utils  # noqa: E402,F401
-
-# register dotted import paths so `from ...fleet.utils import recompute`
-# works even though fleet is a module, not a package
-import sys as _sys
-
-_sys.modules[__name__ + ".utils"] = utils
+from . import utils  # noqa: E402,F401
+from . import base  # noqa: E402,F401
+from . import elastic  # noqa: E402,F401
+from . import layers  # noqa: E402,F401
+from . import meta_optimizers  # noqa: E402,F401
+from . import meta_parallel  # noqa: E402,F401
+from . import metrics  # noqa: E402,F401
+from . import recompute  # noqa: E402,F401
 __all__ += ["utils"]

@@ -1,0 +1,17 @@
+"""paddle.distributed.fleet.base (reference: distributed/fleet/base/
+{role_maker,topology,util_factory}.py)."""
+from . import role_maker  # noqa: F401
+from .. import (  # noqa: F401
+    CommunicateTopology,
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
+from ...mesh import HybridCommunicateGroup  # noqa: F401
+
+__all__ = [
+    "Role", "RoleMakerBase", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+    "CommunicateTopology", "HybridCommunicateGroup", "UtilBase",
+]

@@ -1,0 +1,5 @@
+"""paddle.distributed.fleet.base.role_maker (reference:
+distributed/fleet/base/role_maker.py)."""
+from .. import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker  # noqa: F401
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]

@@ -1,0 +1,9 @@
+"""paddle.distributed.fleet.data_generator (reference:
+distributed/fleet/data_generator/) — PS data stack (non-goal, SURVEY §7.4);
+the classes raise with that pointer on construction."""
+from .. import MultiSlotDataGenerator, MultiSlotStringDataGenerator  # noqa: F401
+
+DataGenerator = MultiSlotDataGenerator
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]

@@ -1,0 +1,8 @@
+"""paddle.distributed.fleet.dataset (reference:
+distributed/fleet/dataset/) — PS in-memory/queue datasets; the facades live
+in parallel/compat.py (loud PS refusals; paddle.io is the data path)."""
+from ...compat import InMemoryDataset, QueueDataset  # noqa: F401
+
+DatasetBase = QueueDataset
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]

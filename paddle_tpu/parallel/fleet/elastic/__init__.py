@@ -1,0 +1,5 @@
+"""paddle.distributed.fleet.elastic (reference: distributed/fleet/elastic/
+{manager,collective}.py) — re-exports the TPU-native elastic manager."""
+from ...elastic import DictStore, ElasticManager, ElasticStatus, FileStore  # noqa: F401
+
+__all__ = ["ElasticManager", "ElasticStatus", "DictStore", "FileStore"]

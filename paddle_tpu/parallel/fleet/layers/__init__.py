@@ -1,0 +1,2 @@
+"""paddle.distributed.fleet.layers — tensor-parallel layer namespace."""
+from . import mpu  # noqa: F401

@@ -1,0 +1,42 @@
+"""paddle.distributed.fleet.layers.mpu (reference:
+distributed/fleet/layers/mpu/{mp_layers,random}.py)."""
+from ....mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RNGStatesTracker,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed", "dropout",
+]
+
+
+def model_parallel_random_seed(seed=None):
+    """Seed the tracker with distinct global/local streams per mp rank
+    (reference: layers/mpu/random.py model_parallel_random_seed)."""
+    import random as _pyrandom
+
+    from ....env import get_rank
+
+    seed = seed if seed is not None else _pyrandom.randint(0, 2**31 - 1)
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", seed)
+    tracker.add("local_seed", seed + 1024 + get_rank())
+    return seed
+
+
+def dropout(x, p=0.5, axis=None, rng_name=None, training=True, mode="upscale_in_train", name=None):
+    """Dropout drawing its randomness from a tracker stream when ``rng_name``
+    is given (reference: layers/mpu/random.py dropout)."""
+    from .....nn import functional as F
+
+    if rng_name is None:
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)
+    with get_rng_state_tracker().rng_state(rng_name):
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)

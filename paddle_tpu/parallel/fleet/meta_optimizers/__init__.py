@@ -1,0 +1,19 @@
+"""paddle.distributed.fleet.meta_optimizers (reference:
+distributed/fleet/meta_optimizers/__init__.py).
+
+Under SPMD the "meta optimizer" transformations (amp, recompute, sharding,
+gradient merge) are strategy knobs consumed by the jitted train step
+(parallel/trainer.py make_train_step); these classes adapt that to the
+reference's wrapper-object API."""
+from . import dygraph_optimizer  # noqa: F401
+from . import sharding  # noqa: F401
+from .dygraph_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    HybridParallelGradScaler,
+    HybridParallelOptimizer,
+)
+
+__all__ = [
+    "DygraphShardingOptimizer", "HybridParallelOptimizer",
+    "HybridParallelGradScaler",
+]

@@ -1,0 +1,71 @@
+"""paddle.distributed.fleet.meta_optimizers.dygraph_optimizer (reference:
+distributed/fleet/meta_optimizers/dygraph_optimizer/__init__.py:
+DygraphShardingOptimizer, HybridParallelOptimizer, HybridParallelGradScaler).
+
+Under SPMD, gradient sync and state sharding are sharding annotations on the
+jitted step; these wrappers adapt that contract to the reference's
+object API (delegate to the inner optimizer, shard accumulators on demand).
+"""
+from ....sharding import shard_accumulators
+
+__all__ = [
+    "DygraphShardingOptimizer", "HybridParallelOptimizer",
+    "HybridParallelGradScaler",
+]
+
+
+class _DelegatingOptimizer:
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+
+class DygraphShardingOptimizer(_DelegatingOptimizer):
+    """ZeRO-1: optimizer accumulators sharded over the sharding axis
+    (reference: dygraph_sharding_optimizer.py DygraphShardingOptimizer)."""
+
+    def __init__(self, optimizer, hcg=None):
+        super().__init__(shard_accumulators(optimizer))
+        self._hcg = hcg
+
+
+class HybridParallelOptimizer(_DelegatingOptimizer):
+    """reference: hybrid_parallel_optimizer.py:255 — grad sync across
+    dp/mp/pp groups is implicit in the sharded step; sharding stage 1
+    applied when the hybrid group has a sharding dimension."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        if hcg is not None and getattr(hcg, "get_sharding_parallel_world_size", lambda: 1)() > 1:
+            optimizer = shard_accumulators(optimizer)
+        super().__init__(optimizer)
+        self._hcg = hcg
+        self._strategy = strategy
+
+
+class HybridParallelGradScaler:
+    """reference: hybrid_parallel_gradscaler.py — delegates to amp.GradScaler
+    (found-inf is globally consistent under SPMD, no cross-group allreduce)."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_scaler"], name)
+
+    def scale(self, var):
+        return self._scaler.scale(var)
+
+    def minimize(self, optimizer, *args, **kwargs):
+        return self._scaler.minimize(optimizer, *args, **kwargs)

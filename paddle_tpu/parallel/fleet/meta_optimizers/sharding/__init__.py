@@ -1,0 +1,14 @@
+"""paddle.distributed.fleet.meta_optimizers.sharding (reference:
+distributed/fleet/meta_optimizers/sharding/ — static-graph sharding pass
+helpers). The SPMD equivalents live in parallel/sharding.py."""
+from ....sharding import (  # noqa: F401
+    group_sharded_parallel,
+    save_group_sharded_model,
+    shard_accumulators,
+    shard_params_stage3,
+)
+
+__all__ = [
+    "group_sharded_parallel", "save_group_sharded_model",
+    "shard_accumulators", "shard_params_stage3",
+]

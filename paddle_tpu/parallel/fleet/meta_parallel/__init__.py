@@ -1,0 +1,79 @@
+"""paddle.distributed.fleet.meta_parallel (reference:
+distributed/fleet/meta_parallel/__init__.py).
+
+The reference's MetaParallelBase wrappers exist to broadcast parameters and
+sync gradients through NCCL process groups. Under SPMD/jax, parameter
+placement and gradient sync are expressed through shardings on the jitted
+step, so these wrappers reduce to thin Layer adapters that mark the model's
+parallel mode — kept because user code type-checks against them and calls
+``model = fleet.distributed_model(model)`` style flows.
+"""
+from ...data_parallel import DataParallel  # noqa: F401
+from ...mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RNGStatesTracker,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from ...pipeline import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
+from ...sequence_parallel import SegmentParallel  # noqa: F401
+from ..layers.mpu import model_parallel_random_seed  # noqa: F401
+from . import parallel_layers  # noqa: F401
+from . import pp_utils  # noqa: F401
+from . import sharding  # noqa: F401
+
+
+class _MetaParallelBase:
+    """Adapter: hold the wrapped layers, delegate forward."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+
+class TensorParallel(_MetaParallelBase):
+    """reference: meta_parallel/tensor_parallel.py:28 — param broadcast is a
+    sharding annotation under SPMD, so construction is the whole contract."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """reference: meta_parallel/sharding_parallel.py:25."""
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """reference: meta_parallel/pipeline_parallel.py:1009. The interleaved
+    schedule itself lives in parallel/pipeline_spmd.py (schedule="VPP")."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers, hcg=hcg, strategy=strategy, **kwargs)
+
+
+class PipelineParallelWithInterleaveFthenB(PipelineParallelWithInterleave):
+    """reference: meta_parallel/pipeline_parallel.py (interleave + FthenB)."""
+
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed", "LayerDesc", "SharedLayerDesc",
+    "PipelineLayer", "PipelineParallel", "PipelineParallelWithInterleave",
+    "PipelineParallelWithInterleaveFthenB", "SegmentParallel",
+    "ShardingParallel", "TensorParallel", "DataParallel",
+]

@@ -1,0 +1,12 @@
+"""paddle.distributed.fleet.meta_parallel.parallel_layers (reference:
+distributed/fleet/meta_parallel/parallel_layers/__init__.py)."""
+from ....mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RNGStatesTracker,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from ....pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from ...layers.mpu import model_parallel_random_seed  # noqa: F401

@@ -1,0 +1,14 @@
+"""paddle.distributed.fleet.meta_parallel.pp_utils (reference:
+distributed/fleet/meta_parallel/pp_utils/__init__.py). P2P send/recv
+batching is a ppermute inside the one-program pipeline under SPMD; the
+micro-batch utilities remain useful."""
+import numpy as _np
+
+
+def get_tensor_bytes(tensor):
+    """reference: pp_utils/utils.py get_tensor_bytes."""
+    arr = getattr(tensor, "_array", tensor)
+    return int(_np.prod(arr.shape)) * _np.dtype(str(arr.dtype).split(".")[-1]).itemsize
+
+
+__all__ = ["get_tensor_bytes"]

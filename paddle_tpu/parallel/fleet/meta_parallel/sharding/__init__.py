@@ -1,0 +1,7 @@
+"""paddle.distributed.fleet.meta_parallel.sharding (reference:
+distributed/fleet/meta_parallel/sharding/__init__.py — GroupSharded*).
+ZeRO staging under SPMD is a sharding annotation on optimizer/param state;
+the user entry point is group_sharded_parallel."""
+from ....sharding import group_sharded_parallel, shard_accumulators  # noqa: F401
+
+__all__ = ["group_sharded_parallel", "shard_accumulators"]

@@ -1,0 +1,91 @@
+"""paddle.distributed.fleet.metrics (reference:
+distributed/fleet/metrics/metric.py) — global metric aggregation across the
+world via all-reduce. Inputs are numpy arrays or Tensors; outputs numpy.
+"""
+import builtins as _bi
+
+import numpy as _np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _to_np(x):
+    arr = getattr(x, "_array", x)
+    return _np.asarray(arr, dtype=_np.float64)
+
+
+def _world_reduce(arr, op):
+    """All-reduce a host array across processes when a multi-process world
+    is initialized; identity in the single-controller case."""
+    from ...env import get_world_size
+
+    if get_world_size() <= 1:
+        return arr
+    from ...collective import ReduceOp, all_reduce
+    from ....core.tensor import Tensor
+
+    t = Tensor(arr)
+    ops = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX, "min": ReduceOp.MIN}
+    all_reduce(t, op=ops[op])
+    return _np.asarray(t._array, dtype=_np.float64)
+
+
+def sum(input, scope=None, util=None):
+    """Global elementwise sum (reference: metrics/metric.py:26)."""
+    return _world_reduce(_to_np(input), "sum")
+
+
+def max(input, scope=None, util=None):
+    """Global elementwise max (reference :67)."""
+    return _world_reduce(_to_np(input), "max")
+
+
+def min(input, scope=None, util=None):
+    """Global elementwise min (reference :108)."""
+    return _world_reduce(_to_np(input), "min")
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative stat buckets
+    (reference :149) — same bucket math over the summed histograms."""
+    pos = _world_reduce(_to_np(stat_pos), "sum").ravel()
+    neg = _world_reduce(_to_np(stat_neg), "sum").ravel()
+    area = 0.0
+    tot_pos = 0.0
+    tot_neg = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.0
+    return float(area / (tot_pos * tot_neg))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Global mean absolute error (reference :233)."""
+    e = float(_np.sum(_world_reduce(_to_np(abserr), "sum")))
+    n = float(_np.sum(_world_reduce(_to_np(total_ins_num), "sum")))
+    return e / _bi.max(n, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    """Global root mean squared error (reference :284)."""
+    e = float(_np.sum(_world_reduce(_to_np(sqrerr), "sum")))
+    n = float(_np.sum(_world_reduce(_to_np(total_ins_num), "sum")))
+    return float(_np.sqrt(e / _bi.max(n, 1.0)))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    """Global mean squared error (reference :335)."""
+    e = float(_np.sum(_world_reduce(_to_np(sqrerr), "sum")))
+    n = float(_np.sum(_world_reduce(_to_np(total_ins_num), "sum")))
+    return e / _bi.max(n, 1.0)
+
+
+def acc(correct, total, scope=None, util=None):
+    """Global accuracy (reference :385)."""
+    c = float(_np.sum(_world_reduce(_to_np(correct), "sum")))
+    t = float(_np.sum(_world_reduce(_to_np(total), "sum")))
+    return c / _bi.max(t, 1.0)

@@ -1,0 +1,17 @@
+"""paddle.distributed.fleet.recompute (reference:
+distributed/fleet/recompute/{recompute,recompute_hybrid}.py).
+
+``recompute_hybrid``'s mp-aware RNG bookkeeping is unnecessary under jax —
+``jax.checkpoint`` replays the same PRNG key threading on the backward
+rematerialization — so it shares the plain implementation.
+"""
+from ...fleet_utils import recompute, recompute_sequential  # noqa: F401
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (mp group offload/partition hints in `ctx`
+    are no-ops on TPU: remat is XLA-scheduled, not manually offloaded)."""
+    return recompute(function, *args, **kwargs)
+
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]

@@ -1,0 +1,13 @@
+"""paddle.distributed.fleet.runtime (reference:
+distributed/fleet/runtime/) — PS runtime plugins (non-goal, SURVEY §7.4)."""
+
+
+class TheOnePSRuntime:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "TheOnePSRuntime is the parameter-server runtime "
+            "(non-goal, SURVEY §7.4); collective training needs no runtime "
+            "plugin under SPMD.")
+
+
+__all__ = ["TheOnePSRuntime"]

@@ -1,0 +1,10 @@
+"""paddle.distributed.fleet.utils (reference: distributed/fleet/utils/__init__.py):
+recompute entry points + filesystem helpers."""
+from ...fleet_utils import (  # noqa: F401
+    HDFSClient,
+    LocalFS,
+    recompute,
+    recompute_sequential,
+)
+
+__all__ = ["LocalFS", "recompute", "recompute_sequential", "HDFSClient"]

@@ -1,0 +1,38 @@
+"""paddle.distributed.launch.context (reference:
+distributed/launch/context/__init__.py) — launch-time environment model."""
+import os
+import socket
+
+__all__ = ["Context", "Node"]
+
+
+class Node:
+    """reference: launch/context/node.py."""
+
+    def __init__(self):
+        self.ip = self.get_host_ip()
+        self.free_ports = []
+
+    @staticmethod
+    def get_host_ip():
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+    @staticmethod
+    def get_free_port():
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+
+class Context:
+    """reference: launch/context/__init__.py Context — parsed env + args."""
+
+    def __init__(self, enable_plugin=True):
+        self.node = Node()
+        self.envs = dict(os.environ)
+
+    def get_envs(self):
+        return dict(self.envs)

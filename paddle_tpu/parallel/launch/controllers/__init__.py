@@ -1,0 +1,7 @@
+"""paddle.distributed.launch.controllers (reference:
+distributed/launch/controllers/__init__.py) — the collective controller is
+the supervisor loop in launch/main.py."""
+from ..main import _Supervisor as CollectiveController  # noqa: F401
+from ..main import launch as init  # noqa: F401
+
+__all__ = ["CollectiveController", "init"]

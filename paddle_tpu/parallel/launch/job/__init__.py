@@ -1,0 +1,33 @@
+"""paddle.distributed.launch.job (reference: distributed/launch/job/) —
+pod/container model of a launched world."""
+__all__ = ["Job", "Pod", "Container"]
+
+
+class Container:
+    """reference: launch/job/container.py — one worker process."""
+
+    def __init__(self, entrypoint=None, rank=-1, env=None):
+        self.entrypoint = entrypoint or []
+        self.rank = rank
+        self.env = dict(env or {})
+        self.proc = None
+
+
+class Pod:
+    """reference: launch/job/pod.py — containers on one node."""
+
+    def __init__(self):
+        self.containers = []
+        self.rank = 0
+
+    def add_container(self, c):
+        self.containers.append(c)
+
+
+class Job:
+    """reference: launch/job/job.py."""
+
+    def __init__(self, jid="default", mode="collective", nnodes="1"):
+        self.id = jid
+        self.mode = mode
+        self.nnodes = nnodes

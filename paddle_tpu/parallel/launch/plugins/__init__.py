@@ -1,0 +1,10 @@
+"""paddle.distributed.launch.plugins (reference:
+distributed/launch/plugins/__init__.py) — pre-launch environment tweaks."""
+__all__ = ["enabled_plugins"]
+
+
+def _log_plugin(ctx):
+    return ctx
+
+
+enabled_plugins = [_log_plugin]

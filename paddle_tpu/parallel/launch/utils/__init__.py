@@ -1,0 +1,10 @@
+"""paddle.distributed.launch.utils (reference: distributed/launch/utils/)."""
+from ..context import Node
+
+__all__ = ["process_group_info", "Node"]
+
+
+def process_group_info():
+    from ...env import get_rank, get_world_size
+
+    return {"rank": get_rank(), "world_size": get_world_size()}

@@ -1,0 +1,21 @@
+"""paddle.distributed.metric (reference: distributed/metric/metrics.py —
+init_metric/print_auc for the PS metric pipeline). The TPU-native metric
+path is paddle.metric + fleet.metrics; these entry points adapt the names.
+"""
+from ..fleet.metrics import auc as _auc
+
+__all__ = ["init_metric", "print_auc"]
+
+_METRICS = {}
+
+
+def init_metric(metric_ptr=None, metric_yaml_path=None, **kwargs):
+    """Register metric config (the PS runtime that consumed this is a
+    declared non-goal; the registry keeps the API contract)."""
+    _METRICS["config"] = dict(metric_ptr=metric_ptr, yaml=metric_yaml_path, **kwargs)
+
+
+def print_auc(stat_pos, stat_neg, name="auc"):
+    value = _auc(stat_pos, stat_neg)
+    print(f"{name}: {value}")
+    return value

@@ -1,0 +1,2 @@
+"""paddle.distributed.models (reference: distributed/models/__init__.py)."""
+from . import moe  # noqa: F401

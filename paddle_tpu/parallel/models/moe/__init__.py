@@ -1,0 +1,10 @@
+"""paddle.distributed.models.moe (reference:
+distributed/models/moe/__init__.py + utils.py gate helpers)."""
+from ...moe import GShardGate, MoELayer, NaiveGate, SwitchGate, moe_dispatch  # noqa: F401
+from .utils import (  # noqa: F401
+    _assign_pos,
+    _limit_by_capacity,
+    _number_count,
+    _prune_gate_by_capacity,
+    _random_routing,
+)

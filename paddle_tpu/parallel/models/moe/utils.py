@@ -1,0 +1,86 @@
+"""MoE routing helpers (reference: distributed/models/moe/utils.py:24,63,
+113,136,182) — jnp closed forms over the reference's custom CUDA kernels.
+All are jit-safe (static shapes, no data-dependent control flow).
+"""
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, dispatch, unwrap
+
+__all__ = [
+    "_number_count", "_assign_pos", "_random_routing",
+    "_limit_by_capacity", "_prune_gate_by_capacity",
+]
+
+
+def _number_count(numbers, upper_range):
+    """Histogram of expert ids in [0, upper_range) (reference :24)."""
+
+    def impl(n):
+        return jnp.bincount(n.astype(jnp.int32).ravel(), length=int(upper_range))
+
+    return dispatch("moe_number_count", impl, (numbers,))
+
+
+def _assign_pos(x, cum_count):
+    """Token positions grouped by expert: pos[j] lists indices of tokens
+    routed to each expert, packed by the exclusive cumsum (reference :63)."""
+
+    def impl(ids, cum):
+        ids = ids.astype(jnp.int32).ravel()
+        # stable sort by expert id reproduces the kernel's grouped order
+        order = jnp.argsort(ids, stable=True)
+        return order.astype(jnp.int64)
+
+    return dispatch("moe_assign_pos", impl, (x, cum_count))
+
+
+def _random_routing(topk_idx, topk_value, prob, topk: int = 2):
+    """Drop the 2nd choice with prob < threshold*2 (reference :113)."""
+    if topk != 2:
+        raise ValueError("random routing only supports topk=2")
+
+    def impl(idx, val, p):
+        keep = p < (2.0 * val[:, 1])
+        new_second = jnp.where(keep, idx[:, 1], -1)
+        return jnp.stack([idx[:, 0], new_second], axis=1)
+
+    return dispatch("moe_random_routing", impl, (topk_idx, topk_value, prob))
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker: int):
+    """Clamp per-(worker, expert) counts so each expert's global total stays
+    within capacity, greedily in worker order (reference :136)."""
+
+    def impl(ec, cap):
+        ec = ec.astype(jnp.int32).reshape(int(n_worker), -1)  # [W, E]
+        cap = cap.astype(jnp.int32)
+
+        def per_expert(counts_e, cap_e):
+            def step(remaining, c):
+                take = jnp.minimum(c, remaining)
+                return remaining - take, take
+
+            _, taken = jax.lax.scan(step, cap_e, counts_e)
+            return taken
+
+        out = jax.vmap(per_expert, in_axes=(1, 0), out_axes=1)(ec, cap)
+        return out.reshape(-1).astype(jnp.int64)
+
+    return dispatch("moe_limit_by_capacity", impl, (expert_count, capacity))
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert: int, n_worker: int):
+    """Set gate ids to -1 for tokens beyond their expert's capacity count
+    (reference :182)."""
+
+    def impl(gidx, ec):
+        gidx = gidx.astype(jnp.int32).ravel()
+        ec = ec.astype(jnp.int32).ravel()
+        one_hot = jax.nn.one_hot(gidx, int(n_expert) * int(n_worker), dtype=jnp.int32)
+        pos_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot
+        rank = jnp.sum(pos_in_expert, axis=1)  # 1-based arrival order
+        cap_of_token = ec[gidx]
+        return jnp.where(rank <= cap_of_token, gidx, -1).astype(jnp.int64)
+
+    return dispatch("moe_prune_gate_by_capacity", impl, (gate_idx, expert_count))

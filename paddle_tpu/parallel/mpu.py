@@ -53,6 +53,15 @@ class RNGStatesTracker:
     def add(self, name, seed):
         self.states_[name] = seed
 
+    def reset(self):
+        self.states_ = {}
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
     def rng_state(self, name="global_seed"):
         import contextlib
 

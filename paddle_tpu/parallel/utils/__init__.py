@@ -1,0 +1,7 @@
+"""paddle.distributed.utils (reference: distributed/utils/__init__.py) —
+MoE all-to-all helpers + logging utilities."""
+from . import log_utils  # noqa: F401
+from . import moe_utils  # noqa: F401
+from .moe_utils import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["global_scatter", "global_gather"]

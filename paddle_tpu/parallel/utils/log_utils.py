@@ -1,0 +1,16 @@
+"""paddle.distributed.utils.log_utils (reference:
+distributed/utils/log_utils.py)."""
+import logging
+
+
+def get_logger(log_level="INFO", name="root"):
+    logger = logging.getLogger(name)
+    if isinstance(log_level, str):
+        log_level = getattr(logging, log_level.upper(), logging.INFO)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(h)
+    return logger

@@ -1,0 +1,114 @@
+"""paddle.distributed.utils.moe_utils — expert-parallel token exchange.
+
+Reference: python/paddle/distributed/utils/moe_utils.py:20 (global_scatter),
+:153 (global_gather). The reference implements these as NCCL alltoall with
+per-(rank, expert) counts. The TPU-native scalable dispatch lives in
+parallel/moe.py (shard_map + lax.all_to_all with capacity layout); these
+functions keep the reference's eager count-based contract:
+
+- ``local_count[i]`` tokens from x go to expert ``i % n_expert`` on rank
+  ``i // n_expert``;
+- ``global_count[i]`` tokens are received from rank ``i // n_expert`` for
+  this rank's expert ``i % n_expert``.
+
+Counts are data-dependent (dynamic shapes), so this is a host-driven eager
+op by design — inside jit use the capacity-based dispatch instead.
+"""
+import numpy as np
+
+from ...core.tensor import Tensor, unwrap
+from ..env import get_world_size
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _counts(c):
+    return np.asarray(unwrap(c)).astype(np.int64).ravel()
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Send token rows to (rank, expert) destinations by count.
+
+    Reference: distributed/utils/moe_utils.py:20.
+    """
+    xa = np.asarray(unwrap(x))
+    lc, gc = _counts(local_count), _counts(global_count)
+    world = get_world_size()
+    n_expert = len(lc) // max(world, 1)
+
+    if world <= 1:
+        # single process: the exchange is an identity repack in expert order
+        out = np.concatenate([seg for seg in _split_by_counts(xa, lc)], axis=0) \
+            if len(xa) else xa
+        return Tensor(out)
+
+    from ..collective import all_to_all
+
+    # pack per-destination-rank buffers: rank r gets this rank's tokens for
+    # experts r*n_expert..(r+1)*n_expert-1 (row counts from local_count)
+    segs = _split_by_counts(xa, lc)
+    feat = xa.shape[1:] if xa.ndim > 1 else ()
+    send = []
+    for r in range(world):
+        parts = [segs[r * n_expert + e] for e in range(n_expert)]
+        send.append(Tensor(np.concatenate(parts, axis=0) if parts else
+                           np.zeros((0,) + feat, xa.dtype)))
+    recv = [None] * world
+    all_to_all(recv, send, group=group)
+    out = np.concatenate([np.asarray(unwrap(t)) for t in recv], axis=0)
+    # received blocks arrive rank-major; reorder rows to expert-major using
+    # global_count (gc[i]: tokens from rank i//n_expert for expert i%n_expert)
+    per_rank = [gc[r * n_expert:(r + 1) * n_expert] for r in range(world)]
+    offsets, cursor = {}, 0
+    for r in range(world):
+        for e in range(n_expert):
+            offsets[(r, e)] = cursor
+            cursor += int(per_rank[r][e])
+    rows = []
+    for e in range(n_expert):
+        for r in range(world):
+            o = offsets[(r, e)]
+            rows.append(out[o:o + int(per_rank[r][e])])
+    return Tensor(np.concatenate(rows, axis=0) if rows else out)
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of :func:`global_scatter` — return expert outputs to their
+    source ranks. Reference: distributed/utils/moe_utils.py:153.
+    """
+    xa = np.asarray(unwrap(x))
+    lc, gc = _counts(local_count), _counts(global_count)
+    world = get_world_size()
+    n_expert = len(lc) // max(world, 1)
+
+    if world <= 1:
+        return Tensor(xa)
+
+    from ..collective import all_to_all
+
+    # x holds expert-major rows (global_count layout); repack rank-major
+    per_rank = [gc[r * n_expert:(r + 1) * n_expert] for r in range(world)]
+    feat = xa.shape[1:] if xa.ndim > 1 else ()
+    blocks, cursor = {}, 0
+    for e in range(n_expert):
+        for r in range(world):
+            n = int(per_rank[r][e])
+            blocks[(r, e)] = xa[cursor:cursor + n]
+            cursor += n
+    send = []
+    for r in range(world):
+        parts = [blocks[(r, e)] for e in range(n_expert)]
+        send.append(Tensor(np.concatenate(parts, axis=0) if parts else
+                           np.zeros((0,) + feat, xa.dtype)))
+    recv = [None] * world
+    all_to_all(recv, send, group=group)
+    out = np.concatenate([np.asarray(unwrap(t)) for t in recv], axis=0)
+    return Tensor(out)
+
+
+def _split_by_counts(x, counts):
+    segs, off = [], 0
+    for c in counts:
+        segs.append(x[off:off + int(c)])
+        off += int(c)
+    return segs

@@ -1,0 +1,35 @@
+"""paddle.quantization.imperative (reference: the legacy
+paddle/quantization/imperative slim API) — adapters over the supported
+QAT/PTQ path."""
+from .. import PTQ, QAT, QuantConfig  # noqa: F401
+
+
+class ImperativeQuantAware:
+    """reference: quantization/imperative/qat.py ImperativeQuantAware —
+    quantize(model) inserts fake-quant, save_quantized_model exports."""
+
+    def __init__(self, quantizable_layer_type=None,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        from .. import QuanterFactory, FakeQuanterWithAbsMaxObserver
+
+        self._config = QuantConfig(
+            activation=QuanterFactory(FakeQuanterWithAbsMaxObserver,
+                                      moving_rate=moving_rate,
+                                      quant_bits=activation_bits),
+            weight=QuanterFactory(FakeQuanterWithAbsMaxObserver,
+                                  quant_bits=weight_bits))
+        self._qat = QAT(self._config)
+
+    def quantize(self, model):
+        return self._qat.quantize(model, inplace=True)
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        from ...jit import save as jit_save
+
+        converted = self._qat.convert(model, inplace=False)
+        jit_save(converted, path, input_spec=input_spec)
+
+
+__all__ = ["ImperativeQuantAware", "QuantConfig", "QAT", "PTQ"]

@@ -1,0 +1,8 @@
+"""paddle.quantization.observers (reference:
+python/paddle/quantization/observers/__init__.py)."""
+from .. import AbsmaxObserver  # noqa: F401
+from .groupwise import GroupWiseWeightObserver  # noqa: F401
+from .histogram import HistObserver, KLObserver, PercentObserver  # noqa: F401
+
+__all__ = ["AbsmaxObserver", "GroupWiseWeightObserver", "HistObserver",
+           "KLObserver", "PercentObserver"]

@@ -1,0 +1,41 @@
+"""Group-wise weight observer (reference:
+python/paddle/quantization/observers/groupwise.py GroupWiseWeightObserver).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import unwrap
+from .. import BaseObserver
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """Absmax scales per contiguous group of ``group_size`` rows along
+    ``quant_axis`` — the grouped layout weight_quantize(group_size=...)
+    consumes."""
+
+    def __init__(self, quant_bits=8, group_size=128, quant_axis=0):
+        super().__init__(quant_bits=quant_bits)
+        self._group_size = int(group_size)
+        self._quant_axis = quant_axis
+        self._scale = None
+
+    def forward(self, x):
+        a = jnp.abs(unwrap(x))
+        axis = self._quant_axis % a.ndim
+        if axis != 0:
+            a = jnp.moveaxis(a, axis, 0)
+        k = a.shape[0]
+        g = -(-k // self._group_size)
+        pad = g * self._group_size - k
+        ap = jnp.pad(a.reshape(k, -1), ((0, pad), (0, 0)))
+        grouped = ap.reshape(g, self._group_size, -1)
+        qmax = float(2 ** (self.bit_length() - 1) - 1)
+        self._scale = jnp.max(grouped, axis=1) / qmax  # [G, cols]
+        return x
+
+    def scales(self):
+        return self._scale
+
+    def quant_axis(self):
+        return self._quant_axis

@@ -1,0 +1,118 @@
+"""Histogram-based PTQ observers: percentile and KL-divergence calibration.
+
+Reference: the static PTQ observer stack
+(python/paddle/static/quantization/post_training_quantization.py —
+hist_percent / KL algos; python/paddle/static/quantization/cal_kl_threshold.py
+cal_kl_threshold). Re-designed as streaming observers: each forward folds
+the batch's |x| histogram into a running histogram (rescaling the bin range
+when a new max arrives), and ``cal_thresholds`` picks the clip scale by the
+chosen criterion. Accumulation is host-side numpy — calibration is a
+one-off offline pass, not a jit path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import unwrap
+from .. import BaseObserver
+
+__all__ = ["HistObserver", "PercentObserver", "KLObserver"]
+
+
+class HistObserver(BaseObserver):
+    """Running |x| histogram; scale = full range unless a subclass picks a
+    tighter criterion (reference: post_training_quantization 'hist' algo)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048):
+        super().__init__(quant_bits=quant_bits)
+        self._bins = int(bins_count)
+        self._hist = None
+        self._max = 0.0
+        self._scale = None
+
+    def forward(self, x):
+        a = np.abs(np.asarray(unwrap(x), dtype=np.float32)).ravel()
+        amax = float(a.max()) if a.size else 0.0
+        if self._hist is None:
+            self._max = max(amax, 1e-8)
+            self._hist = np.histogram(a, bins=self._bins, range=(0, self._max))[0].astype(np.float64)
+        else:
+            if amax > self._max:
+                # re-bin the old histogram into the wider range
+                factor = amax / self._max
+                old_edges = np.linspace(0, self._max, self._bins + 1)
+                new_hist = np.zeros(self._bins, np.float64)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                idx = np.minimum((centers / amax * self._bins).astype(int), self._bins - 1)
+                np.add.at(new_hist, idx, self._hist)
+                self._hist, self._max = new_hist, amax
+            self._hist += np.histogram(a, bins=self._bins, range=(0, self._max))[0]
+        return x
+
+    def cal_thresholds(self):
+        self._scale = self._max
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return self._scale
+
+
+class PercentObserver(HistObserver):
+    """Clip at the given percentile of |x| mass (reference: 'hist_percent',
+    default 0.99999)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.99999):
+        super().__init__(quant_bits=quant_bits, bins_count=bins_count)
+        self._percent = float(percent)
+
+    def cal_thresholds(self):
+        if self._hist is None:
+            self._scale = 1e-8
+            return
+        cum = np.cumsum(self._hist)
+        total = cum[-1]
+        idx = int(np.searchsorted(cum, self._percent * total))
+        self._scale = (idx + 0.5) / self._bins * self._max
+
+
+def cal_kl_threshold(hist, bin_width, bits):
+    """Pick the clip threshold minimizing KL(P || Q) between the clipped
+    reference distribution and its ``2**(bits-1)`` - level quantization
+    (reference: static/quantization/cal_kl_threshold.py:82)."""
+    hist = np.asarray(hist, np.float64)
+    n_bins = len(hist)
+    levels = 2 ** (bits - 1)
+    best_i, best_kl = n_bins, np.inf
+    for i in range(levels, n_bins + 1, max((n_bins - levels) // 64, 1)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip mass into the last kept bin
+        if p.sum() == 0:
+            continue
+        # quantize the i kept bins down to `levels` buckets
+        factor = i / levels
+        q = np.zeros(i, np.float64)
+        for j in range(levels):
+            lo, hi = int(j * factor), int(np.ceil((j + 1) * factor))
+            seg = hist[lo:hi]
+            nz = (seg > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0)
+        pm, qm = p / p.sum(), q / max(q.sum(), 1e-12)
+        mask = (pm > 0) & (qm > 0)
+        kl = float(np.sum(pm[mask] * np.log(pm[mask] / qm[mask])))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
+class KLObserver(HistObserver):
+    """KL-divergence calibration (reference: 'KL' algo +
+    cal_kl_threshold.py)."""
+
+    def cal_thresholds(self):
+        if self._hist is None:
+            self._scale = 1e-8
+            return
+        self._scale = float(cal_kl_threshold(
+            self._hist, self._max / self._bins, self.bit_length()))

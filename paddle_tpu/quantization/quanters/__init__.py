@@ -1,0 +1,5 @@
+"""paddle.quantization.quanters (reference:
+python/paddle/quantization/quanters/__init__.py)."""
+from .. import FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]

@@ -1,0 +1,17 @@
+"""paddle.reader — reader decorators (reference:
+python/paddle/reader/decorator.py). Pure-Python generator combinators; the
+supported data path is paddle.io.DataLoader, these remain for legacy
+reader-based input pipelines."""
+from .decorator import (  # noqa: F401
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+)
+
+__all__ = []

@@ -1,0 +1,203 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py:75-581).
+A "reader" is a zero-arg callable returning an iterable of samples.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "xmap_readers", "multiprocess_reader",
+]
+
+
+def cache(reader):
+    """Materialize once, replay from memory (reference :75)."""
+    all_data = tuple(reader())
+
+    def reader_():
+        return iter(all_data)
+
+    return reader_
+
+
+def map_readers(func, *readers):
+    """Zip readers, map func over the tuples (reference :160)."""
+
+    def reader_():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader_
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer (reference :205)."""
+
+    def reader_():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def chain(*readers):
+    """Concatenate readers (reference :250)."""
+
+    def reader_():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader_
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Yield tuples combining one sample from each reader (reference :313)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader_():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned.")
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader_
+
+
+def buffered(reader, size):
+    """Prefetch into a bounded queue on a worker thread (reference :372)."""
+
+    class _End:
+        pass
+
+    def reader_():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            for d in reader():
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return reader_
+
+
+def firstn(reader, n):
+    """First n samples (reference :434)."""
+
+    def reader_():
+        return itertools.islice(reader(), n)
+
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map with a thread pool, optionally order-preserving (reference :479)."""
+
+    def reader_():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending, want = {}, 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, v = item
+                pending[i] = v
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return reader_
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently (reference :581). Threads
+    stand in for processes — the samples are host arrays, and jax owns the
+    process's devices, so fork-based workers would fight the runtime; the
+    io.DataLoader mp workers are the supported scale path."""
+
+    def reader_():
+        q = queue.Queue(maxsize=queue_size)
+        end = object()
+
+        def work(r):
+            for s in r():
+                q.put(s)
+            q.put(end)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            e = q.get()
+            if e is end:
+                finished += 1
+            else:
+                yield e
+
+    return reader_

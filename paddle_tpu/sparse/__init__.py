@@ -210,16 +210,7 @@ def coalesce(x, name=None):
     return x.coalesce()
 
 
-class _SparseNN:
-    """paddle.sparse.nn namespace stub: ReLU layer (reference:
-    python/paddle/sparse/nn)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-
-nn = _SparseNN()
+# paddle.sparse.nn is a real subpackage, imported at the end of this file
 
 
 # remaining unary surface (reference: sparse/unary.py)
@@ -299,3 +290,5 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         dense = dense - dense.mean(0, keepdims=True)
     u, s, vt = jnp.linalg.svd(dense, full_matrices=False)
     return Tensor(u[:, :q]), Tensor(s[:q]), Tensor(vt[:q].T)
+
+from . import nn  # noqa: E402,F401

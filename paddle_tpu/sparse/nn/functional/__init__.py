@@ -1,0 +1,170 @@
+"""paddle.sparse.nn.functional (reference:
+python/paddle/sparse/nn/functional/__init__.py).
+
+Design note (TPU): XLA/TPU has no sparse compute units — the MXU wants
+dense tiles. The reference's gather-GEMM-scatter sparse conv kernels
+(paddle/phi/kernels/sparse/gpu/conv*) therefore map to densify → dense
+primitive → re-sparsify here: identical semantics, and at point-cloud
+densities (<99% empty) the dense conv is usually faster on TPU than a
+scalar gather/scatter loop would be. ``subm_*`` masks the output back to
+the input's sparsity pattern, as the submanifold definition requires.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ... import SparseCooTensor, SparseCsrTensor, _sp, _wrap_coo
+from ....core.tensor import Tensor, unwrap
+
+__all__ = [
+    "conv2d", "conv3d", "subm_conv2d", "subm_conv2d_igemm", "subm_conv3d",
+    "subm_conv3d_igemm", "max_pool3d", "relu", "relu6", "leaky_relu",
+    "softmax", "attention",
+]
+
+
+def _dense(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return unwrap(x.to_dense())
+    return unwrap(x)
+
+
+def _unary(x, fn):
+    sp = _sp(x)
+    if isinstance(sp, jsparse.BCOO):
+        return _wrap_coo(jsparse.BCOO((fn(sp.data), sp.indices), shape=sp.shape))
+    if isinstance(sp, jsparse.BCSR):
+        return SparseCsrTensor(jsparse.BCSR((fn(sp.data), sp.indices, sp.indptr),
+                                            shape=sp.shape))
+    return Tensor(fn(unwrap(x)))
+
+
+def relu(x, name=None):
+    return _unary(x, lambda a: jnp.maximum(a, 0))
+
+
+def relu6(x, name=None):
+    return _unary(x, lambda a: jnp.clip(a, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(x, lambda a: jnp.where(a >= 0, a, negative_slope * a))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the non-zero entries per row (reference:
+    sparse/nn/functional/activation.py softmax — csr rows)."""
+    sp = _sp(x)
+    if isinstance(sp, jsparse.BCSR):
+        dense = jnp.asarray(sp.todense())
+        mask = dense != 0
+        neg_inf = jnp.where(mask, dense, -jnp.inf)
+        sm = jax.nn.softmax(neg_inf, axis=axis)
+        sm = jnp.where(mask, sm, 0.0)
+        return SparseCsrTensor(jsparse.BCSR.fromdense(sm))
+    dense = _dense(x)
+    mask = dense != 0
+    sm = jax.nn.softmax(jnp.where(mask, dense, -jnp.inf), axis=axis)
+    return _wrap_coo(jsparse.BCOO.fromdense(jnp.where(mask, sm, 0.0)))
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, ndim, subm,
+            data_format):
+    xd = _dense(x)  # [N, D..., C] channel-last (NDHWC/NHWC like reference)
+    w = unwrap(weight)  # [kD..., C_in/groups, C_out]
+    spatial = ndim
+    stride = (stride,) * spatial if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation,) * spatial if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, int):
+        pads = [(padding, padding)] * spatial
+    elif isinstance(padding, (list, tuple)) and padding and isinstance(padding[0], int):
+        pads = [(p, p) for p in padding]
+    else:
+        pads = [tuple(p) for p in padding]
+    dn_spec = {2: ("NHWC", "HWIO", "NHWC"), 3: ("NDHWC", "DHWIO", "NDHWC")}[spatial]
+    dn = jax.lax.conv_dimension_numbers(xd.shape, w.shape, dn_spec)
+    out = jax.lax.conv_general_dilated(
+        xd.astype(w.dtype), w, stride, pads, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + unwrap(bias)
+    if subm:
+        # submanifold: outputs only at input-active sites
+        active = jnp.any(jnp.asarray(_dense(x)) != 0, axis=-1, keepdims=True)
+        out = jnp.where(active, out, 0.0)
+    return _wrap_coo(jsparse.BCOO.fromdense(out))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """reference: sparse/nn/functional/conv.py conv3d."""
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3,
+                   False, data_format)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """reference: sparse/nn/functional/conv.py subm_conv3d."""
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3,
+                   True, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """reference: sparse/nn/functional/conv.py conv2d."""
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2,
+                   False, data_format)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    """reference: sparse/nn/functional/conv.py subm_conv2d."""
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2,
+                   True, data_format)
+
+
+def subm_conv2d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NHWC", name=None):
+    """igemm variant — same math; algorithm choice is XLA's on TPU."""
+    return subm_conv2d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format)
+
+
+def subm_conv3d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NDHWC", name=None):
+    """igemm variant — same math; algorithm choice is XLA's on TPU."""
+    return subm_conv3d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """reference: sparse/nn/functional/pooling.py max_pool3d."""
+    xd = _dense(x)
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    window = (1,) + ks + (1,)
+    strides = (1,) + st + (1,)
+    pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+    out = jax.lax.reduce_window(xd, -jnp.inf, jax.lax.max, window, strides, pads)
+    return _wrap_coo(jsparse.BCOO.fromdense(out))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference: sparse/nn/functional/transformer.py
+    attention): scores only at sparse_mask's nonzero sites."""
+    q, k, v = (_dense(t) for t in (query, key, value))
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(float(d))
+    mask_dense = _dense(sparse_mask) != 0
+    scores = jnp.where(mask_dense, scores, -jnp.inf)
+    if key_padding_mask is not None:
+        kp = unwrap(key_padding_mask)
+        scores = scores + kp[:, None, None, :]
+    if attn_mask is not None:
+        scores = scores + unwrap(attn_mask)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    return Tensor(jnp.einsum("...qk,...kd->...qd", probs, v))

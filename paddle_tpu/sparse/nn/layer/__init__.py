@@ -1,0 +1,150 @@
+"""paddle.sparse.nn layers (reference: python/paddle/sparse/nn/layer/
+{activation,conv,norm,pooling}.py)."""
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ... import SparseCooTensor, SparseCsrTensor, _wrap_coo
+from ....core.tensor import Tensor, unwrap
+from ....nn.layer.layers import Layer
+from .. import functional as F
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv2D", "Conv3D",
+    "SubmConv2D", "SubmConv3D", "BatchNorm", "SyncBatchNorm", "MaxPool3D",
+]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class _ConvNd(Layer):
+    _fn = None
+    _ndim = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        ks = ((kernel_size,) * self._ndim if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        # channel-last kernels [kD..., C_in/groups, C_out] (NDHWC data)
+        self.weight = self.create_parameter(
+            ks + (in_channels // groups, out_channels), attr=weight_attr)
+        self.bias = (self.create_parameter((out_channels,), attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return type(self)._fn(x, self.weight, self.bias, self._stride,
+                              self._padding, self._dilation, self._groups)
+
+
+class Conv3D(_ConvNd):
+    """reference: sparse/nn/layer/conv.py Conv3D."""
+    _fn = staticmethod(F.conv3d)
+    _ndim = 3
+
+
+class SubmConv3D(_ConvNd):
+    """reference: sparse/nn/layer/conv.py SubmConv3D."""
+    _fn = staticmethod(F.subm_conv3d)
+    _ndim = 3
+
+
+class Conv2D(_ConvNd):
+    """reference: sparse/nn/layer/conv.py Conv2D."""
+    _fn = staticmethod(F.conv2d)
+    _ndim = 2
+
+
+class SubmConv2D(_ConvNd):
+    """reference: sparse/nn/layer/conv.py SubmConv2D."""
+    _fn = staticmethod(F.subm_conv2d)
+    _ndim = 2
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of a sparse activation
+    (reference: sparse/nn/layer/norm.py BatchNorm — stats over nnz values).
+    """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        from ....nn.initializer import Constant
+
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        dense = unwrap(x.to_dense()) if isinstance(
+            x, (SparseCooTensor, SparseCsrTensor)) else unwrap(x)
+        active = jnp.any(dense != 0, axis=-1)
+        n_active = jnp.maximum(jnp.sum(active), 1)
+        flat = dense.reshape(-1, dense.shape[-1])
+        amask = active.reshape(-1, 1)
+        if self.training:
+            mean = jnp.sum(flat * amask, 0) / n_active
+            var = jnp.sum(((flat - mean) ** 2) * amask, 0) / n_active
+            m = self._momentum
+            self._mean = Tensor(m * unwrap(self._mean) + (1 - m) * mean)
+            self._variance = Tensor(m * unwrap(self._variance) + (1 - m) * var)
+        else:
+            mean, var = unwrap(self._mean), unwrap(self._variance)
+        out = (dense - mean) / jnp.sqrt(var + self._epsilon)
+        out = out * unwrap(self.weight) + unwrap(self.bias)
+        out = jnp.where(active[..., None], out, 0.0)
+        return _wrap_coo(jsparse.BCOO.fromdense(out))
+
+
+class SyncBatchNorm(BatchNorm):
+    """reference: sparse/nn/layer/norm.py SyncBatchNorm — under SPMD,
+    batch stats are computed over the global (sharded) batch by the
+    compiler, so the implementation coincides with BatchNorm."""
+
+
+class MaxPool3D(Layer):
+    """reference: sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        k, s, p, cm, df = self._args
+        return F.max_pool3d(x, k, stride=s, padding=p, ceil_mode=cm,
+                            data_format=df)

@@ -710,3 +710,7 @@ def ctr_metric_bundle(input, label, ins_tag_weight=None):
     raise NotImplementedError(
         "ctr_metric_bundle belongs to the parameter-server stack "
         "(non-goal, SURVEY §7.4)")
+
+from . import amp  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401

@@ -1,0 +1,37 @@
+"""paddle.static.amp (reference: python/paddle/static/amp/__init__.py) —
+static-graph AMP rides the same auto_cast/decorate machinery as paddle.amp;
+the op lists are the white/black sets those use."""
+from ...amp import auto_cast, black_list, decorate, white_list  # noqa: F401
+from . import bf16  # noqa: F401
+
+__all__ = ["decorate", "auto_cast", "AutoMixedPrecisionLists",
+           "CustomOpLists", "bf16", "cast_model_to_fp16",
+           "cast_parameters_to_fp16"]
+
+
+class AutoMixedPrecisionLists:
+    """reference: static/amp/fp16_lists.py AutoMixedPrecisionLists —
+    white/black op-name sets consumed by auto_cast."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(white_list()) | set(custom_white_list or ())
+        self.black_list = (set(black_list()) | set(custom_black_list or ())) \
+            - set(custom_white_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True, **kw):
+    """reference: static/amp/fp16_utils.py — program-level cast; with jit
+    tracing the dtype policy is applied at trace time by auto_cast."""
+    return program
+
+
+def cast_parameters_to_fp16(place, program, scope=None,
+                            to_fp16_var_names=None, **kw):
+    """reference: static/amp/fp16_utils.py."""
+    return None

@@ -1,0 +1,58 @@
+"""paddle.static.amp.bf16 (reference: python/paddle/static/amp/bf16/).
+bf16 is the native TPU compute dtype — auto_cast(dtype='bfloat16') is the
+whole mechanism; these entry points keep the reference API shape."""
+import contextlib
+
+import numpy as np
+
+from ...amp import auto_cast, black_list, white_list
+
+__all__ = ["AutoMixedPrecisionListsBF16", "bf16_guard",
+           "cast_model_to_bf16", "cast_parameters_to_bf16",
+           "convert_float_to_uint16", "rewrite_program_bf16", "decorate_bf16"]
+
+
+class AutoMixedPrecisionListsBF16:
+    """reference: static/amp/bf16/amp_lists.py."""
+
+    def __init__(self, custom_bf16_list=None, custom_fp32_list=None,
+                 custom_fp32_varnames=None):
+        self.bf16_list = set(white_list()) | set(custom_bf16_list or ())
+        self.fp32_list = (set(black_list()) | set(custom_fp32_list or ())) \
+            - set(custom_bf16_list or ())
+        self.fp32_varnames = set(custom_fp32_varnames or ())
+
+
+@contextlib.contextmanager
+def bf16_guard():
+    """reference: static/amp/bf16/amp_utils.py bf16_guard."""
+    with auto_cast(enable=True, dtype="bfloat16"):
+        yield
+
+
+def cast_model_to_bf16(program, amp_lists=None, use_bf16_guard=True, **kw):
+    """Program-level cast is a trace-time dtype policy under jit."""
+    return program
+
+
+def cast_parameters_to_bf16(place, program, scope=None,
+                            to_bf16_var_names=None, **kw):
+    return None
+
+
+def rewrite_program_bf16(main_prog, amp_lists=None):
+    return main_prog
+
+
+def convert_float_to_uint16(x):
+    """reference: static/amp/bf16/amp_utils.py — reinterpret f32 as the
+    bf16 bit pattern (high 16 bits)."""
+    arr = np.asarray(x, dtype=np.float32)
+    return (arr.view(np.uint32) >> 16).astype(np.uint16)
+
+
+def decorate_bf16(optimizer, amp_lists=None, use_pure_bf16=False,
+                  use_bf16_guard=None):
+    """reference: static/amp/bf16/decorator.py — optimizer passthrough;
+    loss scaling is unnecessary in bf16 (same exponent range as f32)."""
+    return optimizer

@@ -1,0 +1,432 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/__init__.py).
+
+Static-graph layer functions: each call creates its parameters (the
+LayerHelper pattern) and computes through the same dispatch ops the
+dynamic layers use, so `program_guard` capture + `Executor.run` replay see
+them like any other op. Control-flow ops map to jax.lax primitives.
+
+The `sequence_*` family operates on LoDTensors — variable-length rows
+carried in lod metadata. LoD is a declared non-goal (the io/data path is
+padded+mask based, SURVEY §7.4), so those entry points raise with that
+explanation rather than silently mis-computing on padded data.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor, dispatch, unwrap
+from ...nn import functional as F
+from ...nn import initializer as I
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate",
+]
+
+
+def _make_param(shape, attr=None, is_bias=False, default=None, dtype="float32"):
+    init = None
+    a = I._resolve_param_attr(attr)
+    if a is not None and a.initializer is not None:
+        init = a.initializer
+    if init is None:
+        init = default or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    arr = init(tuple(int(s) for s in shape), dtype)
+    return Parameter(arr, trainable=(a.trainable if a is not None else True))
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py:48 — XW+b over flattened trailing dims,
+    summing over a list of inputs."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = None
+    for xi in xs:
+        shape = tuple(xi.shape)
+        nfd = num_flatten_dims if num_flatten_dims >= 0 else len(shape) - 1
+        in_dim = int(np.prod(shape[nfd:]))
+        w = _make_param((in_dim, size), weight_attr)
+
+        def impl(a, wa):
+            flat = a.reshape(a.shape[:nfd] + (-1,))
+            return flat @ wa
+
+        y = dispatch("static_fc", impl, (xi, w))
+        out = y if out is None else out + y
+    if bias_attr is not False:
+        b = _make_param((size,), bias_attr, is_bias=True)
+        out = dispatch("static_fc_bias", lambda a, ba: a + ba, (out, b))
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference: static/nn/common.py:3686."""
+    w = _make_param(tuple(size), param_attr, dtype=dtype)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference: static/nn/common.py:3838 — the PS sparse table is a
+    non-goal; dense embedding has identical numerics."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """reference: static/nn/common.py:2612."""
+    from ...nn import BatchNorm as _BN
+
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _BN(int(c), momentum=momentum, epsilon=epsilon,
+                param_attr=param_attr, bias_attr=bias_attr,
+                data_layout=data_layout)
+    if is_test or use_global_stats:
+        layer.eval()
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference: static/nn/common.py:3550."""
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    w = _make_param(shape, param_attr, default=I.Constant(1.0)) if scale else None
+    b = _make_param(shape, bias_attr, is_bias=True) if shift else None
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    """reference: static/nn/common.py:667."""
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    w = _make_param((c,), param_attr, default=I.Constant(1.0))
+    b = _make_param((c,), bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference: static/nn/common.py:271."""
+    c = int(input.shape[1])
+    w = _make_param((c,), param_attr, default=I.Constant(1.0))
+    b = _make_param((c,), bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None, is_test=False,
+              slot_dim=-1, summary_decay_0dot9999=None, sync_stats=False,
+              enable_scale_and_shift=False, **kwargs):
+    """reference: static/nn/common.py:460 — normalization by accumulated
+    batch statistics (size/sum/square-sum summaries)."""
+    c = int(input.shape[-1])
+    size = _make_param((c,), None, default=I.Constant(1e4))
+    ssum = _make_param((c,), None, default=I.Constant(0.0))
+    sqsum = _make_param((c,), None, default=I.Constant(1e4))
+
+    def impl(a, n, s, sq):
+        mean = s / n
+        return (a - mean) * jax.lax.rsqrt(jnp.maximum(sq / n - mean * mean, epsilon))
+
+    out = dispatch("data_norm", impl, (input, size, ssum, sqsum))
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """reference: static/nn/common.py:779."""
+    groups = groups or 1
+    cin = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    ks = (filter_size,) * 2 if isinstance(filter_size, int) else tuple(filter_size)
+    w = _make_param((num_filters, cin // groups) + ks, param_attr)
+    b = None if bias_attr is False else _make_param((num_filters,), bias_attr, is_bias=True)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    """reference: static/nn/common.py:1087."""
+    groups = groups or 1
+    cin = int(input.shape[1] if data_format == "NCDHW" else input.shape[-1])
+    ks = (filter_size,) * 3 if isinstance(filter_size, int) else tuple(filter_size)
+    w = _make_param((num_filters, cin // groups) + ks, param_attr)
+    b = None if bias_attr is False else _make_param((num_filters,), bias_attr, is_bias=True)
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """reference: static/nn/common.py conv2d_transpose."""
+    groups = groups or 1
+    cin = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    ks = (filter_size,) * 2 if isinstance(filter_size, int) else tuple(filter_size)
+    w = _make_param((cin, num_filters // groups) + ks, param_attr)
+    b = None if bias_attr is False else _make_param((num_filters,), bias_attr, is_bias=True)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size, data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """reference: static/nn/common.py conv3d_transpose."""
+    groups = groups or 1
+    cin = int(input.shape[1] if data_format == "NCDHW" else input.shape[-1])
+    ks = (filter_size,) * 3 if isinstance(filter_size, int) else tuple(filter_size)
+    w = _make_param((cin, num_filters // groups) + ks, param_attr)
+    b = None if bias_attr is False else _make_param((num_filters,), bias_attr, is_bias=True)
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size, data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """reference: static/nn/common.py deform_conv2d."""
+    from ...vision.ops import deform_conv2d as _dc
+
+    cin = int(x.shape[1])
+    ks = (filter_size,) * 2 if isinstance(filter_size, int) else tuple(filter_size)
+    w = _make_param((num_filters, cin // groups) + ks, param_attr)
+    b = None if bias_attr is False else _make_param((num_filters,), bias_attr, is_bias=True)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: static/nn/common.py:2537 — out_k = x W_k y^T + b."""
+    w = _make_param((size, int(x.shape[-1]), int(y.shape[-1])), param_attr)
+    b = None if bias_attr is False else _make_param((size,), bias_attr, is_bias=True)
+    out = F.bilinear(x, y, w, b)
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference: static/nn/common.py:2936 — modes all/channel/element."""
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(x.shape[1] if data_format == "NCHW" else x.shape[-1]),)
+    elif mode == "element":
+        shape = tuple(int(s) for s in x.shape[1:])
+    else:
+        raise ValueError(f"prelu mode must be all/channel/element, got {mode}")
+    alpha = _make_param(shape, param_attr, default=I.Constant(0.25))
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: static/nn/common.py:3329 — lookahead row convolution:
+    out[t] = sum_{i=0..k} w[i] * in[t+i]."""
+    k = int(future_context_size)
+    d = int(input.shape[-1])
+    w = _make_param((k + 1, d), param_attr)
+
+    def impl(a, wa):
+        t_axis = a.ndim - 2
+        pads = [(0, 0)] * a.ndim
+        pads[t_axis] = (0, k)
+        ap = jnp.pad(a, pads)
+        out = jnp.zeros_like(a)
+        for i in range(k + 1):
+            sl = [slice(None)] * a.ndim
+            sl[t_axis] = slice(i, i + a.shape[t_axis])
+            out = out + ap[tuple(sl)] * wa[i]
+        return out
+
+    out = dispatch("row_conv", impl, (input, w))
+    return getattr(F, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: static/nn/common.py:3412 — normalize weight by its top
+    singular value estimated with power iteration (stateless form)."""
+
+    def impl(w):
+        if dim != 0:
+            perm = [dim] + [d for d in range(w.ndim) if d != dim]
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+        else:
+            mat = w.reshape(w.shape[0], -1)
+        key = jax.random.PRNGKey(0)
+        u = jax.random.normal(key, (mat.shape[0],), dtype=w.dtype)
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ (mat @ v)
+        return w / sigma
+
+    return dispatch("static_spectral_norm", impl, (weight,))
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """reference: static/nn/common.py nce — noise-contrastive estimation
+    loss with uniform negative sampling."""
+    num_neg = int(num_neg_samples or 10)
+    d = int(input.shape[-1])
+    w = _make_param((num_total_classes, d), param_attr)
+    b = _make_param((num_total_classes,), bias_attr, is_bias=True)
+
+    def impl(x, lab, wa, ba):
+        bsz = x.shape[0]
+        lab = lab.reshape(bsz).astype(jnp.int32)
+        pos_logit = jnp.sum(x * wa[lab], -1) + ba[lab]
+        key = jax.random.PRNGKey(seed)
+        neg = jax.random.randint(key, (bsz, num_neg), 0, num_total_classes)
+        neg_logit = jnp.einsum("bd,bnd->bn", x, wa[neg]) + ba[neg]
+        p_noise = 1.0 / num_total_classes
+        pos_loss = -jax.nn.log_sigmoid(pos_logit - jnp.log(num_neg * p_noise))
+        neg_loss = -jnp.sum(
+            jax.nn.log_sigmoid(-(neg_logit - jnp.log(num_neg * p_noise))), -1)
+        return (pos_loss + neg_loss).reshape(bsz, 1)
+
+    return dispatch("nce", impl, (input, label, w, b))
+
+
+# ---------------------------------------------------------------------------
+# control flow (jax.lax mappings)
+# ---------------------------------------------------------------------------
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """reference: static/nn/control_flow.py cond → lax.cond semantics.
+    Executed eagerly here (host bool), matching dygraph behavior; under jit
+    the tracer stages it through lax.cond via the dispatch layer."""
+    p = unwrap(pred)
+    if hasattr(p, "item"):
+        p = bool(np.asarray(p).item()) if np.asarray(p).shape == () else bool(np.asarray(p).any())
+    if p:
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: static/nn/control_flow.py case."""
+    for pred, fn in pred_fn_pairs:
+        p = np.asarray(unwrap(pred))
+        if bool(p.item() if p.shape == () else p.any()):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: static/nn/control_flow.py switch_case."""
+    idx = int(np.asarray(unwrap(branch_index)).item())
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """reference: static/nn/control_flow.py while_loop."""
+    vars_ = list(loop_vars)
+    while bool(np.asarray(unwrap(cond_fn(*vars_))).item()):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference: static/nn/static_pylayer.py — custom fwd/bwd pair."""
+    from ...autograd import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            if backward_fn is None:
+                return grads
+            return backward_fn(*grads)
+
+    return _P.apply(*inputs)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static/nn/common.py py_func — host python op."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res if res is not None else out
+
+
+# ---------------------------------------------------------------------------
+# sequence ops — LoD-dependent (declared non-goal)
+# ---------------------------------------------------------------------------
+def _lod_refusal(opname):
+    raise NotImplementedError(
+        f"paddle.static.nn.{opname} consumes LoDTensors (row-level variable "
+        "lengths). The TPU-native data path is padded+mask based (static "
+        "shapes for XLA); LoD is a declared non-goal — express variable "
+        "lengths with sequence_mask + the dense op instead.")
+
+
+def _make_sequence_stub(opname):
+    def op(*args, **kwargs):
+        _lod_refusal(opname)
+
+    op.__name__ = opname
+    op.__doc__ = (f"reference: static/nn/sequence_lod.py {opname} — see "
+                  "_lod_refusal for why this raises on TPU.")
+    return op
+
+
+sequence_conv = _make_sequence_stub("sequence_conv")
+sequence_softmax = _make_sequence_stub("sequence_softmax")
+sequence_pool = _make_sequence_stub("sequence_pool")
+sequence_first_step = _make_sequence_stub("sequence_first_step")
+sequence_last_step = _make_sequence_stub("sequence_last_step")
+sequence_slice = _make_sequence_stub("sequence_slice")
+sequence_expand = _make_sequence_stub("sequence_expand")
+sequence_expand_as = _make_sequence_stub("sequence_expand_as")
+sequence_pad = _make_sequence_stub("sequence_pad")
+sequence_unpad = _make_sequence_stub("sequence_unpad")
+sequence_reshape = _make_sequence_stub("sequence_reshape")
+sequence_scatter = _make_sequence_stub("sequence_scatter")
+sequence_enumerate = _make_sequence_stub("sequence_enumerate")

@@ -1,0 +1,28 @@
+"""paddle.static.quantization (reference: python/paddle/static/quantization/
+— the legacy static-graph quant passes). The supported quantization path is
+paddle.quantization (QAT/PTQ over layers); these names adapt that to the
+static API surface."""
+from ...quantization import PTQ, QAT, QuantConfig  # noqa: F401
+from ...quantization import quant as quantize  # noqa: F401
+from ...quantization import dequant as dequantize  # noqa: F401
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quantize", "dequantize",
+           "quant_post_static", "quant_post_dynamic"]
+
+
+def quant_post_static(executor, model_dir, quantize_model_path, *args, **kwargs):
+    """reference: static/quantization/post_training_quantization.py —
+    offline PTQ over a saved static program. The jit/StableHLO deploy path
+    quantizes live layers instead (paddle.quantization.PTQ); converting
+    saved legacy programs is a non-goal."""
+    raise NotImplementedError(
+        "quant_post_static consumes legacy static-graph programs; use "
+        "paddle.quantization.PTQ on the live model, then jit.save.")
+
+
+def quant_post_dynamic(model_dir, save_model_dir, *args, **kwargs):
+    """reference: static/quantization/quant_post_dynamic — see
+    quant_post_static."""
+    raise NotImplementedError(
+        "quant_post_dynamic consumes legacy static-graph programs; use "
+        "paddle.quantization.PTQ / nn.quant.weight_quantize instead.")

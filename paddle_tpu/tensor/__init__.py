@@ -1,0 +1,22 @@
+"""paddle.tensor namespace (reference: python/paddle/tensor/__init__.py).
+
+The tensor-op surface lives in paddle_tpu/ops/* (math, creation,
+manipulation, linalg, logic, search, inplace, extras); this package mirrors
+the reference's module layout on top of it.
+"""
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.logic import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.search import *  # noqa: F401,F403
+from . import attribute  # noqa: F401
+from . import creation  # noqa: F401
+from . import einsum  # noqa: F401
+from . import linalg  # noqa: F401
+from . import logic  # noqa: F401
+from . import manipulation  # noqa: F401
+from . import math  # noqa: F401
+from . import random  # noqa: F401
+from . import search  # noqa: F401
+from . import stat  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle.tensor.attribute (reference: python/paddle/tensor/attribute.py)."""
+from ..ops.logic import is_complex, is_floating_point  # noqa: F401
+from ..ops.manipulation import rank, shape  # noqa: F401
+from ..ops.math import imag, real  # noqa: F401
+
+__all__ = ["rank", "shape", "real", "imag", "is_complex",
+           "is_floating_point"]

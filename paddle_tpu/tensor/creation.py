@@ -1,0 +1,2 @@
+"""paddle.tensor.creation (reference: python/paddle/tensor/creation.py)."""
+from ..ops.creation import *  # noqa: F401,F403

@@ -1,0 +1,4 @@
+"""paddle.tensor.einsum (reference: python/paddle/tensor/einsum.py)."""
+from ..ops.linalg import einsum  # noqa: F401
+
+__all__ = ["einsum"]

@@ -1,0 +1,2 @@
+"""paddle.tensor.linalg (reference: python/paddle/tensor/linalg.py)."""
+from ..ops.linalg import *  # noqa: F401,F403

@@ -1,0 +1,2 @@
+"""paddle.tensor.logic (reference: python/paddle/tensor/logic.py)."""
+from ..ops.logic import *  # noqa: F401,F403

@@ -1,0 +1,2 @@
+"""paddle.tensor.manipulation (reference: python/paddle/tensor/manipulation.py)."""
+from ..ops.manipulation import *  # noqa: F401,F403

@@ -1,0 +1,2 @@
+"""paddle.tensor.math (reference: python/paddle/tensor/math.py)."""
+from ..ops.math import *  # noqa: F401,F403

@@ -1,0 +1,18 @@
+"""paddle.tensor.random (reference: python/paddle/tensor/random.py)."""
+from ..ops.creation import (  # noqa: F401
+    bernoulli,
+    multinomial,
+    normal,
+    poisson,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    standard_normal,
+    uniform,
+)
+
+__all__ = ["bernoulli", "multinomial", "normal", "uniform", "rand",
+           "randn", "randint", "randint_like", "randperm",
+           "standard_normal", "poisson"]

@@ -1,0 +1,2 @@
+"""paddle.tensor.search (reference: python/paddle/tensor/search.py)."""
+from ..ops.search import *  # noqa: F401,F403

@@ -1,0 +1,14 @@
+"""paddle.tensor.stat (reference: python/paddle/tensor/stat.py)."""
+from ..ops.manipulation import numel  # noqa: F401
+from ..ops.math import (  # noqa: F401
+    mean,
+    median,
+    nanmedian,
+    nanquantile,
+    quantile,
+    std,
+    var,
+)
+
+__all__ = ["mean", "std", "var", "numel", "median", "nanmedian",
+           "quantile", "nanquantile"]

@@ -1,0 +1,94 @@
+"""Submodule-directory parity audit (closes round-2 VERDICT Weak #6).
+
+Round 2 shipped with paddle.nn.quant and paddle.nn.utils missing entirely
+while the __all__-based audit stayed green, because it only checked modules
+it already knew about. This test enumerates EVERY package directory under
+the reference's python/paddle and requires the same dotted path to import
+from paddle_tpu — a new reference submodule can never again go silently
+missing. Declared non-goals are excluded EXPLICITLY, each with the reason.
+"""
+import importlib
+import os
+import unittest
+
+REF = "/root/reference/python/paddle"
+
+# Trees that are consciously out of scope. Prefixes; see SURVEY §7.1/§7.4
+# and VERDICT n/a rows. Anything NOT listed here must import.
+NON_GOALS = {
+    # build/runtime internals of the C++ reference, no python-facing API
+    "_typing": "typing helper stubs for the reference's CI",
+    "libs": "bundled .so loader",
+    "proto": "protobuf codegen for ProgramDesc (jaxpr/StableHLO instead)",
+    "utils.gast": "vendored gast for the AST transpiler",
+    # legacy fluid namespace (pre-2.0 BC) — declared non-goal
+    "base": "legacy fluid API surface (VERDICT: Imperative n/a)",
+    # compiler stacks replaced by XLA (SURVEY §7.1/§7.4)
+    "cinn": "CINN compiler (XLA is the compiler)",
+    "pir": "PIR IR (jaxpr/StableHLO is the IR)",
+    "decomposition": "PIR op decomposition (jax.grad/primitive lowering)",
+    # parameter-server / RPC stack (SURVEY §7.4)
+    "distributed.ps": "parameter server",
+    "distributed.rpc": "PS-era RPC",
+    "distributed.transpiler": "PS transpiler",
+    "incubate.distributed.fleet.parameter_server": "parameter server",
+    "incubate.distributed.fleet": "PS-era fleet API (collective fleet is "
+                                  "paddle.distributed.fleet)",
+    # bytecode-translator internals: the repo's SOT analog is per-path jit
+    # specialization (jit/api.py); these are implementation modules with no
+    # stable user contract
+    "jit.sot": "SOT bytecode translator internals",
+    "jit.pir_dy2static": "PIR dy2static internals",
+    "jit.dy2static.transformers": "AST transformer internals",
+}
+
+
+def _excluded(pkg):
+    return any(pkg == p or pkg.startswith(p + ".") for p in NON_GOALS)
+
+
+def _reference_packages():
+    pkgs = []
+    for root, dirs, files in os.walk(REF):
+        if "__init__.py" in files and root != REF:
+            pkgs.append(os.path.relpath(root, REF).replace(os.sep, "."))
+    return sorted(pkgs)
+
+
+class TestSubmoduleParity(unittest.TestCase):
+    @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
+    def test_every_reference_subpackage_importable(self):
+        missing = []
+        for pkg in _reference_packages():
+            if _excluded(pkg):
+                continue
+            try:
+                importlib.import_module("paddle_tpu." + pkg)
+            except Exception as e:
+                missing.append(f"{pkg}: {type(e).__name__}: {e}")
+        self.assertEqual(missing, [],
+                         "reference subpackages missing from paddle_tpu:\n"
+                         + "\n".join(missing))
+
+    @unittest.skipUnless(os.path.isdir(REF), "reference not mounted")
+    def test_non_goals_actually_absent_from_reference_or_documented(self):
+        # guard against stale exclusions: every NON_GOALS prefix must still
+        # exist in the reference (otherwise the entry should be dropped)
+        pkgs = set(_reference_packages())
+        for p in NON_GOALS:
+            hit = p in pkgs or any(q.startswith(p + ".") for q in pkgs)
+            self.assertTrue(hit, f"NON_GOALS entry {p} no longer in reference")
+
+    def test_round2_blind_spot_closed(self):
+        # the two modules that round 2 shipped without
+        import paddle_tpu.nn.quant
+        import paddle_tpu.nn.utils
+
+        self.assertTrue(hasattr(paddle_tpu.nn.quant, "weight_only_linear"))
+        self.assertTrue(hasattr(paddle_tpu.nn.utils, "weight_norm"))
+
+
+import paddle_tpu  # noqa: E402  (ensures the alias registry is populated)
+
+if __name__ == "__main__":
+    unittest.main()
