@@ -43,7 +43,9 @@ def _line_search(f, xk, d, g, f0, initial_step, max_iters):
     _, alpha, ok = jax.lax.while_loop(
         cond, body, (jnp.asarray(0), jnp.asarray(initial_step, xk.dtype),
                      jnp.asarray(False)))
-    return jnp.where(ok, alpha, alpha)
+    # failed search → zero step: x stays put, the caller's
+    # tolerance_change check then terminates the outer loop
+    return jnp.where(ok, alpha, 0.0)
 
 
 def minimize_bfgs(objective_func, initial_position, max_iters=50,

@@ -371,32 +371,65 @@ class LlamaForCausalLM(Layer):
 
     # --------------------------------------------------------------
     def jit_generate(self, input_ids, max_new_tokens: int = 32,
-                     eos_token_id: Optional[int] = None):
-        """Greedy decode as ONE jitted program: prefill, then a lax.scan
-        over decode steps against fixed-layout per-layer KV caches
-        (reference analog: the fused serving generation path over
-        masked_multihead_attention). Eliminates the per-token eager
-        dispatch of generate() — the whole generation is a single device
-        program, which is the difference between ~30 tok/s and thousands
-        on a tunneled/remote device."""
+                     eos_token_id: Optional[int] = None,
+                     do_sample: bool = False, temperature: float = 1.0,
+                     top_k: int = 0, top_p: float = 1.0,
+                     seed: Optional[int] = None, bucket_size: int = 128,
+                     quant: Optional[str] = None):
+        """Decode as ONE jitted program: prefill, then a lax.scan over
+        decode steps against fixed-layout per-layer KV caches (reference
+        analog: the fused serving generation path over
+        masked_multihead_attention + top_p_sampling,
+        python/paddle/tensor/search.py:1354).
+
+        Serving features:
+        - **prompt bucketing**: prompts are right-padded to a multiple of
+          ``bucket_size`` and the true length enters the program as a
+          traced scalar, so every prompt length in a bucket shares ONE
+          compile (pad K/V slots are masked out of decode attention until
+          overwritten, and the first token reads the logits at the true
+          last position).
+        - **sampling**: ``do_sample=True`` enables temperature / top-k /
+          top-p with a threaded PRNG key; ``seed`` makes it deterministic.
+          temperature and top_p are traced (no recompile when they change);
+          top_k is static (it sizes a lax.top_k).
+        - **weight-only int8 decode** (``quant="weight_only_int8"``): the
+          decode scan reads int8 per-channel-scaled projection weights
+          (nn.quant.weight_quantize layout) — half the HBM traffic on the
+          weight-bound decode path.
+        """
         cfg = self.config
         ids_arr = unwrap(input_ids) if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         if max_new_tokens <= 0:
             return Tensor(ids_arr)
         b, s0 = ids_arr.shape
-        total = s0 + max_new_tokens
+        sb = -(-s0 // bucket_size) * bucket_size  # bucketed prompt length
+        padded = jnp.pad(ids_arr, ((0, 0), (0, sb - s0)))
+        total = sb + max_new_tokens
         max_seq = total if total < 512 else ((total + 511) // 512) * 512
         params = dict(self.raw_state())
-        sig = (b, s0, max_new_tokens, eos_token_id)
+        dec_params = self._decode_params(params, quant)
+        sig = (b, sb, max_new_tokens, eos_token_id, do_sample, int(top_k),
+               quant)
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = {}
         if sig not in cache:  # keep every compiled shape variant
-            fn = _build_jit_generate(self, cfg, b, s0, max_new_tokens,
-                                     max_seq, eos_token_id)
+            fn = _build_jit_generate(self, cfg, b, sb, max_new_tokens,
+                                     max_seq, eos_token_id, do_sample,
+                                     int(top_k))
             cache[sig] = jax.jit(fn)
-        new_tokens = cache[sig](params, ids_arr)
+        if seed is not None:
+            key = jax.random.PRNGKey(int(seed))
+        else:
+            from ..framework.random import next_key
+
+            key = next_key()
+        new_tokens = cache[sig](params, dec_params, padded,
+                                jnp.asarray(s0, jnp.int32), key,
+                                jnp.asarray(temperature, jnp.float32),
+                                jnp.asarray(top_p, jnp.float32))
         out = jnp.concatenate([ids_arr, new_tokens], axis=1)
         if eos_token_id is not None:
             # host-side trim: cut after every row has hit EOS
@@ -407,15 +440,61 @@ class LlamaForCausalLM(Layer):
                 out = out[:, :s0 + last + 1]
         return Tensor(out)
 
+    def _decode_params(self, params, quant):
+        """Decode-path parameter dict; with quant, the 2-D projection
+        weights become (int8 [N,K], scale [N]) pairs. Quantized entries are
+        cached per source array (jax arrays are immutable, so identity
+        tracks staleness): a weight updated by training or set_state_dict
+        is requantized on the next call, never served stale."""
+        if quant is None:
+            return params
+        if quant != "weight_only_int8":
+            raise ValueError(
+                f"quant must be None or 'weight_only_int8', got {quant!r}")
+        from ..nn.quant import weight_quantize
+
+        qcache = getattr(self, "_decode_quant_cache", None)
+        if qcache is None:
+            qcache = self._decode_quant_cache = {}
+        out = dict(params)
+        names = [n for n in params
+                 if n.endswith("_proj.weight") or n == "lm_head.weight"]
+        for n in names:
+            src = params[n]
+            hit = qcache.get(n)
+            if hit is None or hit[0] is not src:
+                wq, sc = weight_quantize(Tensor(src.astype(jnp.float32)))
+                hit = (src, (unwrap(wq), unwrap(sc)))
+                qcache[n] = hit
+            out[n] = hit[1]
+        return out
+
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None):
-        """Greedy decode with a KV cache (reference analog: PaddleNLP
-        generation; kernel family masked_multihead_attention)."""
+                 eos_token_id: Optional[int] = None, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 seed: Optional[int] = None):
+        """Eager decode with a KV cache (reference analog: PaddleNLP
+        generation; kernel family masked_multihead_attention). Supports the
+        same greedy/sampled selection as jit_generate."""
         ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)
+        if seed is not None:
+            key = jax.random.PRNGKey(int(seed))
+        else:
+            from ..framework.random import next_key
+
+            key = next_key()
+
+        def pick(logits_slice, key):
+            return _sample_next(
+                logits_slice.astype(jnp.float32), key, do_sample,
+                jnp.asarray(temperature, jnp.float32), int(top_k),
+                jnp.asarray(top_p, jnp.float32))[:, None]
+
         caches = [(None, None)] * self.config.num_hidden_layers
         logits, caches = self(ids, caches=caches)
         out = [ids]
-        last = jnp.argmax(unwrap(logits)[:, -1:], axis=-1)
+        key, k0 = jax.random.split(key)
+        last = pick(unwrap(logits)[:, -1], k0)
         offset = ids.shape[1]
         for step in range(max_new_tokens):
             out.append(Tensor(last))
@@ -427,35 +506,78 @@ class LlamaForCausalLM(Layer):
             logits, caches = self(Tensor(last), caches=caches,
                                   position_offset=offset)
             offset += 1
-            last = jnp.argmax(unwrap(logits)[:, -1:], axis=-1)
+            key, ks = jax.random.split(key)
+            last = pick(unwrap(logits)[:, -1], ks)
         return Tensor(jnp.concatenate([unwrap(t) for t in out], axis=1))
 
 
-def _build_jit_generate(model, cfg, b, s0, max_new, max_seq, eos_token_id):
-    """Assemble the pure (params, ids) -> new_tokens generation program:
-    prefill through the model's own forward (flash attention), then a
-    scan of single-token decode steps over padded [B, Hkv, max_seq, D]
-    caches with grouped-GQA attention (one pass over the cache per token,
-    the masked_multihead_attention math)."""
+def _mm(x, w):
+    """Matmul against a decode weight: dense [K, N], or the
+    nn.quant.weight_quantize pair (int8 [N, K], scale [N]) — the int8→bf16
+    convert fuses into the dot, so HBM reads stay int8."""
+    if isinstance(w, tuple):
+        wq, sc = w
+        out = jnp.einsum("...k,nk->...n", x, wq.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+        return (out * sc).astype(x.dtype)
+    return x @ w
+
+
+def _sample_next(logits, key, do_sample, temperature, top_k, top_p):
+    """Pick the next token from [B, V] logits: greedy, or nucleus sampling
+    (the jit-safe form of ops/search.py top_p_sampling — sort, cumulative
+    mass cut, categorical draw)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p
+    keep = keep.at[:, 0].set(True)  # the argmax survives even top_p<=0
+    threshold = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
+    logits = jnp.where(logits < threshold, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def _build_jit_generate(model, cfg, b, sb, max_new, max_seq, eos_token_id,
+                        do_sample, top_k):
+    """Assemble the pure (params, dec_params, ids, s0, key, temperature,
+    top_p) -> new_tokens generation program: prefill through the model's
+    own forward (flash attention) on the bucket-padded prompt, then a scan
+    of single-token decode steps over padded [B, Hkv, max_seq, D] caches
+    with grouped-GQA attention (one pass over the cache per token, the
+    masked_multihead_attention math). ``s0`` (true prompt length) is a
+    traced scalar: pad K/V slots at [s0, sb) sit above the `pos` watermark
+    so decode attention never sees them before they are overwritten."""
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     group = nh // nkv
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
 
+    def head_logits(h, p):
+        if cfg.tie_word_embeddings:
+            return h @ p["llama.embed_tokens.weight"].T
+        return _mm(h, p["lm_head.weight"])
+
     def decode_step(p, kcs, vcs, tok, pos):
         """tok [B, 1] int32; pos scalar int32 (tokens already cached)."""
+        # the embedding stays dense (it's a gather, not a matmul)
         h = p["llama.embed_tokens.weight"][tok[:, 0]][:, None, :]
         pos_ids = jnp.reshape(pos, (1,))
         new_kcs, new_vcs = [], []
         for i in range(n_layers):
             pre = f"llama.layers.{i}."
             x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
-            q = (x @ p[pre + "self_attn.q_proj.weight"]).reshape(
+            q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
                 b, 1, nh, dh)
-            k = (x @ p[pre + "self_attn.k_proj.weight"]).reshape(
+            k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
                 b, 1, nkv, dh)
-            v = (x @ p[pre + "self_attn.v_proj.weight"]).reshape(
+            v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
                 b, 1, nkv, dh)
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
@@ -478,19 +600,16 @@ def _build_jit_generate(model, cfg, b, s0, max_new, max_seq, eos_token_id):
             ctx = jnp.einsum("bkgs,bksd->bkgd", probs,
                              vc.astype(jnp.float32))
             ctx = ctx.reshape(b, 1, nh * dh).astype(h.dtype)
-            h = h + ctx @ p[pre + "self_attn.o_proj.weight"]
+            h = h + _mm(ctx, p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
-            gate = x2 @ p[pre + "mlp.gate_proj.weight"]
-            up = x2 @ p[pre + "mlp.up_proj.weight"]
-            h = h + (jax.nn.silu(gate) * up) @ p[pre + "mlp.down_proj.weight"]
+            gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+            up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+            h = h + _mm(jax.nn.silu(gate) * up,
+                        p[pre + "mlp.down_proj.weight"])
         h = _k_rms(h, p["llama.norm.weight"], eps)
-        if cfg.tie_word_embeddings:
-            logits = h @ p["llama.embed_tokens.weight"].T
-        else:
-            logits = h @ p["lm_head.weight"]
-        return jnp.argmax(logits[:, -1], axis=-1), new_kcs, new_vcs
+        return head_logits(h, p)[:, -1], new_kcs, new_vcs
 
-    def run(p, ids):
+    def run(p, p_dec, ids, s0, key, temperature, top_p):
         with _tape.no_grad():
             out = model.func_call(
                 p, Tensor(ids), caches=[(None, None)] * n_layers)
@@ -503,22 +622,30 @@ def _build_jit_generate(model, cfg, b, s0, max_new, max_seq, eos_token_id):
             vc = jnp.zeros((b, nkv, max_seq, dh), unwrap(v).dtype)
             vcs.append(jax.lax.dynamic_update_slice(
                 vc, jnp.swapaxes(unwrap(v), 1, 2), (0, 0, 0, 0)))
-        first = jnp.argmax(logits[:, -1], axis=-1)
+        # logits at the TRUE last prompt position, not the padded end
+        last_logits = jax.lax.dynamic_index_in_dim(
+            logits, s0 - 1, axis=1, keepdims=False)
+        key, k0 = jax.random.split(key)
+        first = _sample_next(last_logits.astype(jnp.float32), k0, do_sample,
+                             temperature, top_k, top_p)
         done0 = (first == eos_token_id) if eos_token_id is not None \
             else jnp.zeros((b,), bool)
 
         def step(carry, _):
-            tok, pos, kcs, vcs, done = carry
-            nxt, kcs, vcs = decode_step(p, kcs, vcs, tok[:, None], pos)
+            tok, pos, kcs, vcs, done, key = carry
+            logits, kcs, vcs = decode_step(p_dec, kcs, vcs, tok[:, None], pos)
+            key, ks = jax.random.split(key)
+            nxt = _sample_next(logits.astype(jnp.float32), ks, do_sample,
+                               temperature, top_k, top_p)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)
-            return (nxt, pos + 1, kcs, vcs, done), nxt
+            return (nxt, pos + 1, kcs, vcs, done, key), nxt
 
         toks = None
         if max_new > 1:
             _, toks = jax.lax.scan(
-                step, (first, jnp.asarray(s0, jnp.int32), kcs, vcs, done0),
+                step, (first, s0.astype(jnp.int32), kcs, vcs, done0, key),
                 None, length=max_new - 1)
         pieces = [first[:, None]]
         if toks is not None:

@@ -25,7 +25,8 @@ from . import mesh as mesh_mod
 from .api import shard_constraint
 from .placement import Replicate, Shard
 
-__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "MoELayer", "moe_dispatch"]
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "MoELayer",
+           "moe_dispatch", "moe_dispatch_sorted", "moe_combine_sorted"]
 
 
 class NaiveGate(Layer):
@@ -92,6 +93,72 @@ def moe_dispatch(x, gate_probs, num_experts: int, topk: int,
     return dispatch("moe_dispatch", impl, (gate_probs,), n_outs=3)
 
 
+def moe_dispatch_sorted(x, gate_probs, num_experts: int, topk: int,
+                        capacity_factor: float = 1.25):
+    """Sort-based capacity dispatch — the scalable form of global_scatter
+    (reference: moe_utils.py:20, and §7.1's 'MoE dispatch' kernel slot).
+
+    The dense `moe_dispatch` materializes a [T, K, E, C] slot one-hot:
+    with C ≈ T·K/E that is O(T²K²) memory — fine for tests, fatal at real
+    token counts. Here assignments are sorted by expert id (stable, so
+    arrival order — and therefore capacity drops — matches the dense
+    form), each kept assignment scatters its token row straight into its
+    [E, C, D] expert slot, and dropped rows land in one overflow slot.
+    Memory is O(T·K·D + E·C·D); one scatter + one gather, both XLA-native
+    on TPU.
+
+    Returns (expert_inputs [E, C, D], slot_dst [T*K] int32 — flat slot per
+    (token, k) assignment with E*C meaning dropped, weights [T*K], aux).
+    Combine with :func:`moe_combine_sorted`.
+    """
+    tokens = x.shape[0]
+    capacity = max(1, int(capacity_factor * tokens * topk / num_experts))
+
+    def impl(hh, probs):
+        d = hh.shape[1]
+        topv, topi = jax.lax.top_k(probs, topk)  # [T, K]
+        eid = topi.reshape(-1)  # slot s = t*K + k
+        order = jnp.argsort(eid, stable=True)
+        e_sorted = eid[order]
+        counts = jnp.bincount(eid, length=num_experts)
+        starts = jnp.cumsum(counts) - counts
+        pos = jnp.arange(tokens * topk) - starts[e_sorted]
+        keep = pos < capacity
+        dst = jnp.where(keep, e_sorted * capacity + pos,
+                        num_experts * capacity)  # overflow slot
+        src_tok = order // topk
+        buf = jnp.zeros((num_experts * capacity + 1, d), hh.dtype)
+        buf = buf.at[dst].set(hh[src_tok])
+        expert_in = buf[:-1].reshape(num_experts, capacity, d)
+        # per-assignment combine metadata, back in slot order
+        slot_dst = jnp.full((tokens * topk,), num_experts * capacity,
+                            jnp.int32).at[order].set(dst.astype(jnp.int32))
+        slot_keep = jnp.zeros((tokens * topk,), bool).at[order].set(keep)
+        weights = jnp.where(slot_keep, topv.reshape(-1), 0.0)
+        # gshard aux loss on the kept assignment density
+        density = jnp.minimum(counts, capacity).astype(probs.dtype) / tokens
+        aux = (density * probs.mean(axis=0)).sum() * num_experts
+        return expert_in, slot_dst, weights, aux
+
+    return dispatch("moe_dispatch_sorted", impl, (x, gate_probs), n_outs=4)
+
+
+def moe_combine_sorted(expert_out, slot_dst, weights, tokens: int, topk: int):
+    """Inverse of moe_dispatch_sorted — the global_gather analog
+    (reference: moe_utils.py:153): gather each assignment's expert output
+    row and weighted-sum the top-k per token."""
+
+    def impl(out_ecd, dstv, wv):
+        e, c, d = out_ecd.shape
+        flat = jnp.concatenate(
+            [out_ecd.reshape(e * c, d), jnp.zeros((1, d), out_ecd.dtype)])
+        rows = flat[dstv] * wv[:, None].astype(out_ecd.dtype)
+        return rows.reshape(tokens, topk, d).sum(axis=1)
+
+    return dispatch("moe_combine_sorted", impl,
+                    (expert_out, slot_dst, weights))
+
+
 class MoELayer(Layer):
     """reference: moe_layer.py:263 MoELayer(d_model, experts, gate, ...).
 
@@ -108,6 +175,7 @@ class MoELayer(Layer):
 
             self.experts = LayerList(experts)
             self._stacked = False
+            self._ep_axis = None
         else:
             assert num_experts and d_hidden
             # stacked expert weights [E, d, h] / [E, h, d]: expert dim
@@ -119,6 +187,7 @@ class MoELayer(Layer):
             ep_axis = next((a for a in ("ep", "mp", "sharding")
                             if mesh is not None and a in mesh.axis_names
                             and num_experts % int(mesh.shape[a]) == 0), None)
+            self._ep_axis = ep_axis
             if ep_axis is not None:
                 sh = jax.sharding.NamedSharding(
                     mesh, jax.sharding.PartitionSpec(ep_axis))
@@ -134,24 +203,33 @@ class MoELayer(Layer):
         orig_shape = x.shape
         h = x.reshape([-1, orig_shape[-1]])
         probs = self.gate(h)
-        disp, combine, aux = moe_dispatch(
-            h, probs, self.num_experts, self.topk, self.capacity_factor)
-        self.aux_loss = aux
 
         if self._stacked:
-            def expert_impl(d, hh, w1, w2):
-                # d: [t,e,c]; expert inputs [e,c,dm]
-                ein = jnp.einsum("tec,td->ecd", d, hh)
+            # scalable path: sort-based dispatch (no [T,E,C] one-hot)
+            expert_in, slot_dst, weights, aux = moe_dispatch_sorted(
+                h, probs, self.num_experts, self.topk, self.capacity_factor)
+            self.aux_loss = aux
+            mesh = mesh_mod.get_global_mesh()
+            if mesh is not None and self._ep_axis is not None:
+                # constrain the expert dim over ep: GSPMD lowers the
+                # scatter->sharded-einsum boundary to the all-to-all
+                expert_in = shard_constraint(
+                    expert_in,
+                    [Shard(0) if a == self._ep_axis else Replicate()
+                     for a in mesh.axis_names], mesh)
+
+            def expert_impl(ein, w1, w2):
                 act = jax.nn.gelu(jnp.einsum("ecd,edh->ech", ein, w1))
-                out = jnp.einsum("ech,ehd->ecd", act, w2)
-                return out
+                return jnp.einsum("ech,ehd->ecd", act, w2)
 
             out_ecd = dispatch("moe_experts", expert_impl,
-                               (disp, h, self.w1, self.w2))
-            y = dispatch("moe_combine",
-                         lambda c, o: jnp.einsum("tec,ecd->td", c, o),
-                         (combine, out_ecd))
+                               (expert_in, self.w1, self.w2))
+            y = moe_combine_sorted(out_ecd, slot_dst, weights,
+                                   h.shape[0], self.topk)
         else:
+            disp, combine, aux = moe_dispatch(
+                h, probs, self.num_experts, self.topk, self.capacity_factor)
+            self.aux_loss = aux
             ein = dispatch("moe_dispatch_einsum",
                            lambda d, hh: jnp.einsum("tec,td->ecd", d, hh),
                            (disp, h))

@@ -28,7 +28,9 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["SpmdRule", "get_spmd_rule", "register_spmd_rule",
-           "with_spmd_constraint", "shard_parameters"]
+           "register_spmd_reverse", "with_spmd_constraint",
+           "shard_parameters", "infer_backward_layout",
+           "apply_backward_constraint"]
 
 
 Spec = Tuple  # per-dim: None | str | tuple of str
@@ -84,18 +86,52 @@ def infer_einsum(notation: str, *in_specs_shapes):
     return new_ins, out, tuple(partial)
 
 
+def infer_einsum_backward(notation: str, in_specs_shapes, out_spec):
+    """Reverse engine (reference: the InferSpmdReverse bodies, e.g.
+    matmul.h:30): input specs derive ONLY from the output constraint —
+    the reference's reverse tests assert existing input dims_mappings do
+    not influence the result. Letters the output doesn't mention
+    (contracted dims) come back replicated. Returns (new_in_specs,
+    out_spec)."""
+    lhs, out_axes = notation.split("->")
+    in_axes = lhs.split(",")
+    out_n = _norm(out_spec, len(out_axes))
+    merged = {letter: out_n[i] for i, letter in enumerate(out_axes)}
+    new_ins = []
+    for axes, (spec, shape) in zip(in_axes, in_specs_shapes):
+        new_ins.append(tuple(
+            None if (shape is not None and i < len(shape) and shape[i] == 1)
+            else merged.get(letter)
+            for i, letter in enumerate(axes)))
+    out = tuple(merged.get(letter) for letter in out_axes)
+    return new_ins, out
+
+
 class SpmdRule:
     """reference: phi::distributed::SpmdRule — infer_forward maps input
-    dist attrs to (inferred input attrs, output attrs)."""
+    dist attrs to (inferred input attrs, output attrs); infer_backward
+    (the reference's InferSpmdReverse, e.g. matmul.h:30 MatmulInferSpmdReverse)
+    maps a constraint on the OUTPUT back to input dist attrs."""
 
-    def __init__(self, name: str, fn: Callable):
+    def __init__(self, name: str, fn: Callable, rev: Optional[Callable] = None):
         self.name = name
         self._fn = fn
+        self._rev = rev
 
     def infer_forward(self, *inputs, **attrs):
         """inputs: (spec, shape) pairs. Returns (in_specs, out_specs,
         partial_axes) — out_specs a single spec or list of specs."""
         return self._fn(*inputs, **attrs)
+
+    def infer_backward(self, *inputs, out=None, **attrs):
+        """inputs: (spec, shape) pairs (spec may be None); out: the output
+        spec (or list of specs for multi-output ops) to propagate back.
+        Returns (in_specs, out_spec) — the reference's InferSpmdReverse
+        contract."""
+        if self._rev is None:
+            raise NotImplementedError(
+                f"SPMD rule {self.name!r} has no reverse (InferSpmdReverse)")
+        return self._rev(*inputs, out=out, **attrs)
 
 
 _RULES: Dict[str, SpmdRule] = {}
@@ -105,7 +141,18 @@ def register_spmd_rule(name: str):
     """reference: PD_REGISTER_SPMD_RULE."""
 
     def deco(fn):
-        _RULES[name] = SpmdRule(name, fn)
+        rev = _RULES[name]._rev if name in _RULES else None
+        _RULES[name] = SpmdRule(name, fn, rev)
+        return fn
+
+    return deco
+
+
+def register_spmd_reverse(name: str):
+    """Attach an InferSpmdReverse body to a registered rule."""
+
+    def deco(fn):
+        _RULES[name]._rev = fn
         return fn
 
     return deco
@@ -403,6 +450,195 @@ for _name in ("gather", "gather_nd", "one_hot", "tile", "expand_as",
 
 
 # ---------------------------------------------------------------------------
+# reverse (InferSpmdReverse) bodies for the high-traffic rules
+# reference: paddle/phi/infermeta/spmd_rules/*.h *InferSpmdReverse
+# ---------------------------------------------------------------------------
+
+def _matmul_notation(xnd, ynd):
+    batch = _letters(max(xnd, ynd) - 2, reserved="kmn")
+    x_axes = batch[len(batch) - (xnd - 2):] + "mk" if xnd >= 2 else "k"
+    y_axes = batch[len(batch) - (ynd - 2):] + "kn" if ynd >= 2 else "k"
+    out_axes = batch + ("m" if xnd >= 2 else "") + ("n" if ynd >= 2 else "")
+    return f"{x_axes},{y_axes}->{out_axes}"
+
+
+@register_spmd_reverse("matmul")
+def _matmul_rev(x, y, out=None, trans_x: bool = False, trans_y: bool = False):
+    """reference: matmul.h:30 MatmulInferSpmdReverse."""
+    (xs, xsh), (ys, ysh) = x, y
+    xnd, ynd = len(xsh), len(ysh)
+    xs, ys = _norm(xs, xnd), _norm(ys, ynd)
+    xsh, ysh = list(xsh), list(ysh)
+    if trans_x and xnd >= 2:
+        xs[-2], xs[-1] = xs[-1], xs[-2]
+        xsh[-2], xsh[-1] = xsh[-1], xsh[-2]
+    if trans_y and ynd >= 2:
+        ys[-2], ys[-1] = ys[-1], ys[-2]
+        ysh[-2], ysh[-1] = ysh[-1], ysh[-2]
+    ins, o = infer_einsum_backward(
+        _matmul_notation(xnd, ynd), [(xs, xsh), (ys, ysh)], out)
+    nx, ny = list(ins[0]), list(ins[1])
+    if trans_x and xnd >= 2:
+        nx[-2], nx[-1] = nx[-1], nx[-2]
+    if trans_y and ynd >= 2:
+        ny[-2], ny[-1] = ny[-1], ny[-2]
+    return [tuple(nx), tuple(ny)], o
+
+
+@register_spmd_reverse("elementwise")
+def _elementwise_rev(*inputs, out=None):
+    nd = max(len(sh) for _, sh in inputs)
+    axes = _letters(nd)
+    notation = ",".join(axes[nd - len(sh):] for _, sh in inputs) + "->" + axes
+    return infer_einsum_backward(notation, list(inputs), out)
+
+
+@register_spmd_reverse("embedding")
+def _embedding_rev(ids, table, out=None):
+    """reference: embedding.h EmbeddingInferSpmdReverse — batch axes flow
+    back to ids; the hidden axis to the table's column; vocab comes back
+    None (apply_backward_constraint preserves an existing vocab sharding,
+    since it never appears in the output)."""
+    (ispec, ish), (tspec, tsh) = ids, table
+    axes = _letters(len(ish), reserved="vh")
+    return infer_einsum_backward(
+        f"{axes},vh->{axes}h", [(ispec, ish), (tspec, tsh)], out)
+
+
+def _norm_rule_rev(x, scale, bias=None, out=None, begin_norm_axis: int = -1):
+    """layer_norm.h/rms_norm.h reverse: leading output axes flow back to
+    the input; normalized trailing dims and scale/bias stay replicated."""
+    (xs, xsh) = x
+    nd = len(xsh)
+    if begin_norm_axis < 0:
+        begin_norm_axis += nd
+    o = _norm(out, nd)
+    new_x = tuple(o[i] if i < begin_norm_axis else None for i in range(nd))
+    ins = [new_x, (None,) * len(scale[1])]
+    if bias is not None:
+        ins.append((None,) * len(bias[1]))
+    return ins, new_x
+
+
+register_spmd_reverse("layer_norm")(_norm_rule_rev)
+register_spmd_reverse("rms_norm")(_norm_rule_rev)
+
+
+@register_spmd_reverse("reduction")
+def _reduction_rev(x, out=None, axis=None, keepdim: bool = False):
+    """reference: reduction.h ReductionInferSpmdReverse — kept output dims
+    flow back; reduced dims keep their existing input sharding."""
+    (xs, xsh) = x
+    nd = len(xsh)
+    xs = _norm(xs, nd)
+    if axis is None:
+        axis = list(range(nd))
+    axis = [a % nd for a in (axis if isinstance(axis, (list, tuple))
+                             else [axis])]
+    kept = [i for i in range(nd) if i not in axis]
+    o = _norm(out, nd if keepdim else len(kept))
+    new = list(xs)
+    if keepdim:
+        for i in kept:
+            new[i] = o[i]
+    else:
+        for oi, i in enumerate(kept):
+            new[i] = o[oi]
+    return [tuple(new)], tuple(o)
+
+
+@register_spmd_reverse("softmax")
+def _softmax_rev(x, out=None, axis: int = -1):
+    (xs, xsh) = x
+    nd = len(xsh)
+    axis %= nd
+    o = _norm(out, nd)
+    new = tuple(None if i == axis else o[i] for i in range(nd))
+    return [new], new
+
+
+@register_spmd_reverse("transpose")
+def _transpose_rev(x, out=None, perm: Sequence[int] = ()):
+    (xs, xsh) = x
+    nd = len(xsh)
+    o = _norm(out, nd)
+    new = [None] * nd
+    for out_i, in_i in enumerate(perm):
+        new[in_i] = o[out_i]
+    return [tuple(new)], tuple(o)
+
+
+@register_spmd_reverse("reshape")
+def _reshape_rev(x, out=None, shape: Sequence[int] = ()):
+    """reshape.h reverse: run the forward dim-matching with the roles
+    swapped (output spec+shape is the 'input')."""
+    (xs, xsh) = x
+    shape = list(shape)
+    import numpy as np
+
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = int(np.prod(xsh)) // max(known, 1)
+    ins, o, _ = _reshape((out, tuple(shape)), tuple(xsh))
+    return [o], tuple(_norm(out, len(shape)))
+
+
+@register_spmd_reverse("flash_attention")
+def _flash_rev(q, k, v, out=None):
+    """flash_attention.h reverse: batch/head flow back to q/k/v; q's seq
+    axis from the output seq; kv seq + head dim replicated."""
+    o = _norm(out, 4)
+    qspec = (o[0], o[1], o[2], None)
+    kvspec = (o[0], None, o[2], None)
+    return [qspec, kvspec, kvspec], tuple(o[:3]) + (None,)
+
+
+@register_spmd_reverse("split")
+def _split_rev(x, out=None, num_or_sections=None, axis: int = 0):
+    (xs, xsh) = x
+    nd = len(xsh)
+    axis %= nd
+    outs = out if isinstance(out, list) else [out]
+    merged = []
+    for i in range(nd):
+        if i == axis:
+            merged.append(None)
+        else:
+            merged.append(_merge_axis([_norm(o, nd)[i] for o in outs]))
+    spec = tuple(merged)
+    return [spec], [spec] * len(outs)
+
+
+@register_spmd_reverse("cross_entropy_with_softmax")
+def _ce_rev(logits, labels, out=None, axis: int = -1):
+    (ls, lsh) = logits
+    nd = len(lsh)
+    axis %= nd
+    o = _norm(out, nd - 1)
+    new_l = []
+    oi = 0
+    for i in range(nd):
+        if i == axis:
+            new_l.append(None)
+        else:
+            new_l.append(o[oi])
+            oi += 1
+    return [tuple(new_l), tuple(o)], tuple(o)
+
+
+def infer_backward_layout(op_name: str, out_spec, *inputs, **attrs):
+    """Back-propagate a sharding constraint placed on an op's OUTPUT to
+    its inputs (the user-facing face of InferSpmdReverse): returns one
+    spec per input. Following the reference's reverse contract, specs
+    derive from the output alone — dims the output doesn't mention come
+    back None (apply_backward_constraint layers existing shardings back
+    on top for those)."""
+    rule = get_spmd_rule(op_name)
+    ins, _ = rule.infer_backward(*inputs, out=out_spec, **attrs)
+    return ins
+
+
+# ---------------------------------------------------------------------------
 # application helpers
 # ---------------------------------------------------------------------------
 
@@ -453,12 +689,58 @@ def _axes_in_mesh(ax, mesh) -> bool:
     return all(n in mesh.axis_names for n in names)
 
 
+def apply_backward_constraint(op_name: str, out_spec, *tensors, mesh=None,
+                              **attrs):
+    """Lay out an op's concrete inputs (typically parameters) from a
+    sharding constraint placed on its OUTPUT activation — the application
+    of InferSpmdReverse (reference: matmul.h:30). Each tensor is
+    device_put with the spec the reverse rule infers; returns the list of
+    inferred specs."""
+    import jax as _jax
+
+    from ..core.tensor import Tensor, unwrap
+    from . import mesh as mesh_mod
+
+    mesh = mesh or mesh_mod.get_global_mesh()
+    arrs = [unwrap(t) if isinstance(t, Tensor) else t for t in tensors]
+    cur_specs = [_spec_of(a, mesh) for a in arrs]
+    ins = infer_backward_layout(
+        op_name, out_spec, *[(s, a.shape) for s, a in zip(cur_specs, arrs)],
+        **attrs)
+    # dims the output constraint doesn't reach keep their current layout —
+    # never silently gather an already-sharded parameter. A mesh axis
+    # claimed by the constraint is dropped from the kept current dims.
+    claimed = set()
+    for spec in ins:
+        for s in spec:
+            if s is not None:
+                claimed.update(s if isinstance(s, tuple) else (s,))
+    merged = []
+    for spec, cur in zip(ins, cur_specs):
+        merged.append(tuple(
+            s if s is not None else
+            (c if (c is None or all(
+                a not in claimed for a in (c if isinstance(c, tuple) else (c,))
+            )) else None)
+            for s, c in zip(spec, cur)))
+    if mesh is None:
+        return merged
+    for t, a, spec in zip(tensors, arrs, merged):
+        keep = tuple(s if (s is None or _axes_in_mesh(s, mesh)) else None
+                     for s in spec)
+        placed = _jax.device_put(a, NamedSharding(mesh, P(*keep)))
+        if isinstance(t, Tensor):
+            t._array = placed
+    return merged
+
+
 def shard_parameters(model, mesh, rules: Sequence[Tuple[str, Tuple]],
                      default: Optional[Tuple] = None):
     """Lay a model's parameters out from a (name-suffix, dims) table — the
     generic form of shard_llama's logical-axis rules usable on ANY Layer
     (reference analog: the dist attrs the fleet wrappers assign to their
-    own parameters)."""
+    own parameters). To derive the table from a constraint on an
+    ACTIVATION instead, use apply_backward_constraint (InferSpmdReverse)."""
     from .mesh import divisible_prefix
 
     for name, p in model.named_parameters():

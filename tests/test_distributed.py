@@ -370,6 +370,59 @@ class TestMoE:
         assert moe.w1.grad is not None
         assert moe.gate.gate_weight.grad is not None
 
+    def test_sorted_dispatch_matches_dense(self, _mesh):
+        """Round-2 VERDICT item 9: the sort-based dispatch must reproduce
+        the dense [T,E,C] one-hot form exactly — expert inputs, combine,
+        capacity drops, and aux loss."""
+        from paddle_tpu.parallel.moe import (moe_combine_sorted,
+                                             moe_dispatch,
+                                             moe_dispatch_sorted)
+
+        rng = np.random.default_rng(3)
+        T, D, E, K = 32, 8, 4, 2
+        h = paddle.to_tensor(rng.standard_normal((T, D)).astype("float32"))
+        logits = rng.standard_normal((T, E)).astype("float32")
+        probs = paddle.to_tensor(
+            np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+        # capacity_factor 0.5 forces real drops, exercising arrival order
+        for cf in (1.25, 0.5):
+            disp, combine, aux_d = moe_dispatch(h, probs, E, K, cf)
+            ein_dense = np.einsum("tec,td->ecd", np.asarray(disp._array),
+                                  np.asarray(h._array))
+            ein, dst, w, aux_s = moe_dispatch_sorted(h, probs, E, K, cf)
+            np.testing.assert_allclose(np.asarray(ein._array), ein_dense,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(float(aux_d._array),
+                                       float(aux_s._array), rtol=1e-5)
+            out_dense = np.einsum("tec,ecd->td",
+                                  np.asarray(combine._array), ein_dense)
+            y = moe_combine_sorted(ein, dst, w, T, K)
+            np.testing.assert_allclose(np.asarray(y._array), out_dense,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_sorted_dispatch_compiled_memory(self, _mesh):
+        """At a shape where the dense slot one-hot alone would be ~335 MB,
+        the sorted dispatch's whole compiled temp footprint must stay an
+        order of magnitude under it."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.parallel.moe import moe_dispatch_sorted
+
+        T, E, D, K = 4096, 64, 64, 2
+        cap = int(1.25 * T * K / E)
+        dense_slot_bytes = T * K * E * cap * 4
+
+        def run(hh, pp):
+            ein, dst, w, aux = moe_dispatch_sorted(
+                paddle.Tensor(hh), paddle.Tensor(pp), E, K, 1.25)
+            return ein._array.sum()
+
+        mem = jax.jit(run).lower(
+            jnp.zeros((T, D)), jnp.ones((T, E)) / E
+        ).compile().memory_analysis().temp_size_in_bytes
+        assert mem < dense_slot_bytes / 10, (mem, dense_slot_bytes)
+
 
 class TestFleet:
     def test_fleet_init_and_wrap(self):

@@ -212,6 +212,118 @@ class TestLlama:
         z = model.jit_generate(paddle.to_tensor(row), max_new_tokens=0)
         np.testing.assert_array_equal(z.numpy(), row)
 
+    def test_jit_generate_prompt_bucketing_one_compile(self):
+        """Two prompt lengths inside one 128-token bucket must share ONE
+        compiled program, and padded decode must match the unbucketed
+        (eager) result (round-2 VERDICT item 8)."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(6)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(1)
+        ids17 = rng.integers(1, cfg.vocab_size, (2, 17))
+        ids30 = rng.integers(1, cfg.vocab_size, (2, 30))
+        out17 = model.jit_generate(paddle.to_tensor(ids17), max_new_tokens=5)
+        n = len(model._jit_gen_cache)
+        out30 = model.jit_generate(paddle.to_tensor(ids30), max_new_tokens=5)
+        assert len(model._jit_gen_cache) == n, "second length recompiled"
+        # numerics match the unbucketed eager path
+        e17 = model.generate(paddle.to_tensor(ids17), max_new_tokens=5)
+        e30 = model.generate(paddle.to_tensor(ids30), max_new_tokens=5)
+        np.testing.assert_array_equal(out17.numpy(), e17.numpy())
+        np.testing.assert_array_equal(out30.numpy(), e30.numpy())
+
+    def test_jit_generate_sampling(self):
+        """Sampled decoding in the jitted loop (round-2 VERDICT item 5):
+        seeded determinism, temp→0 == greedy, and no recompile when
+        temperature/top_p change (they are traced scalars)."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(7)
+        model = LlamaForCausalLM(cfg)
+        x = np.random.default_rng(2).integers(1, cfg.vocab_size, (2, 9))
+        xt = paddle.to_tensor(x)
+        greedy = model.jit_generate(xt, max_new_tokens=6)
+        s1 = model.jit_generate(xt, max_new_tokens=6, do_sample=True,
+                                temperature=1.0, top_p=0.9, seed=42)
+        s2 = model.jit_generate(xt, max_new_tokens=6, do_sample=True,
+                                temperature=1.0, top_p=0.9, seed=42)
+        np.testing.assert_array_equal(s1.numpy(), s2.numpy())
+        cold = model.jit_generate(xt, max_new_tokens=6, do_sample=True,
+                                  temperature=1e-4, seed=3)
+        np.testing.assert_array_equal(cold.numpy(), greedy.numpy())
+        n = len(model._jit_gen_cache)
+        model.jit_generate(xt, max_new_tokens=6, do_sample=True,
+                           temperature=0.7, top_p=0.5, seed=4)
+        assert len(model._jit_gen_cache) == n, "temperature/top_p recompiled"
+        # high temperature spreads mass: over many draws, the first sampled
+        # token should not be constant across seeds
+        firsts = {int(model.jit_generate(
+            xt[:1], max_new_tokens=1, do_sample=True, temperature=50.0,
+            seed=s).numpy()[0, -1]) for s in range(8)}
+        assert len(firsts) > 1, "high-temperature sampling is degenerate"
+
+    def test_jit_generate_top_k_restricts_support(self):
+        cfg = LlamaConfig.tiny()
+        paddle.seed(8)
+        model = LlamaForCausalLM(cfg)
+        x = np.random.default_rng(3).integers(1, cfg.vocab_size, (1, 9))
+        xt = paddle.to_tensor(x)
+        greedy_tok = int(model.jit_generate(xt, max_new_tokens=1).numpy()[0, -1])
+        # top_k=1 == greedy regardless of temperature/seed
+        for s in range(4):
+            t = model.jit_generate(xt, max_new_tokens=1, do_sample=True,
+                                   top_k=1, temperature=5.0, seed=s)
+            assert int(t.numpy()[0, -1]) == greedy_tok
+
+    def test_jit_generate_int8_weight_only_decode(self):
+        """quant='weight_only_int8' decode (round-2 VERDICT item 3): the
+        int8 per-channel path must track the fp greedy path."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(9)
+        model = LlamaForCausalLM(cfg)
+        x = np.random.default_rng(4).integers(1, cfg.vocab_size, (2, 9))
+        xt = paddle.to_tensor(x)
+        fp = model.jit_generate(xt, max_new_tokens=6)
+        q = model.jit_generate(xt, max_new_tokens=6, quant="weight_only_int8")
+        agree = (fp.numpy() == q.numpy()).mean()
+        assert agree > 0.7, f"int8 decode diverged: agreement {agree}"
+        with pytest.raises(ValueError):
+            model.jit_generate(xt, max_new_tokens=2, quant="int3")
+
+
+    def test_jit_generate_top_p_zero_is_greedy(self):
+        cfg = LlamaConfig.tiny()
+        paddle.seed(10)
+        model = LlamaForCausalLM(cfg)
+        x = np.random.default_rng(5).integers(1, cfg.vocab_size, (1, 9))
+        xt = paddle.to_tensor(x)
+        greedy = model.jit_generate(xt, max_new_tokens=4)
+        for s in range(3):
+            t = model.jit_generate(xt, max_new_tokens=4, do_sample=True,
+                                   top_p=0.0, seed=s)
+            np.testing.assert_array_equal(t.numpy(), greedy.numpy())
+
+    def test_int8_decode_requantizes_after_weight_update(self):
+        """The quant cache keys on source-array identity: updating a weight
+        must be reflected in the next quantized generation."""
+        import jax.numpy as jnp
+
+        cfg = LlamaConfig.tiny()
+        paddle.seed(11)
+        model = LlamaForCausalLM(cfg)
+        x = np.random.default_rng(6).integers(1, cfg.vocab_size, (1, 9))
+        xt = paddle.to_tensor(x)
+        model.jit_generate(xt, max_new_tokens=2, quant="weight_only_int8")
+        cache = model._decode_quant_cache
+        name = next(iter(cache))
+        old_q = cache[name][1][0]
+        # perturb that weight through the raw-state path
+        state = model.raw_state()
+        state[name] = state[name] + 1.0
+        model.load_raw_state(state)
+        model.jit_generate(xt, max_new_tokens=2, quant="weight_only_int8")
+        new_q = model._decode_quant_cache[name][1][0]
+        assert not np.array_equal(np.asarray(old_q), np.asarray(new_q))
+
     def test_sep_matches_serial(self):
         """Ulysses SEP must be numerically equivalent to serial training,
         same bar as TP/DP/sharding (reference:

@@ -188,3 +188,162 @@ class TestApplication:
         l1, params, state = step(params, state, x, y)
         l2, params, state = step(params, state, x, y)
         assert np.isfinite(float(l1)) and float(l2) < float(l1)
+
+
+class TestReverseRules:
+    """InferSpmdReverse (reference: matmul.h:30 MatmulInferSpmdReverse and
+    test_matmul_rule.py test_matmul_infer_backward): a constraint on the
+    OUTPUT propagates back to input layouts; pre-existing input dims must
+    not influence the result."""
+
+    def test_matmul_reverse_mn(self):
+        # mn["mp","dp"] -> mk["mp",None], kn[None,"dp"]
+        ins, out = get_spmd_rule("matmul").infer_backward(
+            (None, (64, 32)), (None, (32, 48)), out=("mp", "dp"))
+        assert ins[0] == ("mp", None)
+        assert ins[1] == (None, "dp")
+        assert out == ("mp", "dp")
+
+    def test_matmul_reverse_ignores_input_dims(self):
+        # reference: "dims mapping of input should not influence
+        # inferbackward"
+        ins, out = get_spmd_rule("matmul").infer_backward(
+            (("dp", "mp"), (64, 32)), (("mp", None), (32, 48)),
+            out=(None, None))
+        assert ins[0] == (None, None)
+        assert ins[1] == (None, None)
+
+    def test_matmul_reverse_broadcast_batch(self):
+        # abmn["mp","dp",None,None] -> 1mk[None,None,None],
+        # abkn["mp","dp",None,None] (size-1 batch dim takes no sharding)
+        ins, out = get_spmd_rule("matmul").infer_backward(
+            (None, (1, 64, 32)), (None, (512, 48, 32, 48)),
+            out=("mp", "dp", None, None))
+        assert ins[0] == (None, None, None)
+        assert ins[1] == ("mp", "dp", None, None)
+
+    def test_matmul_reverse_trans_y(self):
+        # with trans_y, n sharding lands on y dim 0
+        ins, out = get_spmd_rule("matmul").infer_backward(
+            (None, (8, 16)), (None, (32, 16)), out=(None, "mp"), trans_y=True)
+        assert ins[1] == ("mp", None)
+
+    def test_embedding_reverse(self):
+        # out[b,s,h] = ["dp", None, "mp"] -> ids["dp", None],
+        # table[None, "mp"] (vocab never sharded from the output)
+        ins, out = get_spmd_rule("embedding").infer_backward(
+            (None, (4, 1024)), (None, (512, 768)),
+            out=("dp", None, "mp"))
+        assert ins[0] == ("dp", None)
+        assert ins[1] == (None, "mp")
+
+    def test_layer_norm_reverse(self):
+        ins, out = get_spmd_rule("layer_norm").infer_backward(
+            (None, (8, 16, 32)), (None, (32,)), out=("dp", "sep", None),
+            begin_norm_axis=2)
+        assert ins[0] == ("dp", "sep", None)
+        assert ins[1] == (None,)
+
+    def test_reduction_reverse_keepdim_and_not(self):
+        ins, out = get_spmd_rule("reduction").infer_backward(
+            (None, (8, 16, 32)), out=("dp", None), axis=1)
+        assert ins[0] == ("dp", None, None)
+        ins2, _ = get_spmd_rule("reduction").infer_backward(
+            (None, (8, 16, 32)), out=("dp", None, "mp"), axis=1,
+            keepdim=True)
+        assert ins2[0] == ("dp", None, "mp")
+
+    def test_softmax_reverse_axis_replicated(self):
+        ins, out = get_spmd_rule("softmax").infer_backward(
+            (None, (4, 8, 32)), out=("dp", None, "mp"), axis=-1)
+        assert ins[0] == ("dp", None, None)
+
+    def test_transpose_reverse(self):
+        ins, out = get_spmd_rule("transpose").infer_backward(
+            (None, (4, 8, 16)), out=("mp", None, "dp"), perm=(2, 0, 1))
+        # out dim0 <- in dim2, out dim1 <- in dim0, out dim2 <- in dim1
+        assert ins[0] == (None, "dp", "mp")
+
+    def test_reshape_reverse_merge(self):
+        # in [4, 8, 16] reshaped to [32, 16]; out ["dp", "mp"] -> the
+        # merged leading group's first factor carries "dp", last dim "mp"
+        ins, out = get_spmd_rule("reshape").infer_backward(
+            (None, (4, 8, 16)), out=("dp", "mp"), shape=(32, 16))
+        assert ins[0][0] == "dp"
+        assert ins[0][2] == "mp"
+
+    def test_flash_attention_reverse(self):
+        ins, out = get_spmd_rule("flash_attention").infer_backward(
+            (None, (2, 128, 16, 64)), (None, (2, 128, 16, 64)),
+            (None, (2, 128, 16, 64)), out=("dp", "sep", "mp", None))
+        assert ins[0] == ("dp", "sep", "mp", None)
+        assert ins[1] == ("dp", None, "mp", None)  # kv seq gathered
+        assert ins[2] == ("dp", None, "mp", None)
+
+    def test_split_reverse_merges_outputs(self):
+        ins, outs = get_spmd_rule("split").infer_backward(
+            (None, (8, 32)), out=[("dp", None), ("dp", None)],
+            num_or_sections=2, axis=1)
+        assert ins[0] == ("dp", None)
+
+    def test_elementwise_reverse_broadcast(self):
+        ins, out = get_spmd_rule("elementwise").infer_backward(
+            (None, (8, 16)), (None, (16,)), out=("dp", "mp"))
+        assert ins[0] == ("dp", "mp")
+        assert ins[1] == ("mp",)
+
+    def test_no_reverse_raises(self):
+        with pytest.raises(NotImplementedError):
+            get_spmd_rule("gather").infer_backward((None, (4,)), out=(None,))
+
+
+class TestApplyBackwardConstraint:
+    def test_params_laid_out_from_activation_constraint(self):
+        """shard_parameters' reverse companion: constraining y = x @ w to
+        (dp, mp) must place w as (None, mp) on the mesh."""
+        from paddle_tpu.parallel.spmd_rules import apply_backward_constraint
+
+        mesh = build_mesh((2, 4), ("dp", "mp"))
+        w = paddle.to_tensor(np.zeros((16, 32), np.float32))
+        x = paddle.to_tensor(np.zeros((8, 16), np.float32))
+        specs = apply_backward_constraint(
+            "matmul", ("dp", "mp"), x, w, mesh=mesh)
+        assert specs[0] == ("dp", None)
+        assert specs[1] == (None, "mp")
+        from jax.sharding import NamedSharding
+
+        sh = w._array.sharding
+        assert isinstance(sh, NamedSharding)
+        assert tuple(sh.spec) == (None, "mp")
+
+    def test_backward_constraint_preserves_contracted_sharding(self):
+        """A vocab-sharded embedding table must NOT be gathered when the
+        output constraint doesn't mention the vocab dim."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel.spmd_rules import apply_backward_constraint
+
+        mesh = build_mesh((2, 4), ("dp", "mp"))
+        table = paddle.to_tensor(np.zeros((512, 8), np.float32))
+        table._array = jax.device_put(
+            table._array, NamedSharding(mesh, P("mp", None)))
+        ids = paddle.to_tensor(np.zeros((4, 16), np.int32))
+        specs = apply_backward_constraint(
+            "embedding", ("dp", None, None), ids, table, mesh=mesh)
+        assert specs[1] == ("mp", None)  # vocab sharding survives
+        assert tuple(table._array.sharding.spec) == ("mp", None)
+
+    def test_backward_constraint_claimed_axis_not_duplicated(self):
+        """An axis the output constraint claims must not also survive on a
+        contracted dim (one mesh axis, one tensor dim)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel.spmd_rules import apply_backward_constraint
+
+        mesh = build_mesh((2, 4), ("dp", "mp"))
+        w = paddle.to_tensor(np.zeros((16, 32), np.float32))
+        w._array = jax.device_put(
+            w._array, NamedSharding(mesh, P("mp", None)))  # k-sharded
+        x = paddle.to_tensor(np.zeros((8, 16), np.float32))
+        specs = apply_backward_constraint(
+            "matmul", (None, "mp"), x, w, mesh=mesh)
+        # "mp" moved to the n dim; it must not remain on k as well
+        assert specs[1] == (None, "mp")
