@@ -30,8 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_mod
 
-__all__ = ["pipeline_forward", "pipeline_1f1b", "stack_stage_params",
-           "unstack_stage_params"]
+__all__ = ["pipeline_forward", "pipeline_1f1b", "pipeline_vpp_forward",
+           "pipeline_zb1f1b", "stack_stage_params", "unstack_stage_params"]
 
 
 def _to_varying(x, axis):
@@ -148,9 +148,146 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, *,
     return run(stacked_params, x)
 
 
+def pipeline_vpp_forward(chunk_fn: Callable, chunked_params, x, *,
+                         mesh: Optional[Mesh] = None, axis: str = "pp",
+                         n_micro: Optional[int] = None):
+    """Interleaved (VPP) pipeline forward — one SPMD program.
+
+    Reference: fleet/meta_parallel/pipeline_parallel.py:1009
+    PipelineParallelWithInterleave and
+    passes/pipeline_scheduler_pass/pipeline_vpp.py. There, each rank holds
+    V non-contiguous model chunks and a hand-written schedule interleaves
+    them; here the same interleaving is ONE scan whose tick body picks the
+    rank's active chunk by a dynamic index derived from (tick, rank) — a
+    gather over the rank's V chunk parameter slices, NOT V× compute (the
+    round-2 punt claimed otherwise; it was wrong).
+
+    Layout: ``chunked_params`` leaves are [S, V, ...] — element [r, v] is
+    model chunk ``v*S + r`` (Megatron interleaved assignment), dim 0
+    sharded over `axis`. Microbatch m flows through chunks 0..S*V-1 in
+    order; every chunk boundary moves rank r → r+1 (mod S), produced at
+    one tick and consumed exactly at the next, so no boundary buffering is
+    needed. With the local clock u = t - r:
+
+        g = u // (S*V);  w = u % (S*V);  v = w // S;  m = g*S + (w % S)
+
+    T = n_micro*V + S - 1 ticks of ONE chunk's work — the interleaved
+    bubble is (S-1) chunk-ticks vs (S-1) full-stage-ticks for V=1, the
+    1/V bubble shrink VPP exists for. Requires n_micro % S == 0 (the same
+    constraint the reference's interleaved schedule imposes).
+    """
+    mesh = mesh or mesh_mod.get_global_mesh()
+    leaves = jax.tree.leaves(chunked_params)
+    S_dim, V = int(leaves[0].shape[0]), int(leaves[0].shape[1])
+    if mesh is None or axis not in mesh.axis_names \
+            or int(mesh.shape[axis]) == 1:
+        h = x
+        for c in range(S_dim * V):
+            p_c = jax.tree.map(lambda t, c=c: t[c % S_dim, c // S_dim],
+                               chunked_params)
+            h = chunk_fn(p_c, h)
+        return h
+
+    n_stages = int(mesh.shape[axis])
+    if S_dim != n_stages:
+        raise ValueError(f"chunk rank-dim {S_dim} != pp axis {n_stages}")
+    batch = x.shape[0]
+    n_micro = n_micro or n_stages
+    if batch % n_micro != 0:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    if n_micro % n_stages != 0:
+        raise ValueError(
+            f"VPP needs n_micro ({n_micro}) divisible by pp ({n_stages}) — "
+            "the reference interleaved schedule has the same constraint")
+    mb = batch // n_micro
+    SV = n_stages * V
+
+    @partial(jax.shard_map, mesh=mesh, axis_names={axis},
+             in_specs=(P(axis), P()), out_specs=P())
+    def run(params_local, xg):
+        chunks = jax.tree.map(lambda t: t[0], params_local)  # [V, ...]
+        r = jax.lax.axis_index(axis)
+        micro = xg.reshape((n_micro, mb) + xg.shape[1:])
+        t_total = n_micro * V + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            boundary, outputs = carry
+            u = t - r
+            active = (u >= 0) & (u < n_micro * V)
+            uc = jnp.clip(u, 0, n_micro * V - 1)
+            g = uc // SV
+            w = uc % SV
+            v = w // n_stages
+            m = g * n_stages + (w % n_stages)
+            p_v = jax.tree.map(
+                lambda t_: jax.lax.dynamic_index_in_dim(
+                    t_, v, axis=0, keepdims=False), chunks)
+            first_chunk = (r == 0) & (v == 0)
+            x_in = jnp.where(first_chunk, micro[m], boundary)
+            y = chunk_fn(p_v, x_in)
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            last_chunk = (r == n_stages - 1) & (v == V - 1)
+            outputs = jnp.where(
+                last_chunk & active, outputs.at[m].set(y), outputs)
+            boundary = jax.lax.ppermute(y, axis, perm)
+            return (boundary, outputs), None
+
+        boundary0 = _to_varying(
+            jnp.zeros((mb,) + xg.shape[1:], xg.dtype), axis)
+        outputs0 = _to_varying(
+            jnp.zeros((n_micro, mb) + xg.shape[1:], xg.dtype), axis)
+        (boundary, outputs), _ = jax.lax.scan(
+            tick, (boundary0, outputs0), jnp.arange(t_total))
+        out = outputs.reshape((batch,) + xg.shape[1:])
+        mask = ((r == n_stages - 1)).astype(out.dtype)
+        return jax.lax.psum(out * mask, axis)
+
+    return run(chunked_params, x)
+
+
+def pipeline_zb1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
+                    head_params, x, labels, *, mesh: Optional[Mesh] = None,
+                    axis: str = "pp", n_micro: Optional[int] = None,
+                    head_specs=None):
+    """Zero-bubble-style 1F1B: weight gradients leave the tick loop.
+
+    Reference: distributed/passes/pipeline_scheduler_pass/
+    pipeline_zero_bubble.py (ZBH1) — split each backward into B
+    (activation grad, on the critical path) and W (weight grad, not), and
+    schedule W into bubble slots.
+
+    TPU-native translation: the SPMD pipeline is ONE program whose ticks
+    synchronize at every ppermute, so per-rank-asynchronous W slotting (the
+    GPU form) cannot shorten a tick — any tick in which SOME rank does W
+    costs F+B+W for everyone. What the one-program model CAN do is take W
+    out of the scan entirely: ticks run F + B only (dx via a vjp w.r.t.
+    the input alone), each microbatch's (input, output-cotangent) pair is
+    saved, and ALL weight gradients are computed after the scan as one
+    vmapped-and-summed vjp — n_micro microbatches of weight-grad matmuls
+    batched into single large MXU-friendly contractions instead of
+    n_micro small ones serialized through the scan.
+
+    Cost model vs 1F1B (T = n_micro + 2S - 1 ticks): the scan saves T
+    weight-grad units; the post-pass spends n_micro recompute-forward +
+    n_micro weight-grad units (batched). Net tick-FLOP saving ≈
+    (2S - 1 - n_micro) weight-grad units — a win for n_micro < 2S-1, a
+    wash above, with the batched W pass's better MXU utilization on top
+    either way. Memory: 2·n_micro microbatch activations (x and dy
+    buffers) vs 1F1B's 2S inputs — the classic zero-bubble
+    compute-for-memory trade (ZB-H2 territory).
+    Same contract and return values as pipeline_1f1b.
+    """
+    return _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params,
+                               head_params, x, labels, mesh=mesh, axis=axis,
+                               n_micro=n_micro, defer_weight_grads=True,
+                               head_specs=head_specs)
+
+
 def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
                   head_params, x, labels, *, mesh: Optional[Mesh] = None,
-                  axis: str = "pp", n_micro: Optional[int] = None):
+                  axis: str = "pp", n_micro: Optional[int] = None,
+                  head_specs=None):
     """One-pass fwd+bwd pipeline with the (eager-)1F1B memory profile.
 
     Reference: fleet/meta_parallel/pipeline_parallel.py:459
@@ -178,15 +315,25 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
     microbatches and gradients w.r.t. the stacked stage params, the head
     params, and the pipeline input activations.
 
-    Known cost: every rank evaluates head_fn's fwd+vjp each tick and keeps
-    the masked last-rank result, so head FLOPs scale by ~n_stages relative
-    to a once-per-microbatch head. Pass ONLY the params head_fn reads (each
-    leaf is carried as an f32 accumulator in the scan), and for
-    head-dominated configs (huge vocab, few layers) prefer
-    schedule="FThenB" or a cooperative vocab-parallel head (each rank
-    takes vocab/n_stages — requires all ranks to process the SAME
-    microbatch per tick, a different schedule).
+    The head runs COOPERATIVELY when `head_specs` is passed (a pytree of
+    PartitionSpecs for head_params, sharding e.g. the vocab dim over
+    `axis`; see make_llama_pp_train_step): every tick, the last rank's
+    recomputed stage output is broadcast and all ranks evaluate the head
+    on their own vocab shard, psum-combining the CE pieces — per-tick head
+    FLOPs are 1/n_stages of a full head instead of the n_stages× a
+    replicated per-rank head pays. head_fn must then combine its partial
+    results with collectives over `axis` itself (coop_head_fn in
+    models/llama_pipe.py is the model of this contract).
     """
+    return _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params,
+                               head_params, x, labels, mesh=mesh, axis=axis,
+                               n_micro=n_micro, defer_weight_grads=False,
+                               head_specs=head_specs)
+
+
+def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
+                        labels, *, mesh, axis, n_micro, defer_weight_grads,
+                        head_specs=None):
     mesh = mesh or mesh_mod.get_global_mesh()
     n_stages = int(mesh.shape[axis]) if (
         mesh is not None and axis in mesh.axis_names) else 1
@@ -213,19 +360,27 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
     if batch % n_micro != 0:
         raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
     mb = batch // n_micro
-    buf_n = 2 * n_stages          # > max in-flight (2S-1): no slot reuse
+    # ZBH1 keeps every microbatch input for the post-scan W pass; plain
+    # 1F1B only needs the 2S-1 in-flight inputs (slots reused modulo)
+    buf_n = n_micro if defer_weight_grads else 2 * n_stages
     inv_m = 1.0 / n_micro
+    coop = head_specs is not None
+    hp_specs = head_specs if coop else jax.tree.map(
+        lambda _: P(), head_params)
 
     @partial(jax.shard_map, mesh=mesh, axis_names={axis},
-             in_specs=(P(axis), P(), P(), P()),
-             out_specs=(P(), P(axis), P(), P()))
+             in_specs=(P(axis), hp_specs, P(), P()),
+             out_specs=(P(), P(axis), hp_specs, P()))
     def run(params_local, head_p, xg, lbg):
         p_stage = jax.tree.map(lambda t: t[0], params_local)
-        # make the replicated head params VARYING before differentiating:
-        # the cotangent of an unvaried input gets an automatic psum over
-        # the manual axis, which would leak every rank's (masked-garbage)
-        # head gradients into the last stage's accumulation
-        head_p = jax.tree.map(lambda a: _to_varying(a, axis), head_p)
+        # make REPLICATED head params VARYING before differentiating: the
+        # cotangent of an unvaried input gets an automatic psum over the
+        # manual axis, which would leak every rank's (masked-garbage)
+        # head gradients into the last stage's accumulation. Leaves whose
+        # spec mentions the axis arrive sharded (already varying).
+        head_p = jax.tree.map(
+            lambda a, s: a if axis in jax.tree.leaves(tuple(s))
+            else _to_varying(a, axis), head_p, hp_specs)
         sid = jax.lax.axis_index(axis)
         is_first = sid == 0
         is_last = sid == n_stages - 1
@@ -240,8 +395,35 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
                 lambda a, gg: a + jnp.where(active, gg, 0).astype(a.dtype),
                 acc, g)
 
+        def run_head(head_p, y2, t):
+            """One head evaluation + vjp. Cooperative mode: the head's
+            microbatch is the LAST rank's backward microbatch, its hidden
+            is broadcast from the last rank, and every rank computes its
+            own vocab shard's piece (head_fn psum-combines internally)."""
+            if coop:
+                i_h = t - n_stages  # the last rank's i_b
+                act_h = (i_h >= 0) & (i_h < n_micro)
+                ih_c = jnp.clip(i_h, 0, n_micro - 1)
+                h_in = jax.lax.psum(
+                    jnp.where(is_last, y2, jnp.zeros_like(y2)), axis)
+                lb_mb = micro_lb[ih_c]
+            else:
+                i_b = t - (2 * n_stages - 1 - sid)
+                act_h = (i_b >= 0) & (i_b < n_micro)
+                ih_c = jnp.clip(i_b, 0, n_micro - 1)
+                h_in = y2
+                lb_mb = micro_lb[ih_c]
+            loss_i, vjp_head = jax.vjp(
+                lambda hp, yy: head_fn(hp, yy, lb_mb), head_p, h_in)
+            dhp_i, dy_head = vjp_head(
+                _to_varying(jnp.asarray(inv_m, loss_i.dtype), axis))
+            if coop:
+                # each rank's dy is its shard's partial: sum them
+                dy_head = jax.lax.psum(dy_head, axis)
+            return loss_i, dhp_i, dy_head, act_h
+
         def tick(carry, t):
-            fwd_bnd, bwd_bnd, in_buf, dp, dhp, dx_buf, loss = carry
+            fwd_bnd, bwd_bnd, in_buf, dy_buf, dp, dhp, dx_buf, loss = carry
 
             # ---- forward slot: stage `sid` forwards microbatch i_f ----
             i_f = t - sid
@@ -259,19 +441,29 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
             act_b = (i_b >= 0) & (i_b < n_micro)
             ib_c = jnp.clip(i_b, 0, n_micro - 1)
             x_sv = in_buf[ib_c % buf_n]
-            y2, vjp_stage = jax.vjp(stage_fn, p_stage, x_sv)
-            lb_mb = micro_lb[ib_c]
-            loss_i, vjp_head = jax.vjp(
-                lambda hp, yy: head_fn(hp, yy, lb_mb), head_p, y2)
-            dhp_i, dy_head = vjp_head(
-                _to_varying(jnp.asarray(inv_m, loss_i.dtype), axis))
+            if defer_weight_grads:
+                # ZBH1: activation-grad only — the weight part of this
+                # vjp happens once, batched, after the scan
+                y2, vjp_x = jax.vjp(
+                    lambda xx: stage_fn(p_stage, xx), x_sv)
+            else:
+                y2, vjp_stage = jax.vjp(stage_fn, p_stage, x_sv)
+            loss_i, dhp_i, dy_head, act_h = run_head(head_p, y2, t)
             dy_in = jnp.where(is_last, dy_head.astype(bwd_bnd.dtype),
                               bwd_bnd)
-            dp_i, dx = vjp_stage(dy_in)
-            dp = masked_add(dp, dp_i, act_b)
-            dhp = masked_add(dhp, dhp_i, act_b & is_last)
-            loss = loss + jnp.where(act_b & is_last,
-                                    loss_i.astype(loss.dtype) * inv_m, 0.0)
+            if defer_weight_grads:
+                (dx,) = vjp_x(dy_in)
+                dy_buf = dy_buf.at[ib_c].set(
+                    jnp.where(act_b, dy_in.astype(dy_buf.dtype),
+                              dy_buf[ib_c]))
+            else:
+                dp_i, dx = vjp_stage(dy_in)
+                dp = masked_add(dp, dp_i, act_b)
+            dhp = masked_add(dhp, dhp_i,
+                             act_h if coop else (act_b & is_last))
+            loss = loss + jnp.where(
+                (act_h if coop else act_b) & is_last,
+                loss_i.astype(loss.dtype) * inv_m, 0.0)
             dx_buf = dx_buf.at[ib_c].set(
                 jnp.where(act_b & is_first, dx.astype(dx_buf.dtype),
                           dx_buf[ib_c]))
@@ -280,14 +472,19 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
             fwd_bnd = jax.lax.ppermute(y, axis, fwd_perm)
             bwd_bnd = jax.lax.ppermute(
                 jnp.where(act_b, dx, jnp.zeros_like(dx)), axis, bwd_perm)
-            return (fwd_bnd, bwd_bnd, in_buf, dp, dhp, dx_buf, loss), None
+            return (fwd_bnd, bwd_bnd, in_buf, dy_buf, dp, dhp, dx_buf,
+                    loss), None
 
         act_shape = (mb,) + xg.shape[1:]
         vary = lambda z: _to_varying(z, axis)
+        dy_slots = buf_n if defer_weight_grads else 1  # 1: placeholder
         carry0 = (
             vary(jnp.zeros(act_shape, xg.dtype)),               # fwd_bnd
             vary(jnp.zeros(act_shape, xg.dtype)),               # bwd_bnd
             vary(jnp.zeros((buf_n,) + act_shape, xg.dtype)),    # in_buf
+            vary(jnp.zeros((dy_slots,) + act_shape, xg.dtype)),  # dy_buf
+            # ZBH1 computes dp post-scan: don't carry a param-sized zero
+            vary(jnp.zeros((), jnp.float32)) if defer_weight_grads else
             jax.tree.map(
                 lambda a: vary(jnp.zeros(a.shape, jnp.float32)), p_stage),
             jax.tree.map(
@@ -296,9 +493,28 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
             vary(jnp.zeros((), jnp.float32)),                   # loss
         )
         carry, _ = jax.lax.scan(tick, carry0, jnp.arange(t_total))
-        _, _, _, dp, dhp, dx_buf, loss = carry
+        _, _, in_buf, dy_buf, dp, dhp, dx_buf, loss = carry
+        if defer_weight_grads:
+            # ZBH1 W pass: all microbatches' weight grads in ONE batched
+            # vjp (recompute-forward per microbatch, like the in-tick
+            # backward would have done — just batched and off the
+            # critical path)
+            def wgrad(x_i, dy_i):
+                _, vjp_p = jax.vjp(lambda pp: stage_fn(pp, x_i), p_stage)
+                return vjp_p(dy_i)[0]
+
+            dps = jax.vmap(wgrad)(in_buf, dy_buf)
+            dp = jax.tree.map(
+                lambda g: g.astype(jnp.float32).sum(axis=0), dps)
         d_stacked = jax.tree.map(lambda a: a[None], dp)
-        d_head = jax.tree.map(lambda a: jax.lax.psum(a, axis), dhp)
+        if coop:
+            # sharded head leaves already hold exactly their shard's grad;
+            # replicated leaves (e.g. the final norm) hold partials
+            d_head = jax.tree.map(
+                lambda a, s: a if axis in jax.tree.leaves(tuple(s))
+                else jax.lax.psum(a, axis), dhp, hp_specs)
+        else:
+            d_head = jax.tree.map(lambda a: jax.lax.psum(a, axis), dhp)
         d_x = jax.lax.psum(dx_buf, axis).reshape((batch,) + xg.shape[1:])
         return jax.lax.psum(loss, axis), d_stacked, d_head, d_x
 

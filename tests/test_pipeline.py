@@ -223,13 +223,19 @@ class TestLlamaPipeline:
         l1, p, o = step(p, o, x, y)
         l2, p, o = step(p, o, x, y)
         assert float(l2) < float(l1)
-        # VPP selection through the pass surfaces the documented refusal
+        # VPP selection through the pass builds the interleaved step
+        import dataclasses
+
         config2 = {}
-        PassManager([new_pass("pipeline_scheduler_VPP")]).apply(config2)
+        PassManager([new_pass("pipeline_scheduler_VPP",
+                              {"accumulate_steps": 4})]).apply(config2)
+        assert config2["pipeline"]["schedule_mode"] == "VPP"
         paddle.seed(0)
-        with pytest.raises(NotImplementedError):
-            make_llama_pp_train_step(LlamaForCausalLM(cfg), mesh,
-                                     strategy=config2)
+        cfg8 = dataclasses.replace(cfg, num_hidden_layers=4)
+        step2, p2, o2 = make_llama_pp_train_step(
+            LlamaForCausalLM(cfg8), mesh, lr=1e-3, strategy=config2)
+        lv, p2, o2 = step2(p2, o2, x, y)
+        assert np.isfinite(float(lv))
 
     def test_state_split_merge_roundtrip(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -245,3 +251,202 @@ class TestLlamaPipeline:
         for k in state:
             np.testing.assert_array_equal(np.asarray(state[k]),
                                           np.asarray(merged[k]))
+
+
+class TestSchedulesRound3:
+    """VPP / ZBH1 / cooperative head (round-2 VERDICT items 1 and 2)."""
+
+    def _serial(self, stacked, head, x, lb, stage_fn, head_fn, S):
+        h = x
+        for s in range(S):
+            h = stage_fn(jax.tree.map(lambda t, s=s: t[s], stacked), h)
+        return head_fn(head, h, lb)
+
+    def test_zb1f1b_grads_match_serial(self):
+        from paddle_tpu.parallel.pipeline_spmd import pipeline_zb1f1b
+
+        S, M, mb, d = 4, 8, 1, 8
+        rng = np.random.default_rng(1)
+        stacked = {"w": jnp.asarray(rng.normal(size=(S, d, d), scale=0.4),
+                                    jnp.float32)}
+        head = {"u": jnp.asarray(rng.normal(size=(d, 3), scale=0.4),
+                                 jnp.float32)}
+        x = jnp.asarray(rng.normal(size=(M * mb, d)), jnp.float32)
+        lb = jnp.asarray(rng.normal(size=(M * mb, 3)), jnp.float32)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        def head_fn(hp, h, y):
+            return jnp.mean((h @ hp["u"] - y) ** 2)
+
+        mesh = build_mesh({"dp": 2, "pp": S, "mp": 1})
+        set_global_mesh(mesh)
+        loss_m, d_st, d_hp, d_x = jax.jit(
+            lambda a, b, c, e: pipeline_zb1f1b(
+                stage_fn, head_fn, a, b, c, e, mesh=mesh,
+                n_micro=M))(stacked, head, x, lb)
+        loss_s, (d_st_s, d_hp_s, d_x_s) = jax.jit(jax.value_and_grad(
+            lambda a, b, c, e: self._serial(a, b, c, e, stage_fn, head_fn, S),
+            argnums=(0, 1, 2)))(stacked, head, x, lb)
+        np.testing.assert_allclose(float(loss_m), float(loss_s), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(d_st["w"]),
+                                   np.asarray(d_st_s["w"]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(d_hp["u"]),
+                                   np.asarray(d_hp_s["u"]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_x_s),
+                                   atol=1e-5)
+
+    def test_vpp_forward_and_grads_match_serial(self):
+        from paddle_tpu.parallel.pipeline_spmd import pipeline_vpp_forward
+
+        S, V, d = 4, 2, 8
+        rng = np.random.default_rng(2)
+        Ws = rng.standard_normal((S * V, d, d)).astype(np.float32) * 0.3
+        chunked = jnp.stack([jnp.stack([Ws[v * S + r] for v in range(V)])
+                             for r in range(S)])
+        x = jnp.asarray(rng.standard_normal((8, 5, d)), jnp.float32)
+
+        def chunk_fn(W, h):
+            return jnp.tanh(h @ W)
+
+        mesh = build_mesh({"dp": 2, "pp": S, "mp": 1})
+        set_global_mesh(mesh)
+        out = pipeline_vpp_forward(chunk_fn, jax.device_put(chunked), x,
+                                   mesh=mesh, n_micro=8)
+        h = np.asarray(x)
+        for c in range(S * V):
+            h = np.tanh(h @ Ws[c])
+        np.testing.assert_allclose(np.asarray(out), h, rtol=1e-5, atol=1e-5)
+
+        def loss(params, xx):
+            return pipeline_vpp_forward(chunk_fn, params, xx, mesh=mesh,
+                                        n_micro=8).sum()
+
+        g = jax.grad(loss)(jax.device_put(chunked), x)
+
+        def loss_serial(Ws_, xx):
+            hh = xx
+            for c in range(S * V):
+                hh = jnp.tanh(hh @ Ws_[c])
+            return hh.sum()
+
+        g_ref = jax.grad(loss_serial)(jnp.asarray(Ws), x)
+        for r in range(S):
+            for v in range(V):
+                np.testing.assert_allclose(
+                    np.asarray(g[r, v]), np.asarray(g_ref[v * S + r]),
+                    rtol=1e-4, atol=1e-4)
+
+    def test_vpp_requires_divisible_microbatches(self):
+        from paddle_tpu.parallel.pipeline_spmd import pipeline_vpp_forward
+
+        mesh = build_mesh({"dp": 2, "pp": 4, "mp": 1})
+        set_global_mesh(mesh)
+        chunked = jnp.zeros((4, 2, 8, 8))
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_vpp_forward(lambda W, h: h, chunked,
+                                 jnp.zeros((6, 8)), mesh=mesh, n_micro=6)
+
+    def test_llama_all_schedules_match_serial(self):
+        """schedule='VPP'/'ZBH1' accepted and loss-matching serial over 3
+        steps (round-2 VERDICT item 1 'Done' bar)."""
+        import dataclasses
+
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_pipe import make_llama_pp_train_step
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), num_hidden_layers=8)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (8, 16))
+        y = rng.integers(0, cfg.vocab_size, (8, 16))
+        paddle.seed(21)
+        m0 = LlamaForCausalLM(cfg)
+        s0, p0, o0 = make_llama_pp_train_step(m0, mesh=None, lr=1e-3)
+        serial = []
+        for _ in range(3):
+            l, p0, o0 = s0(p0, o0, x, y)
+            serial.append(float(l))
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        set_global_mesh(mesh)
+        for sched, kw in (("ZBH1", {}), ("VPP", {"vpp_degree": 2})):
+            paddle.seed(21)
+            m = LlamaForCausalLM(cfg)
+            st, p, o = make_llama_pp_train_step(
+                m, mesh=mesh, lr=1e-3, schedule=sched, n_micro=8, **kw)
+            losses = []
+            for _ in range(3):
+                l, p, o = st(p, o, x, y)
+                losses.append(float(l))
+            np.testing.assert_allclose(losses, serial, atol=3e-3,
+                                       err_msg=sched)
+
+    def test_coop_head_matches_and_shrinks_head_cost(self):
+        """The cooperative vocab-parallel head (VERDICT item 2): numerics
+        match the replicated head, and the per-rank head matmul is
+        vocab/pp wide — asserted via compiled FLOP estimate."""
+        import dataclasses
+
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_pipe import make_llama_pp_train_step
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), num_hidden_layers=8,
+                                  vocab_size=2048)
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, cfg.vocab_size, (8, 16))
+        y = rng.integers(0, cfg.vocab_size, (8, 16))
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        set_global_mesh(mesh)
+        results = {}
+        for coop in (True, False):
+            paddle.seed(22)
+            m = LlamaForCausalLM(cfg)
+            st, p, o = make_llama_pp_train_step(
+                m, mesh=mesh, lr=1e-3, schedule="1F1B", n_micro=8,
+                coop_head=coop)
+            l, p2, o2 = st(p, o, x, y)
+            flops = st.lower(p, o, x, y).compile().cost_analysis()["flops"]
+            results[coop] = (float(l), flops)
+        np.testing.assert_allclose(results[True][0], results[False][0],
+                                   atol=2e-3)
+        # replicated head pays ~pp x head FLOPs each tick; cooperative
+        # must compile to clearly fewer total FLOPs
+        assert results[True][1] < results[False][1] * 0.75, results
+
+    def test_chunked_state_split_merge_roundtrip(self):
+        """chunk_llama_state / merge_llama_chunked_state must be exact
+        inverses (a swapped r/v index would scramble layer weights on VPP
+        checkpoint export)."""
+        import dataclasses
+
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_pipe import (chunk_llama_state,
+                                                  merge_llama_chunked_state)
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), num_hidden_layers=8)
+        model = LlamaForCausalLM(cfg)
+        state = dict(model.raw_state())
+        outer, chunked = chunk_llama_state(state, 8, n_stages=4,
+                                           vpp_degree=2, mesh=None)
+        back = merge_llama_chunked_state(outer, chunked, 8)
+        assert set(back) == set(state)
+        for k in state:
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(state[k]), err_msg=k)
+
+    def test_coop_head_validation(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_pipe import make_llama_pp_train_step
+
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        set_global_mesh(mesh)
+        cfg = LlamaConfig.tiny()
+        with pytest.raises(ValueError, match="coop_head"):
+            make_llama_pp_train_step(LlamaForCausalLM(cfg), mesh,
+                                     schedule="FThenB", coop_head=True)
+        import dataclasses
+
+        cfg_bad = dataclasses.replace(cfg, vocab_size=126)
+        with pytest.raises(ValueError, match="divisible"):
+            make_llama_pp_train_step(LlamaForCausalLM(cfg_bad), mesh,
+                                     schedule="1F1B", coop_head=True)
