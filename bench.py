@@ -21,41 +21,46 @@ import numpy as np
 def _op_bench():
     """Per-op latency table (reference: tools/ci_op_benchmark.sh +
     check_op_benchmark_result.py — the regression gate over op kernels).
-    Each op loops inside ONE jitted call (per-dispatch tunnel latency would
-    otherwise dominate); results land in OPBENCH.json and regress >10%
-    against the previous run's numbers with a stderr warning."""
+
+    Timing is TWO-POINT SLOPE: each op is measured as
+    (t(iters_hi) - t(iters_lo)) / (iters_hi - iters_lo), each a
+    fori_loop inside ONE jitted call. Round-3 root cause of the round-2
+    "+14% rms_norm / +29% all_reduce" warnings: at a fixed 30 iters, the
+    ~90 ms tunnel round-trip per call dominated sub-ms ops entirely
+    (measured: rms_norm 3.17 ms/iter at 30 iters vs 0.88 at 100 — the
+    'op time' was round-trip jitter, not the kernel). The slope cancels
+    the fixed cost, so the table now measures the kernels themselves."""
     import numpy as np
 
     rng = np.random.default_rng(0)
     ops = {}
 
-    ITERS = 30
+    IT_LO, IT_HI = 20, 120
 
-    def timed(name, make_fn, iters=ITERS):
-        # the loop AND the final scalar reduction live inside one jitted
-        # call: one tunnel dispatch, one 4-byte fetch (an eager post-hoc
-        # jnp.sum would itself be a ~35 ms tunneled op). Best-of-3 timed
-        # calls: tunnel stalls add ~1 ms/iter of one-sided noise that
-        # would otherwise need a gate floor big enough to mask real
-        # regressions on small ops
-        f = jax.jit(make_fn())
-        float(f())
-        best = float("inf")
-        for _ in range(3):
+    def timed(name, make_body, x0, reps=6):
+        def build(iters):
+            def run():
+                out = jax.lax.fori_loop(0, iters,
+                                        lambda i, x: make_body(x), x0)
+                return jnp.sum(out.astype(jnp.float32))
+            return jax.jit(run)
+
+        f_lo, f_hi = build(IT_LO), build(IT_HI)
+        float(f_lo()), float(f_hi())
+        best_lo = best_hi = float("inf")
+        for _ in range(reps):
             t0 = time.perf_counter()
-            float(f())
-            best = min(best, time.perf_counter() - t0)
-        ops[name] = round(best / iters * 1e3, 4)
-
-    def chain(body, x0, iters=ITERS):
-        def run():
-            out = jax.lax.fori_loop(0, iters, lambda i, x: body(x), x0)
-            return jnp.sum(out.astype(jnp.float32))
-        return run
+            float(f_lo())
+            best_lo = min(best_lo, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            float(f_hi())
+            best_hi = min(best_hi, time.perf_counter() - t0)
+        ops[name] = round(max(best_hi - best_lo, 0.0)
+                          / (IT_HI - IT_LO) * 1e3, 4)
 
     # matmul 4096^3 bf16 (MXU headline)
     a = jnp.asarray(rng.normal(size=(4096, 4096)), jnp.bfloat16)
-    timed("matmul_4096_bf16", lambda: chain(lambda x: (x @ a), a))
+    timed("matmul_4096_bf16", lambda x: (x @ a), a)
 
     # flash attention fwd and fwd+bwd on the bench GQA shape
     from paddle_tpu.kernels.flash_attention import flash_attention
@@ -64,21 +69,21 @@ def _op_bench():
     q = jnp.asarray(rng.normal(size=(B, S, HQ, D)), jnp.bfloat16)
     k = jnp.asarray(rng.normal(size=(B, S, HK, D)), jnp.bfloat16)
     v = jnp.asarray(rng.normal(size=(B, S, HK, D)), jnp.bfloat16)
-    timed("flash_attn_fwd_gqa", lambda: chain(
-        lambda x: flash_attention(x, k, v, causal=True), q))
+    timed("flash_attn_fwd_gqa",
+          lambda x: flash_attention(x, k, v, causal=True), q)
 
     def fa_grad(x):
         return jax.grad(lambda qq: jnp.sum(
             flash_attention(qq, k, v, causal=True).astype(jnp.float32)))(x)
 
-    timed("flash_attn_fwdbwd_gqa", lambda: chain(fa_grad, q))
+    timed("flash_attn_fwdbwd_gqa", fa_grad, q)
 
     # rms_norm on the model's hidden shape
     from paddle_tpu.kernels.rms_norm import rms_norm
 
     h = jnp.asarray(rng.normal(size=(8, 2048, 2048)), jnp.bfloat16)
     w = jnp.ones((2048,), jnp.bfloat16)
-    timed("rms_norm", lambda: chain(lambda x: rms_norm(x, w, 1e-6), h))
+    timed("rms_norm", lambda x: rms_norm(x, w, 1e-6), h)
 
     # single-token decode attention over a full cache
     from paddle_tpu.kernels.decode_attention import decode_attention
@@ -87,8 +92,7 @@ def _op_bench():
     vc = jnp.asarray(rng.normal(size=(B, HQ, S, D)), jnp.bfloat16)
     lens = jnp.full((B,), S - 1, jnp.int32)
     qd = jnp.asarray(rng.normal(size=(B, HQ, D)), jnp.bfloat16)
-    timed("decode_attention", lambda: chain(
-        lambda x: decode_attention(x, kc, vc, lens), qd))
+    timed("decode_attention", lambda x: decode_attention(x, kc, vc, lens), qd)
 
     # all_reduce across the visible devices (1 chip: measures the floor)
     from jax.sharding import Mesh, PartitionSpec as P
@@ -99,33 +103,80 @@ def _op_bench():
     psum = jax.shard_map(lambda x: jax.lax.psum(x, "i"), mesh=mesh1,
                          in_specs=P("i"), out_specs=P("i"))
     g = jnp.asarray(rng.normal(size=(1024, 1024)), jnp.float32)
-    timed("all_reduce_4mb", lambda: chain(psum, g))
+    timed("all_reduce_4mb", psum, g)
+
+    # eager dispatch overhead: one tiny op, eager, host-timed — tracks the
+    # per-op cost of the eager tape + device round-trip over rounds
+    # (reference: test/cpp/eager/performance_tests/benchmark_eager_cuda.cc)
+    import paddle_tpu as _paddle
+
+    t_small = _paddle.to_tensor(np.ones((8, 8), np.float32))
+    (t_small + t_small)  # warm the dispatch path
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = t_small + t_small
+    float(out.numpy().sum())
+    ops["eager_dispatch_add"] = round(
+        (time.perf_counter() - t0) / reps * 1e3, 4)
     return ops
 
 
+# regressions consciously accepted, with a dated reason — an entry here is
+# the ONLY way to silence the gate (reference: the PR-note workflow of
+# tools/check_op_benchmark_result.py). The corresponding note must also
+# land in BASELINE.md.
+ACKNOWLEDGED_REGRESSIONS = {
+    # 2026-07-31: the op timer changed from fixed-30-iteration calls to
+    # two-point slope (see _op_bench docstring) because the old numbers
+    # measured tunnel round-trip amortization, not kernels; every op's
+    # scale shifted, so the first slope-based run rebaselines the table.
+    "__rebaseline_2026_07_31__": "timer change, see _op_bench docstring",
+}
+
+
 def _op_regressions(ops, path="OPBENCH.json", threshold=0.10):
-    prev = None
+    """>10% (+0.1 ms) slower than the BEST ever recorded for the op ⇒ a
+    regression. The rolling-best baseline cannot be inflated by a noisy
+    run (a slow sample never becomes the bar), so real regressions keep
+    flagging every round until fixed or acknowledged. Unacknowledged
+    regressions surface in the driver-parsed JSON line AND fail the run
+    (the round-2 warn-only gate was ignorable by design; this one is not).
+    """
+    prev = best = None
     if os.path.exists(path):
         try:
             with open(path) as f:
-                prev = json.load(f).get("ops")
+                data = json.load(f)
+            prev = data.get("ops")
+            best = data.get("best") or prev
         except Exception:
-            prev = None
+            prev = best = None
+    rebaseline = any(k.startswith("__rebaseline") and best is not None
+                     and k not in (best or {})
+                     for k in ACKNOWLEDGED_REGRESSIONS)
     warned = []
-    if prev:
+    if best and not rebaseline:
         for name, ms in ops.items():
-            old = prev.get(name)
-            # relative threshold + a small absolute floor (best-of-3
-            # timing keeps residual tunnel jitter under ~0.3 ms/iter)
-            if old and ms > old * (1 + threshold) and ms - old > 0.3:
-                warned.append(f"{name}: {old:.3f} -> {ms:.3f} ms "
+            old = best.get(name)
+            if old and ms > old * (1 + threshold) and ms - old > 0.1 \
+                    and name not in ACKNOWLEDGED_REGRESSIONS:
+                warned.append(f"{name}: best {old:.3f} -> {ms:.3f} ms "
                               f"(+{(ms / old - 1) * 100:.0f}%)")
+    marker = {k: v for k, v in ACKNOWLEDGED_REGRESSIONS.items()}
+    if rebaseline or not best:
+        new_best = dict(ops)
+    else:
+        new_best = {n: min(ms, best.get(n, ms)) for n, ms in ops.items()}
+    sentinel = {k: 0.0 for k in marker if k.startswith("__")}
     with open(path, "w") as f:
-        json.dump({"ops": ops, "prev": prev}, f, indent=1)
+        json.dump({"ops": dict(ops, **sentinel),
+                   "best": dict(new_best, **sentinel),
+                   "prev": prev, "acknowledged": marker}, f, indent=1)
     if warned:
         import sys
-        print("OP REGRESSION WARNING (>10% and >0.3 ms vs previous run):\n  "
-              + "\n  ".join(warned), file=sys.stderr)
+        print("OP REGRESSION (>10% and >0.1 ms vs best recorded, "
+              "unacknowledged):\n  " + "\n  ".join(warned), file=sys.stderr)
     return warned
 
 
@@ -141,10 +192,14 @@ def main():
     if on_tpu:
         # GQA config (4 kv heads, llama-2-70B/llama-3 class ratio) so the
         # gate measures the grouped-attention fast path — the config class
-        # that matters for real deployments. GQA shrinks kv activations
-        # enough that the full no-remat step fits 16 GB at bs 8 (measured
-        # +8% over recompute_skip=4: 24.8k vs 23.0k tok/s)
-        cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=False,
+        # that matters for real deployments. Round 3: the step runs the
+        # HONEST production config — real AdamW with fp32 moments and
+        # norm/bias decay exclusion. fp32 moments cost +4.4 GB vs the
+        # round-2 fallback's silently-bf16 moments, so the last 8 of 16
+        # layers skip remat instead of all 16 (measured best fit:
+        # no-remat OOMs, skip8 21.6k > skip4 21.3k tok/s)
+        cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=True,
+                                   recompute_skip=8,
                                    num_key_value_heads=4,
                                    max_position_embeddings=2048)
         batch, seq, iters = 8, 2048, 10
@@ -162,8 +217,21 @@ def main():
     if mesh is not None:
         model = shard_llama(model, mesh)
     crit = LlamaPretrainingCriterion(cfg)
+    # the honest training config: real AdamW through the FusedOptimizer
+    # path, weight decay excluded from norm scales / biases (reference:
+    # python/paddle/optimizer/adamw.py apply_decay_param_fun) — the bench
+    # measures the step users would actually run, not a shortcut
+    from paddle_tpu.optimizer import AdamW
+
+    def _decay(name: str) -> bool:
+        # auto names: "linear_3.w_0" / "llamarmsnorm_7.w_0" / "...b_0"
+        return "norm" not in name and not name.endswith(".b_0")
+
+    optimizer = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                      apply_decay_param_fun=_decay,
+                      parameters=model.parameters())
     step, params, opt = make_train_step(
-        model, lambda lg, lb: crit(lg, lb), mesh, lr=1e-4)
+        model, lambda lg, lb: crit(lg, lb), mesh, optimizer=optimizer)
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
@@ -196,22 +264,29 @@ def main():
     peak = 918e12 if "v6" in kind else 197e12
     mfu = achieved / (peak * n_dev) if on_tpu else 0.0
 
+    regressions = []
     if on_tpu:
-        # per-op regression gate (stderr + OPBENCH.json; stdout stays the
-        # single JSON line the driver parses)
+        # per-op regression gate: unacknowledged >10% regressions go into
+        # the driver-parsed JSON line AND fail the process (round-2's
+        # warn-only gate could be ignored; this one cannot)
         try:
-            _op_regressions(_op_bench())
+            regressions = _op_regressions(_op_bench())
         except Exception as e:
             import sys
             print(f"op bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    print(json.dumps({
+    result = {
         "metric": "llama_train_tokens_per_sec",
         "value": round(tok_per_s, 2),
         "unit": f"tokens/s ({'1B-class llama, bf16, 1 chip' if on_tpu else 'tiny cpu smoke'}; loss={float(loss):.3f}; mfu={mfu:.3f})",
         "vs_baseline": round(mfu / 0.45, 3) if on_tpu else 0.0,
-    }))
+    }
+    if regressions:
+        result["regressions"] = regressions
+    print(json.dumps(result))
+    if regressions:
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":

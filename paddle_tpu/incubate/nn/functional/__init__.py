@@ -509,11 +509,23 @@ def _fused_multi_transformer_cached(x, ln_scales, ln_biases, qkv_weights,
                 "bshd,bhtd->bhst", q.astype(jnp.float32),
                 new_k[:, :, :lim].astype(jnp.float32)) * scale
             qpos = offset + jnp.arange(s)
-            causal = jnp.arange(lim)[None, :] <= qpos[:, None]
-            logits = jnp.where(causal[None, None], logits, -1e30)
             if mask_a is not None:
+                # the provided attn_mask is the SOLE mask STRUCTURE
+                # (reference fused_multi_transformer semantics) — a
+                # bidirectional/prefix mask must not be clamped causal.
+                # Cache VALIDITY is separate from structure: positions the
+                # cache hasn't been written at yet (beyond offset+s, which
+                # exist only when a traced offset forces lim=max_seq) hold
+                # zeros and must never be attended
                 m = mask_a.astype(jnp.float32)
                 logits = logits + m[..., :lim]
+                if not offset_static:
+                    written = jnp.arange(lim) < offset + s  # [lim]
+                    logits = jnp.where(written[None, None, None], logits,
+                                       -1e30)
+            else:
+                causal = jnp.arange(lim)[None, :] <= qpos[:, None]
+                logits = jnp.where(causal[None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             ctx = jnp.einsum("bhst,bhtd->bshd", probs,
                              new_v[:, :, :lim].astype(jnp.float32))

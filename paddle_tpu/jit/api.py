@@ -534,11 +534,18 @@ def save(layer, path, input_spec=None, **configs):
             ]
             # multi-platform artifact: the deployment shell (native/
             # predictor_capi.cpp) may serve on a different backend than
-            # the one that exported
-            exported = jexport.export(
-                jax.jit(pure), platforms=("cpu", "tpu"))(
-                [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params], *specs
-            )
+            # the one that exported. A trace that took a TPU-only Pallas
+            # fast path (Mosaic custom calls) cannot lower for "cpu" —
+            # fall back to a single-platform export rather than failing
+            # the save outright.
+            pspecs = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params]
+            try:
+                exported = jexport.export(
+                    jax.jit(pure), platforms=("cpu", "tpu"))(pspecs, *specs)
+                meta["platforms"] = ["cpu", "tpu"]
+            except Exception:
+                exported = jexport.export(jax.jit(pure))(pspecs, *specs)
+                meta["platforms"] = [jax.default_backend()]
             with open(path + ".pdmodel", "wb") as f:
                 f.write(exported.serialize())
             meta["stablehlo"] = True

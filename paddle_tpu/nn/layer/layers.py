@@ -20,6 +20,9 @@ from ...core.tensor import Parameter, Tensor, unwrap
 from ...framework import dtype as dtypes
 
 
+_param_auto_counter = 0
+
+
 class HookRemoveHelper:
     def __init__(self, hooks, hook_id):
         self._hooks = hooks
@@ -152,6 +155,16 @@ class Layer:
         if init is None:
             init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
         arr = init(tuple(int(s) for s in shape), dtype)
+        if name is None:
+            # reference Parameters always carry an auto-generated unique
+            # name ("linear_0.w_0", LayerHelper naming) assigned at
+            # CREATION — caller-independent, so name-keyed configs
+            # (apply_decay_param_fun, no-clip lists) bind identically in
+            # the eager and fused optimizer paths
+            global _param_auto_counter
+            name = (f"{type(self).__name__.lower()}_{_param_auto_counter}"
+                    f".{'b' if is_bias else 'w'}_0")
+            _param_auto_counter += 1
         p = Parameter(arr, dtype=dtype, name=name, trainable=trainable)
         p.optimize_attr["learning_rate"] = lr
         return p

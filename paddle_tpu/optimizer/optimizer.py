@@ -181,8 +181,11 @@ class Optimizer:
             for p in group["params"]:
                 st = self._accumulators.get(id(p), {})
                 for k, v in st.items():
-                    name = (p.name or f"param_{i}") + "_" + k
-                    out[name] = Tensor(v)
+                    # positional key: params carry auto-generated names
+                    # whose global counter differs between model instances,
+                    # so a name key would break resume into a REBUILT model
+                    # (position is stable for the same architecture)
+                    out[f"param_{i}_{k}"] = Tensor(v)
                 i += 1
         return out
 
@@ -197,9 +200,13 @@ class Optimizer:
                     self._accumulators[id(p)] = self._create_accumulators(p)
                 st = self._accumulators[id(p)]
                 for k in list(st.keys()):
-                    name = (p.name or f"param_{i}") + "_" + k
-                    if name in state_dict:
-                        st[k] = unwrap(state_dict[name])
+                    # canonical positional key; legacy name-keyed entries
+                    # (explicitly named params saved by older code) still load
+                    for name in (f"param_{i}_{k}",
+                                 (p.name or f"param_{i}") + "_" + k):
+                        if name in state_dict:
+                            st[k] = unwrap(state_dict[name])
+                            break
                 i += 1
 
     load_state_dict = set_state_dict

@@ -124,8 +124,21 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
         with open(fn) as f:
             by_rank[r] = json.load(f)
     if by_rank:
-        if isinstance(by_rank.get(0), dict) and "entries" in by_rank.get(
-                0, {}):
+        # detect the tagged format per FILE (any {world, entries} wrapper),
+        # not just from rank 0 — a partial save may have lost meta.0.json,
+        # and treating tagged wrappers as name->entry maps would crash
+        # later on entry["chunks"] with no hint of the real problem
+        tagged = any(isinstance(m, dict) and "entries" in m
+                     for m in by_rank.values())
+        if tagged:
+            if not (isinstance(by_rank.get(0), dict)
+                    and "entries" in by_rank[0]):
+                raise FileNotFoundError(
+                    f"sharded checkpoint at {path!r} has world-tagged "
+                    "rank metas but meta.0.json is missing or untagged — "
+                    "rank 0's meta records the save generation; this "
+                    "checkpoint is incomplete (partial save or deleted "
+                    "file)")
             # world-tagged metas: only ranks of the LATEST save generation
             # (rank < world recorded by rank 0, same world tag) are valid;
             # higher-rank files are stale leftovers of a larger world

@@ -83,7 +83,13 @@ class FusedOptimizer:
                 name = by_id.get(id(p))
                 if name is None or p.stop_gradient:
                     continue
-                decay = optimizer._apply_decay(p)
+                # Parameters carry reference-style auto names from
+                # creation (layers.py create_parameter), so name-based
+                # decay filters bind identically here and in eager step();
+                # the structured path remains the fallback for hand-built
+                # Parameters
+                decay = optimizer._apply_decay(
+                    p if p.name else _ParamProxy(p._array, name))
                 self._wd[name] = wd if decay else 0.0
                 self._l1[name] = l1 if decay else 0.0
                 self._proxies[name] = _ParamProxy(p._array, p.name)

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -186,6 +187,24 @@ def launch(argv=None) -> int:
             FileStore(args.elastic_store), job_id=args.job_id,
             np_range=np_range, host=host_id).register().watch(
                 poll_interval=0.5)
+        # advertise a coordinator endpoint this node could serve, so a
+        # rescale can re-derive the master when the original master node
+        # is the one that left (the primary elastic failure mode —
+        # reference: the fleet elastic relaunch path re-elects rank 0)
+        addr_prefix = f"/paddle_tpu/elastic/{args.job_id}/addr/"
+        port = master.rsplit(":", 1)[-1]
+        my_addr = master
+        if args.rank != 0:
+            try:
+                ip = socket.gethostbyname(socket.gethostname())
+                if not ip.startswith("127."):
+                    my_addr = f"{ip}:{port}"
+                # loopback / unresolvable hostname: advertise the original
+                # master rather than an address no peer can reach — the
+                # failover then degrades to round-2 behavior, never worse
+            except OSError:
+                pass
+        elastic.store.put(addr_prefix + host_id, my_addr)
 
         def rebuild(members):
             if host_id not in members:
@@ -196,8 +215,13 @@ def launch(argv=None) -> int:
                 members = sorted(set(members) | {host_id})
             node_rank = members.index(host_id)
             new_total = len(members) * args.nproc_per_node
+            # coordinator = the advertised address of the settled world's
+            # first member — NOT the launch-time master, whose node may be
+            # exactly the one that departed
+            new_master = elastic.store.get(addr_prefix + members[0]) \
+                or master
             return [
-                _worker_env(os.environ, master, args.nproc_per_node,
+                _worker_env(os.environ, new_master, args.nproc_per_node,
                             node_rank, lr, new_total)
                 for lr in range(args.nproc_per_node)
             ]
@@ -207,6 +231,13 @@ def launch(argv=None) -> int:
                            elastic=elastic, rebuild_envs=rebuild).run()
     finally:
         if elastic is not None:
+            if args.elastic_store:
+                try:  # drop the advertised coordinator endpoint
+                    elastic.store.delete(
+                        f"/paddle_tpu/elastic/{args.job_id}/addr/"
+                        + (args.host_id or f"node-{args.rank}"))
+                except OSError:
+                    pass
             elastic.exit()
 
 

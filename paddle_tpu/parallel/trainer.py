@@ -61,7 +61,14 @@ def init_adamw_state(params: Dict[str, jax.Array]) -> AdamWState:
 def adamw_update(params, grads, state: AdamWState, lr, *, beta1=0.9,
                  beta2=0.999, eps=1e-8, weight_decay=0.01,
                  grad_clip_norm: Optional[float] = 1.0):
-    """Pure AdamW with global-norm clipping (ClipGradByGlobalNorm analog)."""
+    """Pure AdamW with global-norm clipping (ClipGradByGlobalNorm analog).
+
+    Weight decay applies to params with ndim > 1 only: 1-D leaves are norm
+    scales / biases, which standard AdamW configs exclude (reference:
+    apply_decay_param_fun in python/paddle/optimizer/adamw.py — pass a real
+    AdamW(apply_decay_param_fun=...) through make_train_step(optimizer=)
+    for name-based control). Decaying RMSNorm scales was the round-2
+    default-path footgun; off by default now."""
     step = state.step + 1
     if grad_clip_norm is not None:
         gnorm = jnp.sqrt(sum(
@@ -79,7 +86,8 @@ def adamw_update(params, grads, state: AdamWState, lr, *, beta1=0.9,
         mhat = m_ / c1
         vhat = v_ / c2
         p32 = p.astype(jnp.float32)
-        p_ = p32 - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p32)
+        wd = weight_decay if p.ndim > 1 else 0.0
+        p_ = p32 - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p32)
         return p_.astype(p.dtype), m_.astype(m.dtype), v_.astype(v.dtype)
 
     out = jax.tree.map(upd, params, grads, state.m, state.v)

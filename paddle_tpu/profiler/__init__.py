@@ -239,9 +239,61 @@ class Profiler:
                        "displayTimeUnit": "ms"}, f)
         return path
 
+    def _device_op_stats(self):
+        """Parse the captured device trace (the XPlane chrome export jax
+        writes under the profile dir) into per-op totals — the device half
+        of the reference's merged host+device statistic tree
+        (python/paddle/profiler/profiler_statistic.py +
+        paddle/fluid/platform/profiler/event_node.cc)."""
+        import glob as _glob
+        import gzip
+
+        if not self._device_dir:
+            return {}
+        runs = sorted(_glob.glob(os.path.join(
+            self._device_dir, "plugins", "profile", "*")))
+        if not runs:
+            return {}
+        traces = _glob.glob(os.path.join(runs[-1], "*.trace.json.gz"))
+        if not traces:
+            return {}
+        try:
+            with gzip.open(traces[-1], "rt") as f:
+                data = json.load(f)
+        except Exception:
+            return {}
+        events = data.get("traceEvents", [])
+        # device lanes: process names carry the accelerator id; host python
+        # threads are excluded so the table is the DEVICE op view
+        device_pids = set()
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                name = str(e.get("args", {}).get("name", ""))
+                # "/device:TPU:n" on real chips; "/host:CPU" carries the
+                # XLA thread-pool op events on the CPU backend
+                if any(t in name for t in ("TPU", "GPU", "/device:",
+                                           "host:CPU")):
+                    device_pids.add(e.get("pid"))
+        agg = {}
+        for e in events:
+            if e.get("ph") != "X" or e.get("pid") not in device_pids:
+                continue
+            name = e.get("name", "?")
+            # host python frames share the CPU-backend process; keep the
+            # runtime/op rows ("PjitFunction(f)", fusion names, compiler
+            # phases), not source locations
+            if name.startswith("$") or "importlib" in name:
+                continue
+            a = agg.setdefault(name, [0.0, 0])
+            a[0] += float(e.get("dur", 0)) / 1000.0
+            a[1] += 1
+        return agg
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        """Host-span aggregate table (reference: profiler_statistic.py)."""
+        """Merged host + device aggregate tables (reference:
+        profiler_statistic.py — the host RecordEvent tree merged with the
+        device event tree into one op-level report)."""
         with _events_lock:
             events = list(_events)
         agg = {}
@@ -253,6 +305,18 @@ class Profiler:
                  "-" * 72]
         for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
             lines.append(f"{name:<40}{cnt:>8}{tot:>12.3f}{tot / cnt:>12.3f}")
+        dev = self._device_op_stats()
+        if dev:
+            lines.append("")
+            lines.append("Device ops (from the jax device trace)")
+            lines.append(
+                f"{'Op':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}")
+            lines.append("-" * 72)
+            shown = sorted(dev.items(), key=lambda kv: -kv[1][0])[:30]
+            for name, (tot, cnt) in shown:
+                nm = name if len(name) <= 39 else name[:36] + "..."
+                lines.append(
+                    f"{nm:<40}{cnt:>8}{tot:>12.3f}{tot / max(cnt, 1):>12.3f}")
         table = "\n".join(lines)
         print(table)
         return table

@@ -18,6 +18,7 @@ from ..core.tensor import Tensor, dispatch, unwrap
 from ..nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "QuanterFactory",
+           "QuantizedExecutionLinear",
            "FakeQuanterWithAbsMaxObserver", "quant", "dequant",
            "BaseObserver", "BaseQuanter"]
 
@@ -221,15 +222,43 @@ class QAT:
         model.train()
         return _wrap_leaves(model, self._config)
 
-    def convert(self, model: Layer, inplace=False):
-        """Strip quant wrappers, baking weight scales (deploy form)."""
+    def convert(self, model: Layer, inplace=False, _transform=None):
+        """Strip quant wrappers, baking weight scales (deploy form).
+        `_transform` maps each unwrapped leaf to its deploy form (PTQ uses
+        it for int8 execution)."""
         if not inplace:
             model = copy.deepcopy(model)
         for holder in model.sublayers(include_self=True):
             for name, sub in list(holder._sub_layers.items()):
                 if isinstance(sub, _QuantedLayer):
-                    holder._sub_layers[name] = sub.inner
+                    inner = sub.inner
+                    if _transform is not None:
+                        inner = _transform(inner)
+                    holder._sub_layers[name] = inner
         return model
+
+
+class QuantizedExecutionLinear(Layer):
+    """Deploy-form Linear: weights stored int8 per-channel (the
+    nn.quant.weight_quantize layout) and dequantized inside the dot — REAL
+    quantized execution, not fake-quant simulation (reference: the
+    quantized inference ops the convert pass emits,
+    static/quantization/quantization_pass.py)."""
+
+    def __init__(self, linear):
+        super().__init__()
+        from ..nn.quant import weight_quantize
+
+        wq, scale = weight_quantize(linear.weight)
+        self.register_buffer("weight_int8", wq)
+        self.register_buffer("weight_scale", scale)
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        from ..nn.quant import weight_only_linear
+
+        return weight_only_linear(x, self.weight_int8, bias=self.bias,
+                                  weight_scale=self.weight_scale)
 
 
 class PTQ(QAT):
@@ -240,3 +269,15 @@ class PTQ(QAT):
         m = super().quantize(model, inplace=inplace)
         m.eval()
         return m
+
+    def convert(self, model: Layer, inplace=False,
+                quantized_execution: bool = False):
+        """Strip observers; with quantized_execution=True, Linears come
+        back as QuantizedExecutionLinear (int8 weights in memory)."""
+        from ..nn.layer.common import Linear
+
+        transform = (
+            (lambda inner: QuantizedExecutionLinear(inner)
+             if isinstance(inner, Linear) else inner)
+            if quantized_execution else None)
+        return super().convert(model, inplace=inplace, _transform=transform)

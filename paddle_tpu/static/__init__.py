@@ -40,6 +40,9 @@ class InputSpec:
         return cls(ndarray.shape, ndarray.dtype, name)
 
 
+_MAX_CAPTURED_NODES = 50_000
+
+
 class Program:
     """Recorded forward graph (reference: python/paddle/base/framework.py:5840
     Program/ProgramDesc).
@@ -95,6 +98,16 @@ class Program:
 
     def _record(self, fn, in_arrs, out_arrs, tensor_args=None):
         from ..core.tensor import Tensor
+
+        # past the node cap, stop recording AND stop pinning — nothing may
+        # be appended to _nodes/_keepalive/_literals, or a training loop
+        # inside one guard leaks arrays without bound. (Other impurity
+        # kinds keep recording: they only gate the jit-replay path.)
+        if len(self._nodes) >= _MAX_CAPTURED_NODES:
+            self._mark_impure(
+                f"capture exceeded {_MAX_CAPTURED_NODES} ops - "
+                "program_guard must scope a single iteration's graph")
+            return
 
         in_keys = []
         for i, a in enumerate(in_arrs):
