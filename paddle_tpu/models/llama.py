@@ -334,6 +334,10 @@ class LlamaModel(Layer):
                 if self.config.remat_policy == "save_attn":
                     policy = jax.checkpoint_policies.save_only_these_names(
                         "attn_out")
+                elif self.config.remat_policy in (
+                        "dots_saveable", "dots_with_no_batch_dims_saveable"):
+                    policy = getattr(jax.checkpoint_policies,
+                                     self.config.remat_policy)
                 hidden = Tensor(jax.checkpoint(run, policy=policy)(
                     unwrap(hidden)))
             else:
