@@ -26,6 +26,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+_BLOCK = 512  # default tile edge; alignment and the pallas paths share it
+
+
+def _aligned(m: int, f: int, k: int) -> bool:
+    return m % _BLOCK == 0 and f % _BLOCK == 0 and k % _BLOCK == 0
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
 def _silu(x):
     return x * jax.nn.sigmoid(x)
 
@@ -56,7 +67,8 @@ def _fwd_kernel(x_ref, wg_ref, wu_ref, o_ref, acc_g, acc_u, *, n_k: int):
         o_ref[...] = (_silu(acc_g[...]) * acc_u[...]).astype(o_ref.dtype)
 
 
-def _fwd_pallas(x2d, wg, wu, *, bm: int = 512, bf: int = 512, bk: int = 512):
+def _fwd_pallas(x2d, wg, wu, *, bm: int = _BLOCK, bf: int = _BLOCK,
+                bk: int = _BLOCK):
     m, k = x2d.shape
     f = wg.shape[1]
     bm, bf, bk = min(bm, m), min(bf, f), min(bk, k)
@@ -78,6 +90,7 @@ def _fwd_pallas(x2d, wg, wu, *, bm: int = 512, bf: int = 512, bk: int = 512):
                         pltpu.VMEM((bm, bf), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
     )(x2d, wg, wu)
 
 
@@ -111,11 +124,16 @@ def _bwd_kernel(x_ref, wg_ref, wu_ref, g_ref, dg_ref, du_ref, acc_g, acc_u,
         du_ref[...] = (dout * silu).astype(du_ref.dtype)
 
 
-def _bwd_pallas(x2d, wg, wu, dout, *, bm: int = 512, bf: int = 512,
-                bk: int = 512):
+def _bwd_pallas(x2d, wg, wu, dout, *, bm: int = _BLOCK, bf: int = _BLOCK,
+                bk: int = _BLOCK):
     m, k = x2d.shape
     f = wg.shape[1]
     bm, bf, bk = min(bm, m), min(bf, f), min(bk, k)
+    if m % bm or f % bf or k % bk:
+        raise ValueError(
+            f"_bwd_pallas needs block-aligned shapes, got {x2d.shape} x "
+            f"{wg.shape} (the custom vjp routes misaligned shapes to the "
+            "XLA ref path before reaching here)")
     n_k = k // bk
     grid = (m // bm, f // bf, n_k)
     return pl.pallas_call(
@@ -135,6 +153,7 @@ def _bwd_pallas(x2d, wg, wu, dout, *, bm: int = 512, bf: int = 512,
                         pltpu.VMEM((bm, bf), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
     )(x2d, wg, wu, dout)
 
 
@@ -154,8 +173,8 @@ def _swiglu_fused_bwd(res, dout):
     x2d, wg, wu = res
     m, k = x2d.shape
     f = wg.shape[1]
-    if any(d % 512 and d < 512 for d in (m, f, k)):
-        # tiny shapes went through the ref path in fwd; mirror it
+    if not _aligned(m, f, k):
+        # these shapes went through the ref path in fwd; mirror it
         _, vjp = jax.vjp(_swiglu_ref, x2d, wg, wu)
         return vjp(dout)
     dh_g, dh_u = _bwd_pallas(x2d, wg, wu, dout)
@@ -166,10 +185,6 @@ def _swiglu_fused_bwd(res, dout):
 
 
 _swiglu_fused.defvjp(_swiglu_fused_fwd, _swiglu_fused_bwd)
-
-
-def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
 
 
 def swiglu_matmul(x, wg, wu, fused=None):
@@ -186,8 +201,7 @@ def swiglu_matmul(x, wg, wu, fused=None):
     x2d = x.reshape(-1, k)
     use_fused = False if fused is None else fused
     m, f = x2d.shape[0], wg.shape[1]
-    aligned = (m % 512 == 0 and f % 512 == 0 and k % 512 == 0)
-    if use_fused and aligned:
+    if use_fused and _aligned(m, f, k):
         out = _swiglu_fused(x2d, wg, wu)
     else:
         out = _swiglu_ref(x2d, wg, wu)

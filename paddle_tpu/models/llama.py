@@ -59,7 +59,9 @@ class LlamaConfig:
     # remat policy: "none" saves only layer boundaries (recompute all);
     # "save_attn" additionally keeps attention outputs, skipping the flash
     # forward re-run in the backward pass (reference analog: selective
-    # recompute in fleet recompute_hybrid)
+    # recompute in fleet recompute_hybrid);
+    # "dots_saveable" / "dots_with_no_batch_dims_saveable" save matmul
+    # outputs (jax.checkpoint_policies; measured: OOM at the bench config)
     remat_policy: str = "none"
     # attention over the sep axis: "ulysses" (all-to-all seq->head reshard)
     # or "ring" (ring attention — k/v rotate with ppermute, exact blockwise
