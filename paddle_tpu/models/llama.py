@@ -399,10 +399,11 @@ class LlamaForCausalLM(Layer):
           top-p with a threaded PRNG key; ``seed`` makes it deterministic.
           temperature and top_p are traced (no recompile when they change);
           top_k is static (it sizes a lax.top_k).
-        - **weight-only int8 decode** (``quant="weight_only_int8"``): the
-          decode scan reads int8 per-channel-scaled projection weights
-          (nn.quant.weight_quantize layout) — half the HBM traffic on the
-          weight-bound decode path.
+        - **weight-only int8/int4 decode** (``quant="weight_only_int8"``
+          or ``"weight_only_int4"``): the decode scan reads per-channel-
+          scaled int8 (or nibble-packed int4) projection weights
+          (nn.quant.weight_quantize layout) — half / quarter the HBM
+          traffic on the weight-bound decode path.
         """
         cfg = self.config
         ids_arr = unwrap(input_ids) if isinstance(input_ids, Tensor) \
@@ -454,9 +455,10 @@ class LlamaForCausalLM(Layer):
         is requantized on the next call, never served stale."""
         if quant is None:
             return params
-        if quant != "weight_only_int8":
+        if quant not in ("weight_only_int8", "weight_only_int4"):
             raise ValueError(
-                f"quant must be None or 'weight_only_int8', got {quant!r}")
+                "quant must be None, 'weight_only_int8' or "
+                f"'weight_only_int4', got {quant!r}")
         from ..nn.quant import weight_quantize
 
         qcache = getattr(self, "_decode_quant_cache", None)
@@ -467,11 +469,12 @@ class LlamaForCausalLM(Layer):
                  if n.endswith("_proj.weight") or n == "lm_head.weight"]
         for n in names:
             src = params[n]
-            hit = qcache.get(n)
+            hit = qcache.get((n, quant))
             if hit is None or hit[0] is not src:
-                wq, sc = weight_quantize(Tensor(src.astype(jnp.float32)))
+                wq, sc = weight_quantize(Tensor(src.astype(jnp.float32)),
+                                         algo=quant)
                 hit = (src, (unwrap(wq), unwrap(sc)))
-                qcache[n] = hit
+                qcache[(n, quant)] = hit
             out[n] = hit[1]
         return out
 
@@ -518,11 +521,27 @@ class LlamaForCausalLM(Layer):
 
 
 def _mm(x, w):
-    """Matmul against a decode weight: dense [K, N], or the
-    nn.quant.weight_quantize pair (int8 [N, K], scale [N]) — the int8→bf16
-    convert fuses into the dot, so HBM reads stay int8."""
+    """Matmul against a decode weight: dense [K, N], or a
+    nn.quant.weight_quantize pair — int8 [N, K] or packed int4 [N, K//2]
+    (detected by the stored K) with per-channel scales [N]. The
+    int→bf16 convert (and the int4 unpack) fuse into the dot, so HBM
+    reads stay at the quantized width."""
     if isinstance(w, tuple):
         wq, sc = w
+        if wq.shape[1] != x.shape[-1]:  # packed int4: two nibbles/byte
+            # two half-K dots instead of unpack-and-interleave: even k's
+            # live in the low nibble, odd k's in the high one, and int8
+            # shifts sign-extend in place — no layout shuffle, the
+            # nibble math fuses into the dots
+            lo = jnp.right_shift(jnp.left_shift(wq, 4), 4)
+            hi = jnp.right_shift(wq, 4)
+            out = jnp.einsum("...k,nk->...n", x[..., 0::2],
+                             lo.astype(x.dtype),
+                             preferred_element_type=jnp.float32)
+            out = out + jnp.einsum("...k,nk->...n", x[..., 1::2],
+                                   hi.astype(x.dtype),
+                                   preferred_element_type=jnp.float32)
+            return (out * sc).astype(x.dtype)
         out = jnp.einsum("...k,nk->...n", x, wq.astype(x.dtype),
                          preferred_element_type=jnp.float32)
         return (out * sc).astype(x.dtype)

@@ -286,6 +286,10 @@ class TestLlama:
         q = model.jit_generate(xt, max_new_tokens=6, quant="weight_only_int8")
         agree = (fp.numpy() == q.numpy()).mean()
         assert agree > 0.7, f"int8 decode diverged: agreement {agree}"
+        q4 = model.jit_generate(xt, max_new_tokens=6,
+                                quant="weight_only_int4")
+        agree4 = (fp.numpy() == q4.numpy()).mean()
+        assert agree4 > 0.5, f"int4 decode diverged: agreement {agree4}"
         with pytest.raises(ValueError):
             model.jit_generate(xt, max_new_tokens=2, quant="int3")
 
