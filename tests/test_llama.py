@@ -318,14 +318,15 @@ class TestLlama:
         xt = paddle.to_tensor(x)
         model.jit_generate(xt, max_new_tokens=2, quant="weight_only_int8")
         cache = model._decode_quant_cache
-        name = next(iter(cache))
-        old_q = cache[name][1][0]
+        key = next(iter(cache))     # (param name, algo)
+        name = key[0]
+        old_q = cache[key][1][0]
         # perturb that weight through the raw-state path
         state = model.raw_state()
         state[name] = state[name] + 1.0
         model.load_raw_state(state)
         model.jit_generate(xt, max_new_tokens=2, quant="weight_only_int8")
-        new_q = model._decode_quant_cache[name][1][0]
+        new_q = model._decode_quant_cache[key][1][0]
         assert not np.array_equal(np.asarray(old_q), np.asarray(new_q))
 
     def test_sep_matches_serial(self):
