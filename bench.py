@@ -269,12 +269,16 @@ def main():
         # per-op regression gate: unacknowledged >10% regressions go into
         # the driver-parsed JSON line AND fail the process (round-2's
         # warn-only gate could be ignored; this one cannot)
-        try:
-            regressions = _op_regressions(_op_bench())
-        except Exception as e:
-            import sys
-            print(f"op bench failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
+        for attempt in (1, 2):
+            try:
+                regressions = _op_regressions(_op_bench())
+                break
+            except Exception as e:
+                import sys
+                # "response body closed" / transient HTTP 500s are known
+                # tunnel flakes — one retry before giving up
+                print(f"op bench attempt {attempt} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
 
     result = {
         "metric": "llama_train_tokens_per_sec",
