@@ -42,34 +42,21 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
             if len(xa) else xa
         return Tensor(out)
 
-    from ..collective import all_to_all
-
-    # pack per-destination-rank buffers: rank r gets this rank's tokens for
-    # experts r*n_expert..(r+1)*n_expert-1 (row counts from local_count)
-    segs = _split_by_counts(xa, lc)
-    feat = xa.shape[1:] if xa.ndim > 1 else ()
-    send = []
-    for r in range(world):
-        parts = [segs[r * n_expert + e] for e in range(n_expert)]
-        send.append(Tensor(np.concatenate(parts, axis=0) if parts else
-                           np.zeros((0,) + feat, xa.dtype)))
-    recv = [None] * world
-    all_to_all(recv, send, group=group)
-    out = np.concatenate([np.asarray(unwrap(t)) for t in recv], axis=0)
-    # received blocks arrive rank-major; reorder rows to expert-major using
-    # global_count (gc[i]: tokens from rank i//n_expert for expert i%n_expert)
-    per_rank = [gc[r * n_expert:(r + 1) * n_expert] for r in range(world)]
-    offsets, cursor = {}, 0
-    for r in range(world):
-        for e in range(n_expert):
-            offsets[(r, e)] = cursor
-            cursor += int(per_rank[r][e])
+    # multi-process eager exchange: allgather everyone's (x, local_count)
+    # and deterministically pick the rows destined for this rank — the
+    # debug/eager analog of the reference's NCCL alltoall (inside jit use
+    # the capacity-based dispatch in parallel/moe.py instead)
+    rank = _my_rank()
+    all_x, all_lc = _allgather_rows(xa, lc, world)
     rows = []
     for e in range(n_expert):
-        for r in range(world):
-            o = offsets[(r, e)]
-            rows.append(out[o:o + int(per_rank[r][e])])
-    return Tensor(np.concatenate(rows, axis=0) if rows else out)
+        for src in range(world):
+            segs = _split_by_counts(all_x[src], all_lc[src])
+            rows.append(segs[rank * n_expert + e])
+    feat = xa.shape[1:] if xa.ndim > 1 else ()
+    out = np.concatenate(rows, axis=0) if rows else \
+        np.zeros((0,) + feat, xa.dtype)
+    return Tensor(out)
 
 
 def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
@@ -84,26 +71,58 @@ def global_gather(x, local_count, global_count, group=None, use_calc_stream=True
     if world <= 1:
         return Tensor(xa)
 
-    from ..collective import all_to_all
-
-    # x holds expert-major rows (global_count layout); repack rank-major
-    per_rank = [gc[r * n_expert:(r + 1) * n_expert] for r in range(world)]
+    rank = _my_rank()
+    # x holds expert-major rows laid out by global_count; allgather every
+    # rank's expert outputs + their global_counts, then rebuild this
+    # rank's original send order from its local_count
+    all_x, all_gc = _allgather_rows(xa, gc, world)
+    # index each destination's buffer once: block (src, expert) -> rows
+    blocks_by_dst = []
+    for dst in range(world):
+        off, blocks = 0, {}
+        gcd = all_gc[dst]
+        for ee in range(n_expert):
+            for src in range(world):
+                n = int(gcd[src * n_expert + ee])
+                blocks[(src, ee)] = all_x[dst][off:off + n]
+                off += n
+        blocks_by_dst.append(blocks)
+    rows = []
+    for i in range(len(lc)):  # destination slot order of OUR send
+        dst, e = i // n_expert, i % n_expert
+        rows.append(blocks_by_dst[dst][(rank, e)])
     feat = xa.shape[1:] if xa.ndim > 1 else ()
-    blocks, cursor = {}, 0
-    for e in range(n_expert):
-        for r in range(world):
-            n = int(per_rank[r][e])
-            blocks[(r, e)] = xa[cursor:cursor + n]
-            cursor += n
-    send = []
-    for r in range(world):
-        parts = [blocks[(r, e)] for e in range(n_expert)]
-        send.append(Tensor(np.concatenate(parts, axis=0) if parts else
-                           np.zeros((0,) + feat, xa.dtype)))
-    recv = [None] * world
-    all_to_all(recv, send, group=group)
-    out = np.concatenate([np.asarray(unwrap(t)) for t in recv], axis=0)
+    out = np.concatenate(rows, axis=0) if rows else \
+        np.zeros((0,) + feat, xa.dtype)
     return Tensor(out)
+
+
+def _my_rank() -> int:
+    import jax
+
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def _allgather_rows(xa, counts, world):
+    """Host allgather of variable-row buffers: exchange counts (fixed
+    shape), pad rows to the global max, gather, unpad."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(counts, np.int64)
+    all_counts = np.asarray(multihost_utils.process_allgather(counts))
+    n_rows = np.asarray(
+        multihost_utils.process_allgather(np.asarray([xa.shape[0]])))
+    max_rows = int(n_rows.max())
+    feat = xa.shape[1:] if xa.ndim > 1 else ()
+    padded = np.zeros((max_rows,) + feat, xa.dtype)
+    padded[:xa.shape[0]] = xa
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    all_x = [gathered[r][:int(n_rows[r][0])] for r in range(world)]
+    return all_x, [all_counts[r] for r in range(world)]
 
 
 def _split_by_counts(x, counts):

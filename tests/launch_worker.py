@@ -67,6 +67,28 @@ def main(out_dir: str) -> None:
     with open(os.path.join(out_dir, f"ckpt_ok.{rank}"), "w") as f:
         f.write("ok")
 
+    # --- MoE token exchange across the REAL process boundary ---
+    # reference semantics (distributed/utils/moe_utils.py): 2 ranks x
+    # 1 expert each; local_count[i] tokens go to expert i%1 on rank i//1
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.utils import global_gather, global_scatter
+
+    # rank r owns tokens valued 10r+1, 10r+2; each rank sends its first
+    # token to rank 0's expert and its second to rank 1's expert. The
+    # values are ASYMMETRIC so a broken identity "exchange" cannot pass.
+    x = Tensor(np.asarray([[10.0 * rank + 1], [10.0 * rank + 2]],
+                          np.float32))
+    lc = Tensor(np.asarray([1, 1], np.int64))  # one token to each rank
+    gc = Tensor(np.asarray([1, 1], np.int64))  # one token from each rank
+    out = global_scatter(x, lc, gc)
+    expect = {0: [[1.0], [11.0]], 1: [[2.0], [12.0]]}[rank]
+    np.testing.assert_array_equal(np.asarray(out._array), expect)
+    back = global_gather(out, lc, gc)
+    np.testing.assert_array_equal(np.asarray(back._array),
+                                  np.asarray(x._array))
+    with open(os.path.join(out_dir, f"moe_ok.{rank}"), "w") as f:
+        f.write("ok")
+
 
 if __name__ == "__main__":
     main(sys.argv[1])

@@ -49,6 +49,8 @@ def test_two_process_psum_and_sharded_checkpoint(tmp_path):
             f"rank {rank} psum marker missing\n{logs}"
         assert (tmp_path / f"ckpt_ok.{rank}").exists(), \
             f"rank {rank} checkpoint marker missing\n{logs}"
+        assert (tmp_path / f"moe_ok.{rank}").exists(), \
+            f"rank {rank} MoE global_scatter/gather marker missing\n{logs}"
     # both ranks' shard files and metadata exist
     assert (tmp_path / "ckpt" / "0.npz").exists()
     assert (tmp_path / "ckpt" / "1.npz").exists()
