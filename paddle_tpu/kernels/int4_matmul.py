@@ -1,0 +1,86 @@
+"""In-register int4 dequant-matmul for weight-bound decode.
+
+TPU-native counterpart of the reference's weight-only int4 GEMV
+(paddle/phi/kernels/fusion/cutlass/fpA_intB_gemm — the CUTLASS
+mixed-dtype path behind nn.quant.weight_only_linear(weight_dtype='int4')).
+
+XLA materializes the sign-extended nibble halves of a packed int4 weight
+before the dot, so the HBM read stays int8-sized and int4 decode measured
+SLOWER than int8 (BASELINE.md). This kernel keeps the packed bytes all the
+way into VMEM and unpacks in-register per tile: HBM traffic is the true
+0.5 byte/weight, which is the whole point of int4 on a weight-bound
+decode. Per-channel scales applied on the output tile.
+
+Layout matches nn.quant.weight_quantize(algo="weight_only_int4"):
+w_packed [N, K//2] int8, low nibble = even k, high nibble = odd k,
+scale [N] float32. x [M, K] with small M (decode): M is padded to the
+sublane minimum outside the kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref):
+    # Mosaic has no i8 vector shifts: nibble math in i32
+    # (xor-subtract sign extension: (v & 15) ^ 8 - 8)
+    w32 = w_ref[...].astype(jnp.int32)  # [bn, K/2]
+    lo = (jnp.bitwise_and(w32, 15) ^ 8) - 8                 # even k
+    hi = (jnp.bitwise_and(jnp.right_shift(w32, 4), 15) ^ 8) - 8  # odd k
+    acc = jax.lax.dot_general(
+        xe_ref[...].astype(jnp.float32), lo.astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    acc += jax.lax.dot_general(
+        xo_ref[...].astype(jnp.float32), hi.astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+
+def int4_matmul(x, w_packed, scale, *, block_n: int = 512):
+    """x [M, K] @ dequant(w_packed [N, K//2]).T * scale [N] → [M, K?N].
+
+    Decode-shaped: the whole x lives in VMEM per tile (small M); the grid
+    walks N. Falls back to the XLA shift form off-TPU or on misaligned
+    shapes."""
+    m, k = x.shape
+    n = w_packed.shape[0]
+    bn = min(block_n, n)
+    aligned = (n % bn == 0) and (k % 2 == 0) and (w_packed.shape[1] * 2 == k)
+    if not aligned:
+        return _xla_fallback(x, w_packed, scale)
+    pad_m = max(8 - m, 0)
+    xp = jnp.pad(x, ((0, pad_m), (0, 0))) if pad_m else x
+    # even/odd split outside the kernel (Mosaic has no strided gather);
+    # x is decode-tiny so this costs nothing
+    xe, xo = xp[:, 0::2], xp[:, 1::2]
+    scale2d = scale.reshape(1, n)  # 2-D: 1-D operands hit XLA/Mosaic
+    # tiling mismatches
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((xp.shape[0], k // 2), lambda j: (0, 0)),
+            pl.BlockSpec((xp.shape[0], k // 2), lambda j: (0, 0)),
+            pl.BlockSpec((bn, k // 2), lambda j: (j, 0)),
+            pl.BlockSpec((1, bn), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((xp.shape[0], bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((xp.shape[0], n), x.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(xe, xo, w_packed, scale2d)
+    return out[:m] if pad_m else out
+
+
+def _xla_fallback(x, w_packed, scale):
+    lo = jnp.right_shift(jnp.left_shift(w_packed, 4), 4)
+    hi = jnp.right_shift(w_packed, 4)
+    out = jnp.einsum("mk,nk->mn", x[:, 0::2], lo.astype(x.dtype),
+                     preferred_element_type=jnp.float32)
+    out += jnp.einsum("mk,nk->mn", x[:, 1::2], hi.astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+    return (out * scale).astype(x.dtype)

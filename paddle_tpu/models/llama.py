@@ -529,19 +529,15 @@ def _mm(x, w):
     if isinstance(w, tuple):
         wq, sc = w
         if wq.shape[1] != x.shape[-1]:  # packed int4: two nibbles/byte
-            # two half-K dots instead of unpack-and-interleave: even k's
-            # live in the low nibble, odd k's in the high one, and int8
-            # shifts sign-extend in place — no layout shuffle, the
-            # nibble math fuses into the dots
-            lo = jnp.right_shift(jnp.left_shift(wq, 4), 4)
-            hi = jnp.right_shift(wq, 4)
-            out = jnp.einsum("...k,nk->...n", x[..., 0::2],
-                             lo.astype(x.dtype),
-                             preferred_element_type=jnp.float32)
-            out = out + jnp.einsum("...k,nk->...n", x[..., 1::2],
-                                   hi.astype(x.dtype),
-                                   preferred_element_type=jnp.float32)
-            return (out * sc).astype(x.dtype)
+            # in-register Pallas dequant-matmul: the packed bytes stay
+            # packed all the way into VMEM (kernels/int4_matmul.py) —
+            # end-to-end decode 1.68 ms/step vs 2.79 for the XLA shift
+            # form (int8 remains fastest at ~1.1-1.3; BASELINE.md)
+            from ..kernels.int4_matmul import int4_matmul
+
+            lead = x.shape[:-1]
+            out = int4_matmul(x.reshape(-1, x.shape[-1]), wq, sc)
+            return out.reshape(*lead, wq.shape[0]).astype(x.dtype)
         out = jnp.einsum("...k,nk->...n", x, wq.astype(x.dtype),
                          preferred_element_type=jnp.float32)
         return (out * sc).astype(x.dtype)

@@ -425,3 +425,37 @@ class TestSwigluKernel:
         for got, want, nm in zip(gf, gx, ("x", "wg", "wu")):
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=2e-3, atol=2e-3, err_msg=nm)
+
+
+class TestInt4MatmulKernel:
+    def test_matches_dequant_oracle(self):
+        from paddle_tpu.kernels.int4_matmul import int4_matmul
+        from paddle_tpu.nn.quant import weight_dequantize, weight_quantize
+
+        rng = np.random.default_rng(0)
+        K, N = 256, 512
+        w = rng.standard_normal((K, N)).astype("float32")
+        wq, sc = paddle.nn.quant.weight_quantize(
+            paddle.to_tensor(w), algo="weight_only_int4")
+        wd = np.asarray(weight_dequantize(
+            wq, sc, algo="weight_only_int4", out_dtype="float32")._array)
+        x = rng.standard_normal((4, K)).astype("float32")
+        out = int4_matmul(jnp.asarray(x), wq._array, sc._array)
+        np.testing.assert_allclose(np.asarray(out), x @ wd,
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_misaligned_falls_back(self):
+        from paddle_tpu.kernels.int4_matmul import int4_matmul
+        from paddle_tpu.nn.quant import weight_dequantize, weight_quantize
+
+        rng = np.random.default_rng(1)
+        K, N = 64, 96  # N not a multiple of the block
+        w = rng.standard_normal((K, N)).astype("float32")
+        wq, sc = paddle.nn.quant.weight_quantize(
+            paddle.to_tensor(w), algo="weight_only_int4")
+        wd = np.asarray(weight_dequantize(
+            wq, sc, algo="weight_only_int4", out_dtype="float32")._array)
+        x = rng.standard_normal((2, K)).astype("float32")
+        out = int4_matmul(jnp.asarray(x), wq._array, sc._array)
+        np.testing.assert_allclose(np.asarray(out), x @ wd,
+                                   rtol=2e-3, atol=2e-3)
