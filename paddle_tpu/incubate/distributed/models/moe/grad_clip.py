@@ -3,7 +3,9 @@ incubate/distributed/models/moe/grad_clip.py ClipGradForMOEByGlobalNorm):
 expert parameters' grad norms are summed across the expert-parallel group
 before forming the global norm, so clipping is consistent with the
 replicated view."""
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .....core.tensor import Tensor
 from .....nn.clip import ClipGradByGlobalNorm
@@ -17,8 +19,35 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
         self._moe_group = moe_group
 
     def apply(self, grads, params=None):
-        # under SPMD, expert grads already carry the ep-sharded layout and
-        # psum happens in the step; the norm math is the standard one
-        total = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+        # split the squared-norm into replicated vs expert contributions;
+        # the expert share must be summed over the expert-parallel group
+        # (each rank holds different experts) before the global norm forms
+        expert = [False] * len(grads)
+        if params is not None:
+            expert = [bool(self._is_expert(p)) for p in params]
+        normal_sq = sum((jnp.sum(jnp.square(g))
+                         for g, e in zip(grads, expert) if not e),
+                        jnp.float32(0.0))
+        expert_sq = sum((jnp.sum(jnp.square(g))
+                         for g, e in zip(grads, expert) if e),
+                        jnp.float32(0.0))
+        if self._moe_group is not None and any(expert):
+            axes = tuple(getattr(self._moe_group, "axes", ()))
+            try:
+                # inside the SPMD step (shard_map over the moe axis) this
+                # is the cross-expert-rank sum the reference does via NCCL
+                expert_sq = lax.psum(expert_sq, axes)
+            except Exception:
+                # not under a bound mesh axis: eager use. With one rank
+                # the local sum IS the group sum; with more, a silent
+                # local norm would diverge from the reference semantics.
+                nranks = int(getattr(self._moe_group, "nranks", 1))
+                if nranks > 1 and not isinstance(expert_sq, jax.core.Tracer):
+                    raise RuntimeError(
+                        "ClipGradForMOEByGlobalNorm with a >1-rank "
+                        "moe_group must run inside the SPMD step (where "
+                        "the expert-norm psum can execute); the eager "
+                        "path would compute a local-only norm.")
+        total = jnp.sqrt(normal_sq + expert_sq)
         scale = jnp.minimum(self.clip_norm / (total + 1e-6), 1.0)
         return [g * scale for g in grads]

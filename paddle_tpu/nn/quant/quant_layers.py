@@ -24,6 +24,23 @@ __all__ = [
 ]
 
 
+def _ema_guard(arr, layer_name):
+    """EMA scale buffers are Python-side state: updating them from a
+    traced value would capture a tracer (leaked-tracer error on next use)
+    and be silently wrong under vmap/grad. These layers are eager-only
+    QAT simulation in training mode — refuse loudly instead of corrupting
+    the buffer (round-3 advisor finding)."""
+    import jax
+
+    if isinstance(arr, jax.core.Tracer):
+        raise RuntimeError(
+            f"{layer_name}: the moving-average scale update runs in "
+            f"training mode under a jax transform (jit/grad/vmap); the "
+            f"EMA buffer write would capture a tracer. Run QAT forward "
+            f"eagerly, or call .eval() to freeze the scale before "
+            f"jitting.")
+
+
 def _fake_quant(a, scale, qmax):
     import jax
 
@@ -64,6 +81,7 @@ class FakeQuantMovingAverageAbsMax(Layer):
     def forward(self, input):
         qmax = float(2 ** (self._quant_bits - 1) - 1)
         if self.training:
+            _ema_guard(unwrap(input), type(self).__name__)
             cur = jnp.max(jnp.abs(unwrap(input))).astype(jnp.float32)
             r = self._moving_rate
             state = unwrap(self.state) * r + 1.0
@@ -107,6 +125,7 @@ class MovingAverageAbsMaxScale(Layer):
 
     def forward(self, input):
         if self.training:
+            _ema_guard(unwrap(input), type(self).__name__)
             cur = jnp.max(jnp.abs(unwrap(input))).astype(jnp.float32)
             r = self._moving_rate
             state = unwrap(self.state) * r + 1.0

@@ -26,15 +26,47 @@ def _counts(c):
     return np.asarray(unwrap(c)).astype(np.int64).ravel()
 
 
-def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+def _world_and_experts(lc, group, n_expert):
+    """Resolve (world, n_expert) for the eager exchange, loudly.
+
+    The exchange runs across *processes*. A count vector sized for more
+    ranks than there are processes (the single-process multi-device
+    topology) would silently degenerate to an identity repack, so it is
+    rejected instead (round-3 advisor finding)."""
+    world = get_world_size()
+    if group is not None:
+        gr = int(getattr(group, "nranks", world))
+        if gr != world:
+            raise ValueError(
+                f"global_scatter/global_gather are eager cross-PROCESS "
+                f"exchanges: group implies {gr} ranks but only {world} "
+                f"process(es) exist. For single-process multi-device "
+                f"expert parallelism use the jit capacity dispatch in "
+                f"paddle_tpu.parallel.moe instead.")
+    if n_expert is not None:
+        if world * n_expert != len(lc):
+            raise ValueError(
+                f"len(local_count)={len(lc)} != n_expert({n_expert}) * "
+                f"world({world}) — the count layout is (rank, expert) "
+                f"row-major, one entry per (rank, expert) pair.")
+    else:
+        if world < 1 or len(lc) % world:
+            raise ValueError(
+                f"len(local_count)={len(lc)} is not divisible by the "
+                f"process world {world}; pass n_expert= explicitly.")
+        n_expert = len(lc) // world
+    return world, n_expert
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True, n_expert=None):
     """Send token rows to (rank, expert) destinations by count.
 
     Reference: distributed/utils/moe_utils.py:20.
     """
     xa = np.asarray(unwrap(x))
     lc, gc = _counts(local_count), _counts(global_count)
-    world = get_world_size()
-    n_expert = len(lc) // max(world, 1)
+    world, n_expert = _world_and_experts(lc, group, n_expert)
 
     if world <= 1:
         # single process: the exchange is an identity repack in expert order
@@ -59,14 +91,14 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
     return Tensor(out)
 
 
-def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True, n_expert=None):
     """Inverse of :func:`global_scatter` — return expert outputs to their
     source ranks. Reference: distributed/utils/moe_utils.py:153.
     """
     xa = np.asarray(unwrap(x))
     lc, gc = _counts(local_count), _counts(global_count)
-    world = get_world_size()
-    n_expert = len(lc) // max(world, 1)
+    world, n_expert = _world_and_experts(lc, group, n_expert)
 
     if world <= 1:
         return Tensor(xa)
