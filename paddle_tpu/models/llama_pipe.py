@@ -24,9 +24,9 @@ from ..core.tensor import Tensor, unwrap
 from ..core import tape as _tape
 from ..kernels.rope import rope_freqs
 from ..parallel import mesh as mesh_mod
-from ..parallel.pipeline_spmd import (pipeline_1f1b, pipeline_forward,
-                                      pipeline_vpp_forward, pipeline_zb1f1b,
-                                      stack_stage_params)
+from ..parallel.pipeline_spmd import (pipeline_1f1b, pipeline_eager_1f1b,
+                                      pipeline_forward, pipeline_vpp_forward,
+                                      pipeline_zb1f1b, stack_stage_params)
 from ..parallel.trainer import adamw_update, batch_sharding, \
     init_adamw_state
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
@@ -158,6 +158,12 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
       - "ZBH1": zero-bubble-style 1F1B — activation-grad-only ticks, all
         weight grads batched after the scan (pipeline_spmd.pipeline_zb1f1b
         documents the TPU-native cost model).
+      - "Eager1F1B": 1F1B with a full tick of slack on every boundary
+        exchange so XLA overlaps the collective-permute with compute, at
+        the cost of more in-flight activations — the reference
+        eager-1F1B's memory-for-overlap trade
+        (pipeline_scheduler_pass/pipeline_eager_1f1b.py:31) in
+        one-program form (pipeline_spmd.pipeline_eager_1f1b).
 
     coop_head (default: on for 1F1B/ZBH1 when vocab %% pp == 0): the final
     norm+LM-head+CE run COOPERATIVELY — every rank holds vocab/pp of the
@@ -187,7 +193,7 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
                 vpp_degree = int(pipe_cfg["vpp_degree"])
     if schedule is None:
         schedule = "1F1B"
-    if schedule not in ("1F1B", "FThenB", "VPP", "ZBH1"):
+    if schedule not in ("1F1B", "Eager1F1B", "FThenB", "VPP", "ZBH1"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if vpp_degree is None:
         vpp_degree = 2
@@ -196,9 +202,9 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
     n_stages = int(mesh.shape["pp"]) if (mesh is not None
                                          and "pp" in mesh.axis_names) else 1
     if coop_head:
-        if schedule not in ("1F1B", "ZBH1") or n_stages == 1:
+        if schedule not in ("1F1B", "Eager1F1B", "ZBH1") or n_stages == 1:
             raise ValueError(
-                "coop_head=True requires schedule='1F1B' or 'ZBH1' with a "
+                "coop_head=True requires a 1F1B-family schedule with a "
                 f"pp axis > 1 (got schedule={schedule!r}, pp={n_stages}); "
                 "FThenB/VPP compute the head once per step outside the "
                 "pipeline, so there is nothing to cooperate on")
@@ -220,7 +226,8 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
     template = model.llama.layers[0]
     crit = LlamaPretrainingCriterion(cfg)
     if coop_head is None:
-        coop_head = (schedule in ("1F1B", "ZBH1") and n_stages > 1
+        coop_head = (schedule in ("1F1B", "Eager1F1B", "ZBH1")
+                     and n_stages > 1
                      and cfg.vocab_size % n_stages == 0)
 
     def stage_fn(stage_params, h):
@@ -320,7 +327,9 @@ def make_llama_pp_train_step(model: LlamaForCausalLM,
         # accumulator through the whole scan
         head_keys = {"llama.norm.weight", head_key}
         head_p = {k: p["outer"][k] for k in head_keys}
-        pipe = pipeline_zb1f1b if schedule == "ZBH1" else pipeline_1f1b
+        pipe = {"ZBH1": pipeline_zb1f1b,
+                "Eager1F1B": pipeline_eager_1f1b}.get(schedule,
+                                                      pipeline_1f1b)
         if coop_head:
             from jax.sharding import PartitionSpec as _P
 

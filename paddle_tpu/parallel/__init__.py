@@ -59,6 +59,10 @@ from . import cost_model  # noqa: F401
 from . import elastic  # noqa: F401
 from . import pipeline_spmd  # noqa: F401
 from .pipeline_spmd import pipeline_forward, stack_stage_params  # noqa: F401
+from . import pipeline_viz  # noqa: F401
+from .pipeline_viz import (  # noqa: F401
+    pipeline_timeline, render_timeline, save_chrome_trace, timeline_stats,
+)
 from . import ring_attention as ring_attention_mod  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from . import watchdog  # noqa: F401

@@ -244,6 +244,14 @@ class Pipeline1F1BPass(_PipelinePassBase):
     schedule = "1F1B"
 
 
+@register_pass("pipeline_scheduler_Eager1F1B")
+class PipelineEager1F1BPass(_PipelinePassBase):
+    """reference: pipeline_scheduler_pass/pipeline_eager_1f1b.py:31 —
+    more in-flight warmup forwards so boundary sends overlap compute;
+    realised one-program in pipeline_spmd.pipeline_eager_1f1b."""
+    schedule = "Eager1F1B"
+
+
 @register_pass("pipeline_scheduler_VPP")
 class PipelineVPPPass(_PipelinePassBase):
     """reference: pipeline_scheduler_pass/pipeline_vpp.py (interleaved

@@ -4,6 +4,7 @@ passes consumed by the pp train step (see tests/test_pipeline.py)."""
 from .. import (  # noqa: F401
     PassContext,
     Pipeline1F1BPass,
+    PipelineEager1F1BPass,
     PipelineFThenBPass,
     PipelineVPPPass,
     PipelineZeroBubblePass,
@@ -23,8 +24,8 @@ def apply_pass(main_program, startup_program, pass_name, pass_attr=None):
         raise AssertionError(
             "pipeline scheduler only support FThenB, 1F1B, Eager1F1B, VPP "
             f"and ZBH1, but receive {pass_name}")
-    name = "1F1B" if pass_name == "Eager1F1B" else pass_name
-    pipeline_pass = new_pass("pipeline_scheduler_" + name, pass_attr or {})
+    pipeline_pass = new_pass("pipeline_scheduler_" + pass_name,
+                             pass_attr or {})
     context = PassContext()
     pipeline_pass.apply([main_program], [startup_program], context)
     return context

@@ -30,8 +30,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_mod
 
-__all__ = ["pipeline_forward", "pipeline_1f1b", "pipeline_vpp_forward",
-           "pipeline_zb1f1b", "stack_stage_params", "unstack_stage_params"]
+__all__ = ["pipeline_forward", "pipeline_1f1b", "pipeline_eager_1f1b",
+           "pipeline_vpp_forward", "pipeline_zb1f1b", "stack_stage_params",
+           "unstack_stage_params"]
 
 
 def _to_varying(x, axis):
@@ -331,9 +332,45 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stacked_params,
                                head_specs=head_specs)
 
 
+def pipeline_eager_1f1b(stage_fn: Callable, head_fn: Callable,
+                        stacked_params, head_params, x, labels, *,
+                        mesh: Optional[Mesh] = None, axis: str = "pp",
+                        n_micro: Optional[int] = None, head_specs=None):
+    """Eager-1F1B: trade activation memory for guaranteed comm overlap.
+
+    Reference: distributed/passes/pipeline_scheduler_pass/
+    pipeline_eager_1f1b.py:31 — relative to 1F1B, stage s issues
+    2*(S-s)-1 warmup forwards instead of S-s, holding more microbatches
+    in flight so activation sends overlap with compute
+    (enable_send_recv_overlap) instead of stalling the steady state.
+
+    TPU-native translation: the one-program lockstep scan already has the
+    eager in-flight *profile* (a stage cannot stall on a recv — every
+    ppermute is a program-ordered collective), so "eager" here takes the
+    same trade one step further in the direction the reference's schedule
+    exists for: every boundary exchange gets a FULL TICK of slack.
+    Forward of microbatch i runs at stage s at tick 2s+i (vs s+i) and its
+    backward at tick 4S-4-2s+i (vs 2S-1-s+i); an activation produced at
+    tick t is consumed at t+2, so XLA's latency-hiding scheduler can run
+    the collective-permute entirely under tick t+1's compute — on a real
+    ICI mesh no tick ever waits on the wire. Cost, exactly the
+    reference's: more in-flight activations (a stage buffers up to
+    4(S-s)-3 microbatch inputs vs 2(S-s)-1 — asserted relative to 1F1B
+    in tests/test_pipeline.py) and 2S-3 extra (masked) schedule ticks.
+    Same contract and return values as pipeline_1f1b.
+    """
+    return _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params,
+                               head_params, x, labels, mesh=mesh, axis=axis,
+                               n_micro=n_micro, defer_weight_grads=False,
+                               head_specs=head_specs, eager=True)
+
+
 def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
                         labels, *, mesh, axis, n_micro, defer_weight_grads,
-                        head_specs=None):
+                        head_specs=None, eager=False):
+    if eager and defer_weight_grads:
+        raise ValueError("eager comm-slack scheduling composes with plain "
+                         "1F1B only (ZBH1 already restructures the ticks)")
     mesh = mesh or mesh_mod.get_global_mesh()
     n_stages = int(mesh.shape[axis]) if (
         mesh is not None and axis in mesh.axis_names) else 1
@@ -361,8 +398,14 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
         raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
     mb = batch // n_micro
     # ZBH1 keeps every microbatch input for the post-scan W pass; plain
-    # 1F1B only needs the 2S-1 in-flight inputs (slots reused modulo)
-    buf_n = n_micro if defer_weight_grads else 2 * n_stages
+    # 1F1B only needs the 2S-1 in-flight inputs (slots reused modulo);
+    # eager's slack scheduling stretches a slot's lifetime to 4(S-s)-3
+    if defer_weight_grads:
+        buf_n = n_micro
+    elif eager:
+        buf_n = min(n_micro, 4 * n_stages - 3)
+    else:
+        buf_n = 2 * n_stages
     inv_m = 1.0 / n_micro
     coop = head_specs is not None
     hp_specs = head_specs if coop else jax.tree.map(
@@ -386,7 +429,14 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
         is_last = sid == n_stages - 1
         micro_x = xg.reshape((n_micro, mb) + xg.shape[1:])
         micro_lb = lbg.reshape((n_micro, mb) + lbg.shape[1:])
-        t_total = n_micro + 2 * n_stages - 1
+        # per-stage tick offsets of the schedule (eager doubles the
+        # stride so every boundary has one tick of comm slack)
+        f_off = 2 * sid if eager else sid
+        b_off = (4 * n_stages - 4 - 2 * sid) if eager \
+            else (2 * n_stages - 1 - sid)
+        h_off = (2 * n_stages - 2) if eager else n_stages
+        t_total = n_micro + (4 * n_stages - 4 if eager
+                             else 2 * n_stages - 1)
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
@@ -401,14 +451,14 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
             is broadcast from the last rank, and every rank computes its
             own vocab shard's piece (head_fn psum-combines internally)."""
             if coop:
-                i_h = t - n_stages  # the last rank's i_b
+                i_h = t - h_off  # the last rank's i_b
                 act_h = (i_h >= 0) & (i_h < n_micro)
                 ih_c = jnp.clip(i_h, 0, n_micro - 1)
                 h_in = jax.lax.psum(
                     jnp.where(is_last, y2, jnp.zeros_like(y2)), axis)
                 lb_mb = micro_lb[ih_c]
             else:
-                i_b = t - (2 * n_stages - 1 - sid)
+                i_b = t - b_off
                 act_h = (i_b >= 0) & (i_b < n_micro)
                 ih_c = jnp.clip(i_b, 0, n_micro - 1)
                 h_in = y2
@@ -423,13 +473,19 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
             return loss_i, dhp_i, dy_head, act_h
 
         def tick(carry, t):
-            fwd_bnd, bwd_bnd, in_buf, dy_buf, dp, dhp, dx_buf, loss = carry
+            if eager:
+                (fwd_bnd, fwd_rdy, bwd_bnd, bwd_rdy, in_buf, dy_buf, dp,
+                 dhp, dx_buf, loss) = carry
+            else:
+                fwd_bnd, bwd_bnd, in_buf, dy_buf, dp, dhp, dx_buf, \
+                    loss = carry
+                fwd_rdy, bwd_rdy = fwd_bnd, bwd_bnd
 
             # ---- forward slot: stage `sid` forwards microbatch i_f ----
-            i_f = t - sid
+            i_f = t - f_off
             act_f = (i_f >= 0) & (i_f < n_micro)
             if_c = jnp.clip(i_f, 0, n_micro - 1)
-            x_in = jnp.where(is_first, micro_x[if_c], fwd_bnd)
+            x_in = jnp.where(is_first, micro_x[if_c], fwd_rdy)
             y = stage_fn(p_stage, x_in)
             y = jnp.where(act_f, y, jnp.zeros_like(y))
             slot_f = if_c % buf_n
@@ -437,7 +493,7 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
                 jnp.where(act_f, x_in, in_buf[slot_f]))
 
             # ---- backward slot: stage `sid` backwards microbatch i_b ----
-            i_b = t - (2 * n_stages - 1 - sid)
+            i_b = t - b_off
             act_b = (i_b >= 0) & (i_b < n_micro)
             ib_c = jnp.clip(i_b, 0, n_micro - 1)
             x_sv = in_buf[ib_c % buf_n]
@@ -449,8 +505,8 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
             else:
                 y2, vjp_stage = jax.vjp(stage_fn, p_stage, x_sv)
             loss_i, dhp_i, dy_head, act_h = run_head(head_p, y2, t)
-            dy_in = jnp.where(is_last, dy_head.astype(bwd_bnd.dtype),
-                              bwd_bnd)
+            dy_in = jnp.where(is_last, dy_head.astype(bwd_rdy.dtype),
+                              bwd_rdy)
             if defer_weight_grads:
                 (dx,) = vjp_x(dy_in)
                 dy_buf = dy_buf.at[ib_c].set(
@@ -469,10 +525,15 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
                           dx_buf[ib_c]))
 
             # ---- boundary exchange for the next tick ----
-            fwd_bnd = jax.lax.ppermute(y, axis, fwd_perm)
-            bwd_bnd = jax.lax.ppermute(
+            fwd_new = jax.lax.ppermute(y, axis, fwd_perm)
+            bwd_new = jax.lax.ppermute(
                 jnp.where(act_b, dx, jnp.zeros_like(dx)), axis, bwd_perm)
-            return (fwd_bnd, bwd_bnd, in_buf, dy_buf, dp, dhp, dx_buf,
+            if eager:
+                # received boundaries rest one tick before consumption —
+                # the slack XLA overlaps the collective-permute into
+                return (fwd_new, fwd_bnd, bwd_new, bwd_bnd, in_buf,
+                        dy_buf, dp, dhp, dx_buf, loss), None
+            return (fwd_new, bwd_new, in_buf, dy_buf, dp, dhp, dx_buf,
                     loss), None
 
         act_shape = (mb,) + xg.shape[1:]
@@ -480,7 +541,11 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
         dy_slots = buf_n if defer_weight_grads else 1  # 1: placeholder
         carry0 = (
             vary(jnp.zeros(act_shape, xg.dtype)),               # fwd_bnd
+            *((vary(jnp.zeros(act_shape, xg.dtype)),)           # fwd_rdy
+              if eager else ()),
             vary(jnp.zeros(act_shape, xg.dtype)),               # bwd_bnd
+            *((vary(jnp.zeros(act_shape, xg.dtype)),)           # bwd_rdy
+              if eager else ()),
             vary(jnp.zeros((buf_n,) + act_shape, xg.dtype)),    # in_buf
             vary(jnp.zeros((dy_slots,) + act_shape, xg.dtype)),  # dy_buf
             # ZBH1 computes dp post-scan: don't carry a param-sized zero
@@ -493,7 +558,7 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
             vary(jnp.zeros((), jnp.float32)),                   # loss
         )
         carry, _ = jax.lax.scan(tick, carry0, jnp.arange(t_total))
-        _, _, in_buf, dy_buf, dp, dhp, dx_buf, loss = carry
+        in_buf, dy_buf, dp, dhp, dx_buf, loss = carry[-6:]
         if defer_weight_grads:
             # ZBH1 W pass: all microbatches' weight grads in ONE batched
             # vjp (recompute-forward per microbatch, like the in-tick

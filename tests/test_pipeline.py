@@ -369,7 +369,8 @@ class TestSchedulesRound3:
             serial.append(float(l))
         mesh = build_mesh({"pp": 4, "dp": 2})
         set_global_mesh(mesh)
-        for sched, kw in (("ZBH1", {}), ("VPP", {"vpp_degree": 2})):
+        for sched, kw in (("ZBH1", {}), ("Eager1F1B", {}),
+                          ("VPP", {"vpp_degree": 2})):
             paddle.seed(21)
             m = LlamaForCausalLM(cfg)
             st, p, o = make_llama_pp_train_step(
@@ -380,6 +381,89 @@ class TestSchedulesRound3:
                 losses.append(float(l))
             np.testing.assert_allclose(losses, serial, atol=3e-3,
                                        err_msg=sched)
+
+    def test_eager_1f1b_grads_match_serial(self):
+        """pipeline_eager_1f1b's slack schedule must reproduce plain
+        autodiff gradients exactly (reference bar: the eager-1F1B pass,
+        pipeline_scheduler_pass/pipeline_eager_1f1b.py:31, runs the same
+        math as 1F1B in a different job order)."""
+        from paddle_tpu.parallel.pipeline_spmd import pipeline_eager_1f1b
+
+        S, M, mb, d = 4, 6, 2, 8
+        rng = np.random.default_rng(7)
+        stacked = {"w": jnp.asarray(rng.normal(size=(S, d, d), scale=0.4),
+                                    jnp.float32)}
+        head = {"u": jnp.asarray(rng.normal(size=(d, 3), scale=0.4),
+                                 jnp.float32)}
+        x = jnp.asarray(rng.normal(size=(M * mb, d)), jnp.float32)
+        lb = jnp.asarray(rng.normal(size=(M * mb, 3)), jnp.float32)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        def head_fn(hp, h, y):
+            return jnp.mean((h @ hp["u"] - y) ** 2)
+
+        mesh = build_mesh({"dp": 2, "pp": S, "mp": 1})
+        set_global_mesh(mesh)
+        loss_m, d_st, d_hp, d_x = jax.jit(
+            lambda a, b, c, e: pipeline_eager_1f1b(
+                stage_fn, head_fn, a, b, c, e, mesh=mesh,
+                n_micro=M))(stacked, head, x, lb)
+
+        def serial(stacked, head, x, lb):
+            h = x
+            for s in range(S):
+                h = stage_fn(jax.tree.map(lambda t, s=s: t[s], stacked), h)
+            return head_fn(head, h, lb)
+
+        loss_s, (d_st_s, d_hp_s, d_x_s) = jax.jit(jax.value_and_grad(
+            serial, argnums=(0, 1, 2)))(stacked, head, x, lb)
+        np.testing.assert_allclose(float(loss_m), float(loss_s), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(d_st["w"]),
+                                   np.asarray(d_st_s["w"]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(d_hp["u"]),
+                                   np.asarray(d_hp_s["u"]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_x_s),
+                                   atol=1e-6)
+
+    def test_eager_1f1b_memory_relation_and_pass(self):
+        """Eager1F1B buys comm slack with activation memory: its input
+        buffer is strictly larger than 1F1B's (min(n_micro, 4S-3) vs 2S
+        slots — the reference relation: eager holds more in-flight
+        microbatches), asserted on compiled peak temp memory; and the
+        registered pipeline_scheduler_Eager1F1B pass drives the step."""
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama_pipe import make_llama_pp_train_step
+
+        cfg = LlamaConfig.tiny()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, cfg.vocab_size, (16, 32)))
+        y = jnp.asarray(rng.integers(0, cfg.vocab_size, (16, 32)))
+        results = {}
+        for sched in ("1F1B", "Eager1F1B"):
+            mesh = build_mesh({"dp": 2, "pp": 2, "mp": 2})
+            set_global_mesh(mesh)
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            step, p, o = make_llama_pp_train_step(
+                model, mesh, n_micro=8, lr=1e-3, schedule=sched)
+            loss, p2, o2 = step(p, o, x, y)
+            temp = step.lower(p, o, x, y).compile() \
+                .memory_analysis().temp_size_in_bytes
+            results[sched] = (float(loss), temp)
+            set_global_mesh(None)
+        np.testing.assert_allclose(results["1F1B"][0],
+                                   results["Eager1F1B"][0], atol=1e-4)
+        assert results["Eager1F1B"][1] >= results["1F1B"][1], (
+            "eager should hold at least as many in-flight activations: "
+            f"{results}")
+        # the scheduler pass selects the eager schedule
+        config = {}
+        PassManager([new_pass("pipeline_scheduler_Eager1F1B",
+                              {"accumulate_steps": 4})]).apply(config)
+        assert config["pipeline"]["schedule_mode"] == "Eager1F1B"
 
     def test_coop_head_matches_and_shrinks_head_cost(self):
         """The cooperative vocab-parallel head (VERDICT item 2): numerics
@@ -412,6 +496,73 @@ class TestSchedulesRound3:
         # replicated head pays ~pp x head FLOPs each tick; cooperative
         # must compile to clearly fewer total FLOPs
         assert results[True][1] < results[False][1] * 0.75, results
+
+    def test_timeline_visualizer_matches_analytic_model(self):
+        """pipeline_viz renders every schedule's tick occupancy; bubble
+        and in-flight accounting must match the analytic schedule model
+        (round-4 VERDICT item 10; reference:
+        fleet/meta_parallel/pp_utils/profiler_helper.py)."""
+        import json as _json
+        import tempfile
+
+        from paddle_tpu.parallel.pipeline_viz import (
+            pipeline_timeline, render_timeline, save_chrome_trace,
+            timeline_stats)
+
+        S, M, V = 4, 16, 2
+
+        # FThenB: 2(S-1) bubble ticks/rank, peak in-flight = M (GPipe)
+        st = timeline_stats(pipeline_timeline("FThenB", S, M))
+        assert st["total_ticks"] == 2 * (M + S - 1)
+        for pr in st["per_rank"]:
+            assert (pr["F"], pr["B"]) == (M, M)
+            assert pr["bubbles"] == 2 * (S - 1)
+            assert pr["peak_in_flight"] == M
+
+        # 1F1B: same tick count as the scan (M + 2S - 1); in-flight
+        # bounded by the schedule, not M
+        st1 = timeline_stats(pipeline_timeline("1F1B", S, M))
+        assert st1["total_ticks"] == M + 2 * S - 1
+        for r, pr in enumerate(st1["per_rank"]):
+            assert (pr["F"], pr["B"]) == (M, M)
+            assert pr["peak_in_flight"] == min(M, 2 * (S - r) - 1 + 1)
+            assert pr["peak_in_flight"] < M  # the 1F1B memory win
+
+        # Eager1F1B: more ticks (comm slack) and MORE in-flight than 1F1B
+        ste = timeline_stats(pipeline_timeline("Eager1F1B", S, M))
+        assert ste["total_ticks"] == M + 4 * S - 4
+        for r, pr in enumerate(ste["per_rank"]):
+            assert pr["peak_in_flight"] == min(M, 4 * (S - 1 - r) + 1)
+        assert ste["per_rank"][0]["peak_in_flight"] > \
+            st1["per_rank"][0]["peak_in_flight"]
+
+        # ZBH1: 1F1B ticks + exactly one batched W pass per rank
+        stz = timeline_stats(pipeline_timeline("ZBH1", S, M))
+        assert stz["total_ticks"] == M + 2 * S - 1 + 1
+        for pr in stz["per_rank"]:
+            assert pr["W"] == 1
+
+        # VPP: every mb passes V chunks per rank; the 2(S-1) bubbles are
+        # CHUNK ticks — 1/V of a stage tick, the interleaving win
+        stv = timeline_stats(pipeline_timeline("VPP", S, M, vpp_degree=V))
+        assert stv["total_ticks"] == 2 * (M * V + S - 1)
+        for pr in stv["per_rank"]:
+            assert (pr["F"], pr["B"]) == (M * V, M * V)
+            assert pr["bubbles"] == 2 * (S - 1)
+
+        # rendering covers every schedule; chrome trace is valid JSON
+        for sched in ("FThenB", "1F1B", "Eager1F1B", "VPP", "ZBH1"):
+            tl = pipeline_timeline(sched, S, 8, vpp_degree=V)
+            txt = render_timeline(tl)
+            assert txt.count("rank ") == S and sched in txt
+            with tempfile.NamedTemporaryFile(suffix=".json",
+                                             mode="r+") as f:
+                save_chrome_trace(tl, f.name)
+                f.seek(0)
+                trace = _json.load(f)
+            names = {e["name"] for e in trace["traceEvents"]}
+            assert "F0" in names
+            assert any(n.startswith("B") for n in names)
 
     def test_chunked_state_split_merge_roundtrip(self):
         """chunk_llama_state / merge_llama_chunked_state must be exact
