@@ -358,6 +358,20 @@ def main():
 
     regressions = []
     if on_tpu:
+        # silicon numerics gate: the Pallas kernels are asserted against
+        # on-device fp32 oracles every bench run (tpu_smoke.py; reference:
+        # op_test.py check_output_with_place on CUDAPlace). A numerics
+        # failure rides the same driver-parsed field as a perf regression.
+        try:
+            from tpu_smoke import run_smoke
+
+            regressions += [f"tpu_smoke: {f}" for f in run_smoke()]
+        except Exception as e:
+            import sys
+
+            print(f"tpu_smoke could not run: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            regressions.append(f"tpu_smoke_failed: {type(e).__name__}: {e}")
         # per-op regression gate: unacknowledged >10% regressions go into
         # the driver-parsed JSON line AND fail the process (round-2's
         # warn-only gate could be ignored; this one cannot)

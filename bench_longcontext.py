@@ -1,0 +1,112 @@
+"""Long-context benchmark: seq 8192 train step + attention kernel on one
+chip (SURVEY.md §5.7 — the axis this rebuild is chartered to leapfrog).
+
+Usage: python bench_longcontext.py [bs ...]   (default bs 1 2)
+
+Prints one JSON line per config:
+- full train step (fwd+bwd+AdamW, per-layer remat) tok/s + MFU at
+  seq 8192 on the 1B-class GQA config;
+- the attention kernel's own TF/s at the 8k shape (fwd and fwd+bwd,
+  splash GQA fast path), so the attention share of the step is explicit.
+
+The multi-chip ring-attention path (parallel/ring_attention.py) cannot
+be wall-clocked on one chip — its numerics at the 8k shape are asserted
+on the virtual CPU mesh in tests/test_ring_attention.py; the single-chip
+8k attention below is the splash kernel the ring degenerates to at
+sep=1.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0]).ravel()[0]
+
+
+def attn_kernel_8k(bs: int):
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    S, HQ, HK, D = 8192, 16, 4, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(bs, S, HQ, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(bs, S, HK, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(bs, S, HK, D)), jnp.bfloat16)
+
+    fwd = jax.jit(lambda a: jnp.sum(
+        flash_attention(a, k, v, causal=True).astype(jnp.float32)))
+    bwd = jax.jit(jax.grad(lambda a: jnp.sum(
+        flash_attention(a, k, v, causal=True).astype(jnp.float32))))
+
+    out = {}
+    for name, fn, mult in (("fwd", fwd, 1.0), ("fwd+bwd", bwd, 3.5)):
+        sync(fn(q))
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            sync(fn(q))
+            best = min(best, time.perf_counter() - t0)
+        # causal flash FLOPs: 0.5 * 4 * B * S^2 * Hq * D per fwd
+        flops = 0.5 * 4 * bs * S * S * HQ * D * mult
+        out[name] = {"ms": round(best * 1e3, 2),
+                     "tf_s": round(flops / best / 1e12, 1)}
+    return out
+
+
+def train_step_8k(bs: int):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import make_train_step
+
+    seq = 8192
+    cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=True,
+                               num_key_value_heads=4,
+                               max_position_embeddings=seq)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion(cfg)
+
+    def _decay(name):
+        return "norm" not in name and not name.endswith(".b_0")
+
+    optimizer = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                      apply_decay_param_fun=_decay,
+                      parameters=model.parameters())
+    step, params, opt = make_train_step(
+        model, lambda lg, lb: crit(lg, lb), None, optimizer=optimizer)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, seq)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, seq)))
+    loss, params, opt = step(params, opt, x, y)
+    float(loss)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt = step(params, opt, x, y)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tok_s = bs * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # 6NF weight FLOPs + causal attention FLOPs (12*L*S^2*Hq*D per seq
+    # fwd+bwd-with-remat ~ 4*3.5/2... keep the same 6N convention as
+    # bench.py and report attention share separately)
+    mfu = tok_s * 6 * n_params / 197e12
+    return {"ms_step": round(dt * 1e3, 1), "tok_s": round(tok_s, 1),
+            "mfu_6N": round(mfu, 3), "loss": round(float(loss), 3)}
+
+
+if __name__ == "__main__":
+    sizes = [int(a) for a in sys.argv[1:]] or [1, 2]
+    for bs in sizes:
+        row = {"config": f"1b_gqa_seq8192_bs{bs}",
+               "attention": attn_kernel_8k(bs),
+               "train": train_step_8k(bs)}
+        print(json.dumps(row), flush=True)

@@ -58,7 +58,11 @@ def int4_matmul(x, w_packed, scale, *, block_n: int = 512,
     n = w_packed.shape[0]
     bn = min(block_n, n)
     aligned = (n % bn == 0) and (k % 2 == 0) and (w_packed.shape[1] * 2 == k)
-    if not aligned:
+    # the kernel is decode-shaped: all of x + a dequant tile must fit
+    # scoped VMEM (~16 MB). Large-M calls (prefill through the same _mm)
+    # are compute-bound, where the XLA shift form is the right tool —
+    # measured VMEM OOM at M=512, K=5504 without this route.
+    if not aligned or m > 64:
         return _xla_fallback(x, w_packed, scale)
     on_tpu = jax.default_backend() == "tpu"
     if dot_dtype is None:

@@ -721,7 +721,7 @@ def build_quant_generate(cfg, b, sb, max_new, max_seq=None,
         h_last = jax.lax.dynamic_index_in_dim(h, s0 - 1, axis=1,
                                               keepdims=True)
         last_logits = head_logits(h_last, p_dec)[:, -1]
-        return _decode_tail(decode_step, head_logits, p_dec, kcs, vcs,
+        return _decode_tail(decode_step, p_dec, kcs, vcs,
                             last_logits, s0, key, temperature, top_p,
                             ids.dtype, max_new, eos_token_id, do_sample,
                             top_k, b)
@@ -859,45 +859,20 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
         return ctx.reshape(b, nh, dh).astype(q1.dtype)
 
     def make_decode_step(tables):
-        def decode_step(p, kcs, vcs, tok, lens):
-            """lens [b] int32 per-sequence positions (ragged batch)."""
-            h = p["llama.embed_tokens.weight"][tok[:, 0]][:, None, :]
-            bidx = jnp.arange(b)
-            page = tables[bidx, lens // block_size]
+        """The shared per-layer decode body (_make_decode_step) with the
+        KV store swapped for page/slot scatter + table-indirect attention;
+        `pos` is the per-sequence [b] length vector (ragged batch)."""
+        def kv_write(kc, vc, k, v, lens):
+            page = tables[jnp.arange(b), lens // block_size]
             slot = lens % block_size
-            new_kcs, new_vcs = [], []
-            for i in range(n_layers):
-                pre = f"llama.layers.{i}."
-                x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
-                q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
-                    b, 1, nh, dh)
-                k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
-                    b, 1, nkv, dh)
-                v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
-                    b, 1, nkv, dh)
-                # per-sequence rotary position = its own length (the
-                # [b, 1] position_ids broadcast per-example through
-                # rope_freqs -> _rotate_neox)
-                q, k = apply_rotary_emb(q, k, position_ids=lens[:, None],
-                                        base=cfg.rope_theta)
-                kc = kcs[i].at[page, :, slot, :].set(
-                    k[:, 0].astype(kcs[i].dtype))
-                vc = vcs[i].at[page, :, slot, :].set(
-                    v[:, 0].astype(vcs[i].dtype))
-                new_kcs.append(kc)
-                new_vcs.append(vc)
-                ctx = paged_attn(q[:, 0], kc, vc, tables, lens)
-                h = h + _mm(ctx.reshape(b, 1, nh * dh),
-                            p[pre + "self_attn.o_proj.weight"])
-                x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"],
-                            eps)
-                gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
-                up = _mm(x2, p[pre + "mlp.up_proj.weight"])
-                h = h + _mm(jax.nn.silu(gate) * up,
-                            p[pre + "mlp.down_proj.weight"])
-            h = _k_rms(h, p["llama.norm.weight"], eps)
-            return head_logits(h, p)[:, -1], new_kcs, new_vcs
-        return decode_step
+            return (kc.at[page, :, slot, :].set(k[:, 0].astype(kc.dtype)),
+                    vc.at[page, :, slot, :].set(v[:, 0].astype(vc.dtype)))
+
+        def kv_attend(q1, kc, vc, lens):
+            return paged_attn(q1, kc, vc, tables, lens)
+
+        return _make_decode_step(cfg, b, kv_write=kv_write,
+                                 kv_attend=kv_attend)
 
     def run(p_dec, ids, s0_vec, tables, key, temperature, top_p):
         dtype = p_dec["llama.embed_tokens.weight"].dtype
@@ -911,7 +886,7 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
         last_logits = head_logits(h_last, p_dec)[:, -1]
         kcs = [kv[0] for kv in pools]
         vcs = [kv[1] for kv in pools]
-        return _decode_tail(make_decode_step(tables), head_logits, p_dec,
+        return _decode_tail(make_decode_step(tables), p_dec,
                             kcs, vcs, last_logits, s0_vec, key,
                             temperature, top_p, ids.dtype, max_new,
                             eos_token_id, do_sample, top_k, b)
@@ -968,7 +943,7 @@ def init_quant_serving_params(cfg, quant, seed: int = 0,
     return p
 
 
-def _decode_tail(decode_step, head_logits, p_dec, kcs, vcs, last_logits,
+def _decode_tail(decode_step, p_dec, kcs, vcs, last_logits,
                  s0, key, temperature, top_p, ids_dtype, max_new,
                  eos_token_id, do_sample, top_k, b):
     """Shared post-prefill decode loop: sample the first token from the
@@ -1001,11 +976,18 @@ def _decode_tail(decode_step, head_logits, p_dec, kcs, vcs, last_logits,
     return jnp.concatenate(pieces, axis=1).astype(ids_dtype)
 
 
-def _make_decode_step(cfg, b, max_seq):
-    """Single-token decode step over contiguous [B, Hkv, max_seq, D]
-    caches with grouped-GQA attention (the masked_multihead_attention
-    math) — shared by the fp and quant-only generation programs. The
-    decode head computes logits via `head_logits` at the call site."""
+def _make_decode_step(cfg, b, max_seq=None, kv_write=None, kv_attend=None):
+    """Single-token decode step — the per-layer transformer math shared
+    by EVERY generation program (fp, quant-only, paged); only the KV
+    store differs, injected via two callbacks:
+
+      kv_write(kc, vc, k, v, pos)  -> (kc, vc)   store the token's K/V
+          (k/v [B, 1, Hkv, D]; pos scalar or [B] vector of cached counts)
+      kv_attend(q1, kc, vc, pos)   -> ctx [B, Hq, D]
+
+    Defaults (both None, requires max_seq): contiguous [B, Hkv, max_seq,
+    D] caches with the grouped masked softmax — the
+    masked_multihead_attention math."""
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     group = nh // nkv
@@ -1013,11 +995,37 @@ def _make_decode_step(cfg, b, max_seq):
     eps = cfg.rms_norm_eps
     head_logits = _make_head_logits(cfg)
 
+    if kv_write is None:
+        def kv_write(kc, vc, k, v, pos):
+            kc = jax.lax.dynamic_update_slice(
+                kc, jnp.swapaxes(k, 1, 2).astype(kc.dtype), (0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, jnp.swapaxes(v, 1, 2).astype(vc.dtype), (0, 0, pos, 0))
+            return kc, vc
+
+    if kv_attend is None:
+        def kv_attend(q1, kc, vc, pos):
+            # grouped-GQA decode attention: one masked pass over the cache
+            qg = q1.reshape(b, nkv, group, dh)
+            logits = jnp.einsum(
+                "bkgd,bksd->bkgs", qg.astype(jnp.float32),
+                kc.astype(jnp.float32)) / math.sqrt(dh)
+            valid = jnp.arange(max_seq)[None, None, None, :] <= pos
+            logits = jnp.where(valid, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("bkgs,bksd->bkgd", probs,
+                             vc.astype(jnp.float32))
+            return ctx.reshape(b, nh, dh).astype(q1.dtype)
+
     def decode_step(p, kcs, vcs, tok, pos):
-        """tok [B, 1] int32; pos scalar int32 (tokens already cached)."""
+        """tok [B, 1] int32; pos: tokens already cached — a traced scalar
+        (contiguous) or a per-sequence [B] vector (paged ragged batch;
+        the [B, 1] position_ids broadcast per-example through the rope
+        tables)."""
         # the embedding stays dense (it's a gather, not a matmul)
         h = p["llama.embed_tokens.weight"][tok[:, 0]][:, None, :]
-        pos_ids = jnp.reshape(pos, (1,))
+        pos_ids = pos[:, None] if getattr(pos, "ndim", 0) == 1 \
+            else jnp.reshape(pos, (1,))
         new_kcs, new_vcs = [], []
         for i in range(n_layers):
             pre = f"llama.layers.{i}."
@@ -1030,26 +1038,12 @@ def _make_decode_step(cfg, b, max_seq):
                 b, 1, nkv, dh)
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
-            kc = jax.lax.dynamic_update_slice(
-                kcs[i], jnp.swapaxes(k, 1, 2).astype(kcs[i].dtype),
-                (0, 0, pos, 0))
-            vc = jax.lax.dynamic_update_slice(
-                vcs[i], jnp.swapaxes(v, 1, 2).astype(vcs[i].dtype),
-                (0, 0, pos, 0))
+            kc, vc = kv_write(kcs[i], vcs[i], k, v, pos)
             new_kcs.append(kc)
             new_vcs.append(vc)
-            # grouped-GQA decode attention: one masked pass over the cache
-            qg = q[:, 0].reshape(b, nkv, group, dh)
-            logits = jnp.einsum(
-                "bkgd,bksd->bkgs", qg.astype(jnp.float32),
-                kc.astype(jnp.float32)) / math.sqrt(dh)
-            valid = jnp.arange(max_seq)[None, None, None, :] <= pos
-            logits = jnp.where(valid, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            ctx = jnp.einsum("bkgs,bksd->bkgd", probs,
-                             vc.astype(jnp.float32))
-            ctx = ctx.reshape(b, 1, nh * dh).astype(h.dtype)
-            h = h + _mm(ctx, p[pre + "self_attn.o_proj.weight"])
+            ctx = kv_attend(q[:, 0], kc, vc, pos)
+            h = h + _mm(ctx.reshape(b, 1, nh * dh),
+                        p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
             gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
             up = _mm(x2, p[pre + "mlp.up_proj.weight"])
@@ -1092,7 +1086,7 @@ def _build_jit_generate(model, cfg, b, sb, max_new, max_seq, eos_token_id,
         # logits at the TRUE last prompt position, not the padded end
         last_logits = jax.lax.dynamic_index_in_dim(
             logits, s0 - 1, axis=1, keepdims=False)
-        return _decode_tail(decode_step, head_logits, p_dec, kcs, vcs,
+        return _decode_tail(decode_step, p_dec, kcs, vcs,
                             last_logits, s0, key, temperature, top_p,
                             ids.dtype, max_new, eos_token_id, do_sample,
                             top_k, b)

@@ -1,0 +1,36 @@
+"""Opt-in silicon test wrapper around tpu_smoke.run_smoke.
+
+The CPU conftest forces JAX onto the virtual 8-device CPU mesh, so these
+tests SKIP under the normal suite. On a machine with the real chip run:
+
+    PADDLE_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_smoke.py -p no:cacheprovider --noconftest
+
+(--noconftest so the CPU override doesn't apply), or simply
+`python tpu_smoke.py`. bench.py also runs the suite on every TPU bench,
+so each round's BENCH artifact implies these assertions passed.
+
+Reference: test/legacy_test/op_test.py:2119 check_output_with_place.
+"""
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import tpu_smoke  # noqa: E402
+
+_on_silicon = (os.environ.get("PADDLE_TPU_RUN_TPU_TESTS") == "1"
+               and jax.default_backend() == "tpu")
+
+
+@pytest.mark.parametrize("name,check", tpu_smoke.CHECKS,
+                         ids=[n for n, _ in tpu_smoke.CHECKS])
+@pytest.mark.skipif(not _on_silicon,
+                    reason="opt-in: PADDLE_TPU_RUN_TPU_TESTS=1 + real TPU "
+                           "(run with --noconftest; bench.py runs this "
+                           "suite on every TPU bench)")
+def test_tpu_smoke(name, check):
+    msg = check()
+    assert msg is None, msg
