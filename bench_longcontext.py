@@ -31,6 +31,11 @@ def sync(x):
 
 
 def attn_kernel_8k(bs: int):
+    """Loop-slope timing with IN-DEVICE scalar reduction: a single timed
+    call at this scale measures the tunnel (~80 ms RTT; a returned
+    gradient array is ~33 MB over a ~15 MB/s link ≈ 2.4 s — the round-4
+    first-draft numbers were exactly that artifact). The fori_loop body
+    perturbs q by the carry so XLA cannot hoist it."""
     from paddle_tpu.kernels.flash_attention import flash_attention
 
     S, HQ, HK, D = 8192, 16, 4, 128
@@ -39,23 +44,41 @@ def attn_kernel_8k(bs: int):
     k = jnp.asarray(rng.normal(size=(bs, S, HK, D)), jnp.bfloat16)
     v = jnp.asarray(rng.normal(size=(bs, S, HK, D)), jnp.bfloat16)
 
-    fwd = jax.jit(lambda a: jnp.sum(
-        flash_attention(a, k, v, causal=True).astype(jnp.float32)))
-    bwd = jax.jit(jax.grad(lambda a: jnp.sum(
-        flash_attention(a, k, v, causal=True).astype(jnp.float32))))
+    def loss(a):
+        return jnp.sum(flash_attention(a, k, v,
+                                       causal=True).astype(jnp.float32))
+
+    grad = jax.grad(loss)
+
+    def timed(fn):
+        @jax.jit
+        def run(n, xx):
+            def body(i, acc):
+                return fn(xx + (acc * 1e-9).astype(xx.dtype))
+            return jax.lax.fori_loop(0, n, body,
+                                     jnp.zeros((), jnp.float32))
+        lo, hi = 2, 62   # ~120+ ms of signal even at bs1
+        float(run(lo, q)); float(run(hi, q))
+        slopes = []
+        for _ in range(6):
+            t0 = time.perf_counter(); float(run(lo, q))
+            tl = time.perf_counter() - t0
+            t0 = time.perf_counter(); float(run(hi, q))
+            th = time.perf_counter() - t0
+            slopes.append(max(th - tl, 0.0) / (hi - lo))
+        slopes.sort()
+        return (slopes[2] + slopes[3]) / 2
 
     out = {}
-    for name, fn, mult in (("fwd", fwd, 1.0), ("fwd+bwd", bwd, 3.5)):
-        sync(fn(q))
-        best = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            sync(fn(q))
-            best = min(best, time.perf_counter() - t0)
+    for name, fn, mult in (
+            ("fwd", loss, 1.0),
+            ("fwd+bwd", lambda a: jnp.sum(grad(a).astype(jnp.float32)),
+             3.5)):
+        t = timed(fn)
         # causal flash FLOPs: 0.5 * 4 * B * S^2 * Hq * D per fwd
         flops = 0.5 * 4 * bs * S * S * HQ * D * mult
-        out[name] = {"ms": round(best * 1e3, 2),
-                     "tf_s": round(flops / best / 1e12, 1)}
+        out[name] = {"ms": round(t * 1e3, 2),
+                     "tf_s": round(flops / t / 1e12, 1)}
     return out
 
 

@@ -63,6 +63,19 @@ class LlamaConfig:
     # "dots_saveable" / "dots_with_no_batch_dims_saveable" save matmul
     # outputs (jax.checkpoint_policies; measured: OOM at the bench config)
     remat_policy: str = "none"
+    # remat granularity (reference: fleet/recompute/recompute.py:109 is
+    # op-level, not layer-level): "layer" wraps the whole decoder layer;
+    # "attn" / "mlp" checkpoint only that sub-block — the attn ("mlp")
+    # path's activations are saved and only the other block recomputes,
+    # a finer memory/FLOPs point than whole-layer skip counts
+    remat_scope: str = "layer"
+    # MLP via the fused Pallas swiglu kernel (kernels/swiglu.py): ~18%
+    # slower per-op than XLA's dual-matmul at the bench shape, but its
+    # custom vjp recomputes per-tile, so the two [B,S,F] gate/up
+    # intermediates are never saved — an activation-memory lever that
+    # can buy whole no-remat layers (single-chip knob: the pallas call
+    # has no SPMD partition rule)
+    fused_swiglu: bool = False
     # attention over the sep axis: "ulysses" (all-to-all seq->head reshard)
     # or "ring" (ring attention — k/v rotate with ppermute, exact blockwise
     # softmax; the long-context leapfrog the reference lacks)
@@ -263,12 +276,21 @@ class LlamaAttention(Layer):
 class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
+        self._fused = config.fused_swiglu
         h, i = config.hidden_size, config.intermediate_size
         self.gate_proj = Linear(h, i, bias_attr=False)
         self.up_proj = Linear(h, i, bias_attr=False)
         self.down_proj = Linear(i, h, bias_attr=False)
 
     def forward(self, x):
+        if self._fused:
+            from ..kernels.swiglu import swiglu_matmul
+
+            act = dispatch(
+                "fused_swiglu",
+                lambda a, g, u: swiglu_matmul(a, g, u, fused=True),
+                (x, self.gate_proj.weight, self.up_proj.weight))
+            return self.down_proj(act)
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
@@ -281,18 +303,34 @@ class LlamaDecoderLayer(Layer):
         self.input_layernorm = LlamaRMSNorm(config)
         self.post_attention_layernorm = LlamaRMSNorm(config)
 
-    def forward(self, hidden, cos, sin, cache=None, mesh=None):
+    def forward(self, hidden, cos, sin, cache=None, mesh=None, remat=None):
+        """remat: None, or "attn"/"mlp" — checkpoint ONLY that sub-block
+        (sub-layer recompute granularity; the reference's recompute is
+        op-level too, fleet/recompute/recompute.py:109)."""
         residual = hidden
         h = self.input_layernorm(hidden)
         if cache is not None:
             attn, new_cache = self.self_attn(h, cos, sin, cache=cache, mesh=mesh)
         else:
-            attn = self.self_attn(h, cos, sin, mesh=mesh)
             new_cache = None
+            if remat == "attn":
+                def attn_fn(h_):
+                    return unwrap(self.self_attn(Tensor(h_), cos, sin,
+                                                 mesh=mesh))
+
+                attn = Tensor(jax.checkpoint(attn_fn)(unwrap(h)))
+            else:
+                attn = self.self_attn(h, cos, sin, mesh=mesh)
         hidden = residual + attn
         residual = hidden
         h = self.post_attention_layernorm(hidden)
-        hidden = residual + self.mlp(h)
+        if remat == "mlp" and cache is None:
+            def mlp_fn(h_):
+                return unwrap(self.mlp(Tensor(h_)))
+
+            hidden = residual + Tensor(jax.checkpoint(mlp_fn)(unwrap(h)))
+        else:
+            hidden = residual + self.mlp(h)
         hidden = _constrain(hidden, mesh, BATCH_AXES, SEQ_AXIS, None)
         if cache is not None:
             return hidden, new_cache
@@ -329,6 +367,13 @@ class LlamaModel(Layer):
                 new_caches.append(c)
             elif use_ckpt and li < len(self.layers) - \
                     self.config.recompute_skip:
+                if self.config.remat_scope in ("attn", "mlp"):
+                    # sub-layer granularity: the layer itself wraps just
+                    # that block; no outer whole-layer checkpoint
+                    hidden = layer(hidden, cos, sin, mesh=mesh,
+                                   remat=self.config.remat_scope)
+                    continue
+
                 def run(h, l=layer):
                     return unwrap(l(Tensor(h), cos, sin, mesh=mesh))
 

@@ -339,6 +339,38 @@ class TestLlama:
             assert toks.shape == (2, 4)
             assert (np.asarray(toks) >= 0).all()
 
+    def test_remat_scope_and_fused_swiglu_match_baseline(self):
+        """Sub-layer remat granularity (remat_scope='attn'/'mlp') and the
+        fused-swiglu MLP are numerics-preserving: same loss trajectory as
+        the plain config (round-4 VERDICT item 4 levers; reference:
+        fleet/recompute/recompute.py:109 — op-level recompute)."""
+        from paddle_tpu.models import LlamaPretrainingCriterion
+        from paddle_tpu.parallel import make_train_step
+
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(rng.integers(0, 128, (4, 32)))
+        y = jnp.asarray(rng.integers(0, 128, (4, 32)))
+
+        def losses(**over):
+            cfg = LlamaConfig.tiny(**over)
+            paddle.seed(15)
+            m = LlamaForCausalLM(cfg)
+            crit = LlamaPretrainingCriterion(cfg)
+            step, p, o = make_train_step(m, lambda lg, lb: crit(lg, lb),
+                                         None, lr=1e-3)
+            out = []
+            for _ in range(3):
+                l, p, o = step(p, o, x, y)
+                out.append(float(l))
+            return out
+
+        base = losses(recompute=True)
+        for over in ({"recompute": True, "remat_scope": "attn"},
+                     {"recompute": True, "remat_scope": "mlp"},
+                     {"recompute": True, "fused_swiglu": True}):
+            np.testing.assert_allclose(losses(**over), base, atol=2e-5,
+                                       err_msg=str(over))
+
     def test_paged_generation_matches_contiguous(self):
         """cache_layout='paged' (block tables + paged pools) must produce
         the same greedy tokens as the contiguous cache — round-4 VERDICT
