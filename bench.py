@@ -286,15 +286,16 @@ def main():
         # gate measures the grouped-attention fast path — the config class
         # that matters for real deployments. Round 3: the step runs the
         # HONEST production config — real AdamW with fp32 moments and
-        # norm/bias decay exclusion. fp32 moments cost +4.4 GB vs the
-        # round-2 fallback's silently-bf16 moments, so the last 8 of 16
-        # layers skip remat instead of all 16 (measured best fit:
-        # no-remat OOMs, skip8 21.6k > skip4 21.3k tok/s)
-        cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=True,
-                                   recompute_skip=8,
+        # norm/bias decay exclusion. Round 5 (bench_mfu.py matrix, 15
+        # configs in BASELINE.md): at bs 8 the fp32 moments force 8/16
+        # layers to remat (55.3-55.5% MFU, every deeper skip OOMs); bs 4
+        # halves the activation pool so NO layer needs remat — the full
+        # recompute FLOPs come back and MXU efficiency holds: 24.3k tok/s,
+        # 62.1% MFU, the honest-step frontier on one 16 GB chip
+        cfg = LlamaConfig.llama_1b(dtype="bfloat16", recompute=False,
                                    num_key_value_heads=4,
                                    max_position_embeddings=2048)
-        batch, seq, iters = 8, 2048, 10
+        batch, seq, iters = 4, 2048, 10
     else:  # CPU smoke config so the harness always yields a number
         cfg = LlamaConfig.tiny()
         batch, seq, iters = 4, 64, 3

@@ -40,10 +40,29 @@ MATRIX = {
     "fused_skip12": dict(recompute=True, recompute_skip=12,
                          fused_swiglu=True),
     "fused_noremat": dict(recompute=False, fused_swiglu=True),
+    # save_only_these_names("attn_out"): backward skips re-running the
+    # flash forward (the FLOPs-densest recompute share) at 64 MB/layer
+    # of saved attention outputs
+    "saveattn_all": dict(recompute=True, recompute_skip=0,
+                         remat_policy="save_attn"),
+    "saveattn_skip4": dict(recompute=True, recompute_skip=4,
+                           remat_policy="save_attn"),
+    "saveattn_skip8": dict(recompute=True, recompute_skip=8,
+                           remat_policy="save_attn"),
+    # batch axis: smaller batches shrink the activation pool, buying
+    # remat-free layers at the cost of MXU tile efficiency
+    "bs4_noremat": dict(batch=4, recompute=False),
+    "bs4_skip12": dict(batch=4, recompute=True, recompute_skip=12),
+    "bs6_noremat": dict(batch=6, recompute=False),
 }
+
+_OOM_MARKS = ("RESOURCE_EXHAUSTED", "Allocation type: HLO temp",
+              "out of memory", "exceeds the limit", "exceeds available")
 
 
 def run_config(name: str, overrides: dict, batch=8, seq=2048, iters=8):
+    overrides = dict(overrides)
+    batch = overrides.pop("batch", batch)
     import paddle_tpu as paddle
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                    LlamaPretrainingCriterion)
@@ -76,9 +95,15 @@ def run_config(name: str, overrides: dict, batch=8, seq=2048, iters=8):
         float(loss)
         dt = (time.perf_counter() - t0) / iters
     except Exception as e:
-        print(json.dumps({"config": name, "error":
-                          f"{type(e).__name__}: {str(e)[:160]}"}),
-              flush=True)
+        msg = str(e)
+        if any(m in msg for m in _OOM_MARKS):
+            # compile-time HBM OOM: the config does not fit 16 GB — a
+            # data point for the frontier, not an infrastructure failure
+            print(json.dumps({"config": name, "oom": True}), flush=True)
+        else:
+            print(json.dumps({"config": name, "error":
+                              f"{type(e).__name__}: {msg[:160]}"}),
+                  flush=True)
         return
     tok_s = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())

@@ -3,7 +3,6 @@ incubate/distributed/models/moe/grad_clip.py ClipGradForMOEByGlobalNorm):
 expert parameters' grad norms are summed across the expert-parallel group
 before forming the global norm, so clipping is consistent with the
 replicated view."""
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -33,21 +32,31 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
                         jnp.float32(0.0))
         if self._moe_group is not None and any(expert):
             axes = tuple(getattr(self._moe_group, "axes", ()))
+            nranks = int(getattr(self._moe_group, "nranks", 1))
+            if not axes and nranks > 1:
+                # psum over () is a silent no-op — a >1-rank group without
+                # mesh axes would clip with a local-only expert norm
+                raise RuntimeError(
+                    "ClipGradForMOEByGlobalNorm: moe_group has nranks="
+                    f"{nranks} but no mesh axes; the expert-norm psum "
+                    "needs the group's mesh axis names")
             try:
                 # inside the SPMD step (shard_map over the moe axis) this
                 # is the cross-expert-rank sum the reference does via NCCL
                 expert_sq = lax.psum(expert_sq, axes)
-            except Exception:
-                # not under a bound mesh axis: eager use. With one rank
-                # the local sum IS the group sum; with more, a silent
-                # local norm would diverge from the reference semantics.
-                nranks = int(getattr(self._moe_group, "nranks", 1))
-                if nranks > 1 and not isinstance(expert_sq, jax.core.Tracer):
+            except NameError:
+                # ONLY the unbound-axis case ("unbound axis name: ...") is
+                # survivable: eager use outside shard_map. Any other psum
+                # failure (misnamed axis vs the mesh, bad group wiring)
+                # must surface — swallowing it would silently clip with a
+                # local-only expert norm at nranks > 1.
+                if nranks > 1:
                     raise RuntimeError(
                         "ClipGradForMOEByGlobalNorm with a >1-rank "
-                        "moe_group must run inside the SPMD step (where "
-                        "the expert-norm psum can execute); the eager "
-                        "path would compute a local-only norm.")
+                        "moe_group must run inside the SPMD step with the "
+                        f"moe axes {axes!r} bound (shard_map over the moe "
+                        "mesh axis); the eager path would compute a "
+                        "local-only expert norm.")
         total = jnp.sqrt(normal_sq + expert_sq)
         scale = jnp.minimum(self.clip_norm / (total + 1e-6), 1.0)
         return [g * scale for g in grads]

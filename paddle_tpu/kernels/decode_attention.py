@@ -164,22 +164,135 @@ def _paged_decode_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
+def _paged_gqa_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, block_size: int,
+                      scale: float):
+    """Grouped-query paged decode. Grid (B, Hkv, n_blocks): each step
+    streams ONE page of ONE kv head and scores the whole query group
+    against it — the page never leaves VMEM at query-head width, which is
+    the HBM saving the jnp gather fallback forfeited (reference GQA paged
+    decode: block_attn.h with gqa_group_size)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    valid_until = len_ref[b]
+
+    @pl.when(j * block_size <= valid_until)
+    def _compute():
+        q = q_ref[0]                                   # [group, D]
+        k = k_ref[0]                                   # [block_size, D]
+        # grouped decode has real matmuls (group >= 2 rows), so the MXU
+        # does the scoring — unlike the equal-heads kernels' batched
+        # matvec, these 2-D dots lower cleanly at any D
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [group, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= valid_until, s, _NEG_INF)
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [group, D]
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nb - 1)
+    def _final():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale):
+    """Refs stay rank-3 (Mosaic cannot shape-cast 4-D blocks): q/out
+    collapse (hkv, group) into one axis indexed at h*group; the pools
+    collapse (page, hkv) so page selection becomes tbl[b, j]*hkv + h —
+    both are metadata-only row-major collapses, no data movement."""
+    b, hq, d = q.shape
+    hkv = key_cache.shape[1]
+    group = hq // hkv
+    block_size = key_cache.shape[2]
+    n_blocks = block_tables.shape[1]
+    max_pages = key_cache.shape[0]
+    # blocks must exactly span trailing array dims unless 8/128-divisible,
+    # so q/out collapse to [b*hkv, group, d] (block = one full row) and
+    # the pools to [pages*hkv, block_size, d] (block = one page x one kv
+    # head at flat row tbl[b, j]*hkv + h)
+    qg = q.reshape(b * hkv, group, d)
+    kc = key_cache.reshape(max_pages * hkv, block_size, d)
+    vc = value_cache.reshape(max_pages * hkv, block_size, d)
+    kernel = functools.partial(_paged_gqa_kernel, block_size=block_size,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, group, d),
+                             lambda b, h, j, tbl, lens, hkv=hkv:
+                             (b * hkv + h, 0, 0)),
+                pl.BlockSpec((1, block_size, d),
+                             lambda b, h, j, tbl, lens, hkv=hkv:
+                             (tbl[b, j] * hkv + h, 0, 0)),
+                pl.BlockSpec((1, block_size, d),
+                             lambda b, h, j, tbl, lens, hkv=hkv:
+                             (tbl[b, j] * hkv + h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, group, d),
+                lambda b, h, j, tbl, lens, hkv=hkv: (b * hkv + h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, group, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),
+      qg, kc, vc)
+    return out.reshape(b, hq, d)
+
+
 def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
                            value_cache: jax.Array, block_tables: jax.Array,
                            lens: jax.Array,
                            scale: float | None = None) -> jax.Array:
     """One decode step over a paged cache (reference: block_attn.h).
 
-    q: [B, H, D]; key_cache/value_cache: [max_pages, H, block_size, D];
-    block_tables: [B, n_blocks] page ids covering positions
-    [0, n_blocks*block_size); lens: [B] previous-token counts (current
-    token already written at position lens[b]). Returns [B, H, D].
+    q: [B, Hq, D]; key_cache/value_cache: [max_pages, Hkv, block_size, D]
+    with Hq a multiple of Hkv (grouped queries take the GQA grid, equal
+    heads the all-heads-per-page grid); block_tables: [B, n_blocks] page
+    ids covering positions [0, n_blocks*block_size); lens: [B]
+    previous-token counts (current token already written at position
+    lens[b]). Returns [B, Hq, D].
     """
     b, h, d = q.shape
-    block_size = key_cache.shape[2]
-    n_blocks = block_tables.shape[1]
+    hkv = key_cache.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if h != hkv:
+        if h % hkv:
+            raise ValueError(f"Hq {h} not a multiple of Hkv {hkv}")
+        return _paged_decode_gqa(q, key_cache, value_cache, block_tables,
+                                 lens, scale)
+    block_size = key_cache.shape[2]
+    n_blocks = block_tables.shape[1]
     kernel = functools.partial(_paged_decode_kernel, block_size=block_size,
                                scale=scale)
     # page selection: the k/v BlockSpec index maps read the prefetched

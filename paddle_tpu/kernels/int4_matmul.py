@@ -70,6 +70,9 @@ def int4_matmul(x, w_packed, scale, *, block_n: int = 512,
         # dots; the bf16 fast path is TPU-only
         dot_dtype = x.dtype if on_tpu and x.dtype in (
             jnp.bfloat16, jnp.float32) else jnp.float32
+    elif not on_tpu and jnp.dtype(dot_dtype) == jnp.bfloat16:
+        # same CPU limitation applies to an explicitly requested bf16
+        dot_dtype = jnp.float32
     pad_m = max(8 - m, 0)
     xp = jnp.pad(x, ((0, pad_m), (0, 0))) if pad_m else x
     # even/odd split outside the kernel (Mosaic has no strided gather);

@@ -65,9 +65,10 @@ class LlamaConfig:
     remat_policy: str = "none"
     # remat granularity (reference: fleet/recompute/recompute.py:109 is
     # op-level, not layer-level): "layer" wraps the whole decoder layer;
-    # "attn" / "mlp" checkpoint only that sub-block — the attn ("mlp")
-    # path's activations are saved and only the other block recomputes,
-    # a finer memory/FLOPs point than whole-layer skip counts
+    # "attn" / "mlp" checkpoint only the NAMED sub-block — that block's
+    # interior activations are dropped and recomputed in backward while
+    # the OTHER block's are saved — a finer memory/FLOPs point than
+    # whole-layer skip counts
     remat_scope: str = "layer"
     # MLP via the fused Pallas swiglu kernel (kernels/swiglu.py): ~18%
     # slower per-op than XLA's dual-matmul at the bench shape, but its
@@ -774,6 +775,24 @@ def build_quant_generate(cfg, b, sb, max_new, max_seq=None,
     return run
 
 
+def make_paged_kv_helpers(b, n_pre, nkv, dh, block_size, tables):
+    """The two paged-cache plumbing pieces shared by every paged program
+    (build_paged_generate and serving.engine): prefill page transpose and
+    the per-token page/slot scatter, closed over the traced block table."""
+    def to_pages(kv):
+        """[b, n_pre*block_size, nkv, dh] -> [b, n_pre, nkv, block_size, dh]"""
+        return jnp.transpose(
+            kv.reshape(b, n_pre, block_size, nkv, dh), (0, 1, 3, 2, 4))
+
+    def kv_write(kc, vc, k, v, lens):
+        page = tables[jnp.arange(b), lens // block_size]
+        slot = lens % block_size
+        return (kc.at[page, :, slot, :].set(k[:, 0].astype(kc.dtype)),
+                vc.at[page, :, slot, :].set(v[:, 0].astype(vc.dtype)))
+
+    return to_pages, kv_write
+
+
 class PagedKVManager:
     """Host-side KV page allocator for the paged generation path
     (reference: the block-table management serving engines drive above
@@ -797,7 +816,9 @@ class PagedKVManager:
         return -(-int(n_tokens) // self.block_size)
 
     def alloc(self, n_tokens: int):
-        n = self.pages_needed(n_tokens)
+        return self.alloc_pages(self.pages_needed(n_tokens))
+
+    def alloc_pages(self, n: int):
         if n > len(self._free):
             raise RuntimeError(
                 f"paged KV pool exhausted: need {n} pages, "
@@ -840,10 +861,10 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     reads its own last-position logits.
 
     Decode attention: the Pallas paged kernel
-    (kernels/decode_attention.paged_decode_attention) when Hq == Hkv;
-    GQA configs take a gather-based jnp form (pages gathered via the
-    table, then the grouped masked softmax) — same block-table
-    indirection, no kernel specialization for grouped heads yet.
+    (kernels/decode_attention.paged_decode_attention) for equal heads AND
+    grouped queries — the GQA grid streams one page of one kv head per
+    step and scores the whole query group in VMEM, so no path ever
+    gathers pages at query width (the round-4 jnp fallback is gone).
 
     Weights are read through `_mm`, so the dec_params dict may hold
     dense OR nn.quant-quantized projections (int8/int4 serving composes
@@ -852,9 +873,7 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     """
     from ..kernels.decode_attention import paged_decode_attention
 
-    nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                   cfg.head_dim)
-    group = nh // nkv
+    nkv, dh = cfg.num_key_value_heads, cfg.head_dim
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
     if sb % block_size:
@@ -867,12 +886,9 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     head_logits = _make_head_logits(cfg)
     base_prefill = _make_prefill(cfg, b, sb)
 
-    def to_pages(kv):
-        """[b, sb, nkv, dh] -> [b, n_pre, nkv, block_size, dh]"""
-        return jnp.transpose(
-            kv.reshape(b, n_pre, block_size, nkv, dh), (0, 1, 3, 2, 4))
-
     def prefill(p, ids, tables, pools):
+        to_pages, _ = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
+                                            tables)
         h, kvs = base_prefill(p, ids)
         for i, (k, v) in enumerate(kvs):
             kc, vc = pools[i]
@@ -884,34 +900,16 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
 
     def paged_attn(q1, kc, vc, tables, lens):
         """q1 [b, nh, dh]; lens [b] = cached positions (current token
-        already written at lens[b])."""
-        if group == 1:
-            return paged_decode_attention(q1, kc, vc, tables, lens)
-        # GQA fallback: gather the sequence's pages, grouped softmax
-        kg = kc[tables]                       # [b, P, nkv, bs, dh]
-        vg = vc[tables]
-        S = pages_per_seq * block_size
-        kl = jnp.transpose(kg, (0, 2, 1, 3, 4)).reshape(b, nkv, S, dh)
-        vl = jnp.transpose(vg, (0, 2, 1, 3, 4)).reshape(b, nkv, S, dh)
-        qg = q1.reshape(b, nkv, group, dh)
-        s = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
-                       kl.astype(jnp.float32)) / math.sqrt(dh)
-        valid = jnp.arange(S)[None, None, None, :] <= \
-            lens[:, None, None, None]
-        s = jnp.where(valid, s, -1e30)
-        probs = jax.nn.softmax(s, axis=-1)
-        ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vl.astype(jnp.float32))
-        return ctx.reshape(b, nh, dh).astype(q1.dtype)
+        already written at lens[b]). The Pallas kernel covers both equal
+        and grouped heads (GQA grid: one page x one kv head per step)."""
+        return paged_decode_attention(q1, kc, vc, tables, lens)
 
     def make_decode_step(tables):
         """The shared per-layer decode body (_make_decode_step) with the
         KV store swapped for page/slot scatter + table-indirect attention;
         `pos` is the per-sequence [b] length vector (ragged batch)."""
-        def kv_write(kc, vc, k, v, lens):
-            page = tables[jnp.arange(b), lens // block_size]
-            slot = lens % block_size
-            return (kc.at[page, :, slot, :].set(k[:, 0].astype(kc.dtype)),
-                    vc.at[page, :, slot, :].set(v[:, 0].astype(vc.dtype)))
+        _, kv_write = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
+                                            tables)
 
         def kv_attend(q1, kc, vc, lens):
             return paged_attn(q1, kc, vc, tables, lens)

@@ -1,0 +1,7 @@
+"""Serving layer: the continuous-batching engine above the paged KV
+cache (reference contract: block_multihead_attention.py:25 — block
+tables + per-sequence lengths exist to serve ragged, changing batches).
+"""
+from .engine import ContinuousBatchingEngine, ServeRequest
+
+__all__ = ["ContinuousBatchingEngine", "ServeRequest"]

@@ -1,0 +1,362 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The scheduler layer the paged cache exists for (reference contract:
+python/paddle/incubate/nn/functional/block_multihead_attention.py:25 —
+block tables + per-sequence lengths serve a ragged, CHANGING batch):
+new prompts enter while other sequences decode, finished rows retire
+mid-stream, and their pages recycle into the live pool. Static batching
+waits for a full batch and holds every slot until the slowest row ends;
+this engine keeps the decode program's slots full instead.
+
+TPU-first design: the decode program is compiled ONCE for a fixed slot
+count and scans `steps_per_sync` tokens per invocation (multi-step
+scheduling), so host<->device round-trips amortise over the chunk.
+Admission, retirement and page accounting are host-side between chunks;
+the device only ever sees fixed shapes:
+
+- per-layer K/V pools [max_pages, Hkv, block_size, D] (donated through
+  every program, so pages are updated in place);
+- a block table [slots, table_width] mapping each slot's logical blocks
+  to pool pages (retired/empty slots point at a reserved scratch page);
+- per-slot lengths/tokens/done flags.
+
+Weights go through the `_decode_params` layout (`_mm`), so dense AND
+weight-only int8/int4 serving compose with the engine unchanged.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import (PagedKVManager, _make_decode_step,
+                            _make_head_logits, _make_prefill, _sample_next,
+                            make_paged_kv_helpers)
+
+
+@dataclass
+class ServeRequest:
+    """One generation request tracked through the engine."""
+    req_id: int
+    prompt: list
+    max_new: int
+    arrival_time: float = 0.0
+    # filled by the engine
+    tokens: list = field(default_factory=list)
+    prefill_time: Optional[float] = None   # when the first token was ready
+    finish_time: Optional[float] = None
+    # host-side scheduling state (None until admitted)
+    slot: Optional[int] = None
+    pages: Optional[list] = None
+    bucket: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_time is not None
+
+
+class _Slot:
+    __slots__ = ("req", "length", "emitted", "done")
+
+    def __init__(self):
+        self.req = None        # ServeRequest or None (free)
+        self.length = 0        # tokens cached (prompt + emitted - 1 pending)
+        self.emitted = 0       # new tokens produced so far
+        self.done = False      # EOS seen inside a chunk
+
+
+class ContinuousBatchingEngine:
+    """vLLM-class continuous batching over `PagedKVManager`.
+
+    Usage::
+
+        eng = ContinuousBatchingEngine(cfg, dec_params, slots=8,
+                                       max_new_tokens=64,
+                                       eos_token_id=2)
+        eng.add_request([1, 5, 9, ...])
+        eng.run()                      # until all queues drain
+        for req in eng.finished: print(req.tokens)
+
+    Scheduling policy: FIFO admission; a request is admitted when a slot
+    is free AND the pool can hold its full capacity
+    (ceil((bucketed_prompt + max_new) / block_size) pages — conservative
+    reservation, so no preemption is ever needed). Prefill runs as its
+    own single-request program (compiled per prompt bucket); decode runs
+    `steps_per_sync` tokens for ALL slots per invocation, then the host
+    retires EOS/finished rows and admits from the wait queue.
+    """
+
+    def __init__(self, cfg, dec_params, *, slots: int = 8,
+                 prompt_bucket: int = 64, max_prompt_len: int = 512,
+                 max_new_tokens: int = 64, block_size: int = 64,
+                 max_pages: Optional[int] = None, steps_per_sync: int = 8,
+                 eos_token_id: Optional[int] = None, do_sample: bool = False,
+                 top_k: int = 0, temperature: float = 1.0,
+                 top_p: float = 1.0, seed: int = 0, dtype=jnp.bfloat16):
+        if prompt_bucket % block_size:
+            raise ValueError(
+                f"prompt_bucket {prompt_bucket} must be a whole number of "
+                f"KV pages (multiple of block_size {block_size}) so "
+                f"prefill scatters whole pages")
+        self.cfg = cfg
+        self.p = dec_params
+        self.slots = slots
+        self.prompt_bucket = prompt_bucket
+        self.max_prompt_len = -(-max_prompt_len // prompt_bucket) \
+            * prompt_bucket
+        self.max_new = max_new_tokens
+        self.block_size = block_size
+        self.steps = steps_per_sync
+        self.eos = eos_token_id
+        self.do_sample = do_sample
+        self.top_k = int(top_k)
+        self.temperature = temperature
+        self.top_p = top_p
+        # capacity: every slot simultaneously full-length, +1 scratch page
+        cap = self._capacity_pages(self.max_prompt_len)
+        self.table_width = cap
+        if max_pages is None:
+            max_pages = slots * cap + 1
+        self.mgr = PagedKVManager(max_pages, block_size)
+        self.scratch_page = self.mgr.alloc_pages(1)[0]  # retired rows' sink
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        self.kcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
+                    for _ in range(cfg.num_hidden_layers)]
+        self.vcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
+                    for _ in range(cfg.num_hidden_layers)]
+        self._slots = [_Slot() for _ in range(slots)]
+        self._tables = np.full((slots, cap), self.scratch_page, np.int32)
+        self._tokens = np.zeros((slots,), np.int32)
+        self._key = jax.random.PRNGKey(seed)
+        self.waiting: list[ServeRequest] = []
+        self.finished: list[ServeRequest] = []
+        self._next_id = 0
+        self._prefill_cache = {}
+        self._decode = jax.jit(self._build_decode_chunk(),
+                               donate_argnums=(1, 2))
+        self.device_steps = 0  # decode-chunk invocations (for metrics)
+
+    # ---- host-side accounting -------------------------------------------
+
+    def _capacity_pages(self, sb: int) -> int:
+        # same ceil-division as PagedKVManager.pages_needed (which is not
+        # constructed yet when __init__ sizes the pool from this)
+        return -(-(sb + self.max_new) // self.block_size)
+
+    @property
+    def n_active(self) -> int:
+        return sum(1 for s in self._slots if s.req is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.n_active > 0
+
+    def add_request(self, prompt, max_new: Optional[int] = None,
+                    arrival_time: Optional[float] = None) -> ServeRequest:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not 1 <= len(prompt) <= self.max_prompt_len:
+            raise ValueError(f"prompt length {len(prompt)} outside "
+                             f"[1, {self.max_prompt_len}]")
+        req = ServeRequest(self._next_id, prompt,
+                           max_new if max_new is not None else self.max_new,
+                           arrival_time if arrival_time is not None
+                           else time.perf_counter())
+        if req.max_new > self.max_new:
+            raise ValueError(f"max_new {req.max_new} > engine budget "
+                             f"{self.max_new}")
+        sb = -(-len(prompt) // self.prompt_bucket) * self.prompt_bucket
+        if self._capacity_pages(sb) > self.mgr.max_pages - 1:
+            # fail fast: this request could never be admitted even with
+            # the whole pool free (minus the scratch page)
+            raise ValueError(
+                f"request needs {self._capacity_pages(sb)} pages "
+                f"(bucketed prompt {sb} + max_new {self.max_new}) but the "
+                f"pool holds only {self.mgr.max_pages - 1}")
+        self._next_id += 1
+        self.waiting.append(req)
+        return req
+
+    # ---- device programs ------------------------------------------------
+
+    def _build_prefill(self, sb: int):
+        """Single-request prefill into this request's pages + first token.
+        One compile per prompt bucket."""
+        cfg = self.cfg
+        bs = self.block_size
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        n_pre = sb // bs
+        base = _make_prefill(cfg, 1, sb)
+        head_logits = _make_head_logits(cfg)
+        do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
+
+        def to_pages(kv):
+            return jnp.transpose(
+                kv.reshape(1, n_pre, bs, nkv, dh), (0, 1, 3, 2, 4))[0]
+
+        def run(p, kcs, vcs, ids, s0, pages, key, temperature, top_p):
+            h, kvs = base(p, ids)
+            for i, (k, v) in enumerate(kvs):
+                kcs[i] = kcs[i].at[pages].set(to_pages(k).astype(
+                    kcs[i].dtype))
+                vcs[i] = vcs[i].at[pages].set(to_pages(v).astype(
+                    vcs[i].dtype))
+            h_last = jax.lax.dynamic_index_in_dim(h, s0 - 1, axis=1,
+                                                  keepdims=True)
+            logits = head_logits(h_last, p)[:, -1]
+            first = _sample_next(logits.astype(jnp.float32), key,
+                                 do_sample, temperature, top_k, top_p)
+            return first[0], kcs, vcs
+
+        return run
+
+    def _build_decode_chunk(self):
+        """`steps` decode tokens for every slot in one program. Retired /
+        free rows point their table at the scratch page and freeze their
+        length, so they compute (fixed shape) but touch nothing live."""
+        from ..kernels.decode_attention import paged_decode_attention
+
+        cfg, b, bs = self.cfg, self.slots, self.block_size
+        steps = self.steps
+        do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
+
+        def run(p, kcs, vcs, toks, lens, tables, live, key,
+                temperature, top_p):
+            _, kv_write = make_paged_kv_helpers(
+                b, 0, cfg.num_key_value_heads, cfg.head_dim, bs, tables)
+
+            def kv_attend(q1, kc, vc, lens_):
+                return paged_decode_attention(q1, kc, vc, tables, lens_)
+
+            decode_step = _make_decode_step(cfg, b, kv_write=kv_write,
+                                            kv_attend=kv_attend)
+
+            def step(carry, _):
+                tok, lens_, kcs_, vcs_, done, key_ = carry
+                logits, kcs_, vcs_ = decode_step(p, kcs_, vcs_,
+                                                 tok[:, None], lens_)
+                key_, ks = jax.random.split(key_)
+                nxt = _sample_next(logits.astype(jnp.float32), ks,
+                                   do_sample, temperature, top_k, top_p)
+                frozen = done | ~live
+                if eos is not None:
+                    nxt = jnp.where(frozen, eos, nxt)
+                    done = done | (nxt == eos)
+                else:
+                    nxt = jnp.where(frozen, 0, nxt)
+                lens_ = jnp.where(frozen, lens_, lens_ + 1)
+                return (nxt, lens_, kcs_, vcs_, done, key_), nxt
+
+            # every live row enters a chunk un-done (retire clears slots
+            # at chunk end); `done` only freezes rows WITHIN the chunk
+            done0 = jnp.zeros((b,), bool)
+            (tok, lens, kcs, vcs, done, _), out = jax.lax.scan(
+                step, (toks, lens, kcs, vcs, done0, key), None,
+                length=steps)
+            return jnp.swapaxes(out, 0, 1), lens, done, kcs, vcs
+
+        return run
+
+    # ---- scheduling loop ------------------------------------------------
+
+    def _admit(self):
+        """FIFO admit while a slot and full-capacity pages are free."""
+        for slot_id, slot in enumerate(self._slots):
+            if slot.req is not None or not self.waiting:
+                continue
+            req = self.waiting[0]
+            s0 = len(req.prompt)
+            sb = -(-s0 // self.prompt_bucket) * self.prompt_bucket
+            need = self._capacity_pages(sb)
+            if need > self.mgr.n_free:
+                break  # FIFO: don't let a short request starve the head
+            self.waiting.pop(0)
+            req.slot, req.bucket = slot_id, sb
+            req.pages = self.mgr.alloc_pages(need)
+            if sb not in self._prefill_cache:
+                self._prefill_cache[sb] = jax.jit(
+                    self._build_prefill(sb), donate_argnums=(1, 2))
+            ids = np.zeros((1, sb), np.int32)
+            ids[0, :s0] = req.prompt
+            self._key, k = jax.random.split(self._key)
+            n_pre = sb // self.block_size
+            first, self.kcs, self.vcs = self._prefill_cache[sb](
+                self.p, self.kcs, self.vcs, jnp.asarray(ids),
+                jnp.asarray(s0, jnp.int32),
+                jnp.asarray(req.pages[:n_pre], jnp.int32), k,
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
+            first = int(first)
+            req.tokens.append(first)
+            req.prefill_time = time.perf_counter()
+            slot.req = req
+            slot.length = s0
+            slot.emitted = 1
+            slot.done = self.eos is not None and first == self.eos
+            row = req.pages + [req.pages[-1]] * \
+                (self.table_width - len(req.pages))
+            self._tables[slot_id] = row
+            self._tokens[slot_id] = first
+            if slot.done or req.max_new == 1:
+                self._retire(slot_id)
+
+    def _retire(self, slot_id: int):
+        slot = self._slots[slot_id]
+        req = slot.req
+        req.finish_time = time.perf_counter()
+        self.finished.append(req)
+        self.mgr.free(req.pages)
+        req.pages = None
+        slot.req, slot.length, slot.emitted, slot.done = None, 0, 0, False
+        # the row MUST stop pointing at freed pages before they recycle
+        self._tables[slot_id] = self.scratch_page
+        self._tokens[slot_id] = 0
+
+    def step(self) -> int:
+        """One scheduling iteration: admit -> decode chunk -> retire.
+        Returns the number of live tokens produced."""
+        self._admit()
+        live = np.asarray([s.req is not None for s in self._slots])
+        if not live.any():
+            return 0
+        lens = np.asarray([s.length for s in self._slots], np.int32)
+        self._key, k = jax.random.split(self._key)
+        out, new_lens, done, self.kcs, self.vcs = self._decode(
+            self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
+            jnp.asarray(lens), jnp.asarray(self._tables),
+            jnp.asarray(live), k,
+            jnp.asarray(self.temperature, jnp.float32),
+            jnp.asarray(self.top_p, jnp.float32))
+        self.device_steps += 1
+        out = np.asarray(out)
+        new_lens = np.asarray(new_lens)
+        done = np.asarray(done)
+        produced = 0
+        for slot_id, slot in enumerate(self._slots):
+            req = slot.req
+            if req is None:
+                continue
+            take = min(self.steps, req.max_new - slot.emitted)
+            toks = out[slot_id, :take].tolist()
+            if self.eos is not None and self.eos in toks:
+                toks = toks[:toks.index(self.eos) + 1]
+            req.tokens.extend(toks)
+            produced += len(toks)
+            slot.emitted += len(toks)
+            slot.length = int(new_lens[slot_id])
+            slot.done = bool(done[slot_id])
+            self._tokens[slot_id] = toks[-1] if toks else 0
+            if slot.done or slot.emitted >= req.max_new:
+                self._retire(slot_id)
+        return produced
+
+    def run(self, max_iters: int = 100000):
+        while self.has_work and max_iters:
+            self.step()
+            max_iters -= 1
+        if self.has_work:
+            raise RuntimeError("engine did not drain within max_iters")
+        return self.finished

@@ -127,6 +127,40 @@ def check_decode_paged():
     return f"paged decode max err {err:.4f} > 5e-2" if err > 5e-2 else None
 
 
+def check_decode_paged_gqa():
+    """Grouped-heads paged decode on silicon — the grid real GQA serving
+    configs take (round-4 Weak #3: the fallback was only loosely checked
+    through jit_generate's token-agreement bar)."""
+    from paddle_tpu.kernels.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(6)
+    B, HQ, HK, D, BS, NBLK = 4, 16, 4, 128, 64, 4
+    max_pages = B * NBLK
+    kc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)), jnp.bfloat16)
+    q = jnp.asarray(rng.normal(size=(B, HQ, D)), jnp.bfloat16)
+    tables = jnp.asarray([[j * B + i for j in range(NBLK)]
+                          for i in range(B)], jnp.int32)
+    lens = jnp.asarray([60, 255, 128, 200], jnp.int32)
+    out = jax.jit(
+        lambda a: paged_decode_attention(a, kc, vc, tables, lens))(q)
+
+    g = HQ // HK
+    kl = jnp.transpose(kc[tables], (0, 2, 1, 3, 4)).reshape(
+        B, HK, NBLK * BS, D).astype(jnp.float32)
+    vl = jnp.transpose(vc[tables], (0, 2, 1, 3, 4)).reshape(
+        B, HK, NBLK * BS, D).astype(jnp.float32)
+    qg = q.astype(jnp.float32).reshape(B, HK, g, D)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg, kl) / math.sqrt(D)
+    valid = jnp.arange(NBLK * BS)[None, None, None, :] <= \
+        lens[:, None, None, None]
+    p = jax.nn.softmax(jnp.where(valid, s, -1e30), axis=-1)
+    ref = jnp.einsum("bkgs,bksd->bkgd", p, vl).reshape(B, HQ, D)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    return (f"paged GQA decode max err {err:.4f} > 5e-2"
+            if err > 5e-2 else None)
+
+
 def check_int4_matmul():
     from paddle_tpu.kernels.int4_matmul import _xla_fallback, int4_matmul
 
@@ -185,6 +219,7 @@ CHECKS = [
     ("flash_fwd_bwd", check_flash_fwd_bwd),
     ("decode_contiguous", check_decode_contiguous),
     ("decode_paged", check_decode_paged),
+    ("decode_paged_gqa", check_decode_paged_gqa),
     ("int4_matmul", check_int4_matmul),
     ("rms_norm", check_rms_norm),
     ("jit_generate", check_jit_generate),
