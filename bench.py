@@ -156,6 +156,40 @@ def _op_bench(only=None):
         g = jnp.asarray(rng.normal(size=(1024, 1024)), jnp.float32)
         timed("all_reduce_4mb", psum, g, n_pairs=4)
 
+    if want("decode_step_1b_int8"):
+        # the flagship serving metric under the regression gate (round-5
+        # VERDICT #6): one full 1B int8 decode step (32-layer loop via
+        # _make_decode_step, contiguous cache) — a composite row, so a
+        # regression anywhere in the serving path (quant matmul, decode
+        # attention, rms/rope fusion) trips it
+        from paddle_tpu.models import (LlamaConfig,
+                                       init_quant_serving_params)
+        from bench_roofline import build_decode_loop
+
+        from bench_util import paired_slope_ms
+
+        dcfg = LlamaConfig.llama_1b(dtype="bfloat16")
+        dp = init_quant_serving_params(dcfg, "weight_only_int8", seed=0)
+        np.asarray(jax.tree.leaves(dp)[-1])
+        # cache sized so the hi leg (pos 128 + 194 steps) never clamps
+        # past capacity — a saturated cache would skew the gate number
+        dkcs = [jnp.zeros((4, dcfg.num_key_value_heads, 512,
+                           dcfg.head_dim), jnp.bfloat16)
+                for _ in range(dcfg.num_hidden_layers)]
+        dvcs = list(dkcs)
+        dfn = build_decode_loop(dcfg, 4, 512)
+        dtok = jnp.ones((4,), jnp.int32)
+        dpos = jnp.asarray(128, jnp.int32)
+
+        def drun(n):
+            return float(dfn(dp, dkcs, dvcs, dtok, dpos,
+                             jnp.asarray(n, jnp.int32)))
+
+        drun(2); drun(194)  # warm (trip count traced: one compile)
+        ops["decode_step_1b_int8"] = round(
+            paired_slope_ms(drun, 2, 194, pairs=8), 4)
+        del dp, dkcs, dvcs
+
     # eager dispatch overhead: one tiny op, eager, host-timed — tracks the
     # per-op cost of the eager tape + device round-trip over rounds
     # (reference: test/cpp/eager/performance_tests/benchmark_eager_cuda.cc).
@@ -376,10 +410,15 @@ def main():
         # per-op regression gate: unacknowledged >10% regressions go into
         # the driver-parsed JSON line AND fail the process (round-2's
         # warn-only gate could be ignored; this one cannot)
+        # free the train state first: the op table's serving row puts a
+        # second model (1B int8) on the chip
+        del params, opt
         last_err = None
         for attempt in (1, 2):
             try:
-                regressions = _op_regressions(_op_bench())
+                # += not =: the smoke failures above must survive the op
+                # gate's result (round-5 fix — they were overwritten)
+                regressions += _op_regressions(_op_bench())
                 last_err = None
                 break
             except Exception as e:
@@ -393,8 +432,8 @@ def main():
             # a gate that cannot run must fail visibly, not pass silently
             # (round-3 advisor finding): the sentinel rides the same
             # driver-parsed JSON field as a real regression
-            regressions = [f"op_bench_failed: {type(last_err).__name__}: "
-                           f"{last_err}"]
+            regressions += [f"op_bench_failed: {type(last_err).__name__}: "
+                            f"{last_err}"]
 
     result = {
         "metric": "llama_train_tokens_per_sec",

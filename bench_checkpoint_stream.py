@@ -1,0 +1,121 @@
+"""7B streaming-conversion dry run: disk shards -> quantized serving.
+
+Round-5 VERDICT #7 at scale: prove the streaming converter
+(models/checkpoint.load_quant_serving_params) lands a Llama-2-7B
+checkpoint (13.5 GB bf16 on disk) in the int8 serving layout on a
+16 GB chip WITHOUT ever materializing the fp model — then actually
+serves from it. Writes random bf16 shards in the HF sharded-safetensors
+layout first (one shard per layer, like real HF repos), streams them,
+and reports load time + a decode-step sanity number.
+
+Usage: python bench_checkpoint_stream.py [--keep] [workdir]
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def write_shards(cfg, root):
+    import torch
+    from safetensors.torch import save_file
+
+    os.makedirs(root, exist_ok=True)
+    gen = torch.Generator().manual_seed(0)
+    h, dh = cfg.hidden_size, cfg.head_dim
+    nh, nkv, im = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.intermediate_size)
+
+    def rnd(*shape):
+        # bf16 like real HF Llama-2 checkpoints; torch layout [out, in]
+        return (torch.randn(*shape, generator=gen) * 0.02).to(
+            torch.bfloat16)
+
+    weight_map, total = {}, 0
+
+    def shard(fname, tensors):
+        nonlocal total
+        save_file(tensors, os.path.join(root, fname))
+        for k, t in tensors.items():
+            weight_map[k] = fname
+            total += t.numel() * t.element_size()
+
+    shard("model-embed.safetensors",
+          {"model.embed_tokens.weight": rnd(cfg.vocab_size, h),
+           "model.norm.weight": torch.ones(h, dtype=torch.bfloat16),
+           "lm_head.weight": rnd(cfg.vocab_size, h)})
+    for i in range(cfg.num_hidden_layers):
+        pre = f"model.layers.{i}."
+        shard(f"model-{i:05d}.safetensors", {
+            pre + "input_layernorm.weight":
+                torch.ones(h, dtype=torch.bfloat16),
+            pre + "post_attention_layernorm.weight":
+                torch.ones(h, dtype=torch.bfloat16),
+            pre + "self_attn.q_proj.weight": rnd(nh * dh, h),
+            pre + "self_attn.k_proj.weight": rnd(nkv * dh, h),
+            pre + "self_attn.v_proj.weight": rnd(nkv * dh, h),
+            pre + "self_attn.o_proj.weight": rnd(h, nh * dh),
+            pre + "mlp.gate_proj.weight": rnd(im, h),
+            pre + "mlp.up_proj.weight": rnd(im, h),
+            pre + "mlp.down_proj.weight": rnd(h, im),
+        })
+    with open(os.path.join(root, "model.safetensors.index.json"),
+              "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+    return total
+
+
+def main():
+    from paddle_tpu.models import (LlamaConfig, build_quant_generate,
+                                   load_quant_serving_params)
+
+    args = [a for a in sys.argv[1:] if a != "--keep"]
+    keep = "--keep" in sys.argv
+    root = args[0] if args else "/tmp/llama7b_shards"
+    cfg = LlamaConfig.llama2_7b(dtype="bfloat16")
+
+    t0 = time.perf_counter()
+    disk_bytes = write_shards(cfg, root)
+    t_write = time.perf_counter() - t0
+    print(json.dumps({"stage": "shards_written",
+                      "disk_gb": round(disk_bytes / 2**30, 2),
+                      "s": round(t_write, 1)}), flush=True)
+
+    t0 = time.perf_counter()
+    p = load_quant_serving_params(cfg, root, "weight_only_int8")
+    np.asarray(jax.tree.leaves(p)[-1])
+    t_load = time.perf_counter() - t0
+    hbm = sum(x.nbytes for x in jax.tree.leaves(p))
+    print(json.dumps({"stage": "streamed_quantized",
+                      "s": round(t_load, 1),
+                      "hbm_gb": round(hbm / 2**30, 2)}), flush=True)
+
+    # serve from the streamed layout: short prefill + a few decode steps
+    b, sb, max_new = 4, 128, 8
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (b, sb)))
+    fn = jax.jit(build_quant_generate(cfg, b, sb, max_new))
+    t0 = time.perf_counter()
+    toks = np.asarray(fn(p, ids, jnp.asarray(sb, jnp.int32),
+                         jax.random.PRNGKey(0),
+                         jnp.asarray(1.0, jnp.float32),
+                         jnp.asarray(1.0, jnp.float32)))
+    t_gen = time.perf_counter() - t0
+    ok = bool((toks >= 0).all() and (toks < cfg.vocab_size).all()
+              and np.unique(toks).size > 1)
+    print(json.dumps({"stage": "served", "compile_plus_gen_s":
+                      round(t_gen, 1), "tokens_shape": list(toks.shape),
+                      "sane": ok}), flush=True)
+    if not keep:
+        shutil.rmtree(root)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Shared bench timing: the paired-slope decode/op timer.
+
+One implementation of the op-gate discipline (bench.py `_op_bench`
+round-4 lessons): cost = (t_hi - t_lo) / span, measured as ADJACENT
+lo/hi pairs so the tunnel's drifting fixed cost cancels within a pair,
+median across pairs so one drifty window cannot set the number. Every
+bench that quotes a per-step or per-iter figure uses this — the
+round-3/4 serving "drift" and the round-4 rms_norm false flag were both
+re-implemented timers diverging from this discipline.
+"""
+from __future__ import annotations
+
+import time
+
+
+def paired_slope_ms(run, lo, hi, pairs: int = 8):
+    """Median over `pairs` of ((t(run(hi)) - t(run(lo))) / (hi - lo)),
+    in milliseconds. `run(n)` must BLOCK until the device result is real
+    (np.asarray / float of a device value — block_until_ready is not a
+    reliable barrier on tunneled platforms). Call sites warm both legs
+    (compile + cache) before timing."""
+    span = hi - lo
+    slopes = []
+    for _ in range(pairs):
+        t0 = time.perf_counter(); run(lo)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter(); run(hi)
+        t_hi = time.perf_counter() - t0
+        slopes.append(max(t_hi - t_lo, 0.0) / span * 1e3)
+    slopes.sort()
+    mid = len(slopes) // 2
+    return slopes[mid] if len(slopes) % 2 else \
+        (slopes[mid - 1] + slopes[mid]) / 2

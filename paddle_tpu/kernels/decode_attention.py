@@ -90,9 +90,31 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     max_seq = k_cache.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    block_s = min(block_s, max_seq)
-    if max_seq % block_s:
-        raise ValueError(f"max_seq {max_seq} % block_s {block_s} != 0")
+    if d % 128:
+        # Mosaic cannot shape-cast the [H, 1, D] broadcast at narrow
+        # head dims; the GQA grid's dot-general form lowers at any D
+        # (including group=1 — verified on silicon at D=32)
+        return gqa_decode_attention(q, k_cache, v_cache, lens,
+                                    block_s=block_s, scale=scale)
+    # cap the block so k+v blocks double-buffer inside the ~16 MB scoped
+    # VMEM (2 operands x 2 buffers x itemsize 2 = 8 bytes per element);
+    # then take the largest divisor of max_seq under the cap so the grid
+    # covers the cache exactly (measured: h=32, block 512, d=128 OOMs
+    # scoped vmem by 48 KB at max_seq 2048)
+    cap = max(1, (12 << 20) // (8 * h * d))
+    block_s = min(block_s, max_seq, cap)
+    while max_seq % block_s:
+        block_s -= 1
+    if block_s < min(32, max_seq):
+        # near-prime max_seq: the largest divisor under the VMEM cap is
+        # pathologically small — a 3-row-block grid would be an
+        # order-of-magnitude silent slowdown. Surface it.
+        import warnings
+
+        warnings.warn(
+            f"decode_attention: max_seq {max_seq} forces block_s "
+            f"{block_s} (largest divisor under the {cap} VMEM cap); pad "
+            f"the cache to a rounder length", stacklevel=2)
     grid = (b, max_seq // block_s)
     kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale)
     return pl.pallas_call(
@@ -164,14 +186,14 @@ def _paged_decode_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-def _paged_gqa_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                      m_scr, l_scr, acc_scr, *, block_size: int,
-                      scale: float):
-    """Grouped-query paged decode. Grid (B, Hkv, n_blocks): each step
-    streams ONE page of ONE kv head and scores the whole query group
-    against it — the page never leaves VMEM at query-head width, which is
-    the HBM saving the jnp gather fallback forfeited (reference GQA paged
-    decode: block_attn.h with gqa_group_size)."""
+def _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, block_size: int, scale: float):
+    """Shared grouped-query decode body for grid (B, Hkv, n_blocks):
+    each step streams ONE kv block of ONE kv head and scores the whole
+    query group against it — the block never leaves VMEM at query-head
+    width (reference GQA decode: block_attn.h with gqa_group_size). The
+    paged and contiguous kernels differ only in how their k/v index maps
+    pick the block."""
     b = pl.program_id(0)
     j = pl.program_id(2)
     nb = pl.num_programs(2)
@@ -213,6 +235,91 @@ def _paged_gqa_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == nb - 1)
     def _final():
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_gqa_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, block_size: int,
+                      scale: float):
+    # tables_ref is consumed by the BlockSpec index maps, not the body
+    _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, block_size=block_size, scale=scale)
+
+
+def gqa_decode_attention(q: jax.Array, k_cache: jax.Array,
+                         v_cache: jax.Array, lens: jax.Array, *,
+                         block_s: int = 512,
+                         scale: float | None = None) -> jax.Array:
+    """Grouped-query decode over a CONTIGUOUS cache — the GQA grid of
+    the paged kernel without a table: one kv block of one kv head per
+    step, whole query group scored in VMEM via MXU dots.
+
+    q: [B, Hq, D]; k_cache/v_cache: [B, Hkv, max_seq, D] with
+    Hq % Hkv == 0; lens: [B] previous-token counts. Returns [B, Hq, D].
+    """
+    b, hq, d = q.shape
+    hkv, max_seq = k_cache.shape[1], k_cache.shape[2]
+    group = hq // hkv
+    if hq % hkv:
+        raise ValueError(f"Hq {hq} not a multiple of Hkv {hkv}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # largest divisor of max_seq <= block_s keeps the collapsed view a
+    # whole number of blocks (any divisor lowers: the block equals the
+    # collapsed trailing dims)
+    bs = min(block_s, max_seq)
+    while max_seq % bs:
+        bs -= 1
+    if bs < min(32, max_seq):
+        import warnings
+
+        warnings.warn(
+            f"gqa_decode_attention: max_seq {max_seq} forces block "
+            f"{bs}; pad the cache to a rounder length", stacklevel=2)
+    nb = max_seq // bs
+    # free row-major collapses: q/out [b*hkv, group, d]; caches
+    # [b*hkv*nb, bs, d] with block row (b*hkv + h)*nb + j
+    qg = q.reshape(b * hkv, group, d)
+    kc = k_cache.reshape(b * hkv * nb, bs, d)
+    vc = v_cache.reshape(b * hkv * nb, bs, d)
+    kernel = functools.partial(_gqa_contig_kernel, block_size=bs,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, nb),
+            in_specs=[
+                pl.BlockSpec((1, group, d),
+                             lambda b, h, j, lens, hkv=hkv:
+                             (b * hkv + h, 0, 0)),
+                pl.BlockSpec((1, bs, d),
+                             lambda b, h, j, lens, hkv=hkv, nb=nb:
+                             ((b * hkv + h) * nb + j, 0, 0)),
+                pl.BlockSpec((1, bs, d),
+                             lambda b, h, j, lens, hkv=hkv, nb=nb:
+                             ((b * hkv + h) * nb + j, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, group, d),
+                lambda b, h, j, lens, hkv=hkv: (b * hkv + h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, group, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(lens.astype(jnp.int32), qg, kc, vc)
+    return out.reshape(b, hq, d)
+
+
+def _gqa_contig_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                       acc_scr, *, block_size: int, scale: float):
+    _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, block_size=block_size, scale=scale)
 
 
 def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale):
@@ -286,7 +393,10 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
     hkv = key_cache.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if h != hkv:
+    if h != hkv or d % 128:
+        # grouped queries — or narrow head dims, where the equal-heads
+        # kernel's [H, 1, D] broadcast fails to lower (see
+        # decode_attention); the GQA grid covers group=1 too
         if h % hkv:
             raise ValueError(f"Hq {h} not a multiple of Hkv {hkv}")
         return _paged_decode_gqa(q, key_cache, value_cache, block_tables,

@@ -11,6 +11,7 @@ from .llama import (  # noqa: F401
     PagedKVManager, build_paged_generate, build_quant_generate,
     init_quant_serving_params, llama_sharding_rules, shard_llama,
 )
+from .checkpoint import load_quant_serving_params  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, shard_gpt  # noqa: F401
 from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
 from .bert import (  # noqa: F401

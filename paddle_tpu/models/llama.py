@@ -1047,18 +1047,18 @@ def _make_decode_step(cfg, b, max_seq=None, kv_write=None, kv_attend=None):
             return kc, vc
 
     if kv_attend is None:
+        # Pallas fused decode attention (round-5 roofline finding: the
+        # old jnp einsum+softmax path read the KV cache at ~450 GB/s
+        # effective and was the whole 17-20% residual above the serving
+        # weight-read bound; the kernels stream it near peak)
+        from ..kernels.decode_attention import (decode_attention,
+                                                gqa_decode_attention)
+
         def kv_attend(q1, kc, vc, pos):
-            # grouped-GQA decode attention: one masked pass over the cache
-            qg = q1.reshape(b, nkv, group, dh)
-            logits = jnp.einsum(
-                "bkgd,bksd->bkgs", qg.astype(jnp.float32),
-                kc.astype(jnp.float32)) / math.sqrt(dh)
-            valid = jnp.arange(max_seq)[None, None, None, :] <= pos
-            logits = jnp.where(valid, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            ctx = jnp.einsum("bkgs,bksd->bkgd", probs,
-                             vc.astype(jnp.float32))
-            return ctx.reshape(b, nh, dh).astype(q1.dtype)
+            lens = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+            if group == 1:
+                return decode_attention(q1, kc, vc, lens)
+            return gqa_decode_attention(q1, kc, vc, lens)
 
     def decode_step(p, kcs, vcs, tok, pos):
         """tok [B, 1] int32; pos: tokens already cached — a traced scalar

@@ -192,17 +192,15 @@ class ContinuousBatchingEngine:
         base = _make_prefill(cfg, 1, sb)
         head_logits = _make_head_logits(cfg)
         do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
-
-        def to_pages(kv):
-            return jnp.transpose(
-                kv.reshape(1, n_pre, bs, nkv, dh), (0, 1, 3, 2, 4))[0]
+        # shared page transform (tables unused by the prefill half)
+        to_pages, _ = make_paged_kv_helpers(1, n_pre, nkv, dh, bs, None)
 
         def run(p, kcs, vcs, ids, s0, pages, key, temperature, top_p):
             h, kvs = base(p, ids)
             for i, (k, v) in enumerate(kvs):
-                kcs[i] = kcs[i].at[pages].set(to_pages(k).astype(
+                kcs[i] = kcs[i].at[pages].set(to_pages(k)[0].astype(
                     kcs[i].dtype))
-                vcs[i] = vcs[i].at[pages].set(to_pages(v).astype(
+                vcs[i] = vcs[i].at[pages].set(to_pages(v)[0].astype(
                     vcs[i].dtype))
             h_last = jax.lax.dynamic_index_in_dim(h, s0 - 1, axis=1,
                                                   keepdims=True)

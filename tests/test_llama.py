@@ -374,10 +374,10 @@ class TestLlama:
     def test_paged_generation_matches_contiguous(self):
         """cache_layout='paged' (block tables + paged pools) must produce
         the same greedy tokens as the contiguous cache — round-4 VERDICT
-        item 3 oracle bar. Covers both attention forms: GQA (gather
-        fallback) and nh==nkv (the Pallas paged kernel, interpret mode
-        on CPU)."""
-        for nkv in (2, 4):   # tiny() has nh=4: GQA fallback + kernel path
+        item 3 oracle bar. Covers both Pallas grids (interpret mode on
+        CPU): grouped queries (nkv=2) and equal heads (nkv=4... tiny()
+        has nh=4)."""
+        for nkv in (2, 4):   # tiny() has nh=4: GQA + equal-heads grids
             cfg = dataclasses.replace(LlamaConfig.tiny(),
                                       num_key_value_heads=nkv)
             paddle.seed(13)

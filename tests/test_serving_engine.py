@@ -107,6 +107,17 @@ class TestContinuousBatchingEngine(unittest.TestCase):
             bucket_size=8).numpy()[0]
         np.testing.assert_array_equal(np.asarray(late.tokens), solo[3:])
 
+    def test_unservable_request_fails_fast(self):
+        """A request that could never fit the pool raises at add_request
+        with an actionable message, instead of spinning run() forever."""
+        cfg, model, params = _tiny_setup()
+        eng = ContinuousBatchingEngine(
+            cfg, params, slots=1, prompt_bucket=8, max_prompt_len=16,
+            max_new_tokens=8, block_size=8, steps_per_sync=2,
+            max_pages=2)  # scratch + 1: every real request needs >= 2
+        with self.assertRaisesRegex(ValueError, "pool holds only"):
+            eng.add_request([1, 2, 3])
+
     def test_quant_params_compose(self):
         """The engine serves the weight-only int8 `_decode_params` layout
         unchanged (quantized serving composes with continuous batching)."""
