@@ -64,11 +64,10 @@ def run_engine(cfg, p, arrivals, prompts, targets):
         cfg, p, slots=SLOTS, prompt_bucket=PROMPT_BUCKET,
         max_prompt_len=PROMPT_BUCKET, max_new_tokens=MAX_NEW,
         block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC)
-    # warm the compiles (prefill bucket + decode chunk) outside the clock
-    w = eng.add_request(prompts[0][:8], max_new=2)
-    eng.run(max_iters=50)
-    eng.finished.clear()
-    eng.device_steps = 0  # warm chunks must not count in occupancy
+    # compile every (bucket, prefill-batch) program + the decode chunk
+    # outside the clock
+    eng.warm([PROMPT_BUCKET])
+    eng.device_steps = 0  # warm chunk must not count in occupancy
 
     t0 = time.perf_counter()
     queued = 0

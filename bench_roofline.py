@@ -38,10 +38,12 @@ B = 4
 HBM_GBS = 819e9
 
 
-def build_decode_loop(cfg, b, max_seq, kv_attend=None):
+def build_decode_loop(cfg, b, max_seq=None, kv_attend=None,
+                      kv_write=None):
     """(p, kcs, vcs, tok0, pos0, n) -> checksum: n chained decode steps
     with traced n (one compile serves every trip count)."""
-    decode_step = _make_decode_step(cfg, b, max_seq, kv_attend=kv_attend)
+    decode_step = _make_decode_step(cfg, b, max_seq, kv_write=kv_write,
+                                    kv_attend=kv_attend)
 
     def run(p, kcs, vcs, tok0, pos0, n):
         def body(i, carry):
@@ -127,6 +129,59 @@ def measure(name):
     return out
 
 
+def measure_paged(name, block_size: int = 64):
+    """Split the paged-vs-contiguous gap into its two mechanisms: the
+    per-token page/slot scatter write vs the table-indirect attend.
+    Same decode-only loop; identity tables at max_seq 256."""
+    from paddle_tpu.kernels.decode_attention import paged_decode_attention
+    from paddle_tpu.models.llama import make_paged_kv_helpers
+
+    cfg = getattr(LlamaConfig, CONFIGS[name])(dtype="bfloat16")
+    quant = "weight_only_int8"
+    p = init_quant_serving_params(cfg, quant, seed=0)
+    np.asarray(jax.tree.leaves(p)[-1])
+    nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    max_seq = 256
+    n_blk = max_seq // block_size
+    tables = jnp.asarray(
+        np.arange(B * n_blk, dtype=np.int32).reshape(B, n_blk))
+    _, kv_write = make_paged_kv_helpers(B, n_blk, nkv, dh, block_size,
+                                        tables)
+
+    def kv_attend(q1, kc, vc, lens):
+        return paged_decode_attention(q1, kc, vc, tables, lens)
+
+    def pools():
+        ks = [jnp.zeros((B * n_blk, nkv, block_size, dh), jnp.bfloat16)
+              for _ in range(L)]
+        return ks, list(ks)
+
+    tok0 = jnp.ones((B,), jnp.int32)
+    pos0 = jnp.full((B,), 128, jnp.int32)  # paged path takes [B] lens
+    lo, hi = jnp.asarray(2), jnp.asarray(66)
+
+    out = {"config": name + "_paged_decomp", "batch": B,
+           "kv_block_size": block_size}
+    runs = [
+        ("paged_full", build_decode_loop(cfg, B, kv_write=kv_write,
+                                         kv_attend=kv_attend)),
+        ("paged_write_only", build_decode_loop(
+            cfg, B, kv_write=kv_write,
+            kv_attend=lambda q1, kc, vc, lens: q1)),
+    ]
+    for nm, fn in runs:
+        kcs, vcs = pools()
+        val = slope_ms(fn, (p, kcs, vcs, tok0, pos0, lo),
+                       (p, kcs, vcs, tok0, pos0, hi), 64)
+        out[nm + "_ms"] = round(val, 3)
+    print(json.dumps(out), flush=True)
+    return out
+
+
 if __name__ == "__main__":
     for nm in (sys.argv[1:] or ["7b_int8"]):
-        measure(nm)
+        if nm.endswith("_paged"):
+            measure_paged(nm[:-len("_paged")])
+        else:
+            measure(nm)

@@ -94,6 +94,7 @@ class ContinuousBatchingEngine:
                  prompt_bucket: int = 64, max_prompt_len: int = 512,
                  max_new_tokens: int = 64, block_size: int = 64,
                  max_pages: Optional[int] = None, steps_per_sync: int = 8,
+                 prefill_batch: int = 4,
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
                  top_k: int = 0, temperature: float = 1.0,
                  top_p: float = 1.0, seed: int = 0, dtype=jnp.bfloat16):
@@ -111,6 +112,7 @@ class ContinuousBatchingEngine:
         self.max_new = max_new_tokens
         self.block_size = block_size
         self.steps = steps_per_sync
+        self.prefill_batch = max(1, prefill_batch)
         self.eos = eos_token_id
         self.do_sample = do_sample
         self.top_k = int(top_k)
@@ -138,7 +140,8 @@ class ContinuousBatchingEngine:
         self._prefill_cache = {}
         self._decode = jax.jit(self._build_decode_chunk(),
                                donate_argnums=(1, 2))
-        self.device_steps = 0  # decode-chunk invocations (for metrics)
+        self.device_steps = 0   # decode-chunk invocations (for metrics)
+        self.prefill_calls = 0  # batched-admission device calls
 
     # ---- host-side accounting -------------------------------------------
 
@@ -182,32 +185,35 @@ class ContinuousBatchingEngine:
 
     # ---- device programs ------------------------------------------------
 
-    def _build_prefill(self, sb: int):
-        """Single-request prefill into this request's pages + first token.
-        One compile per prompt bucket."""
+    def _build_prefill(self, sb: int, bsz: int):
+        """Prefill `bsz` requests in ONE program (batched admission —
+        b=1 prefills underuse the MXU and cost one host round-trip
+        each): scatter each row's pages, sample each row's first token
+        at its own true length. One compile per (bucket, batch) pair;
+        _admit pads partial batches with rows aimed at the scratch
+        page."""
         cfg = self.cfg
         bs = self.block_size
         nkv, dh = cfg.num_key_value_heads, cfg.head_dim
         n_pre = sb // bs
-        base = _make_prefill(cfg, 1, sb)
+        base = _make_prefill(cfg, bsz, sb)
         head_logits = _make_head_logits(cfg)
-        do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
+        do_sample, top_k = self.do_sample, self.top_k
         # shared page transform (tables unused by the prefill half)
-        to_pages, _ = make_paged_kv_helpers(1, n_pre, nkv, dh, bs, None)
+        to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
 
-        def run(p, kcs, vcs, ids, s0, pages, key, temperature, top_p):
+        def run(p, kcs, vcs, ids, s0_vec, pages, key, temperature, top_p):
             h, kvs = base(p, ids)
             for i, (k, v) in enumerate(kvs):
-                kcs[i] = kcs[i].at[pages].set(to_pages(k)[0].astype(
-                    kcs[i].dtype))
-                vcs[i] = vcs[i].at[pages].set(to_pages(v)[0].astype(
-                    vcs[i].dtype))
-            h_last = jax.lax.dynamic_index_in_dim(h, s0 - 1, axis=1,
-                                                  keepdims=True)
+                kcs[i] = kcs[i].at[pages].set(
+                    to_pages(k).astype(kcs[i].dtype))
+                vcs[i] = vcs[i].at[pages].set(
+                    to_pages(v).astype(vcs[i].dtype))
+            h_last = h[jnp.arange(bsz), s0_vec - 1][:, None, :]
             logits = head_logits(h_last, p)[:, -1]
             first = _sample_next(logits.astype(jnp.float32), key,
                                  do_sample, temperature, top_k, top_p)
-            return first[0], kcs, vcs
+            return first, kcs, vcs
 
         return run
 
@@ -260,46 +266,131 @@ class ContinuousBatchingEngine:
 
     # ---- scheduling loop ------------------------------------------------
 
-    def _admit(self):
-        """FIFO admit while a slot and full-capacity pages are free."""
-        for slot_id, slot in enumerate(self._slots):
-            if slot.req is not None or not self.waiting:
-                continue
-            req = self.waiting[0]
-            s0 = len(req.prompt)
-            sb = -(-s0 // self.prompt_bucket) * self.prompt_bucket
-            need = self._capacity_pages(sb)
-            if need > self.mgr.n_free:
-                break  # FIFO: don't let a short request starve the head
-            self.waiting.pop(0)
-            req.slot, req.bucket = slot_id, sb
-            req.pages = self.mgr.alloc_pages(need)
-            if sb not in self._prefill_cache:
-                self._prefill_cache[sb] = jax.jit(
-                    self._build_prefill(sb), donate_argnums=(1, 2))
-            ids = np.zeros((1, sb), np.int32)
-            ids[0, :s0] = req.prompt
-            self._key, k = jax.random.split(self._key)
+    def _get_prefill(self, sb: int, bsz: int):
+        """The single compile point for (bucket, batch) prefill programs
+        (warm and _admit must never diverge in jit options)."""
+        key = (sb, bsz)
+        if key not in self._prefill_cache:
+            self._prefill_cache[key] = jax.jit(
+                self._build_prefill(sb, bsz), donate_argnums=(1, 2))
+        return self._prefill_cache[key]
+
+    def _max_prefill_bsz(self) -> int:
+        """_admit can never batch beyond the slot count — warming larger
+        pow2 variants would be dead full-model compiles."""
+        bsz = 1
+        while bsz < min(self.prefill_batch, self.slots):
+            bsz *= 2
+        return bsz
+
+    def warm(self, buckets=None):
+        """Compile (and cache) every program the engine can need for the
+        given prompt buckets — each power-of-two prefill batch plus the
+        decode chunk — by running them against the scratch page. Call
+        before serving latency-sensitive traffic; mid-stream compiles
+        would otherwise land on the first matching admit."""
+        buckets = [self.max_prompt_len] if buckets is None else buckets
+        cap = self._max_prefill_bsz()
+        for sb in buckets:
+            if sb % self.prompt_bucket:
+                raise ValueError(f"bucket {sb} is not a multiple of "
+                                 f"prompt_bucket {self.prompt_bucket}")
             n_pre = sb // self.block_size
-            first, self.kcs, self.vcs = self._prefill_cache[sb](
+            bsz = 1
+            while True:
+                self._key, k = jax.random.split(self._key)
+                _, self.kcs, self.vcs = self._get_prefill(sb, bsz)(
+                    self.p, self.kcs, self.vcs,
+                    jnp.zeros((bsz, sb), jnp.int32),
+                    jnp.ones((bsz,), jnp.int32),
+                    jnp.full((bsz, n_pre), self.scratch_page, jnp.int32),
+                    k, jnp.asarray(self.temperature, jnp.float32),
+                    jnp.asarray(self.top_p, jnp.float32))
+                if bsz >= cap:
+                    break
+                bsz *= 2
+        self._key, k = jax.random.split(self._key)
+        # scratch-only tables: warming against the live tables would
+        # scatter the warm token's K/V into an admitted request's pages
+        scratch_tables = jnp.full((self.slots, self.table_width),
+                                  self.scratch_page, jnp.int32)
+        out = self._decode(
+            self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
+            jnp.zeros((self.slots,), jnp.int32), scratch_tables,
+            jnp.zeros((self.slots,), bool), k,
+            jnp.asarray(self.temperature, jnp.float32),
+            jnp.asarray(self.top_p, jnp.float32))
+        _, _, _, self.kcs, self.vcs = out
+        np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
+
+    def _bucket(self, req) -> int:
+        return -(-len(req.prompt) // self.prompt_bucket) \
+            * self.prompt_bucket
+
+    def _admit(self):
+        """FIFO admission, batched: the head run of same-bucket waiting
+        requests (bounded by free slots, free pages, and prefill_batch)
+        prefills in ONE device call; partial batches pad with rows aimed
+        at the scratch page."""
+        while self.waiting:
+            free_slots = [i for i, s in enumerate(self._slots)
+                          if s.req is None]
+            if not free_slots:
+                return
+            sb = self._bucket(self.waiting[0])
+            batch = []
+            pages_left = self.mgr.n_free
+            need = self._capacity_pages(sb)
+            for req in self.waiting:
+                if (self._bucket(req) != sb or not free_slots[len(batch):]
+                        or len(batch) >= self.prefill_batch):
+                    break
+                if need > pages_left:
+                    break  # FIFO: a short request must not starve the head
+                pages_left -= need
+                batch.append(req)
+            if not batch:
+                return  # head is blocked on pages
+            del self.waiting[:len(batch)]
+            n_pre = sb // self.block_size
+            bsz = 1
+            while bsz < len(batch):
+                bsz *= 2
+            fn = self._get_prefill(sb, bsz)
+            ids = np.zeros((bsz, sb), np.int32)
+            s0s = np.ones((bsz,), np.int32)
+            pages = np.full((bsz, n_pre), self.scratch_page, np.int32)
+            for row, req in enumerate(batch):
+                req.slot, req.bucket = free_slots[row], sb
+                req.pages = self.mgr.alloc_pages(need)
+                ids[row, :len(req.prompt)] = req.prompt
+                s0s[row] = len(req.prompt)
+                pages[row] = req.pages[:n_pre]
+            self._key, k = jax.random.split(self._key)
+            self.prefill_calls += 1
+            firsts, self.kcs, self.vcs = fn(
                 self.p, self.kcs, self.vcs, jnp.asarray(ids),
-                jnp.asarray(s0, jnp.int32),
-                jnp.asarray(req.pages[:n_pre], jnp.int32), k,
+                jnp.asarray(s0s), jnp.asarray(pages), k,
                 jnp.asarray(self.temperature, jnp.float32),
                 jnp.asarray(self.top_p, jnp.float32))
-            first = int(first)
-            req.tokens.append(first)
-            req.prefill_time = time.perf_counter()
-            slot.req = req
-            slot.length = s0
-            slot.emitted = 1
-            slot.done = self.eos is not None and first == self.eos
-            row = req.pages + [req.pages[-1]] * \
-                (self.table_width - len(req.pages))
-            self._tables[slot_id] = row
-            self._tokens[slot_id] = first
-            if slot.done or req.max_new == 1:
-                self._retire(slot_id)
+            firsts = np.asarray(firsts)
+            now = time.perf_counter()
+            for row, req in enumerate(batch):
+                slot_id = req.slot
+                slot = self._slots[slot_id]
+                first = int(firsts[row])
+                req.tokens.append(first)
+                req.prefill_time = now
+                slot.req = req
+                slot.length = len(req.prompt)
+                slot.emitted = 1
+                slot.done = self.eos is not None and first == self.eos
+                padded = req.pages + [req.pages[-1]] * \
+                    (self.table_width - len(req.pages))
+                self._tables[slot_id] = padded
+                self._tokens[slot_id] = first
+                if slot.done or req.max_new == 1:
+                    self._retire(slot_id)
 
     def _retire(self, slot_id: int):
         slot = self._slots[slot_id]

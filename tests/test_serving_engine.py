@@ -107,6 +107,49 @@ class TestContinuousBatchingEngine(unittest.TestCase):
             bucket_size=8).numpy()[0]
         np.testing.assert_array_equal(np.asarray(late.tokens), solo[3:])
 
+    def test_batched_admission_one_call_same_tokens(self):
+        """Four same-bucket requests with four free slots admit in ONE
+        prefill call (batched admission) and still match solo greedy."""
+        cfg, model, params = _tiny_setup()
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, cfg.vocab_size, (n,)).tolist()
+                   for n in (3, 6, 5, 7)]
+        eng = ContinuousBatchingEngine(
+            cfg, params, slots=4, prompt_bucket=8, max_prompt_len=8,
+            max_new_tokens=5, block_size=8, steps_per_sync=5,
+            prefill_batch=4)
+        for pr in prompts:
+            eng.add_request(pr)
+        eng.run(max_iters=50)
+        self.assertEqual(eng.prefill_calls, 1)
+        for req in eng.finished:
+            solo = model.jit_generate(
+                paddle.to_tensor(np.asarray([req.prompt])),
+                max_new_tokens=5, bucket_size=8).numpy()[0]
+            np.testing.assert_array_equal(
+                np.asarray(req.tokens), solo[len(req.prompt):],
+                err_msg=f"req {req.req_id}")
+
+    def test_warm_mid_stream_does_not_corrupt(self):
+        """warm() while a request is live must only touch the scratch
+        page — the warm decode previously scattered into live tables."""
+        cfg, model, params = _tiny_setup()
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(1, cfg.vocab_size, (6,)).tolist()
+        eng = ContinuousBatchingEngine(
+            cfg, params, slots=2, prompt_bucket=8, max_prompt_len=8,
+            max_new_tokens=6, block_size=8, steps_per_sync=2)
+        req = eng.add_request(prompt)
+        eng.step()  # mid-flight
+        self.assertFalse(req.done)
+        eng.warm([8])
+        eng.run(max_iters=50)
+        solo = model.jit_generate(
+            paddle.to_tensor(np.asarray([prompt])), max_new_tokens=6,
+            bucket_size=8).numpy()[0]
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      solo[len(prompt):])
+
     def test_unservable_request_fails_fast(self):
         """A request that could never fit the pool raises at add_request
         with an actionable message, instead of spinning run() forever."""
