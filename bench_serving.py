@@ -1,13 +1,15 @@
 """Serving benchmark: quantized Llama decode on one chip.
 
 Usage: python bench_serving.py CONFIG [CONFIG...]
-  CONFIG in {7b_int8, 7b_int4, 1b_int8, 1b_int4}; each config runs in
-  its own process invocation (a 7B int8 + int4 pair would not co-resident
-  in 16 GB HBM).
+  CONFIG in {7b,13b,1b}_{int8,int4} (+ `_paged` / `_paged_ragged`
+  variants); each large config runs in its own process invocation (a 7B
+  int8 + int4 pair would not co-reside in 16 GB HBM).
 
-Measures ms/decode-step by the round-3 slope method — the program is run
-at max_new=2 and max_new=66 and the step cost is (t_66 - t_2)/64, which
-cancels prefill and dispatch. Weights are random, generated and quantized
+Measures ms/decode-step by paired slope (bench_util.paired_slope_ms):
+the program runs at max_new=2 and max_new=130, the step cost is the
+MEDIAN over 8 adjacent-pair slopes (t_130 - t_2)/128 — prefill and
+dispatch cancel in the slope, tunnel drift cancels within a pair.
+Weights are random, generated and quantized
 ON DEVICE (models.llama.init_quant_serving_params), so no full-precision
 model ever exists and nothing bulk-crosses the tunnel: this is the only
 way a 7B (13.5 GB bf16) model fits next to its caches on a 16 GB chip.
@@ -33,6 +35,8 @@ from paddle_tpu.models import (LlamaConfig, PagedKVManager,
 CONFIGS = {
     "7b_int8": ("llama2_7b", "weight_only_int8"),
     "7b_int4": ("llama2_7b", "weight_only_int4"),
+    "13b_int4": ("llama2_13b", "weight_only_int4"),  # capacity proof
+    "13b_int8": ("llama2_13b", "weight_only_int8"),  # ~13.1 GB: tight
     "1b_int8": ("llama_1b", "weight_only_int8"),
     "1b_int4": ("llama_1b", "weight_only_int4"),
 }

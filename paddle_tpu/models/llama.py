@@ -91,25 +91,36 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
 
-    # ------ stock sizes ------
+    # ------ stock sizes (any field overridable, e.g.
+    # llama2_13b(num_hidden_layers=2) for a dims-faithful smoke) ------
+    @staticmethod
+    def _stock(defaults: dict, over: dict) -> "LlamaConfig":
+        return LlamaConfig(**{**defaults, **over})
+
     @staticmethod
     def llama2_7b(**over) -> "LlamaConfig":
-        return LlamaConfig(hidden_size=4096, intermediate_size=11008,
-                           num_hidden_layers=32, num_attention_heads=32,
-                           **over)
+        return LlamaConfig._stock(
+            dict(hidden_size=4096, intermediate_size=11008,
+                 num_hidden_layers=32, num_attention_heads=32), over)
+
+    @staticmethod
+    def llama2_13b(**over) -> "LlamaConfig":
+        return LlamaConfig._stock(
+            dict(hidden_size=5120, intermediate_size=13824,
+                 num_hidden_layers=40, num_attention_heads=40), over)
 
     @staticmethod
     def llama_1b(**over) -> "LlamaConfig":
-        return LlamaConfig(hidden_size=2048, intermediate_size=5504,
-                           num_hidden_layers=16, num_attention_heads=16,
-                           **over)
+        return LlamaConfig._stock(
+            dict(hidden_size=2048, intermediate_size=5504,
+                 num_hidden_layers=16, num_attention_heads=16), over)
 
     @staticmethod
     def tiny(**over) -> "LlamaConfig":
-        return LlamaConfig(vocab_size=128, hidden_size=64,
-                           intermediate_size=128, num_hidden_layers=2,
-                           num_attention_heads=4, num_key_value_heads=2,
-                           max_position_embeddings=64, **over)
+        return LlamaConfig._stock(
+            dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, max_position_embeddings=64), over)
 
 
 # ---------------------------------------------------------------------------
