@@ -1,9 +1,10 @@
 """Serving benchmark: quantized Llama decode on one chip.
 
 Usage: python bench_serving.py CONFIG [CONFIG...]
-  CONFIG in {7b,13b,1b}_{int8,int4} (+ `_paged` / `_paged_ragged`
-  variants); each large config runs in its own process invocation (a 7B
-  int8 + int4 pair would not co-reside in 16 GB HBM).
+  CONFIG: any key of CONFIGS ({7b,13b,1b}_{int8,int4}, llama3_8b_int8)
+  plus `_paged` / `_paged_ragged` variants; each large config runs in
+  its own process invocation (a 7B int8 + int4 pair would not co-reside
+  in 16 GB HBM).
 
 Measures ms/decode-step by paired slope (bench_util.paired_slope_ms):
 the program runs at max_new=2 and max_new=130, the step cost is the
@@ -37,6 +38,7 @@ CONFIGS = {
     "7b_int4": ("llama2_7b", "weight_only_int4"),
     "13b_int4": ("llama2_13b", "weight_only_int4"),  # capacity proof
     "13b_int8": ("llama2_13b", "weight_only_int8"),  # ~13.1 GB: tight
+    "llama3_8b_int8": ("llama3_8b", "weight_only_int8"),  # GQA at scale
     "1b_int8": ("llama_1b", "weight_only_int8"),
     "1b_int4": ("llama_1b", "weight_only_int4"),
 }

@@ -110,6 +110,15 @@ class LlamaConfig:
                  num_hidden_layers=40, num_attention_heads=40), over)
 
     @staticmethod
+    def llama3_8b(**over) -> "LlamaConfig":
+        # the modern GQA ratio (32:8) + 128k vocab + long-rope base
+        return LlamaConfig._stock(
+            dict(vocab_size=128256, hidden_size=4096,
+                 intermediate_size=14336, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=8,
+                 rope_theta=500000.0), over)
+
+    @staticmethod
     def llama_1b(**over) -> "LlamaConfig":
         return LlamaConfig._stock(
             dict(hidden_size=2048, intermediate_size=5504,
