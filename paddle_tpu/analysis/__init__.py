@@ -1,0 +1,39 @@
+"""paddle_tpu.analysis — jaxpr-level TPU lint & graph diagnostics.
+
+The reference framework devotes a whole layer to compile-time graph
+checking (PIR passes, infermeta shape/dtype validation, spmd rule
+checks). This package is the TPU-native equivalent: trace any callable
+to its jaxpr and run pluggable rules that catch TPU hazards — tile
+misalignment, recompile-prone scalar captures, silent dtype promotion,
+dead/duplicate collectives, host syncs — before the hardware is touched.
+
+Quick start::
+
+    import paddle_tpu.analysis as analysis
+    report = analysis.analyze(model, example_batch)
+    print(report.format())
+    report.raise_or_warn()              # LintError on error findings
+
+    # at jit time:
+    paddle_tpu.jit.to_static(fn, lint=True)
+    # or globally: PADDLE_TPU_LINT=1 python train.py
+
+    # from a shell:
+    python -m paddle_tpu.analysis mypkg.models:factory
+
+See analysis/README.md for the rule catalog and how to write rules.
+"""
+from .diagnostics import (  # noqa: F401
+    Diagnostic, LintError, Report, Severity,
+)
+from .graph import Graph, trace_graph  # noqa: F401
+from .pipeline import Pipeline, analyze, lint  # noqa: F401
+from .rules import (  # noqa: F401
+    RULES, Rule, default_rules, register_rule,
+)
+
+__all__ = [
+    "Diagnostic", "Graph", "LintError", "Pipeline", "Report", "RULES",
+    "Rule", "Severity", "analyze", "default_rules", "lint",
+    "register_rule", "trace_graph",
+]

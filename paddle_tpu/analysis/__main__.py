@@ -1,0 +1,112 @@
+"""CLI: lint a model factory from the command line.
+
+    python -m paddle_tpu.analysis                       # bundled llama demo
+    python -m paddle_tpu.analysis mypkg.models:factory  # your factory
+    python -m paddle_tpu.analysis mypkg.models:Net --shape 1,128:int32
+
+A factory is any zero-arg callable in an importable module. It may
+return:
+  - ``(fn, args)`` or ``(fn, args, kwargs)``: `fn` is linted called with
+    those example arguments (arrays, Tensors, or ShapeDtypeStructs);
+  - a bare callable / `Layer`: example inputs then come from ``--shape``
+    (repeatable, ``dims:dtype``).
+
+Exit status is 1 when any diagnostic reaches ``--fail-on`` (default:
+error), so it slots straight into CI.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+
+def _parse_shape(spec: str):
+    import jax
+    import jax.numpy as jnp
+
+    dims, _, dtype = spec.partition(":")
+    shape = tuple(int(d) for d in dims.split(",") if d)
+    return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype or "float32"))
+
+
+def _llama_demo():
+    """Default target: the bundled tiny-llama forward pass."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    ids = jax.ShapeDtypeStruct((1, 32), jnp.int32)
+    return model, (ids,), {}
+
+
+def _resolve_target(spec, shapes):
+    if spec is None:
+        return _llama_demo() + ("models.llama tiny forward",)
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(
+            f"target {spec!r} must be module.path:factory_name")
+    sys.path.insert(0, "")
+    mod = importlib.import_module(mod_name)
+    factory = getattr(mod, attr)
+    obj = factory() if callable(factory) else factory
+    if isinstance(obj, tuple):
+        fn = obj[0]
+        args = tuple(obj[1]) if len(obj) > 1 else ()
+        kwargs = dict(obj[2]) if len(obj) > 2 else {}
+    else:
+        fn = obj
+        args = tuple(_parse_shape(s) for s in shapes)
+        kwargs = {}
+    return fn, args, kwargs, spec
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="jaxpr-level TPU lint for paddle_tpu programs")
+    parser.add_argument(
+        "target", nargs="?", default=None,
+        help="module.path:factory (default: bundled tiny-llama demo)")
+    parser.add_argument(
+        "--shape", action="append", default=[], metavar="DIMS[:DTYPE]",
+        help="example input when the factory returns a bare callable, "
+             "e.g. --shape 1,128:int32 (repeatable)")
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all registered)")
+    parser.add_argument(
+        "--mesh-axes", default=None,
+        help="comma-separated mesh axis names collectives may use")
+    parser.add_argument(
+        "--fail-on", default="error",
+        choices=["info", "warning", "error", "never"],
+        help="exit 1 when a diagnostic reaches this severity")
+    parser.add_argument(
+        "--min-severity", default="info",
+        choices=["info", "warning", "error"],
+        help="hide diagnostics below this severity")
+    args = parser.parse_args(argv)
+
+    from . import Severity, analyze
+
+    fn, call_args, call_kwargs, label = _resolve_target(
+        args.target, args.shape)
+    rules = args.rules.split(",") if args.rules else None
+    mesh_axes = args.mesh_axes.split(",") if args.mesh_axes else None
+
+    report = analyze(fn, *call_args, rules=rules, mesh_axes=mesh_axes,
+                     name=label, **call_kwargs)
+    print(report.format(
+        min_severity=Severity[args.min_severity.upper()]))
+    if args.fail_on != "never" and \
+            report.at_least(Severity[args.fail_on.upper()]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

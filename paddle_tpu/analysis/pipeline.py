@@ -1,0 +1,100 @@
+"""The lint pass pipeline: trace -> run rules -> report.
+
+TPU-native analog of the reference's PIR pass pipeline + infermeta
+checks (pir pass registry, analysis_predictor's IR pass list): one
+entry point traces any callable to its jaxpr and runs every registered
+rule over the shared `Graph` view.
+
+Use it three ways:
+  - library:  `report = analyze(fn, *example_args)`
+  - jit hook: `paddle_tpu.jit.to_static(fn, lint=True)` (or the
+    `PADDLE_TPU_LINT` env flag) lints at trace time
+  - CLI:      `python -m paddle_tpu.analysis pkg.module:factory`
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional, Sequence
+
+from .diagnostics import Diagnostic, LintError, Report, Severity
+from .graph import Graph, trace_graph
+from .rules import Rule, RULES, default_rules
+
+
+class Pipeline:
+    """A configured rule set runnable over many graphs."""
+
+    def __init__(self, rules: Optional[Sequence[Rule]] = None,
+                 severity_overrides: Optional[Dict[str, Severity]] = None,
+                 **config):
+        if rules is None:
+            rules = default_rules(severity_overrides, **config)
+        else:
+            rules = list(rules)
+        self.rules = rules
+
+    def run(self, graph: Graph) -> Report:
+        report = Report(target=graph.name)
+        for rule in self.rules:
+            try:
+                report.extend(rule.check(graph))
+            except Exception as e:  # a broken rule must not kill the lint
+                report.add(Diagnostic(
+                    rule=rule.id, severity=Severity.INFO,
+                    message=f"rule crashed: {type(e).__name__}: {e}",
+                    where=graph.name))
+        return report
+
+    def analyze(self, fn: Callable, *args, name: Optional[str] = None,
+                **kwargs) -> Report:
+        return self.run(trace_graph(fn, *args, name=name, **kwargs))
+
+
+def analyze(fn: Callable, *args,
+            rules: Optional[Iterable] = None,
+            severity_overrides: Optional[Dict[str, Severity]] = None,
+            mesh_axes: Optional[Sequence[str]] = None,
+            name: Optional[str] = None,
+            **kwargs) -> Report:
+    """Lint `fn` called with `args`/`kwargs` (arrays, Tensors, or
+    ShapeDtypeStruct placeholders — nothing executes on device).
+
+    `rules` may be Rule instances or registered rule ids; omitted means
+    every registered rule. `severity_overrides` ({rule_id: Severity, or
+    None to disable}) applies whether rules are explicit or defaulted.
+    `mesh_axes` feeds the collective rule the axes it should treat as
+    valid. Returns a `Report`; apply a policy with
+    `report.raise_or_warn()`.
+    """
+    overrides = severity_overrides or {}
+    resolved = None
+    if rules is not None:
+        resolved = []
+        for r in rules:
+            if isinstance(r, Rule):
+                rule = r
+            elif isinstance(r, str):
+                if r not in RULES:
+                    raise KeyError(
+                        f"unknown rule {r!r}; registered: {sorted(RULES)}")
+                rule = RULES[r](mesh_axes=mesh_axes)
+            elif isinstance(r, type) and issubclass(r, Rule):
+                rule = r(mesh_axes=mesh_axes)
+            else:
+                raise TypeError(f"cannot interpret rule {r!r}")
+            if rule.id in overrides:
+                if overrides[rule.id] is None:
+                    continue
+                rule.severity = overrides[rule.id]
+            resolved.append(rule)
+    pipe = Pipeline(rules=resolved, severity_overrides=severity_overrides,
+                    mesh_axes=mesh_axes)
+    return pipe.analyze(fn, *args, name=name, **kwargs)
+
+
+def lint(fn: Callable, *args, fail_on: Severity = Severity.ERROR,
+         **kwargs) -> Report:
+    """`analyze` + the default severity policy: raise `LintError` on
+    error-severity findings, emit python warnings for warnings."""
+    report = analyze(fn, *args, **kwargs)
+    report.raise_or_warn(fail_on=fail_on)
+    return report

@@ -1,0 +1,528 @@
+"""Built-in lint rules: TPU correctness/perf hazards visible in a jaxpr.
+
+Rule catalog (see analysis/README.md for the long-form docs):
+
+  TPU101 tile-alignment       matmul operand dims vs the dtype tile
+  TPU102 kernel-constraints   pallas_call shapes vs the declared
+                              KernelConstraint registry in kernels/
+  TPU201 recompile-risk       weak-typed python scalars baked into the
+                              graph as literals (every new value retraces)
+  TPU202 const-bloat          large arrays captured as compile-time
+                              constants (recompile + HBM duplication)
+  TPU301 dtype-promotion      silent bf16→f32 upcasts feeding compute
+  TPU401 collectives          dead/duplicate collectives; psum over axes
+                              not in the declared mesh
+  TPU501 host-sync            host callbacks inside traced code (ERROR
+                              when inside a scan/while hot loop)
+
+Custom rules: subclass `Rule`, decorate with `@register_rule`, and pass
+the id in `rules=` (or nothing — registered rules run by default).
+
+The fusion-boundary sensitivity of all of these is the subject of
+"Operator Fusion in XLA: Analysis and Evaluation" (PAPERS.md); the tile
+numbers come from the Pallas TPU tiling contract ((8|16|32) x 128 by
+dtype) that "Ragged Paged Attention" §2 works around at the kernel level.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Type
+
+import numpy as np
+
+from ..kernels.constraints import (
+    LANE, constraint_for_kernel_fn, min_tile,
+)
+from .diagnostics import Diagnostic, Severity
+from .graph import EqnCtx, Graph
+
+RULES: Dict[str, Type["Rule"]] = {}
+
+
+def register_rule(cls: Type["Rule"]) -> Type["Rule"]:
+    """Class decorator: adds the rule to the default pipeline set."""
+    RULES[cls.id] = cls
+    return cls
+
+
+class Rule:
+    """Base lint rule. Subclasses set `id`/`name`/`default_severity` and
+    implement `check(graph)` yielding Diagnostics. `self.severity` is
+    the effective severity (pipeline applies per-run overrides)."""
+
+    id: str = "TPU000"
+    name: str = "base"
+    description: str = ""
+    default_severity: Severity = Severity.WARNING
+
+    def __init__(self, severity: Optional[Severity] = None, **config):
+        self.severity = self.default_severity if severity is None \
+            else severity
+        self.config = config
+
+    def diag(self, message: str, where: str = "",
+             hint: Optional[str] = None,
+             severity: Optional[Severity] = None) -> Diagnostic:
+        return Diagnostic(rule=self.id,
+                          severity=self.severity if severity is None
+                          else severity,
+                          message=message, where=where, hint=hint)
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# TPU101: MXU tile alignment of matmuls
+# ---------------------------------------------------------------------------
+
+@register_rule
+class TileAlignmentRule(Rule):
+    """dot_general operands whose dims are not multiples of the
+    dtype-dependent TPU tile ((8|16|32) sublanes x 128 lanes). The MXU
+    pads such operands; a 100-wide contraction runs at 100/128 of the
+    paid FLOPs — invisible in profiles because the padding is inside the
+    fusion."""
+
+    id = "TPU101"
+    name = "tile-alignment"
+    default_severity = Severity.WARNING
+
+    # dims this small are scalar-ish glue (loss reductions etc.), not
+    # MXU work worth flagging
+    MIN_DIM = 8
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        # dedupe: a stacked model repeats the same misaligned matmul per
+        # layer — report each unique (role, size, shapes) once + count
+        found: Dict[tuple, list] = {}
+        for ctx in graph.eqns():
+            if ctx.primitive != "dot_general":
+                continue
+            lhs, rhs = ctx.eqn.invars[0], ctx.eqn.invars[1]
+            l_aval, r_aval = lhs.aval, rhs.aval
+            (l_contract, r_contract), (l_batch, r_batch) = \
+                ctx.params["dimension_numbers"]
+            sub, lane = min_tile(l_aval.dtype)
+            checks = []  # (role, size, multiple)
+            for d in range(len(l_aval.shape)):
+                if d in l_batch:
+                    continue
+                size = l_aval.shape[d]
+                if d in l_contract:
+                    checks.append(("lhs contracting", size, lane))
+                else:
+                    checks.append(("lhs non-contracting", size, sub))
+            for d in range(len(r_aval.shape)):
+                if d in r_batch:
+                    continue
+                size = r_aval.shape[d]
+                if d in r_contract:
+                    checks.append(("rhs contracting", size, lane))
+                else:
+                    checks.append(("rhs non-contracting", size, lane))
+            for role, size, multiple in checks:
+                if size >= self.MIN_DIM and size % multiple:
+                    key = (role, size, multiple, str(l_aval.dtype),
+                           tuple(l_aval.shape), tuple(r_aval.shape))
+                    found.setdefault(key, []).append(ctx.path)
+        for (role, size, multiple, dtype, ls, rs), paths in found.items():
+            sites = "" if len(paths) == 1 else f" ({len(paths)} sites)"
+            yield self.diag(
+                f"{role} dim {size} is not a multiple of the "
+                f"{multiple}-wide tile for {dtype} "
+                f"(lhs {ls} x rhs {rs}){sites}",
+                where=paths[0],
+                hint=f"pad to {-(-size // multiple) * multiple} "
+                     "or fold the ragged dim into the batch")
+
+
+# ---------------------------------------------------------------------------
+# TPU102: pallas_call shapes vs the kernel constraint registry
+# ---------------------------------------------------------------------------
+
+@register_rule
+class KernelConstraintRule(Rule):
+    """pallas_call equations checked against the `KernelConstraint`
+    registry that kernels/ declares — the kernels' own block constants
+    are the single source of truth, so this can never drift from the
+    implementation."""
+
+    id = "TPU102"
+    name = "kernel-constraints"
+    default_severity = Severity.ERROR
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        found: Dict[tuple, list] = {}
+        hints: Dict[tuple, Optional[str]] = {}
+        for ctx in graph.eqns():
+            if ctx.primitive != "pallas_call":
+                continue
+            kernel_name, kernel_src = _pallas_kernel_name(ctx.eqn)
+            constraint = constraint_for_kernel_fn(kernel_name, kernel_src)
+            if constraint is None:
+                continue
+            shapes = [tuple(v.aval.shape) for v in ctx.eqn.invars]
+            dtypes = [str(v.aval.dtype) for v in ctx.eqn.invars]
+            for violation in constraint.check(shapes, dtypes):
+                sev = None
+                if isinstance(violation, tuple):
+                    sev_name, violation = violation
+                    sev = Severity[sev_name.upper()]
+                key = (constraint.name, kernel_name, violation, sev)
+                found.setdefault(key, []).append(ctx.path)
+                hints[key] = constraint.note or None
+        for (cname, kname, violation, sev), paths in found.items():
+            sites = "" if len(paths) == 1 else f" ({len(paths)} sites)"
+            yield self.diag(
+                f"{cname} ({kname}): {violation}{sites}",
+                where=paths[0], hint=hints[(cname, kname, violation, sev)],
+                severity=sev)
+
+
+def _pallas_kernel_name(eqn):
+    """(fn_name, full_src_string) of a pallas_call's kernel."""
+    info = eqn.params.get("name_and_src_info")
+    name = getattr(info, "name", None)
+    if name:
+        return str(name), str(info)
+    return str(eqn.params.get("name", "")), ""
+
+
+# ---------------------------------------------------------------------------
+# TPU201: weak-typed scalars -> recompilation risk
+# ---------------------------------------------------------------------------
+
+@register_rule
+class RecompileRiskRule(Rule):
+    """Python scalars captured into the graph trace as weakly-typed 0-d
+    literals. Under jit each new VALUE is a new cache key: a loss scale
+    or step count threaded as a plain float retraces (and recompiles)
+    every time it changes. Shape-dependent python branches have the same
+    signature — the branch outcome is frozen into the trace."""
+
+    id = "TPU201"
+    name = "recompile-risk"
+    default_severity = Severity.WARNING
+
+    # literals consumed by these primitives are structural (slicing
+    # bounds, pad values, axis sizes), not data the user threads through
+    STRUCTURAL = frozenset({
+        "slice", "dynamic_slice", "dynamic_update_slice", "pad", "iota",
+        "broadcast_in_dim", "reshape", "gather", "scatter", "concatenate",
+        "rev", "transpose", "squeeze", "reduce_sum", "reduce_max",
+        "reduce_min", "convert_element_type", "expand_dims",
+    })
+    # values overwhelmingly used as fixed algebraic identities
+    BENIGN_VALUES = (0, 1, -1, 2, 0.5, -0.5, 1e-6, 1e-5, 1e-12)
+    MAX_REPORTS = 8
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        # When the tracer recorded the python-scalar call arguments we
+        # hunt for exactly those values among the captured literals — a
+        # closure constant (rope theta, eps) is stable across calls, but
+        # an ARGUMENT baked in as a literal means every new value is a
+        # fresh trace. Literals are stored cast to the consuming dtype
+        # (weak types are erased at jaxpr level), so the comparison
+        # casts the argument the same way. The generic branch below the
+        # argument match serves graphs built WITHOUT the tracer
+        # (Graph(closed_jaxpr) + Pipeline.run), where scalar_args is
+        # None and no argument information exists.
+        arg_vals = graph.scalar_args
+        seen_vals = set()
+        n = 0
+        for lit, ctx in graph.scalar_literals():
+            if ctx.primitive in self.STRUCTURAL:
+                continue
+            try:
+                val = np.asarray(lit.val).item()
+            except Exception:
+                continue
+            if arg_vals is not None:
+                lit_is_float = np.issubdtype(lit.val.dtype, np.floating)
+                label = None
+                for a, lbl in arg_vals:
+                    # a FLOAT argument must not match an INT literal:
+                    # the cast truncates (2.5 -> 2) and would mislabel
+                    # an unrelated constant. An int argument stored as
+                    # a float literal is exact and must still match.
+                    if isinstance(a, float) and not lit_is_float:
+                        continue
+                    try:
+                        if np.asarray(
+                                a, dtype=lit.val.dtype).item() == val:
+                            label = lbl
+                            break
+                    except (TypeError, ValueError, OverflowError):
+                        continue
+                if label is None or val in seen_vals:
+                    continue
+                seen_vals.add(val)
+                yield self.diag(
+                    f"python scalar argument {label} (= {val!r}) is "
+                    f"baked into `{ctx.primitive}` as a trace constant; "
+                    "every new value retraces and recompiles",
+                    where=ctx.path,
+                    hint="pass it as a jnp array (jnp.asarray(x)) so it "
+                         "becomes a device input, or mark it static on "
+                         "purpose")
+                continue
+            if val in self.BENIGN_VALUES or val in seen_vals:
+                continue
+            seen_vals.add(val)
+            n += 1
+            if n > self.MAX_REPORTS:
+                yield self.diag(
+                    "more scalar captures elided "
+                    f"(first {self.MAX_REPORTS} shown)", where=graph.name)
+                return
+            yield self.diag(
+                f"python scalar {val!r} is baked into `{ctx.primitive}` "
+                "as a trace constant; a different value at the next "
+                "call retraces and recompiles",
+                where=ctx.path,
+                hint="pass it as a jnp array argument (or mark it static "
+                     "on purpose)")
+
+
+# ---------------------------------------------------------------------------
+# TPU202: large captured constants
+# ---------------------------------------------------------------------------
+
+@register_rule
+class ConstBloatRule(Rule):
+    """Arrays captured from the python closure are burned into the
+    executable: they duplicate in HBM per compilation and defeat donation.
+    Model weights threaded as closure constants (instead of arguments)
+    are the classic cause."""
+
+    id = "TPU202"
+    name = "const-bloat"
+    default_severity = Severity.INFO
+    THRESHOLD_BYTES = 1 << 20  # 1 MiB
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        threshold = self.config.get("threshold_bytes",
+                                    self.THRESHOLD_BYTES)
+        total = 0
+        worst = None
+        for var, val in graph.captured_consts():
+            nbytes = int(np.prod(var.aval.shape)) * var.aval.dtype.itemsize
+            total += nbytes
+            if worst is None or nbytes > worst[0]:
+                worst = (nbytes, tuple(var.aval.shape),
+                         str(var.aval.dtype))
+        if total >= threshold and worst is not None:
+            yield self.diag(
+                f"{total / (1 << 20):.1f} MiB of arrays captured as "
+                f"compile-time constants (largest: {worst[1]} "
+                f"{worst[2]}, {worst[0] / (1 << 20):.1f} MiB)",
+                where=graph.name,
+                hint="thread weights/buffers as function arguments so "
+                     "XLA can donate and share them")
+
+
+# ---------------------------------------------------------------------------
+# TPU301: silent dtype promotion
+# ---------------------------------------------------------------------------
+
+@register_rule
+class DtypePromotionRule(Rule):
+    """f32 upcasts inside bf16 compute paths. Two shapes:
+
+    - a `convert_element_type` bf16→f32 whose result feeds elementwise
+      compute or further converts: the tensor silently doubles its HBM
+      traffic (jnp type promotion from a stray f32 operand is the usual
+      source);
+    - a `dot_general` with one bf16 and one f32 operand: the MXU runs it
+      at the f32 rate — 8x slower than the bf16 path the author thought
+      they wrote.
+
+    Deliberate fp32 accumulation (`preferred_element_type`) does not
+    trip this rule: it never materialises a converted operand."""
+
+    id = "TPU301"
+    name = "dtype-promotion"
+    default_severity = Severity.WARNING
+
+    LOW = ("bfloat16", "float16")
+    # consumers for which an upcast is deliberate numerics, not drift
+    SINK_OK = frozenset({
+        "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+        "argmax", "argmin", "reduce_precision", "stop_gradient",
+        "convert_element_type", "custom_jvp_call", "custom_vjp_call",
+        "pallas_call", "erf_inv", "cumsum", "cumlogsumexp", "rsqrt",
+    })
+    MAX_REPORTS = 8
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        # the report cap applies to the (noisy) upcast findings only —
+        # mixed-precision matmuls are always reported in full
+        n = 0
+        elided = 0
+        for ctx in graph.eqns():
+            if ctx.primitive == "dot_general":
+                l, r = (str(v.aval.dtype) for v in ctx.eqn.invars[:2])
+                if {l, r} & set(self.LOW) and "float32" in (l, r):
+                    yield self.diag(
+                        f"mixed-precision matmul {l} x {r}: the low-"
+                        "precision operand is upcast and the MXU runs "
+                        "at the f32 rate",
+                        where=ctx.path,
+                        hint="cast both operands to bfloat16 and use "
+                             "preferred_element_type=float32 for the "
+                             "accumulator")
+                continue
+            if ctx.primitive != "convert_element_type":
+                continue
+            src = str(ctx.eqn.invars[0].aval.dtype)
+            dst = str(ctx.params.get("new_dtype"))
+            if src not in self.LOW or dst != "float32":
+                continue
+            out_var = ctx.eqn.outvars[0]
+            compute = [c for c in graph.consumers(out_var)
+                       if c.primitive not in self.SINK_OK]
+            if not compute:
+                continue
+            n += 1
+            if n > self.MAX_REPORTS:
+                elided += 1
+                continue
+            ops = sorted({c.primitive for c in compute})
+            yield self.diag(
+                f"{src}→float32 upcast of {tuple(out_var.aval.shape)} "
+                f"feeds compute ({', '.join(ops[:4])}); the path pays "
+                "f32 bandwidth from here on",
+                where=ctx.path,
+                hint="check for a stray f32 operand promoting the whole "
+                     "expression; cast it down once at the source")
+        if elided:
+            yield self.diag(
+                f"{elided} more upcast finding(s) elided "
+                f"(first {self.MAX_REPORTS} shown)", where=graph.name)
+
+
+# ---------------------------------------------------------------------------
+# TPU401: collective hygiene
+# ---------------------------------------------------------------------------
+
+@register_rule
+class CollectiveRule(Rule):
+    """Three checks over collective equations (psum/all_gather/
+    all_to_all/ppermute/reduce_scatter):
+
+    - dead: the collective's result is never consumed — it still pays
+      full ICI latency because XLA cannot DCE effectful comms it kept;
+    - duplicate: two identical collectives over the same operand+axes
+      (fold into one);
+    - unknown axis: the axis name is not in the mesh axes the caller
+      declared via `mesh_axes=` (skipped when not declared).
+    """
+
+    id = "TPU401"
+    name = "collectives"
+    default_severity = Severity.WARNING
+
+    # pbroadcast is shard_map replication bookkeeping, not a comm op
+    COLLECTIVES = frozenset({
+        "psum", "psum2", "pmax", "pmin", "all_gather", "all_to_all",
+        "ppermute", "reduce_scatter", "pgather",
+    })
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        mesh_axes = self.config.get("mesh_axes")
+        seen: Dict[tuple, EqnCtx] = {}
+        for ctx in graph.eqns():
+            if ctx.primitive not in self.COLLECTIVES:
+                continue
+            axes = ctx.params.get("axes",
+                                  ctx.params.get("axis_name", ()))
+            if not isinstance(axes, (tuple, list)):
+                axes = (axes,)
+            axes = tuple(a for a in axes if isinstance(a, str))
+            # unknown axis
+            if mesh_axes is not None:
+                for a in axes:
+                    if a not in mesh_axes:
+                        yield self.diag(
+                            f"{ctx.primitive} over axis {a!r} which is "
+                            f"not in the mesh axes {tuple(mesh_axes)}",
+                            where=ctx.path,
+                            hint="collectives outside any mesh axis "
+                                 "fail at run time or silently no-op",
+                            severity=Severity.ERROR)
+            # dead result
+            if all(graph.use_count(v) == 0 for v in ctx.eqn.outvars):
+                yield self.diag(
+                    f"result of {ctx.primitive} over {axes} is never "
+                    "used (dead collective still pays ICI latency)",
+                    where=ctx.path,
+                    hint="delete it, or consume its result")
+                continue
+            # duplicate
+            key = (ctx.primitive, axes,
+                   tuple(id(v) for v in ctx.eqn.invars))
+            prev = seen.get(key)
+            if prev is not None:
+                yield self.diag(
+                    f"duplicate {ctx.primitive} over {axes} on the same "
+                    f"operand (first at {prev.path})",
+                    where=ctx.path,
+                    hint="reuse the first result; each copy is a full "
+                         "ICI round")
+            else:
+                seen[key] = ctx
+
+
+# ---------------------------------------------------------------------------
+# TPU501: host sync inside traced code
+# ---------------------------------------------------------------------------
+
+@register_rule
+class HostSyncRule(Rule):
+    """Host callbacks (`io_callback`, `pure_callback`, `debug_callback`
+    / jax.debug.print) compiled into the program stall the TPU on a
+    host round-trip. Inside a scan/while hot loop that is a per-step
+    barrier — ERROR; elsewhere a WARNING."""
+
+    id = "TPU501"
+    name = "host-sync"
+    default_severity = Severity.WARNING
+
+    CALLBACKS = frozenset({
+        "io_callback", "pure_callback", "debug_callback",
+        "python_callback", "outside_call",
+    })
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        for ctx in graph.eqns():
+            if ctx.primitive not in self.CALLBACKS:
+                continue
+            if ctx.in_loop:
+                yield self.diag(
+                    f"host callback `{ctx.primitive}` inside a traced "
+                    "loop body: the device blocks on the host every "
+                    "iteration",
+                    where=ctx.path,
+                    hint="hoist it out of the loop, or accumulate on "
+                         "device and read back once",
+                    severity=Severity.ERROR)
+            else:
+                yield self.diag(
+                    f"host callback `{ctx.primitive}` compiled into the "
+                    "program (host round-trip at every execution)",
+                    where=ctx.path,
+                    hint="drop debug prints from production traces")
+
+
+def default_rules(severity_overrides: Optional[Dict[str, Severity]] = None,
+                  **config) -> List[Rule]:
+    """Instantiate every registered rule, applying per-rule severity
+    overrides ({'TPU501': Severity.ERROR} or {'TPU202': None} to
+    disable)."""
+    overrides = severity_overrides or {}
+    out = []
+    for rule_id, cls in sorted(RULES.items()):
+        if rule_id in overrides and overrides[rule_id] is None:
+            continue
+        out.append(cls(severity=overrides.get(rule_id), **config))
+    return out

@@ -24,10 +24,17 @@ def _coerce(default, raw: str):
     return raw
 
 
-def define_flag(name: str, default, doc: str = ""):
+def define_flag(name: str, default, doc: str = "", env_aliases=()):
+    """Register a flag; `env_aliases` are extra environment variable
+    names honoured besides FLAGS_<name> (first set one wins) — used for
+    user-facing switches like PADDLE_TPU_LINT."""
     if not name.startswith("FLAGS_"):
         name = "FLAGS_" + name
     env = os.environ.get(name)
+    for alias in env_aliases:
+        if env is not None:
+            break
+        env = os.environ.get(alias)
     _REGISTRY[name] = _coerce(default, env) if env is not None else default
     return _REGISTRY[name]
 
@@ -71,3 +78,13 @@ define_flag("allocator_strategy", "xla", "allocation is owned by the XLA runtime
 define_flag("tpu_matmul_precision", "default", "jax default_matmul_precision for fp32 matmuls")
 define_flag("enable_pallas_kernels", True, "use Pallas kernels for fused ops when on TPU")
 define_flag("log_level", 0, "VLOG-style verbosity")
+
+# --- analysis / lint (paddle_tpu.analysis) ---
+define_flag("tpu_lint", False,
+            "run the jaxpr lint pipeline on every to_static trace "
+            "(also: PADDLE_TPU_LINT=1)", env_aliases=("PADDLE_TPU_LINT",))
+define_flag("tpu_lint_fail_on", "error",
+            "severity that aborts the trace when tpu_lint is on: "
+            "error|warning|info|never "
+            "(also: PADDLE_TPU_LINT_FAIL_ON)",
+            env_aliases=("PADDLE_TPU_LINT_FAIL_ON",))

@@ -64,11 +64,14 @@ class StaticFunction:
     python/paddle/jit/dy2static/program_translator.py:711
     `SymbolicStaticFunction.__call__`)."""
 
-    def __init__(self, fn: Callable, input_spec=None, build_strategy=None, full_graph=True, layer=None):
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 full_graph=True, layer=None, lint=None):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
+        # None = follow FLAGS_tpu_lint / PADDLE_TPU_LINT; True/False force
+        self._lint = lint
         self._fallback_keys = set()  # guard keys that stay eager
         self._break_keys = set()     # guard keys that cannot trace whole
         self._cache = {}  # guard key -> (jitted, n_params, n_buffers, out_treedef)
@@ -126,6 +129,12 @@ class StaticFunction:
                 # re-validate those values on every call (value guards).
                 if self._full_graph:
                     raise
+                if self._lint_enabled():
+                    import warnings
+                    warnings.warn(
+                        f"to_static lint: {self._fn.__name__} "
+                        "graph-breaks (data-dependent control flow); "
+                        "path-compiled specialisations are NOT linted")
                 self._break_keys.add(key)
                 return self._path_call(key, params, buffers, args, kwargs,
                                        e)
@@ -454,7 +463,64 @@ class StaticFunction:
             *[unwrap(flat_args[i]) for i in tensor_pos],
             *[unwrap(b) for b in buffers],
         )
+        self._maybe_lint(pure, params, buffers, flat_args, tensor_pos)
         return jitted, out_info["treedef"], out_info["n"]
+
+    def _lint_enabled(self) -> bool:
+        """lint=True/False forces; None follows FLAGS_tpu_lint
+        (PADDLE_TPU_LINT)."""
+        if self._lint is not None:
+            return bool(self._lint)
+        from ..framework import flags as _flags
+
+        try:
+            return bool(_flags.flag("tpu_lint"))
+        except KeyError:  # pragma: no cover
+            return False
+
+    def _maybe_lint(self, pure, params, buffers, flat_args, tensor_pos):
+        """Opt-in trace-time lint (paddle_tpu.analysis): runs the rule
+        pipeline over the SAME pure function jax.jit compiles, so what
+        is linted is exactly what runs. Enabled per-function with
+        `to_static(fn, lint=True)` or globally with PADDLE_TPU_LINT=1;
+        severity policy from FLAGS_tpu_lint_fail_on."""
+        from ..framework import flags as _flags
+
+        if not self._lint_enabled():
+            return
+        from ..analysis import Severity, analyze
+
+        # the user-level python scalars are baked into `pure`'s closure
+        # (they are part of the guard key): hand them to the recompile
+        # rule explicitly, labelled by their position in the call
+        scalar_args = []
+        for i, a in enumerate(flat_args):
+            if isinstance(a, (int, float)) and not isinstance(a, bool):
+                scalar_args.append((a, f"arg[{i}]"))
+        # spec of the PRNG key WITHOUT consuming one: lint must not
+        # shift the global key stream (seed-for-seed reproducibility)
+        key_state = _random.get_rng_state()
+        key_spec = jax.ShapeDtypeStruct(key_state.shape, key_state.dtype)
+        report = analyze(
+            pure,
+            key_spec,
+            *[unwrap(p) for p in params],
+            *[unwrap(flat_args[i]) for i in tensor_pos],
+            *[unwrap(b) for b in buffers],
+            name=f"to_static:{self._fn.__name__}",
+            scalar_args=scalar_args,
+        )
+        fail_on = str(_flags.flag("tpu_lint_fail_on")).lower()
+        if fail_on == "never":
+            fail = Severity.ERROR + 1  # nothing reaches it
+        else:
+            try:
+                fail = Severity[fail_on.upper()]
+            except KeyError:
+                raise ValueError(
+                    f"invalid FLAGS_tpu_lint_fail_on {fail_on!r}; "
+                    "expected error|warning|info|never") from None
+        report.raise_or_warn(fail_on=fail)
 
     # paddle parity helpers
     @property
@@ -468,8 +534,11 @@ class StaticFunction:
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
     """paddle.jit.to_static (ref: python/paddle/jit/api.py:182).
     `full_graph=False` (the default, like the reference's SOT front-end)
-    permits graph breaks: specialisations that cannot trace run eagerly."""
+    permits graph breaks: specialisations that cannot trace run eagerly.
+    `lint=True` runs the paddle_tpu.analysis rule pipeline at trace time
+    (default: follow the PADDLE_TPU_LINT env flag)."""
     full_graph = kwargs.pop("full_graph", False)
+    lint = kwargs.pop("lint", None)
 
     def decorate(fn):
         from ..nn.layer.layers import Layer
@@ -477,11 +546,12 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         if isinstance(fn, Layer):
             layer = fn
             sf = StaticFunction(layer.forward, input_spec=input_spec,
-                                full_graph=full_graph, layer=layer)
+                                full_graph=full_graph, layer=layer,
+                                lint=lint)
             layer.forward = sf
             return layer
         return StaticFunction(fn, input_spec=input_spec,
-                              full_graph=full_graph)
+                              full_graph=full_graph, lint=lint)
 
     if function is not None:
         return decorate(function)

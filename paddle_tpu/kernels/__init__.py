@@ -6,6 +6,15 @@ incubate fused op family). Each kernel ships:
   - a Pallas TPU implementation (MXU/VMEM-tiled), used on TPU backends;
   - a jnp reference path (XLA-fusable) used on CPU and as the numerics oracle.
 """
+from .constraints import (  # noqa: F401
+    KERNEL_CONSTRAINTS, KernelConstraint, LANE, SUBLANE,
+    constraint_for_kernel_fn, min_tile, register_constraint,
+)
 from .flash_attention import flash_attention_fwd, flash_attention  # noqa: F401
 from .rms_norm import rms_norm as fused_rms_norm  # noqa: F401
 from .rope import apply_rotary_emb  # noqa: F401
+
+# importing the kernel modules populates KERNEL_CONSTRAINTS; decode and
+# int4 register theirs on import too
+from . import decode_attention as _decode_attention  # noqa: F401
+from . import int4_matmul as _int4_matmul  # noqa: F401

@@ -1,0 +1,111 @@
+"""Declarative tile/block constraints for the Pallas kernel pack.
+
+Single source of truth shared by two consumers:
+
+- the kernels themselves read the named constants (``BLOCK_Q`` etc. live in
+  each kernel module and are registered here) instead of scattering magic
+  numbers through block-spec math;
+- ``paddle_tpu.analysis`` reads the registry to lint traced graphs: a
+  ``pallas_call`` equation whose kernel function matches a registered
+  constraint gets its operand shapes checked against the declared blocks
+  *before* the program ever reaches Mosaic.
+
+Hardware facts (see /opt guides and "Ragged Paged Attention"'s tiling
+discussion): every VMEM tile is (sublane x 128 lanes) with the sublane
+count set by dtype width — fp32 packs 8 rows per tile, bf16 16, int8/fp8
+32. A dimension that is not a multiple of its tile is silently padded in
+VMEM and wastes MXU/VPU issue slots.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# minor-most (lane) dimension of every TPU vector register / VMEM tile
+LANE = 128
+
+# second-minor (sublane) tile dimension by dtype
+SUBLANE: Dict[str, int] = {
+    "float32": 8,
+    "bfloat16": 16,
+    "float16": 16,
+    "int8": 32,
+    "uint8": 32,
+    "int4": 32,
+    "uint4": 32,
+    "float8_e4m3fn": 32,
+    "float8_e5m2": 32,
+}
+
+
+def min_tile(dtype) -> Tuple[int, int]:
+    """(sublane, lane) minimum tile for `dtype`; unknown dtypes get the
+    fp32 tile (the most permissive)."""
+    return SUBLANE.get(str(np.dtype(dtype)), 8), LANE
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelConstraint:
+    """One kernel's declared TPU layout contract.
+
+    `kernel_fns` are the Pallas kernel *function* names (what shows up in
+    a traced `pallas_call` equation's name_and_src_info) this constraint
+    covers. `blocks` are the named block-size constants the kernel tiles
+    with. `checker(shapes, dtypes)` receives the pallas_call operand aval
+    shapes/dtype-names and returns violations: plain strings (severity
+    decided by the lint rule) or ("error"|"warning", message) pairs —
+    "error" for shapes the kernel rejects outright, "warning" for silent
+    perf hazards (padding, fallback routes). Checkers must be pure shape
+    math (no jax calls) so the lint can run on CPU against any graph.
+    """
+
+    name: str
+    kernel_fns: Tuple[str, ...]
+    blocks: Dict[str, int]
+    note: str = ""
+    checker: Optional[
+        Callable[[Sequence[Tuple[int, ...]], Sequence[str]], Sequence[str]]
+    ] = None
+    # source-file hint disambiguating generic kernel fn names (several
+    # kernels use `_fwd_kernel`/`_kernel`): matched against the traced
+    # pallas name_and_src_info string, e.g. "flash_attention.py"
+    source: str = ""
+
+    def check(self, shapes: Sequence[Tuple[int, ...]],
+              dtypes: Sequence[str]) -> list:
+        if self.checker is None:
+            return []
+        return list(self.checker(shapes, dtypes))
+
+
+KERNEL_CONSTRAINTS: Dict[str, KernelConstraint] = {}
+_BY_KERNEL_FN: Dict[str, KernelConstraint] = {}
+
+
+def register_constraint(c: KernelConstraint) -> KernelConstraint:
+    KERNEL_CONSTRAINTS[c.name] = c
+    for fn in c.kernel_fns:
+        _BY_KERNEL_FN[fn] = c
+    return c
+
+
+def constraint_for_kernel_fn(fn_name: str,
+                             src: str = "") -> Optional[KernelConstraint]:
+    """Look up the constraint covering a Pallas kernel function name.
+    `src` is the full traced name-and-source string (when available) —
+    constraints with a `source` hint only match when it appears there,
+    so generic names like `_fwd_kernel` cannot cross-match kernels."""
+
+    def source_ok(c: KernelConstraint) -> bool:
+        return not c.source or not src or c.source in src
+
+    c = _BY_KERNEL_FN.get(fn_name)
+    if c is not None and source_ok(c):
+        return c
+    # prefix match: name_and_src_info may append wrapper suffixes
+    for k, cand in _BY_KERNEL_FN.items():
+        if fn_name.startswith(k) and source_ok(cand):
+            return cand
+    return None

@@ -21,7 +21,64 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import CompilerParams as _CompilerParams
+
+from .constraints import KernelConstraint, LANE, register_constraint
+
 _NEG_INF = -1e30
+
+# default kv-block length each grid step streams through VMEM
+BLOCK_S = 512
+# pairs of k+v blocks must double-buffer inside scoped VMEM; keep a
+# safety margin under the ~16 MB budget (measured: h=32, block 512,
+# d=128 OOMs scoped vmem by 48 KB at max_seq 2048 without it)
+VMEM_BUDGET_BYTES = 12 << 20
+# below this block length the grid degenerates (near-prime max_seq) and
+# the kernel warns to pad the cache
+MIN_BLOCK_S = 32
+
+
+def _fitted_block(block_s: int, max_seq: int, h: int, d: int) -> int:
+    """Largest divisor of max_seq under both the requested block and the
+    VMEM double-buffering cap — the block the contiguous kernel runs."""
+    cap = max(1, VMEM_BUDGET_BYTES // (8 * h * d))
+    bs = min(block_s, max_seq, cap)
+    while max_seq % bs:
+        bs -= 1
+    return bs
+
+
+def _check_decode_shapes(shapes, dtypes):
+    """Checker for the contiguous/GQA decode pallas calls. Operands lead
+    with the scalar-prefetch args; the q/cache trio sits at the tail:
+    q [B, H, D] (or [B*Hkv, group, D]), caches [..., block, D]. Only the
+    lane check is shape-decidable here: a small second-minor cache dim
+    is a legitimate page length in the paged layout, so block-length
+    degradation is surfaced by the kernel's own runtime warning
+    instead."""
+    out = []
+    arr = [s for s in shapes if len(s) >= 3]
+    if not arr:
+        return out
+    d = arr[0][-1]
+    if d % LANE:
+        out.append(("warning",
+                    f"head_dim {d} is not a multiple of the {LANE}-lane "
+                    "tile; decode streams the whole cache padded to "
+                    f"{-(-d // LANE) * LANE} lanes"))
+    return out
+
+
+CONSTRAINT = register_constraint(KernelConstraint(
+    name="decode_attention",
+    kernel_fns=("_decode_kernel", "_paged_decode_kernel",
+                "_gqa_contig_kernel", "_paged_gqa_kernel"),
+    blocks={"block_s": BLOCK_S, "min_block_s": MIN_BLOCK_S},
+    note="bandwidth-bound single-token decode; cache length should admit "
+         f"a divisor >= {MIN_BLOCK_S} under the VMEM double-buffer cap",
+    checker=_check_decode_shapes,
+    source="decode_attention.py",
+))
 
 
 def _on_tpu() -> bool:
@@ -77,7 +134,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
 
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                     lens: jax.Array, *, block_s: int = 512,
+                     lens: jax.Array, *, block_s: int = BLOCK_S,
                      scale: float | None = None) -> jax.Array:
     """One decode step over a contiguous cache.
 
@@ -90,22 +147,17 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     max_seq = k_cache.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if d % 128:
+    if d % LANE:
         # Mosaic cannot shape-cast the [H, 1, D] broadcast at narrow
         # head dims; the GQA grid's dot-general form lowers at any D
         # (including group=1 — verified on silicon at D=32)
         return gqa_decode_attention(q, k_cache, v_cache, lens,
                                     block_s=block_s, scale=scale)
-    # cap the block so k+v blocks double-buffer inside the ~16 MB scoped
-    # VMEM (2 operands x 2 buffers x itemsize 2 = 8 bytes per element);
-    # then take the largest divisor of max_seq under the cap so the grid
-    # covers the cache exactly (measured: h=32, block 512, d=128 OOMs
-    # scoped vmem by 48 KB at max_seq 2048)
-    cap = max(1, (12 << 20) // (8 * h * d))
-    block_s = min(block_s, max_seq, cap)
-    while max_seq % block_s:
-        block_s -= 1
-    if block_s < min(32, max_seq):
+    # take the largest divisor of max_seq under both the requested block
+    # and the VMEM double-buffering cap so the grid covers the cache
+    # exactly (2 operands x 2 buffers x itemsize 2 = 8 bytes per element)
+    block_s = _fitted_block(block_s, max_seq, h, d)
+    if block_s < min(MIN_BLOCK_S, max_seq):
         # near-prime max_seq: the largest divisor under the VMEM cap is
         # pathologically small — a 3-row-block grid would be an
         # order-of-magnitude silent slowdown. Surface it.
@@ -113,7 +165,7 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
         warnings.warn(
             f"decode_attention: max_seq {max_seq} forces block_s "
-            f"{block_s} (largest divisor under the {cap} VMEM cap); pad "
+            f"{block_s} (largest divisor under the VMEM cap); pad "
             f"the cache to a rounder length", stacklevel=2)
     grid = (b, max_seq // block_s)
     kernel = functools.partial(_decode_kernel, block_s=block_s, scale=scale)
@@ -137,7 +189,7 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(lens.astype(jnp.int32), q, k_cache, v_cache)
@@ -247,7 +299,7 @@ def _paged_gqa_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def gqa_decode_attention(q: jax.Array, k_cache: jax.Array,
                          v_cache: jax.Array, lens: jax.Array, *,
-                         block_s: int = 512,
+                         block_s: int = BLOCK_S,
                          scale: float | None = None) -> jax.Array:
     """Grouped-query decode over a CONTIGUOUS cache — the GQA grid of
     the paged kernel without a table: one kv block of one kv head per
@@ -269,7 +321,7 @@ def gqa_decode_attention(q: jax.Array, k_cache: jax.Array,
     bs = min(block_s, max_seq)
     while max_seq % bs:
         bs -= 1
-    if bs < min(32, max_seq):
+    if bs < min(MIN_BLOCK_S, max_seq):
         import warnings
 
         warnings.warn(
@@ -309,7 +361,7 @@ def gqa_decode_attention(q: jax.Array, k_cache: jax.Array,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b * hkv, group, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(lens.astype(jnp.int32), qg, kc, vc)
@@ -368,7 +420,7 @@ def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale):
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b * hkv, group, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),
@@ -393,7 +445,7 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
     hkv = key_cache.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if h != hkv or d % 128:
+    if h != hkv or d % LANE:
         # grouped queries — or narrow head dims, where the equal-heads
         # kernel's [H, 1, D] broadcast fails to lower (see
         # decode_attention); the GQA grid covers group=1 too
@@ -428,7 +480,7 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),

@@ -22,8 +22,66 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import CompilerParams as _CompilerParams
+
+from .constraints import KernelConstraint, LANE, register_constraint
+
 _NEG_INF = -1e30
 _splash_warned = False
+
+# default seq tiling of the in-repo kernels: both grids walk the kv axis
+# in BLOCK_K steps with BLOCK_Q query rows resident in VMEM (clamped to
+# the actual seq len; seq lens must then divide the clamped block)
+BLOCK_Q = 512
+BLOCK_K = 512
+# the bundled jax MHA / splash fast paths tile at 1024 and require
+# 512-divisible seqs and a 128-lane-aligned head dim
+FAST_PATH_BLOCK = 1024
+FAST_PATH_SEQ_MULTIPLE = 512
+
+
+def _check_attention_shapes(shapes, dtypes):
+    """Checker for the fwd/bwd pallas calls: q [BH, Sq, D], k/v
+    [BKVH, Sk, D] (bwd appends o/do/lse operands — same leading trio)."""
+    out = []
+    if len(shapes) < 3:
+        return out
+    q, k = shapes[0], shapes[1]
+    if len(q) == 3 and len(k) == 3:
+        bh, sq, d = q
+        bkv, sk = k[0], k[1]
+        if d % LANE:
+            out.append(("warning",
+                        f"head_dim {d} is not a multiple of the {LANE}-"
+                        "lane tile; VMEM pads every row to "
+                        f"{-(-d // LANE) * LANE} lanes"))
+        if sq % min(BLOCK_Q, sq):
+            out.append(("error",
+                        f"q seq len {sq} does not divide the "
+                        f"{min(BLOCK_Q, sq)} query block; the kernel "
+                        "raises at call time"))
+        if sk % min(BLOCK_K, sk):
+            out.append(("error",
+                        f"kv seq len {sk} does not divide the "
+                        f"{min(BLOCK_K, sk)} kv block; the kernel "
+                        "raises at call time"))
+        if bkv and bh % bkv:
+            out.append(("error",
+                        f"q heads*batch {bh} not a multiple of kv "
+                        f"heads*batch {bkv}; GQA grouping requires "
+                        "Hq % Hkv == 0"))
+    return out
+
+
+CONSTRAINT = register_constraint(KernelConstraint(
+    name="flash_attention",
+    kernel_fns=("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel"),
+    blocks={"block_q": BLOCK_Q, "block_k": BLOCK_K},
+    note="online-softmax tiled attention; seq lens must divide the "
+         "(clamped) q/kv blocks and head_dim should be 128-lane aligned",
+    checker=_check_attention_shapes,
+    source="flash_attention.py",
+))
 
 
 def _on_tpu() -> bool:
@@ -91,7 +149,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd_pallas(q, k, v, causal: bool, scale: float,
-                block_q: int = 512, block_k: int = 512):
+                block_q: int = BLOCK_Q, block_k: int = BLOCK_K):
     """q: [BH, Sq, D]; k/v: [BKVH, Sk, D]. Returns (out [BH, Sq, D],
     lse [BH, Sq, 128] fp32 — the row statistic replicated across lanes,
     the TPU-tileable layout the backward kernels consume directly)."""
@@ -128,7 +186,7 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(q, k, v)
@@ -158,9 +216,9 @@ def _fwd_ref(q, k, v, causal: bool, scale: float):
 
 
 def _pallas_ok(q, k):
-    # must match the kernels' default block choice (min(512, seq))
-    return (q.shape[1] % min(512, q.shape[1]) == 0
-            and k.shape[1] % min(512, k.shape[1]) == 0
+    # must match the kernels' default block choice (min(BLOCK, seq))
+    return (q.shape[1] % min(BLOCK_Q, q.shape[1]) == 0
+            and k.shape[1] % min(BLOCK_K, k.shape[1]) == 0
             and q.shape[0] % k.shape[0] == 0)
 
 
@@ -279,7 +337,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _bwd_pallas(q, k, v, out, lse, do, causal: bool, scale: float,
-                block_q: int = 512, block_k: int = 512):
+                block_q: int = BLOCK_Q, block_k: int = BLOCK_K):
     """Flash backward. Returns (dq [BH,Sq,D], dk/dv [BH,Sk,D] per q-head —
     caller reduces over GQA groups)."""
     bh, sq, d = q.shape
@@ -300,7 +358,7 @@ def _bwd_pallas(q, k, v, out, lse, do, causal: bool, scale: float,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(q, k, v, out, do, lse)
@@ -319,7 +377,7 @@ def _bwd_pallas(q, k, v, out, lse, do, causal: bool, scale: float,
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
     )(q, k, v, out, do, lse)
@@ -388,15 +446,17 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 def _bundled_ok(sq, sk, hq, hk, dh) -> bool:
     """Shapes the bundled jax pallas MHA kernel handles well (equal heads,
     long block-divisible sequences)."""
-    return (_on_tpu() and hq == hk and dh % 128 == 0
-            and sq % 512 == 0 and sk % 512 == 0 and sq == sk)
+    return (_on_tpu() and hq == hk and dh % LANE == 0
+            and sq % FAST_PATH_SEQ_MULTIPLE == 0
+            and sk % FAST_PATH_SEQ_MULTIPLE == 0 and sq == sk)
 
 
 def _splash_ok(sq, sk, hq, hk, dh) -> bool:
     """GQA shapes for the splash kernel (grouped heads natively — the fast
     path for Llama-2-70B/Llama-3-class configs where hk < hq)."""
-    return (_on_tpu() and hq != hk and hq % hk == 0 and dh % 128 == 0
-            and sq % 512 == 0 and sk % 512 == 0 and sq == sk)
+    return (_on_tpu() and hq != hk and hq % hk == 0 and dh % LANE == 0
+            and sq % FAST_PATH_SEQ_MULTIPLE == 0
+            and sk % FAST_PATH_SEQ_MULTIPLE == 0 and sq == sk)
 
 
 @functools.lru_cache(maxsize=16)
@@ -414,9 +474,9 @@ def _splash_kernel(sq, sk, hq, causal: bool):
 
     mk = (_sm.CausalMask((sq, sk)) if causal else _sm.FullMask((sq, sk)))
     mask = _sm.MultiHeadMask([mk for _ in range(hq)])
-    bq = min(1024, sq)
-    bkv = min(1024, sk)
-    bc = min(512, sk)
+    bq = min(FAST_PATH_BLOCK, sq)
+    bkv = min(FAST_PATH_BLOCK, sk)
+    bc = min(FAST_PATH_SEQ_MULTIPLE, sk)
     blocks = _sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bc,
         block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bc,
@@ -467,7 +527,7 @@ def flash_attention(q, k, v, causal: bool = False,
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 BlockSizes, flash_attention as _jax_fa)
 
-            bs = min(1024, sq)
+            bs = min(FAST_PATH_BLOCK, sq)
             blocks = BlockSizes(
                 block_q=bs, block_k_major=bs, block_k=bs, block_b=1,
                 block_q_major_dkv=bs, block_k_major_dkv=bs,

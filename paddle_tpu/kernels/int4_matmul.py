@@ -25,6 +25,53 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .constraints import KernelConstraint, LANE, register_constraint
+
+# output-channel tile each grid step dequantises and multiplies
+BLOCK_N = 512
+# fp32 sublane minimum: x rows are padded up to this before the kernel
+SUBLANE_MIN = 8
+# beyond this M the whole-x-in-VMEM decode shape stops fitting (measured
+# OOM at M=512, K=5504) and calls route to the XLA shift fallback
+MAX_DECODE_M = 64
+
+
+def _check_int4_shapes(shapes, dtypes):
+    """Checker for the decode pallas call: xe/xo [M, K/2], w [N, K/2],
+    scale [1, N]."""
+    out = []
+    if len(shapes) < 3:
+        return out
+    xe, w = shapes[0], shapes[2]
+    if len(xe) == 2 and len(w) == 2:
+        m, khalf = xe
+        n = w[0]
+        # NOTE: no M-cap check here — int4_matmul routes M > MAX_DECODE_M
+        # to the XLA fallback before any pallas_call exists, so a traced
+        # graph can never show an oversized M
+        if n % min(BLOCK_N, n):
+            out.append(("warning",
+                        f"output channels N={n} do not divide the "
+                        f"{min(BLOCK_N, n)} channel block"))
+        if (2 * khalf) % LANE:
+            out.append(("warning",
+                        f"K={2 * khalf} is not a multiple of the "
+                        f"{LANE}-lane tile; the packed nibble rows pad "
+                        "in VMEM"))
+    return out
+
+
+CONSTRAINT = register_constraint(KernelConstraint(
+    name="int4_matmul",
+    kernel_fns=("_kernel",),
+    blocks={"block_n": BLOCK_N, "sublane_min": SUBLANE_MIN,
+            "max_decode_m": MAX_DECODE_M},
+    note="in-register int4 dequant GEMV; decode-shaped M only, N walks "
+         f"in {BLOCK_N}-channel tiles",
+    checker=_check_int4_shapes,
+    source="int4_matmul.py",
+))
+
 
 def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, dot_dtype):
     # Mosaic has no i8 vector shifts: nibble math in i32
@@ -44,7 +91,7 @@ def _kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, dot_dtype):
     o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
 
 
-def int4_matmul(x, w_packed, scale, *, block_n: int = 512,
+def int4_matmul(x, w_packed, scale, *, block_n: int = BLOCK_N,
                 dot_dtype=None):
     """x [M, K] @ dequant(w_packed [N, K//2]).T * scale [N] → [M, K?N].
 
@@ -62,7 +109,7 @@ def int4_matmul(x, w_packed, scale, *, block_n: int = 512,
     # scoped VMEM (~16 MB). Large-M calls (prefill through the same _mm)
     # are compute-bound, where the XLA shift form is the right tool —
     # measured VMEM OOM at M=512, K=5504 without this route.
-    if not aligned or m > 64:
+    if not aligned or m > MAX_DECODE_M:
         return _xla_fallback(x, w_packed, scale)
     on_tpu = jax.default_backend() == "tpu"
     if dot_dtype is None:
@@ -73,7 +120,7 @@ def int4_matmul(x, w_packed, scale, *, block_n: int = 512,
     elif not on_tpu and jnp.dtype(dot_dtype) == jnp.bfloat16:
         # same CPU limitation applies to an explicitly requested bf16
         dot_dtype = jnp.float32
-    pad_m = max(8 - m, 0)
+    pad_m = max(SUBLANE_MIN - m, 0)
     xp = jnp.pad(x, ((0, pad_m), (0, 0))) if pad_m else x
     # even/odd split outside the kernel (Mosaic has no strided gather);
     # x is decode-tiny so this costs nothing

@@ -1,0 +1,14 @@
+"""Pallas TPU API compatibility.
+
+jax renamed `pltpu.TPUCompilerParams` to `pltpu.CompilerParams` (jax
+0.6); the kernel pack is written against the new name. On the pinned
+0.4.x toolchain the old class takes the same keywords, so a plain alias
+suffices — without it every kernel raised AttributeError at call time
+and silently fell back to its jnp reference path.
+"""
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(_pltpu, "CompilerParams", None) or \
+    _pltpu.TPUCompilerParams

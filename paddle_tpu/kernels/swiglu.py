@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import CompilerParams as _CompilerParams
+
 
 _BLOCK = 512  # default tile edge; alignment and the pallas paths share it
 
@@ -88,7 +90,7 @@ def _fwd_pallas(x2d, wg, wu, *, bm: int = _BLOCK, bf: int = _BLOCK,
         out_shape=jax.ShapeDtypeStruct((m, f), x2d.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bf), jnp.float32),
                         pltpu.VMEM((bm, bf), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(x2d, wg, wu)
@@ -151,7 +153,7 @@ def _bwd_pallas(x2d, wg, wu, dout, *, bm: int = _BLOCK, bf: int = _BLOCK,
                    jax.ShapeDtypeStruct((m, f), x2d.dtype)],
         scratch_shapes=[pltpu.VMEM((bm, bf), jnp.float32),
                         pltpu.VMEM((bm, bf), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(x2d, wg, wu, dout)

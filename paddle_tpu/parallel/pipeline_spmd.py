@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .shard_map_compat import shard_map
+
 from . import mesh as mesh_mod
 
 __all__ = ["pipeline_forward", "pipeline_1f1b", "pipeline_eager_1f1b",
@@ -39,7 +41,11 @@ def _to_varying(x, axis):
     """Mark x as varying over the manual axis (scan-carry requirement)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis, to="varying")
-    return jax.lax.pvary(x, axis)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis)
+    # jax 0.4.x: the compat shim runs partial-auto shard_map with the
+    # replication check off, so there is no varying-ness to mark
+    return x
 
 
 def stack_stage_params(per_stage_params: list, mesh: Optional[Mesh] = None,
@@ -100,8 +106,9 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, *,
 
     # manual only over `axis`: the other mesh axes stay "auto" so TP/FSDP
     # shardings of the per-stage weights keep working inside the body
-    # (check_vma must stay on — partial-manual mode relies on it)
-    @partial(jax.shard_map, mesh=mesh, axis_names={axis},
+    # (on jax 0.4.x the compat shim must force the replication check OFF
+    # in partial-auto mode; newer jax keeps check_vma on)
+    @partial(shard_map, mesh=mesh, axis_names={axis},
              in_specs=(P(axis), P()), out_specs=P())
     def run(params_local, xg):
         # params_local: stage dim reduced to 1 on this rank
@@ -203,7 +210,7 @@ def pipeline_vpp_forward(chunk_fn: Callable, chunked_params, x, *,
     mb = batch // n_micro
     SV = n_stages * V
 
-    @partial(jax.shard_map, mesh=mesh, axis_names={axis},
+    @partial(shard_map, mesh=mesh, axis_names={axis},
              in_specs=(P(axis), P()), out_specs=P())
     def run(params_local, xg):
         chunks = jax.tree.map(lambda t: t[0], params_local)  # [V, ...]
@@ -411,7 +418,7 @@ def _pipeline_1f1b_impl(stage_fn, head_fn, stacked_params, head_params, x,
     hp_specs = head_specs if coop else jax.tree.map(
         lambda _: P(), head_params)
 
-    @partial(jax.shard_map, mesh=mesh, axis_names={axis},
+    @partial(shard_map, mesh=mesh, axis_names={axis},
              in_specs=(P(axis), hp_specs, P(), P()),
              out_specs=(P(), P(axis), hp_specs, P()))
     def run(params_local, head_p, xg, lbg):

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_mod
+
+from .shard_map_compat import shard_map
 from .pipeline_spmd import _to_varying
 
 __all__ = ["ring_attention"]
@@ -80,7 +82,7 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
         raise ValueError(f"seq {s} not divisible by {axis} size {n}")
     chunk = s // n
 
-    @functools.partial(jax.shard_map, mesh=mesh, axis_names={axis},
+    @functools.partial(shard_map, mesh=mesh, axis_names={axis},
                        in_specs=(P(None, axis), P(None, axis),
                                  P(None, axis)),
                        out_specs=P(None, axis))

@@ -1,0 +1,43 @@
+"""shard_map compatibility across jax versions.
+
+The distributed stack is written against the stable `jax.shard_map` API
+(jax >= 0.5: `axis_names=` selects the manually-mapped axes, `check_vma=`
+toggles the varying-manual-axes check). On the pinned toolchain (jax
+0.4.x) shard_map still lives in `jax.experimental.shard_map` with the
+older spelling: `auto=` is the complement of `axis_names` and the check
+is called `check_rep`. This module exposes ONE `shard_map` callable with
+the new-style signature and translates when running on the old API.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, check_rep=None):
+        if f is None:
+            return functools.partial(
+                shard_map, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, axis_names=axis_names,
+                check_vma=check_vma, check_rep=check_rep)
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        check = check_vma if check_vma is not None else check_rep
+        if check is None:
+            check = True
+        if auto:
+            # 0.4.x partial-auto mode cannot run the replication check.
+            # NOTE: partial-auto remains second-class on 0.4.x — eager
+            # dispatch raises NotImplementedError and axis_index inside
+            # the body does not lower on CPU SPMD (XLA PartitionId);
+            # callers needing those paths require the jax>=0.5 API.
+            check = False
+        return _esm(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_rep=check, auto=auto)

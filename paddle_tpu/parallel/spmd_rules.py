@@ -759,6 +759,8 @@ def shard_parameters(model, mesh, rules: Sequence[Tuple[str, Tuple]],
                 continue
             names = (d,) if isinstance(d, str) else tuple(d)
             kept = divisible_prefix(mesh, p.shape[i], names)
-            spec.append(kept if kept else None)
+            # bare name for a single axis: PartitionSpec('mp') — older
+            # jax does not normalise the singleton tuple form as equal
+            spec.append(kept[0] if len(kept) == 1 else (kept or None))
         p._array = jax.device_put(p._array, NamedSharding(mesh, P(*spec)))
     return model

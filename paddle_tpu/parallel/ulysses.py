@@ -21,6 +21,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import BATCH_AXES, divisible_prefix as _divisible_prefix
 
+from .shard_map_compat import shard_map
+
 
 def _axes_size(mesh: Mesh, names) -> int:
     return math.prod(int(mesh.shape[n]) for n in names)
@@ -79,7 +81,7 @@ def seq_to_head(x: jax.Array, mesh: Mesh, seq_axis: str = "sep",
         return jax.lax.all_to_all(a, seq_axis, split_axis=2, concat_axis=1,
                                   tiled=True)
 
-    return jax.shard_map(swap, mesh=mesh, in_specs=seq_spec,
+    return shard_map(swap, mesh=mesh, in_specs=seq_spec,
                          out_specs=head_spec, check_vma=False)(x)
 
 
@@ -92,5 +94,5 @@ def head_to_seq(x: jax.Array, mesh: Mesh, seq_axis: str = "sep",
         return jax.lax.all_to_all(a, seq_axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    return jax.shard_map(swap, mesh=mesh, in_specs=head_spec,
+    return shard_map(swap, mesh=mesh, in_specs=head_spec,
                          out_specs=seq_spec, check_vma=False)(x)

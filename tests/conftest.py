@@ -29,3 +29,14 @@ def _seed():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# partial-auto shard_map (axis_names= manual subset) is second-class on
+# jax 0.4.x: eager dispatch raises NotImplementedError and axis_index
+# inside auto axes cannot lower on CPU SPMD (XLA PartitionId). Schedules
+# needing it require the stable jax.shard_map API (jax >= 0.5). Shared
+# by test_pipeline.py and test_ring_attention.py.
+requires_partial_auto = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="partial-auto shard_map needs the stable jax.shard_map API; "
+           "jax 0.4.x cannot lower axis_index under auto axes")

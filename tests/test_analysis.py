@@ -1,0 +1,544 @@
+"""paddle_tpu.analysis: the jaxpr lint pipeline.
+
+Positive AND negative cases per rule: each hazard is exercised with a
+graph that fires the rule and a near-identical clean graph that must not.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.analysis as analysis
+from paddle_tpu.analysis import LintError, Severity
+
+
+def diags(report, rule):
+    return [d for d in report if d.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# TPU101: tile alignment
+# ---------------------------------------------------------------------------
+
+class TestTileAlignment:
+    def test_misaligned_matmul_flagged(self):
+        def f(x, w):
+            return x @ w
+
+        r = analysis.analyze(f, jnp.ones((100, 100), jnp.float32),
+                             jnp.ones((100, 100), jnp.float32),
+                             rules=["TPU101"])
+        found = diags(r, "TPU101")
+        assert found, "misaligned 100x100 matmul must be flagged"
+        assert any("contracting" in d.message for d in found)
+
+    def test_aligned_matmul_clean(self):
+        def f(x, w):
+            return x @ w
+
+        r = analysis.analyze(f, jnp.ones((128, 256), jnp.float32),
+                             jnp.ones((256, 512), jnp.float32),
+                             rules=["TPU101"])
+        assert not diags(r, "TPU101")
+
+    def test_bf16_uses_16_row_tile(self):
+        def f(x, w):
+            return x @ w
+
+        # 8 rows is fine for f32 but HALF a bf16 sublane tile
+        r = analysis.analyze(f, jnp.ones((24, 128), jnp.bfloat16),
+                             jnp.ones((128, 128), jnp.bfloat16),
+                             rules=["TPU101"])
+        found = diags(r, "TPU101")
+        assert any("16-wide" in d.message for d in found)
+
+    def test_repeated_sites_deduped(self):
+        def f(x, w):
+            for _ in range(3):
+                x = x @ w
+            return x
+
+        r = analysis.analyze(f, jnp.ones((100, 100)), jnp.ones((100, 100)),
+                             rules=["TPU101"])
+        per_msg = {}
+        for d in diags(r, "TPU101"):
+            per_msg[d.message] = per_msg.get(d.message, 0) + 1
+        assert all(c == 1 for c in per_msg.values())
+        assert any("3 sites" in m for m in per_msg)
+
+
+# ---------------------------------------------------------------------------
+# TPU102: kernel constraint registry
+# ---------------------------------------------------------------------------
+
+class TestKernelConstraints:
+    def _fa(self):
+        import importlib
+
+        return importlib.import_module(
+            "paddle_tpu.kernels.flash_attention")
+
+    def test_misaligned_head_dim_flagged(self):
+        fa = self._fa()
+
+        def att(q, k, v):
+            return fa._fwd_pallas(q, k, v, False, 1.0)[0]
+
+        q = jax.ShapeDtypeStruct((4, 64, 96), jnp.float32)
+        r = analysis.analyze(att, q, q, q, rules=["TPU102"])
+        found = diags(r, "TPU102")
+        assert found and "head_dim 96" in found[0].message
+        assert found[0].severity == Severity.WARNING
+
+    def test_gqa_mismatch_is_error(self):
+        fa = self._fa()
+
+        def att(q, k, v):
+            return fa._fwd_pallas(q, k, v, False, 1.0)[0]
+
+        q = jax.ShapeDtypeStruct((3, 64, 128), jnp.float32)
+        kv = jax.ShapeDtypeStruct((2, 64, 128), jnp.float32)
+        r = analysis.analyze(att, q, kv, kv, rules=["TPU102"])
+        errs = [d for d in diags(r, "TPU102")
+                if d.severity == Severity.ERROR]
+        assert errs and "Hq % Hkv" in errs[0].message
+
+    def test_aligned_kernel_clean(self):
+        fa = self._fa()
+
+        def att(q, k, v):
+            return fa._fwd_pallas(q, k, v, False, 1.0)[0]
+
+        q = jax.ShapeDtypeStruct((4, 64, 128), jnp.float32)
+        r = analysis.analyze(att, q, q, q, rules=["TPU102"])
+        assert not diags(r, "TPU102")
+
+    def test_generic_kernel_name_needs_matching_source(self):
+        # swiglu also names its kernel `_fwd_kernel`; the source hint
+        # must keep it from inheriting flash_attention's checker
+        from paddle_tpu.kernels.constraints import constraint_for_kernel_fn
+
+        assert constraint_for_kernel_fn(
+            "_fwd_kernel",
+            "_fwd_kernel at .../kernels/swiglu.py:20") is None
+        c = constraint_for_kernel_fn(
+            "_fwd_kernel",
+            "_fwd_kernel at .../kernels/flash_attention.py:98")
+        assert c is not None and c.name == "flash_attention"
+
+    def test_registry_is_shared_source_of_truth(self):
+        from paddle_tpu import kernels
+        from paddle_tpu.kernels import flash_attention as _  # noqa: F401
+
+        c = kernels.KERNEL_CONSTRAINTS["flash_attention"]
+        import importlib
+
+        fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+        assert c.blocks["block_q"] == fa.BLOCK_Q
+        assert c.blocks["block_k"] == fa.BLOCK_K
+        assert kernels.constraint_for_kernel_fn("_fwd_kernel") is c
+
+
+# ---------------------------------------------------------------------------
+# TPU201: recompilation risk
+# ---------------------------------------------------------------------------
+
+class TestRecompileRisk:
+    def test_python_scalar_arg_flagged(self):
+        def f(x, lr):
+            return x * lr
+
+        r = analysis.analyze(f, jnp.ones((8, 128)), 0.77,
+                             rules=["TPU201"])
+        found = diags(r, "TPU201")
+        assert found and "retraces" in found[0].message
+
+    def test_array_scalar_clean(self):
+        def f(x, lr):
+            return x * lr
+
+        r = analysis.analyze(f, jnp.ones((8, 128)), jnp.asarray(0.77),
+                             rules=["TPU201"])
+        assert not diags(r, "TPU201")
+
+    def test_int_scalar_arg_flagged_in_float_math(self):
+        # step counters are the classic recompile key: an int argument
+        # lands in the graph as a float literal and must still match
+        def f(x, step):
+            return x * step
+
+        r = analysis.analyze(f, jnp.ones((8, 128), jnp.float32), 3,
+                             rules=["TPU201"])
+        assert diags(r, "TPU201")
+
+    def test_float_arg_does_not_match_int_literal(self):
+        # 2.5 truncating into the unrelated int literal 2 would be a
+        # false positive
+        def f(x, s):
+            return (x * 2).astype(jnp.int32)
+
+        r = analysis.analyze(f, jnp.ones((8, 128), jnp.int32), 2.5,
+                             rules=["TPU201"])
+        assert not diags(r, "TPU201")
+
+    def test_direct_graph_generic_literal_scan(self):
+        # Graph built WITHOUT the tracer has no argument info; the rule
+        # falls back to flagging suspicious scalar literals generically
+        from paddle_tpu.analysis import Graph, Pipeline
+
+        jxp = jax.make_jaxpr(lambda x: x * 0.77)(
+            jax.ShapeDtypeStruct((8, 128), jnp.float32))
+        report = Pipeline(rules=[analysis.RULES["TPU201"]()]).run(
+            Graph(jxp, name="direct"))
+        assert diags(report, "TPU201")
+
+    def test_closure_constant_not_flagged(self):
+        # rope-theta-style derived constants are stable across calls —
+        # only call ARGUMENTS are recompile keys
+        theta = 1.0 / 10000.0 ** 0.3
+
+        def f(x):
+            return x * theta
+
+        r = analysis.analyze(f, jnp.ones((8, 128)), rules=["TPU201"])
+        assert not diags(r, "TPU201")
+
+
+# ---------------------------------------------------------------------------
+# TPU202: const bloat
+# ---------------------------------------------------------------------------
+
+class TestConstBloat:
+    def test_large_closure_const_flagged(self):
+        big = jnp.ones((512, 600), jnp.float32)  # 1.2 MiB
+
+        def f(x):
+            return x @ big
+
+        r = analysis.analyze(f, jnp.ones((8, 512)), rules=["TPU202"])
+        found = diags(r, "TPU202")
+        assert found and "captured" in found[0].message
+
+    def test_layer_params_ride_as_inputs(self):
+        # a Layer's weights must NOT read as captured constants: the
+        # tracer threads them as inputs like jit/api.py does
+        lin = paddle.nn.Linear(512, 600)
+        r = analysis.analyze(lin, paddle.ones([8, 512]), rules=["TPU202"])
+        assert not diags(r, "TPU202")
+
+
+# ---------------------------------------------------------------------------
+# TPU301: silent dtype promotion
+# ---------------------------------------------------------------------------
+
+class TestDtypePromotion:
+    def test_upcast_feeding_compute_flagged(self):
+        def f(x):
+            return x.astype(jnp.float32) * 2.0 + 1.0
+
+        r = analysis.analyze(f, jnp.ones((16, 128), jnp.bfloat16),
+                             rules=["TPU301"])
+        found = diags(r, "TPU301")
+        assert found and "float32 upcast" in found[0].message
+
+    def test_mixed_precision_matmul_flagged(self):
+        def f(x, w):
+            return jax.lax.dot_general(
+                x, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        r = analysis.analyze(f, jnp.ones((16, 128), jnp.bfloat16),
+                             jnp.ones((128, 128), jnp.float32),
+                             rules=["TPU301"])
+        found = diags(r, "TPU301")
+        assert found and "mixed-precision matmul" in found[0].message
+
+    def test_pure_bf16_clean(self):
+        def f(x, w):
+            y = jax.lax.dot_general(
+                x, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return y.astype(jnp.bfloat16)
+
+        r = analysis.analyze(f, jnp.ones((16, 128), jnp.bfloat16),
+                             jnp.ones((128, 128), jnp.bfloat16),
+                             rules=["TPU301"])
+        assert not diags(r, "TPU301")
+
+    def test_upcast_into_reduction_clean(self):
+        # fp32 accumulation of a reduction is deliberate numerics
+        def f(x):
+            return jnp.sum(x.astype(jnp.float32))
+
+        r = analysis.analyze(f, jnp.ones((16, 128), jnp.bfloat16),
+                             rules=["TPU301"])
+        assert not diags(r, "TPU301")
+
+
+# ---------------------------------------------------------------------------
+# TPU401: collective hygiene (virtual 8-device CPU mesh from conftest)
+# ---------------------------------------------------------------------------
+
+class TestCollectives:
+    def _mesh(self):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()), ("dp",))
+
+    def _smap(self, fn, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.parallel.shard_map_compat import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"), check_vma=False)
+
+    def test_dead_collective_flagged(self):
+        mesh = self._mesh()
+
+        def f(x):
+            _dead = jax.lax.psum(x * 3.0, "dp")
+            return x * 2.0
+
+        r = analysis.analyze(self._smap(f, mesh), jnp.ones((8, 128)),
+                             rules=["TPU401"], mesh_axes=("dp",))
+        found = diags(r, "TPU401")
+        assert found and "never used" in found[0].message
+
+    def test_duplicate_collective_flagged(self):
+        mesh = self._mesh()
+
+        def f(x):
+            y = x * 2.0
+            return jax.lax.psum(y, "dp") + jax.lax.psum(y, "dp")
+
+        r = analysis.analyze(self._smap(f, mesh), jnp.ones((8, 128)),
+                             rules=["TPU401"], mesh_axes=("dp",))
+        found = diags(r, "TPU401")
+        assert any("duplicate" in d.message for d in found)
+
+    def test_axis_outside_mesh_is_error(self):
+        mesh = self._mesh()
+
+        def f(x):
+            return jax.lax.psum(x * 1.0, "dp")
+
+        r = analysis.analyze(self._smap(f, mesh), jnp.ones((8, 128)),
+                             rules=["TPU401"], mesh_axes=("tp", "pp"))
+        errs = [d for d in diags(r, "TPU401")
+                if d.severity == Severity.ERROR]
+        assert errs and "not in the mesh axes" in errs[0].message
+
+    def test_used_collective_on_declared_axis_clean(self):
+        mesh = self._mesh()
+
+        def f(x):
+            return jax.lax.psum(x * 1.0, "dp")
+
+        r = analysis.analyze(self._smap(f, mesh), jnp.ones((8, 128)),
+                             rules=["TPU401"], mesh_axes=("dp",))
+        assert not diags(r, "TPU401")
+
+
+# ---------------------------------------------------------------------------
+# TPU501: host sync
+# ---------------------------------------------------------------------------
+
+class TestHostSync:
+    def test_callback_in_loop_is_error(self):
+        def f(xs):
+            def body(c, x):
+                jax.debug.print("c={c}", c=c)
+                return c + x, c
+
+            return jax.lax.scan(body, jnp.float32(0), xs)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU501"])
+        found = diags(r, "TPU501")
+        assert found and found[0].severity == Severity.ERROR
+        assert "loop" in found[0].message
+
+    def test_callback_outside_loop_is_warning(self):
+        def f(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU501"])
+        found = diags(r, "TPU501")
+        assert found and found[0].severity == Severity.WARNING
+
+    def test_no_callbacks_clean(self):
+        def f(xs):
+            return jax.lax.scan(lambda c, x: (c + x, c),
+                                jnp.float32(0), xs)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU501"])
+        assert not diags(r, "TPU501")
+
+
+# ---------------------------------------------------------------------------
+# pipeline plumbing: severity policy, custom rules, jit integration
+# ---------------------------------------------------------------------------
+
+class TestPipeline:
+    def test_report_raise_on_error(self):
+        def f(xs):
+            def body(c, x):
+                jax.debug.print("c={c}", c=c)
+                return c + x, c
+
+            return jax.lax.scan(body, jnp.float32(0), xs)
+
+        report = analysis.analyze(f, jnp.ones((4,)))
+        with pytest.raises(LintError) as ei:
+            report.raise_or_warn()
+        assert ei.value.report.errors
+
+    def test_severity_override_disables_rule(self):
+        def f(x, w):
+            return x @ w
+
+        r = analysis.analyze(f, jnp.ones((100, 100)), jnp.ones((100, 100)),
+                             severity_overrides={"TPU101": None})
+        assert not diags(r, "TPU101")
+
+    def test_severity_override_promotes_rule(self):
+        def f(x, w):
+            return x @ w
+
+        r = analysis.analyze(
+            f, jnp.ones((100, 100)), jnp.ones((100, 100)),
+            severity_overrides={"TPU101": Severity.ERROR})
+        assert any(d.severity == Severity.ERROR
+                   for d in diags(r, "TPU101"))
+
+    def test_custom_rule_registration(self):
+        from paddle_tpu.analysis import Rule, register_rule
+        from paddle_tpu.analysis.rules import RULES
+
+        @register_rule
+        class NoTanhRule(Rule):
+            id = "TST901"
+            name = "no-tanh"
+            default_severity = Severity.WARNING
+
+            def check(self, graph):
+                for ctx in graph.eqns():
+                    if ctx.primitive == "tanh":
+                        yield self.diag("tanh spotted", where=ctx.path)
+
+        try:
+            r = analysis.analyze(lambda x: jnp.tanh(x), jnp.ones((4,)),
+                                 rules=["TST901"])
+            assert diags(r, "TST901")
+        finally:
+            RULES.pop("TST901", None)
+
+    def test_jit_lint_true_raises_on_error(self):
+        @paddle.jit.to_static(lint=True, full_graph=True)
+        def noisy(x):
+            def body(c, v):
+                jax.debug.print("c={c}", c=c)
+                return c + v, c
+
+            out, _ = jax.lax.scan(body, jnp.float32(0), x._array)
+            return paddle.Tensor(out)
+
+        with pytest.raises(LintError):
+            noisy(paddle.ones([4]))
+
+    def test_jit_lint_warns_below_error(self):
+        @paddle.jit.to_static(lint=True, full_graph=True)
+        def ragged(x):
+            return paddle.matmul(x, x)
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            ragged(paddle.ones([100, 100]))
+        assert any("TPU101" in str(x.message) for x in w)
+
+    def test_jit_lint_fail_on_never(self):
+        paddle.set_flags({"FLAGS_tpu_lint_fail_on": "never"})
+        try:
+            @paddle.jit.to_static(lint=True, full_graph=True)
+            def noisy(x):
+                jax.debug.print("x={x}", x=x._array)
+                return x * 2
+
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                noisy(paddle.ones([4]))
+            assert any("TPU501" in str(x.message) for x in w)
+        finally:
+            paddle.set_flags({"FLAGS_tpu_lint_fail_on": "error"})
+
+    def test_jit_lint_flags_scalar_arg(self):
+        # the recompile rule must see USER-level python scalar args
+        # through the jit hook, where they are part of the guard key
+        @paddle.jit.to_static(lint=True, full_graph=True)
+        def scaled(x, alpha):
+            return x * alpha
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            scaled(paddle.ones([8, 128]), 3.14159)
+        assert any("TPU201" in str(x.message) for x in w)
+
+    def test_jit_lint_preserves_rng_stream(self):
+        from paddle_tpu.framework import random as _random
+
+        paddle.seed(123)
+        @paddle.jit.to_static(lint=True, full_graph=True)
+        def f(x):
+            return x * 2
+
+        f(paddle.ones([8, 128]))
+        after_lint = np.asarray(jax.random.key_data(
+            _random.get_rng_state()))
+
+        paddle.seed(123)
+        @paddle.jit.to_static(full_graph=True)
+        def g(x):
+            return x * 2
+
+        g(paddle.ones([8, 128]))
+        after_plain = np.asarray(jax.random.key_data(
+            _random.get_rng_state()))
+        assert (after_lint == after_plain).all()
+
+    def test_jit_lint_default_off(self):
+        @paddle.jit.to_static(full_graph=True)
+        def noisy(x):
+            jax.debug.print("x={x}", x=x._array)
+            return x * 2
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            noisy(paddle.ones([4]))
+        assert not any("TPU501" in str(x.message) for x in w)
+
+
+# ---------------------------------------------------------------------------
+# lint-self: our own bundled model must stay error-clean
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+class TestLintSelf:
+    def test_llama_forward_error_clean(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        ids = jax.ShapeDtypeStruct((1, 32), jnp.int32)
+        report = analysis.analyze(model, ids,
+                                  name="models.llama tiny forward")
+        assert not report.errors, report.format(Severity.ERROR)
+
+    def test_cli_default_demo(self, capsys):
+        from paddle_tpu.analysis.__main__ import main
+
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "lint models.llama tiny forward" in out

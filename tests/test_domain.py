@@ -297,6 +297,7 @@ class TestAsyncCheckpoint:
 
 
 class TestMoreVisionFamilies:
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_googlenet_inception_forward(self):
         from paddle_tpu.vision.models import googlenet, inception_v3
 
@@ -314,6 +315,7 @@ class TestMoreVisionFamilies:
 
 
 class TestPPYOLOE:
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_train_and_predict(self):
         import paddle_tpu.optimizer as popt
         from paddle_tpu.vision.models import (PPYOLOE, PPYOLOEConfig,

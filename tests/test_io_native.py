@@ -137,7 +137,10 @@ class TestNativePredictor:
 
         env = dict(os.environ)
         env["PYTHONPATH"] = root
-        env["JAX_PLATFORMS"] = ""  # embedded interpreter picks a backend
+        # pin the embedded interpreter to CPU: with "" it auto-picks, and
+        # a TPU plugin with no reachable TPU blocks 4 min on GCP metadata
+        # before dying — the artifact is multi-platform, cpu always works
+        env["JAX_PLATFORMS"] = "cpu"
         r = subprocess.run([str(exe), artifact, "2", "8"],
                            capture_output=True, text=True, env=env,
                            timeout=240)

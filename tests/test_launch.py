@@ -20,6 +20,11 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.skipif(
+    __import__("jax").__version_info__ < (0, 5),
+    reason="cross-process collectives on the CPU backend are "
+           "unimplemented in this jaxlib (XLA: 'Multiprocess "
+           "computations aren't implemented on the CPU backend')")
 def test_two_process_psum_and_sharded_checkpoint(tmp_path):
     from paddle_tpu.parallel.launch.main import launch
 

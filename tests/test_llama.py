@@ -116,6 +116,7 @@ class TestRMSNormKernel:
 
 
 class TestLlama:
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_train_loss_decreases_hybrid_mesh(self):
         mesh = build_mesh({"dp": 2, "sharding": 2, "mp": 2, "sep": 1})
         set_global_mesh(mesh)
@@ -186,6 +187,7 @@ class TestLlama:
         np.testing.assert_allclose(lg_inc.numpy()[:, -1],
                                    full.numpy()[:, -1], atol=2e-5)
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_jit_generate_matches_eager(self):
         """The single-program decode loop (prefill + lax.scan over the
         fixed cache) must reproduce eager generate token for token."""
@@ -212,6 +214,7 @@ class TestLlama:
         z = model.jit_generate(paddle.to_tensor(row), max_new_tokens=0)
         np.testing.assert_array_equal(z.numpy(), row)
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_jit_generate_prompt_bucketing_one_compile(self):
         """Two prompt lengths inside one 128-token bucket must share ONE
         compiled program, and padded decode must match the unbucketed
@@ -339,6 +342,7 @@ class TestLlama:
             assert toks.shape == (2, 4)
             assert (np.asarray(toks) >= 0).all()
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_remat_scope_and_fused_swiglu_match_baseline(self):
         """Sub-layer remat granularity (remat_scope='attn'/'mlp') and the
         fused-swiglu MLP are numerics-preserving: same loss trajectory as
@@ -499,6 +503,7 @@ class TestLlama:
         new_q = model._decode_quant_cache[key][1][0]
         assert not np.array_equal(np.asarray(old_q), np.asarray(new_q))
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_sep_matches_serial(self):
         """Ulysses SEP must be numerically equivalent to serial training,
         same bar as TP/DP/sharding (reference:

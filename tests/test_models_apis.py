@@ -16,6 +16,7 @@ def _clear_mesh():
 
 
 class TestGPT:
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_hybrid_training_decreases_loss(self):
         from paddle_tpu.models import GPTConfig, GPTForCausalLM, shard_gpt
         from paddle_tpu.parallel import make_train_step
@@ -174,6 +175,7 @@ class TestAudio:
 
 
 class TestUNet:
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_forward_backward_tiny(self):
         from paddle_tpu.models import UNetConfig, UNet2DConditionModel
 
@@ -190,6 +192,7 @@ class TestUNet:
         g = m.conv_in.weight.grad
         assert g is not None and float((g * g).sum().numpy()) > 0
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_context_changes_output(self):
         """Cross-attention must actually condition on the text context."""
         from paddle_tpu.models import UNetConfig, UNet2DConditionModel

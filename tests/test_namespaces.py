@@ -18,6 +18,14 @@ def setUpModule():
 class TestRegularizer(unittest.TestCase):
     def test_l1_sign_penalty_exact(self):
         m = nn.Linear(4, 4)
+        # keep every weight further from zero than the total L1 travel
+        # (3 steps x lr 0.1 x coeff 0.05 = 0.015): an element that
+        # crosses zero flips its per-step sign and the closed-form
+        # expectation below no longer holds
+        rng = np.random.default_rng(7)
+        w_init = (rng.uniform(0.05, 0.5, (4, 4)).astype(np.float32)
+                  * rng.choice([-1.0, 1.0], (4, 4)).astype(np.float32))
+        m.weight.set_value(paddle.to_tensor(w_init))
         w0 = np.asarray(m.weight._array).copy()
         o = opt.SGD(learning_rate=0.1, parameters=m.parameters(),
                     weight_decay=paddle.regularizer.L1Decay(0.05))
