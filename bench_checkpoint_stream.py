@@ -9,6 +9,12 @@ layout first (one shard per layer, like real HF repos), streams them,
 and reports load time + a decode-step sanity number.
 
 Usage: python bench_checkpoint_stream.py [--keep] [workdir]
+           [--inject io_error[:P]]
+
+--inject io_error[:P] arms the resilience chaos injector (seam
+shard_read, default P=0.2) for the streaming load, proving the
+RetryPolicy absorbs transient read faults on the full 7B path; the
+JSON output then includes the injected-fault and retry counters.
 """
 from __future__ import annotations
 
@@ -76,10 +82,31 @@ def main():
     from paddle_tpu.models import (LlamaConfig, build_quant_generate,
                                    load_quant_serving_params)
 
-    args = [a for a in sys.argv[1:] if a != "--keep"]
-    keep = "--keep" in sys.argv
+    argv = sys.argv[1:]
+    keep = "--keep" in argv
+    inject = None
+    if "--inject" in argv:
+        at = argv.index("--inject")
+        if at + 1 >= len(argv):
+            raise SystemExit("--inject needs a spec: io_error[:P]")
+        spec = argv[at + 1]
+        kind, _, p = spec.partition(":")
+        if kind != "io_error":
+            raise SystemExit(f"--inject supports io_error[:P], got {spec!r}")
+        inject = f"io_error:{p or 0.2}:shard_read"
+        argv = [a for i, a in enumerate(argv)
+                if a != "--inject" and argv[i - 1:i] != ["--inject"]]
+    args = [a for a in argv if a != "--keep"]
     root = args[0] if args else "/tmp/llama7b_shards"
     cfg = LlamaConfig.llama2_7b(dtype="bfloat16")
+
+    retry_stats = None
+    if inject:
+        from paddle_tpu.resilience import chaos
+
+        chaos.install(inject, seed=0)
+        print(json.dumps({"stage": "chaos_armed", "spec": inject}),
+              flush=True)
 
     t0 = time.perf_counter()
     disk_bytes = write_shards(cfg, root)
@@ -89,13 +116,32 @@ def main():
                       "s": round(t_write, 1)}), flush=True)
 
     t0 = time.perf_counter()
-    p = load_quant_serving_params(cfg, root, "weight_only_int8")
+    if inject:
+        # explicit source so the retry telemetry is reportable; the
+        # load path is identical to the plain string route. 8 attempts:
+        # at P=0.2 a 291-shard 7B read gives up with prob ~1e-6 per
+        # tensor, so the bench measures absorption, not luck
+        from paddle_tpu.models.checkpoint import _SafetensorsSource
+        from paddle_tpu.resilience.retry import RetryPolicy
+
+        src = _SafetensorsSource(root, retry=RetryPolicy(
+            max_attempts=8, base_delay=0.01, max_delay=0.5))
+        p = load_quant_serving_params(cfg, src, "weight_only_int8",
+                                      names="hf")
+        retry_stats = src._retry.stats
+    else:
+        p = load_quant_serving_params(cfg, root, "weight_only_int8")
     np.asarray(jax.tree.leaves(p)[-1])
     t_load = time.perf_counter() - t0
     hbm = sum(x.nbytes for x in jax.tree.leaves(p))
-    print(json.dumps({"stage": "streamed_quantized",
-                      "s": round(t_load, 1),
-                      "hbm_gb": round(hbm / 2**30, 2)}), flush=True)
+    rec = {"stage": "streamed_quantized", "s": round(t_load, 1),
+           "hbm_gb": round(hbm / 2**30, 2)}
+    if retry_stats is not None:
+        from paddle_tpu.resilience import chaos
+
+        rec["injected_faults"] = chaos.counters()
+        rec["retry"] = retry_stats.as_dict()
+    print(json.dumps(rec), flush=True)
 
     # serve from the streamed layout: short prefill + a few decode steps
     b, sb, max_new = 4, 128, 8

@@ -43,6 +43,7 @@ from . import framework  # noqa: F401
 from . import parallel  # noqa: F401
 from . import parallel as distributed  # noqa: F401
 from . import incubate  # noqa: F401
+from . import resilience  # noqa: F401
 from . import kernels  # noqa: F401
 from . import vision  # noqa: F401
 from . import metric  # noqa: F401

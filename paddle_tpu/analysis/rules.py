@@ -14,6 +14,9 @@ Rule catalog (see analysis/README.md for the long-form docs):
                               not in the declared mesh
   TPU501 host-sync            host callbacks inside traced code (ERROR
                               when inside a scan/while hot loop)
+  TPU601 ckpt-in-jit          checkpoint saves / block_until_ready
+                              smuggled into a jitted region via a host
+                              callback (the save serializes the device)
 
 Custom rules: subclass `Rule`, decorate with `@register_rule`, and pass
 the id in `rules=` (or nothing — registered rules run by default).
@@ -512,6 +515,79 @@ class HostSyncRule(Rule):
                     "program (host round-trip at every execution)",
                     where=ctx.path,
                     hint="drop debug prints from production traces")
+
+
+# ---------------------------------------------------------------------------
+# TPU601: checkpoint I/O / host barriers inside a jitted region
+# ---------------------------------------------------------------------------
+
+@register_rule
+class CheckpointInJitRule(Rule):
+    """Checkpoint saves (or explicit `jax.block_until_ready` barriers)
+    wrapped into a jitted program through a host callback. TPU501 flags
+    callbacks generically; THIS pattern is worse and deserves its own
+    id: a checkpoint write is seconds of host I/O, and inside a jit it
+    serializes the device for the whole write — the async-save design
+    (`resilience/checkpoint.py`: snapshot at the step boundary, write
+    on a background thread) exists precisely so this never happens.
+
+    Detection is by callback identity: the callback's function name /
+    module (jax stores it in the eqn params) matching save/checkpoint/
+    serialize/block_until_ready. Calling `resilience.checkpoint.save`
+    directly under trace does not reach the jaxpr at all — it raises at
+    trace time with a message pointing here."""
+
+    id = "TPU601"
+    name = "ckpt-in-jit"
+    default_severity = Severity.ERROR
+
+    CALLBACKS = HostSyncRule.CALLBACKS
+    import re as _re
+    # matched against the callback's bare __name__ (or, when no name is
+    # recoverable, its repr) — see _callback_identity
+    # (?:\b|_) around `save` so snake_case names (save_weights,
+    # shard_save) match — underscores are word chars, \b alone misses
+    PATTERN = _re.compile(
+        r"block_until_ready|checkpoint|ckpt|(?:\b|_)save(?:\b|_)"
+        r"|serialize|state_dict", _re.IGNORECASE)
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        for ctx in graph.eqns():
+            if ctx.primitive not in self.CALLBACKS:
+                continue
+            ident, match_target = _callback_identity(ctx.eqn)
+            if not self.PATTERN.search(match_target):
+                continue
+            yield self.diag(
+                f"host callback `{ident}` looks like checkpoint/"
+                "serialization I/O compiled into the jitted program: "
+                "the device stalls for the entire write"
+                + (" EVERY loop iteration" if ctx.in_loop else ""),
+                where=ctx.path,
+                hint="checkpoint at step boundaries on the host; use "
+                     "resilience.CheckpointManager.save(blocking=False) "
+                     "so the step never waits on storage")
+
+
+def _callback_identity(eqn) -> tuple:
+    """(display, match_target) for a callback eqn's python function.
+    The match target is the bare __name__ only — matching the module
+    path or qualname would flag every benign callback merely DEFINED in
+    a checkpoint-related module or test class."""
+    cb = eqn.params.get("callback")
+    for attr in ("callback_func", "func", "callback", "__wrapped__"):
+        inner = getattr(cb, attr, None)
+        if inner is not None:
+            cb = inner
+    name = getattr(cb, "__name__", None)
+    if name:
+        mod = getattr(cb, "__module__", "") or ""
+        display = getattr(cb, "__qualname__", None) or name
+        if mod:
+            display = f"{mod}.{display}"
+        return display, str(name)
+    rep = repr(cb) if cb is not None else repr(eqn.params)
+    return rep, rep
 
 
 def default_rules(severity_overrides: Optional[Dict[str, Severity]] = None,

@@ -88,3 +88,25 @@ define_flag("tpu_lint_fail_on", "error",
             "error|warning|info|never "
             "(also: PADDLE_TPU_LINT_FAIL_ON)",
             env_aliases=("PADDLE_TPU_LINT_FAIL_ON",))
+
+# --- resilience (paddle_tpu.resilience) ---
+define_flag("tpu_chaos", "",
+            "fault-injection spec, e.g. 'io_error:0.1,preempt_at:200,"
+            "hang:decode' (also: PADDLE_TPU_CHAOS; see resilience/chaos.py)",
+            env_aliases=("PADDLE_TPU_CHAOS",))
+define_flag("tpu_chaos_seed", 0,
+            "seed of the deterministic chaos schedule "
+            "(also: PADDLE_TPU_CHAOS_SEED)",
+            env_aliases=("PADDLE_TPU_CHAOS_SEED",))
+define_flag("io_retry_attempts", 3,
+            "attempts for transient-IOError retry at the io seams "
+            "(shard reads, DataLoader fetch); 1 disables retrying "
+            "(also: PADDLE_TPU_IO_RETRIES)",
+            env_aliases=("PADDLE_TPU_IO_RETRIES",))
+define_flag("io_retry_base_delay_s", 0.05,
+            "first backoff delay of the io RetryPolicy (doubles per "
+            "retry, jittered)")
+define_flag("step_timeout_s", 0.0,
+            "default wall-clock watchdog deadline per serving-engine "
+            "step; 0 disables (also: PADDLE_TPU_STEP_TIMEOUT_S)",
+            env_aliases=("PADDLE_TPU_STEP_TIMEOUT_S",))

@@ -43,6 +43,7 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self.preempted = False
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -122,8 +123,22 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        """reference: hapi/model.py fit (:1807)."""
+            accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
+            resume=False, checkpoint_freq=None):
+        """reference: hapi/model.py fit (:1807).
+
+        Resilience extensions (paddle_tpu.resilience):
+          checkpoint_dir: atomic generation-counted checkpoints (model +
+            optimizer + loop position) land here; a preemption signal
+            (SIGTERM/SIGINT) observed at a step boundary triggers an
+            emergency checkpoint and a clean stop (`self.preempted`).
+          resume: restore the newest valid generation from
+            checkpoint_dir and continue from the recorded epoch/step
+            (deterministic resume needs a deterministic loader —
+            shuffle=False or a seeded sampler).
+          checkpoint_freq: save every N steps (async, off the step
+            path); None saves at epoch boundaries only.
+        """
         loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
@@ -136,31 +151,119 @@ class Model:
         cbks.set_params({"epochs": epochs, "steps": steps,
                          "verbose": verbose, "metrics": self._metric_names()})
         self.stop_training = False
-        cbks.on_train_begin()
-        it_count = 0
-        for epoch in range(epochs):
-            for m in self._metrics:
-                m.reset()
-            cbks.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, labs = self._split_batch(batch)
-                update = (step + 1) % accumulate_grad_batches == 0
-                res = self.train_batch(ins, labs, update=update)
-                logs = self._pack_logs(res)
-                cbks.on_train_batch_end(step, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    self.stop_training = True
+        self.preempted = False
+        from ..resilience import chaos as _chaos
+
+        ckpt_mgr = guard = None
+        start_epoch = skip_steps = it_count = 0
+        try:
+            if checkpoint_dir is not None:
+                from ..resilience import preemption as _preemption
+                from ..resilience.checkpoint import (
+                    CheckpointManager, CheckpointNotFoundError)
+
+                ckpt_mgr = CheckpointManager(checkpoint_dir, max_to_keep=3)
+                guard = _preemption.install()
+                if resume:
+                    try:
+                        ck = ckpt_mgr.restore()
+                    except CheckpointNotFoundError:
+                        # an EMPTY dir is a legitimate fresh run;
+                        # existing-but-unverifiable generations are data
+                        # loss and must not silently restart at step 0
+                        if ckpt_mgr.generations():
+                            raise
+                        ck = None
+                    if ck is not None:
+                        self.network.set_state_dict(ck.value["model"])
+                        if self._optimizer is not None \
+                                and "optimizer" in ck.value:
+                            self._optimizer.set_state_dict(
+                                ck.value["optimizer"])
+                        start_epoch = int(ck.meta.get("epoch", 0))
+                        skip_steps = int(ck.meta.get("step_in_epoch", 0))
+                        it_count = int(ck.meta.get("global_step", 0))
+                        if steps is not None and skip_steps >= steps:
+                            start_epoch, skip_steps = start_epoch + 1, 0
+            cbks.on_train_begin()
+            for epoch in range(start_epoch, epochs):
+                for m in self._metrics:
+                    m.reset()
+                cbks.on_epoch_begin(epoch)
+                logs = {}
+                hit_num_iters = False
+                step = -1
+                for step, batch in enumerate(loader):
+                    if epoch == start_epoch and step < skip_steps:
+                        continue  # replayed batches of a resumed epoch
+                    cbks.on_train_batch_begin(step)
+                    ins, labs = self._split_batch(batch)
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    res = self.train_batch(ins, labs, update=update)
+                    logs = self._pack_logs(res)
+                    cbks.on_train_batch_end(step, logs)
+                    it_count += 1
+                    _chaos.on_step("fit", it_count)
+                    hit_num_iters = num_iters is not None \
+                        and it_count >= num_iters
+                    if hit_num_iters:
+                        self.stop_training = True
+                    if guard is not None and guard.requested:
+                        # emergency checkpoint: blocking, then a clean
+                        # stop — the grace window is for THIS write
+                        self._save_checkpoint(
+                            ckpt_mgr, epoch, step + 1, it_count,
+                            blocking=True)
+                        self.preempted = True
+                        self.stop_training = True
+                        break
+                    if ckpt_mgr is not None and checkpoint_freq \
+                            and it_count % checkpoint_freq == 0:
+                        self._save_checkpoint(ckpt_mgr, epoch, step + 1,
+                                              it_count, blocking=False)
+                    if hit_num_iters:
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                if self.preempted:
+                    break  # the emergency save already recorded position
+                if ckpt_mgr is not None and checkpoint_freq is None:
+                    # a num_iters stop mid-epoch must record the TRUE
+                    # position, not epoch+1 (which would skip the rest
+                    # of this epoch on resume); a completed epoch rolls
+                    # the position forward
+                    if hit_num_iters:
+                        self._save_checkpoint(ckpt_mgr, epoch, step + 1,
+                                              it_count, blocking=False)
+                    else:
+                        self._save_checkpoint(ckpt_mgr, epoch + 1, 0,
+                                              it_count, blocking=False)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, batch_size=batch_size,
+                                  verbose=verbose, callbacks=cbks)
+                if self.stop_training:
                     break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size,
-                              verbose=verbose, callbacks=cbks)
-            if self.stop_training:
-                break
-        cbks.on_train_end()
+            cbks.on_train_end()
+        finally:
+            try:
+                if ckpt_mgr is not None:
+                    ckpt_mgr.wait()  # async-save barrier + error surface
+            finally:
+                if guard is not None:
+                    from ..resilience import preemption as _preemption
+
+                    _preemption.uninstall()
+
+    def _save_checkpoint(self, mgr, epoch, step_in_epoch, global_step,
+                         blocking):
+        """Model + optimizer + loop position as one atomic generation.
+        meta records the NEXT position to run: epoch/step_in_epoch
+        point just past the last completed batch."""
+        state = {"model": self.network.state_dict()}
+        if self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        mgr.save(state, step=global_step,
+                 meta={"epoch": epoch, "step_in_epoch": step_in_epoch,
+                       "global_step": global_step}, blocking=blocking)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -1,6 +1,13 @@
 """paddle.incubate.checkpoint.auto_checkpoint (reference:
-incubate/checkpoint/auto_checkpoint.py) — train-range bookkeeping: resume
-from the last completed epoch recorded in the checkpoint dir."""
+incubate/checkpoint/auto_checkpoint.py) — train-range bookkeeping:
+resume from the last completed epoch recorded in the checkpoint dir.
+
+Thin shim over `paddle_tpu.resilience.checkpoint`: each completed epoch
+commits an atomic, digest-verified generation under
+``$PADDLE_CHECK_POINT_DIR/acp``, so a kill mid-write can never corrupt
+the resume point and a corrupted generation falls back to the previous
+one. The legacy single-file ``acp_meta.json`` layout is still honoured
+on first read for checkpoints written by older code."""
 import json
 import os
 
@@ -11,21 +18,35 @@ _CKPT_ENV = "PADDLE_CHECK_POINT_DIR"
 
 class _EpochRange:
     def __init__(self, max_epoch_num, save_checkpoint_inter=None):
+        from ...resilience.checkpoint import (CheckpointManager,
+                                              CheckpointNotFoundError)
+
         self._max = int(max_epoch_num)
         self._dir = os.environ.get(_CKPT_ENV)
-        self._meta = os.path.join(self._dir, "acp_meta.json") if self._dir else None
+        self._mgr = None
         self._start = 0
-        if self._meta and os.path.exists(self._meta):
-            with open(self._meta) as f:
-                self._start = int(json.load(f).get("epoch", -1)) + 1
+        if self._dir:
+            self._mgr = CheckpointManager(os.path.join(self._dir, "acp"),
+                                          max_to_keep=2)
+            try:
+                ck = self._mgr.restore()
+                self._start = int(ck.value["epoch"]) + 1
+            except CheckpointNotFoundError:
+                # generations that exist but fail verification are data
+                # loss — refuse to silently restart at epoch 0 (same
+                # policy as Model.fit(resume=True))
+                if self._mgr.generations():
+                    raise
+                legacy = os.path.join(self._dir, "acp_meta.json")
+                if os.path.exists(legacy):
+                    with open(legacy) as f:
+                        self._start = int(json.load(f).get("epoch", -1)) + 1
 
     def __iter__(self):
         for e in range(self._start, self._max):
             yield e
-            if self._meta:
-                os.makedirs(self._dir, exist_ok=True)
-                with open(self._meta, "w") as f:
-                    json.dump({"epoch": e}, f)
+            if self._mgr is not None:
+                self._mgr.save({"epoch": e}, step=e)
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None):

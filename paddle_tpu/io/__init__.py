@@ -321,13 +321,22 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 retry_policy=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
+        # transient-IOError retry around sample fetch (disk/NFS/object
+        # stores flake under load); default sizes from
+        # FLAGS_io_retry_attempts — see resilience/retry.py
+        if retry_policy is None:
+            from ..resilience.retry import default_io_policy
+
+            retry_policy = default_io_policy()
+        self.retry_policy = retry_policy
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -346,7 +355,18 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
-        samples = [self.dataset[i] for i in indices]
+        from ..resilience import chaos
+
+        injector = chaos.get_chaos()  # resolved once per batch, not per sample
+
+        def read_one(i):
+            if injector is not None:
+                injector.maybe_io_error("dataloader.fetch")
+            return self.dataset[i]
+
+        # per-sample retry: one flaky read must not re-run the whole
+        # batch's (potentially expensive) decode/augment work
+        samples = [self.retry_policy.call(read_one, i) for i in indices]
         return self.collate_fn(samples)
 
     def _iter_single(self):

@@ -13,6 +13,7 @@ python/paddle/nn/quant/quantized_linear.py:180 (weight_only_linear).
 """
 from __future__ import annotations
 
+import difflib
 import json
 import os
 from typing import Callable, Optional, Union
@@ -22,6 +23,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, unwrap
+from ..resilience import chaos
+from ..resilience.retry import RetryPolicy, default_io_policy
+
+
+def _nearest(name: str, candidates, n: int = 3) -> str:
+    close = difflib.get_close_matches(name, list(candidates), n=n,
+                                      cutoff=0.4)
+    return f"; nearest keys: {close}" if close else ""
 
 
 def _hf_name(our_name: str) -> str:
@@ -39,12 +48,16 @@ def _needs_transpose(name: str, arr) -> bool:
 
 class _SafetensorsSource:
     """name -> np.ndarray over a safetensors file or an HF sharded dir.
-    Tensors are read one at a time; nothing else is resident."""
+    Tensors are read one at a time; nothing else is resident. Shard
+    reads retry transient IOErrors through `retry` (default: the shared
+    io policy, FLAGS_io_retry_attempts attempts)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, retry: Optional[RetryPolicy] = None):
         from safetensors import safe_open
 
         self._safe_open = safe_open
+        self._path = path
+        self._retry = retry if retry is not None else default_io_policy()
         self._by_file = {}
         if os.path.isdir(path):
             idx = os.path.join(path, "model.safetensors.index.json")
@@ -73,6 +86,18 @@ class _SafetensorsSource:
         return name in self._by_file
 
     def __call__(self, name: str) -> np.ndarray:
+        if name not in self._by_file:
+            shards = sorted(set(self._by_file.values()))
+            raise KeyError(
+                f"tensor {name!r} not found in checkpoint {self._path!r} "
+                f"({len(self._by_file)} tensors across "
+                f"{len(shards)} shard file(s): "
+                f"{[os.path.basename(s) for s in shards[:4]]}"
+                f"{'...' if len(shards) > 4 else ''})"
+                f"{_nearest(name, self._by_file)}")
+        return self._retry.call(self._read, name)
+
+    def _read(self, name: str) -> np.ndarray:
         # framework="pt" so bf16/fp16 checkpoints load (numpy has no
         # native bf16). The tensor ships at its STORED width — bf16
         # reinterpreted through ml_dtypes — and upcasts to fp32 on
@@ -81,6 +106,7 @@ class _SafetensorsSource:
         # would double the bytes for nothing.
         import torch
 
+        chaos.maybe_io_error("shard_read")
         with self._safe_open(self._by_file[name], framework="pt") as sf:
             t = sf.get_tensor(name)
         if t.dtype == torch.bfloat16:
@@ -127,7 +153,16 @@ def load_quant_serving_params(cfg, source: Union[str, dict, Callable],
 
     def fetch(our_name, transpose_ok=True):
         key = _hf_name(our_name) if hf_names else our_name
-        arr = np.asarray(reader(key))
+        try:
+            arr = np.asarray(reader(key))
+        except KeyError as e:
+            if isinstance(source, (str, _SafetensorsSource)):
+                raise  # _SafetensorsSource already raised descriptively
+            known = source.keys() if isinstance(source, dict) else ()
+            raise KeyError(
+                f"tensor {key!r} (for param {our_name!r}) not found in "
+                f"the {type(source).__name__} checkpoint source"
+                f"{_nearest(key, known)}") from e
         if hf_names and transpose_ok and _needs_transpose(key, arr):
             arr = arr.T
         return arr

@@ -1,0 +1,44 @@
+"""paddle_tpu.resilience — surviving preemptions, flaky storage, and
+hung steps.
+
+The production counterpart of "runs fast": the ROADMAP north star is a
+fleet serving heavy traffic, and on real TPU fleets that means
+preemptible slices, transient blob-store faults, and occasionally a
+wedged collective. Four pillars, each independently usable and all
+chaos-testable on CPU (see `resilience/README.md` for the failure
+matrix):
+
+- `checkpoint` — atomic, digest-verified, generation-counted pytree
+  checkpoints with async save and corrupt-generation fallback;
+- `chaos`     — deterministic seed-driven fault injection armed via
+  ``PADDLE_TPU_CHAOS`` (io_error / corrupt / preempt_at / hang);
+- `preemption`— SIGTERM/SIGINT -> step-boundary flag -> emergency
+  checkpoint + clean exit;
+- `watchdog`  — wall-clock deadlines around step callables, raising a
+  structured `StepTimeout` with the last-known phase;
+- `retry`     — jittered-exponential-backoff `RetryPolicy` shared by
+  the I/O seams (streaming checkpoint reader, DataLoader).
+
+Integration points: `hapi.Model.fit(checkpoint_dir=..., resume=True)`,
+`serving.ContinuousBatchingEngine.run(watchdog_timeout=...)`,
+`models.checkpoint` shard reads, and the
+`incubate.checkpoint.auto_checkpoint` Paddle-parity shim.
+"""
+from . import chaos  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    Checkpoint, CheckpointCorruptError, CheckpointError,
+    CheckpointManager, CheckpointNotFoundError, restore_checkpoint,
+    save_checkpoint,
+)
+from .chaos import ChaosError, ChaosHang, ChaosMonkey  # noqa: F401
+from .preemption import EXIT_PREEMPTED, PreemptionGuard  # noqa: F401
+from .retry import RetryPolicy, RetryStats, retry  # noqa: F401
+from .watchdog import StepTimeout, Watchdog  # noqa: F401
+
+__all__ = [
+    "Checkpoint", "CheckpointCorruptError", "CheckpointError",
+    "CheckpointManager", "CheckpointNotFoundError", "ChaosError",
+    "ChaosHang", "ChaosMonkey", "EXIT_PREEMPTED", "PreemptionGuard",
+    "RetryPolicy", "RetryStats", "StepTimeout", "Watchdog", "chaos",
+    "restore_checkpoint", "retry", "save_checkpoint",
+]

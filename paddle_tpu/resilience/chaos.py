@@ -1,0 +1,226 @@
+"""Deterministic fault injection for the resilience seams.
+
+Every recovery path in this package is testable on CPU because the
+faults themselves are injectable: a seed-driven registry activated from
+the environment monkey-patches nothing broad — the production code
+calls narrow, named *seams* (`maybe_io_error("shard_read")`,
+`on_step("fit", n)`, `maybe_hang("decode")`, `corrupt("ckpt.write",
+data)`) that are no-ops unless a matching fault is armed.
+
+Activation (see `framework/flags.py`)::
+
+    PADDLE_TPU_CHAOS=io_error:0.1,preempt_at:200,hang:decode python train.py
+    PADDLE_TPU_CHAOS_SEED=7   # deterministic fault schedule
+
+Fault grammar (comma-separated ``kind:arg[:arg2]``):
+
+    io_error:P[:SEAM]   raise IOError with probability P at io seams
+                        (optionally only at seams containing SEAM)
+    corrupt:P[:SEAM]    flip a byte of written payloads with prob. P
+    preempt_at:N        deliver a real SIGTERM at loop step N (once)
+    hang:SEAM[:SECS]    stall SEAM for SECS (default 60) once, then
+                        raise ChaosHang so the abandoned worker thread
+                        unwinds without side effects
+
+Faults count their firings in `.counters` so benches
+(``bench_checkpoint_stream.py --inject io_error``) can report how much
+chaos the retry/restore machinery absorbed.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class ChaosError(IOError):
+    """An injected I/O fault (subclasses IOError so the production
+    retry allowlists treat it exactly like the real thing)."""
+
+
+class ChaosHang(RuntimeError):
+    """Raised after a chaos hang elapses — the stall is over and the
+    (typically watchdog-abandoned) thread must unwind WITHOUT touching
+    shared state it no longer owns."""
+
+
+@dataclass
+class _Fault:
+    kind: str            # io_error | corrupt | preempt_at | hang
+    prob: float = 0.0    # io_error / corrupt
+    step: int = -1       # preempt_at
+    seam: str = ""       # seam filter (io_error/corrupt) or target (hang)
+    seconds: float = 60.0  # hang duration
+    fired: int = 0
+
+
+class ChaosMonkey:
+    """A parsed fault schedule with its own deterministic RNG."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.faults: List[_Fault] = []
+        self.counters: Dict[str, int] = {}
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            bits = part.split(":")
+            kind = bits[0]
+            if kind == "io_error" or kind == "corrupt":
+                f = _Fault(kind, prob=float(bits[1]) if len(bits) > 1
+                           else 1.0,
+                           seam=bits[2] if len(bits) > 2 else "")
+            elif kind == "preempt_at":
+                f = _Fault(kind, step=int(bits[1]))
+            elif kind == "hang":
+                f = _Fault(kind, seam=bits[1] if len(bits) > 1 else "",
+                           seconds=float(bits[2]) if len(bits) > 2
+                           else 60.0)
+            else:
+                raise ValueError(
+                    f"unknown chaos fault {kind!r} in spec {spec!r}; "
+                    "known: io_error, corrupt, preempt_at, hang")
+            self.faults.append(f)
+
+    def _count(self, fault: _Fault):
+        fault.fired += 1
+        self.counters[fault.kind] = self.counters.get(fault.kind, 0) + 1
+
+    def _match(self, kind: str, seam: str) -> Optional[_Fault]:
+        for f in self.faults:
+            if f.kind == kind and (not f.seam or f.seam in seam):
+                return f
+        return None
+
+    # -- seams ---------------------------------------------------------
+    def maybe_io_error(self, seam: str):
+        """Raise ChaosError(IOError) at an I/O seam with the armed
+        probability."""
+        f = self._match("io_error", seam)
+        if f is None:
+            return
+        with self._lock:
+            hit = self._rng.random() < f.prob
+            if hit:
+                self._count(f)
+        if hit:
+            raise ChaosError(f"chaos: injected IOError at seam {seam!r} "
+                             f"(p={f.prob}, seed={self.seed})")
+
+    def corrupt(self, seam: str, data: bytes) -> bytes:
+        """Possibly flip one byte of `data` (deterministic position)."""
+        f = self._match("corrupt", seam)
+        if f is None or not data:
+            return data
+        with self._lock:
+            if self._rng.random() >= f.prob:
+                return data
+            self._count(f)
+            pos = self._rng.randrange(len(data))
+        out = bytearray(data)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
+    def on_step(self, loop: str, step: int):
+        """Called once per loop step; delivers SIGTERM at `preempt_at`'s
+        step (once per fault)."""
+        for f in self.faults:
+            if f.kind == "preempt_at" and not f.fired and step >= f.step \
+                    and (not f.seam or f.seam in loop):
+                self._count(f)
+                from . import preemption
+
+                preemption.self_preempt()
+
+    def maybe_hang(self, seam: str):
+        """Stall once at `seam`, then raise ChaosHang (the stalled
+        thread has usually been abandoned by a watchdog; raising lets it
+        unwind without executing the rest of the step)."""
+        for f in self.faults:
+            if f.kind == "hang" and not f.fired and f.seam \
+                    and f.seam in seam:
+                with self._lock:
+                    if f.fired:
+                        continue
+                    self._count(f)
+                time.sleep(f.seconds)
+                raise ChaosHang(
+                    f"chaos: hang at seam {seam!r} elapsed "
+                    f"({f.seconds}s); abandoning step")
+
+
+# -- activation --------------------------------------------------------
+_installed: Optional[ChaosMonkey] = None
+_env_cache: Dict[tuple, ChaosMonkey] = {}
+
+
+def install(spec: str, seed: int = 0) -> ChaosMonkey:
+    """Programmatic activation (tests); overrides the environment."""
+    global _installed
+    _installed = ChaosMonkey(spec, seed)
+    return _installed
+
+
+def uninstall():
+    global _installed
+    _installed = None
+    _env_cache.clear()
+
+
+def get_chaos() -> Optional[ChaosMonkey]:
+    """The active injector, or None. Environment-armed injectors are
+    cached per (spec, seed) so their RNG stream is continuous across
+    seam calls."""
+    if _installed is not None:
+        return _installed
+    spec = os.environ.get("PADDLE_TPU_CHAOS", "").strip()
+    seed_raw = os.environ.get("PADDLE_TPU_CHAOS_SEED")
+    if not spec:  # env unset: fall back to the flag registry (set_flags)
+        try:
+            from ..framework.flags import flag
+
+            spec = str(flag("tpu_chaos")).strip()
+            if seed_raw is None:
+                seed_raw = flag("tpu_chaos_seed")
+        except Exception:
+            spec = ""
+    if not spec:
+        return None
+    seed = int(seed_raw or 0)
+    key = (spec, seed)
+    if key not in _env_cache:
+        _env_cache[key] = ChaosMonkey(spec, seed)
+    return _env_cache[key]
+
+
+# -- thin module-level seam helpers (no-ops when chaos is off) ---------
+def maybe_io_error(seam: str):
+    c = get_chaos()
+    if c is not None:
+        c.maybe_io_error(seam)
+
+
+def corrupt(seam: str, data: bytes) -> bytes:
+    c = get_chaos()
+    return data if c is None else c.corrupt(seam, data)
+
+
+def on_step(loop: str, step: int):
+    c = get_chaos()
+    if c is not None:
+        c.on_step(loop, step)
+
+
+def maybe_hang(seam: str):
+    c = get_chaos()
+    if c is not None:
+        c.maybe_hang(seam)
+
+
+def counters() -> Dict[str, int]:
+    c = get_chaos()
+    return {} if c is None else dict(c.counters)

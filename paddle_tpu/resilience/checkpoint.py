@@ -1,0 +1,494 @@
+"""Atomic, digest-verified, generation-counted checkpointing.
+
+The availability story of every preemptible-TPU serving/training setup
+(PAPERS.md: the Gemma-on-TPU comparison) rests on checkpoint/restore
+discipline: a preempted worker must leave either the *previous* valid
+checkpoint or the *new* valid checkpoint on disk — never a torn one —
+and a restore must detect silent corruption instead of loading garbage
+into 7B parameters.
+
+Layout (one directory per checkpoint *generation*)::
+
+    ckpt_dir/
+      gen-00000012/
+        manifest.json          # tree skeleton, shapes, dtypes, digests
+        shard-00000.bin        # one raw-bytes shard per tensor leaf
+        shard-00001.bin
+      gen-00000013/ ...
+
+Invariants:
+
+- a generation directory appears only via ``os.replace`` of a finished
+  staging dir — readers never observe a partial checkpoint;
+- the generation counter is monotonic (scan + in-process watermark), so
+  "latest" is a lexicographic max, no clock involved;
+- every shard's digest (crc32 default, sha256 opt-in) is verified on
+  restore; a corrupt generation is skipped and restore falls back to
+  the newest older generation that verifies;
+- ``save(..., blocking=False)`` snapshots tensors to host immediately
+  (that device->host copy is the only part the train step waits for)
+  and writes/commits on a background thread; ``wait()``/``flush()`` is
+  the barrier, and write errors surface there, not in the step loop.
+
+Tensor leaves may be `paddle_tpu` Tensors, jax arrays, or numpy arrays;
+python scalars/strings ride inline in the manifest, so optimizer
+state_dicts (nested dicts with ints and LR scheduler floats) round-trip
+unchanged. Restored tensor leaves come back as numpy arrays — exactly
+what `Layer.set_state_dict` / `Optimizer.set_state_dict` accept.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import warnings
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import chaos
+
+_GEN_PREFIX = "gen-"
+_GEN_WIDTH = 8
+_MANIFEST = "manifest.json"
+_FORMAT = 1
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A generation failed digest/shape/manifest verification."""
+
+
+class CheckpointNotFoundError(CheckpointError, FileNotFoundError):
+    """No generation in the directory survived verification."""
+
+
+@dataclass
+class Checkpoint:
+    """What `restore()` returns: the pytree plus its provenance."""
+
+    value: Any
+    generation: int
+    step: Optional[int]
+    meta: dict = field(default_factory=dict)
+    path: str = ""
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> (skeleton, tensor shards)
+# ---------------------------------------------------------------------------
+
+def _is_tensor_leaf(x) -> bool:
+    if isinstance(x, np.ndarray):
+        return True
+    # jax.Array / paddle Tensor without importing either eagerly
+    if type(x).__module__.startswith("jaxlib") or hasattr(x, "_array"):
+        return True
+    try:
+        import jax
+
+        return isinstance(x, (jax.Array, np.generic))
+    except Exception:  # pragma: no cover
+        return isinstance(x, np.generic)
+
+
+def _to_host(x, path: str) -> np.ndarray:
+    """Leaf -> host numpy (this is where an async save synchronises)."""
+    try:
+        import jax
+
+        if isinstance(x, jax.core.Tracer):
+            raise CheckpointError(
+                f"checkpoint leaf {path!r} is a jax Tracer: checkpoint "
+                "saves must run on the host at step boundaries, never "
+                "inside a jitted region (lint rule TPU601)")
+    except ImportError:  # pragma: no cover
+        pass
+    if hasattr(x, "_array"):  # paddle_tpu Tensor
+        x = x._array
+    return np.asarray(x)
+
+
+def _flatten(obj, path: str, tensors: List[np.ndarray]) -> Any:
+    """obj -> JSON skeleton; tensor leaves appended to `tensors` and
+    referenced by index."""
+    if isinstance(obj, dict):
+        items = []
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"checkpoint dict keys must be str, got {k!r} "
+                    f"at {path!r}")
+            items.append([k, _flatten(v, f"{path}/{k}", tensors)])
+        return {"kind": "dict", "items": items}
+    if isinstance(obj, (list, tuple)):
+        return {"kind": "tuple" if isinstance(obj, tuple) else "list",
+                "items": [_flatten(v, f"{path}[{i}]", tensors)
+                          for i, v in enumerate(obj)]}
+    if _is_tensor_leaf(obj) or hasattr(obj, "_array"):
+        arr = _to_host(obj, path)
+        tensors.append(arr)
+        return {"kind": "tensor", "id": len(tensors) - 1, "key": path}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"kind": "value", "value": obj}
+    raise TypeError(
+        f"cannot checkpoint leaf of type {type(obj).__name__} at "
+        f"{path!r}; supported: Tensor/jax/numpy arrays, "
+        "int/float/bool/str/None, dict/list/tuple")
+
+
+def _unflatten(skel, tensors: Dict[int, np.ndarray]):
+    kind = skel["kind"]
+    if kind == "dict":
+        return {k: _unflatten(v, tensors) for k, v in skel["items"]}
+    if kind == "list":
+        return [_unflatten(v, tensors) for v in skel["items"]]
+    if kind == "tuple":
+        return tuple(_unflatten(v, tensors) for v in skel["items"])
+    if kind == "tensor":
+        return tensors[skel["id"]]
+    if kind == "value":
+        return skel["value"]
+    raise CheckpointCorruptError(f"unknown skeleton node kind {kind!r}")
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _digest(algo: str, data: bytes) -> str:
+    if algo == "crc32":
+        return f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+    if algo == "sha256":
+        return f"sha256:{hashlib.sha256(data).hexdigest()}"
+    raise ValueError(f"unknown digest algo {algo!r} (crc32|sha256)")
+
+
+def _verify_digest(stored: str, data: bytes) -> bool:
+    algo = stored.split(":", 1)[0]
+    return _digest(algo, data) == stored
+
+
+# ---------------------------------------------------------------------------
+# manager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Save/restore generations under one directory.
+
+    ::
+
+        mgr = CheckpointManager("/ckpt/run7", max_to_keep=3)
+        mgr.save(state, step=120)                  # blocking
+        mgr.save(state, step=140, blocking=False)  # background write
+        ...
+        mgr.wait()                                 # barrier + error surface
+        ck = mgr.restore()                         # newest VALID generation
+        ck.value, ck.step, ck.generation
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: Optional[int] = None,
+                 digest: str = "crc32"):
+        self.directory = str(directory)
+        self.max_to_keep = max_to_keep
+        self.digest = digest
+        _digest(digest, b"")  # validate algo now, not mid-save
+        self._lock = threading.Lock()
+        self._last_issued = 0
+        self._pending: Optional[threading.Thread] = None
+        self._async_error: Optional[BaseException] = None
+        os.makedirs(self.directory, exist_ok=True)
+        self._clean_stale_staging()
+
+    # -- inventory -----------------------------------------------------
+    def generations(self) -> List[int]:
+        """Committed generation numbers, ascending."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for n in names:
+            if n.startswith(_GEN_PREFIX):
+                try:
+                    g = int(n[len(_GEN_PREFIX):])
+                except ValueError:
+                    continue
+                if os.path.exists(os.path.join(self.directory, n,
+                                               _MANIFEST)):
+                    out.append(g)
+        return sorted(out)
+
+    def latest_generation(self) -> Optional[int]:
+        gens = self.generations()
+        return gens[-1] if gens else None
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.directory, f"{_GEN_PREFIX}{gen:0{_GEN_WIDTH}d}")
+
+    def _next_generation(self) -> int:
+        with self._lock:
+            gens = self.generations()
+            nxt = max(self._last_issued, gens[-1] if gens else 0) + 1
+            self._last_issued = nxt
+            return nxt
+
+    # -- save ----------------------------------------------------------
+    def save(self, value, step: Optional[int] = None,
+             meta: Optional[dict] = None, *, blocking: bool = True) -> int:
+        """Write `value` as a new generation; returns its number.
+
+        blocking=False snapshots tensors to host NOW (cheap relative to
+        serialization + fsync) and commits on a background thread; call
+        `wait()` (or the next save/restore, which waits implicitly)
+        to surface write errors."""
+        self.wait()  # one in-flight async save; surfaces prior errors
+        tensors: List[np.ndarray] = []
+        skeleton = _flatten(value, "", tensors)
+        gen = self._next_generation()
+        if blocking:
+            self._write_generation(gen, skeleton, tensors, step, meta)
+            return gen
+        # the SNAPSHOT: np.asarray aliases leaves that were already
+        # host ndarrays, so without this copy a train step mutating
+        # them in place would be recorded (with a matching digest!)
+        # by the background writer
+        tensors = [a.copy() for a in tensors]
+
+        def writer():
+            try:
+                self._write_generation(gen, skeleton, tensors, step, meta)
+            except BaseException as e:
+                self._async_error = e
+
+        t = threading.Thread(target=writer, daemon=True,
+                             name=f"ckpt-save:{gen}")
+        self._pending = t
+        t.start()
+        return gen
+
+    def wait(self):
+        """Barrier for an in-flight async save; re-raises its error."""
+        t, self._pending = self._pending, None
+        if t is not None:
+            t.join()
+        err, self._async_error = self._async_error, None
+        if err is not None:
+            raise err
+
+    flush = wait
+
+    def _write_generation(self, gen: int, skeleton, tensors, step, meta):
+        final = self._gen_path(gen)
+        staging = os.path.join(
+            self.directory,
+            f".tmp-{gen:0{_GEN_WIDTH}d}-{os.getpid()}-{threading.get_ident()}")
+        from .retry import default_io_policy
+
+        retry = default_io_policy()
+        os.makedirs(staging, exist_ok=True)
+        try:
+            entries = []
+            for i, arr in enumerate(tensors):
+                fname = f"shard-{i:05d}.bin"
+                data = arr.tobytes(order="C")
+                # digest BEFORE the chaos corruption seam: the injected
+                # bit-flip models on-disk/in-flight corruption, which
+                # restore()'s digest verification must catch
+                digest = _digest(self.digest, data)
+                data = chaos.corrupt("ckpt.write", data)
+                retry.call(self._write_shard,
+                           os.path.join(staging, fname), data)
+                entries.append({
+                    "id": i, "file": fname, "shape": list(arr.shape),
+                    "dtype": str(arr.dtype), "nbytes": len(data),
+                    "digest": digest,
+                })
+            manifest = {"format": _FORMAT, "generation": gen,
+                        "step": step, "meta": meta or {},
+                        "tree": skeleton, "tensors": entries}
+            mpath = os.path.join(staging, _MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            # the commit point: a crash before this line leaves only a
+            # .tmp dir (ignored by restore); after it, a complete,
+            # verified generation
+            os.replace(staging, final)
+            self._fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        self._gc()
+
+    @staticmethod
+    def _write_shard(path: str, data: bytes):
+        chaos.maybe_io_error("ckpt.write")
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    @staticmethod
+    def _read_shard(path: str) -> bytes:
+        chaos.maybe_io_error("ckpt.read")
+        with open(path, "rb") as f:
+            return f.read()
+
+    @staticmethod
+    def _fsync_dir(path: str):
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:  # not supported on this fs — best effort
+            pass
+
+    def _gc(self):
+        if self.max_to_keep is None:
+            return
+        gens = self.generations()
+        for g in gens[:-self.max_to_keep]:
+            shutil.rmtree(self._gen_path(g), ignore_errors=True)
+
+    def _clean_stale_staging(self):
+        """Reclaim `.tmp-*` staging dirs left by a hard-killed writer
+        (SIGKILL after the preemption grace window lands mid-write,
+        skipping the in-process cleanup). The dir name embeds the
+        writer's pid; only dirs whose pid is DEAD are removed — a live
+        concurrent writer keeps its staging."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for n in names:
+            if not n.startswith(".tmp-"):
+                continue
+            parts = n.split("-")
+            try:
+                pid = int(parts[2])
+            except (IndexError, ValueError):
+                continue
+            if pid == os.getpid():
+                continue  # our own (possibly in-flight async) staging
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                shutil.rmtree(os.path.join(self.directory, n),
+                              ignore_errors=True)
+            except OSError:
+                continue  # alive but not ours (EPERM) — leave it
+
+    # -- restore -------------------------------------------------------
+    def restore(self, generation: Optional[int] = None, *,
+                verify: bool = True) -> Checkpoint:
+        """Load the newest generation that verifies (or exactly
+        `generation`, no fallback). Corrupt generations are warned
+        about and skipped — restoring stale-but-valid state beats
+        loading garbage."""
+        self.wait()
+        if generation is not None:
+            return self._load_generation(generation, verify)
+        gens = self.generations()
+        if not gens:
+            raise CheckpointNotFoundError(
+                f"no checkpoint generations under {self.directory!r}")
+        errors = []
+        for g in reversed(gens):
+            try:
+                return self._load_generation(g, verify)
+            except CheckpointCorruptError as e:
+                errors.append(str(e))
+                warnings.warn(
+                    f"checkpoint generation {g} failed verification "
+                    f"({e}); falling back to the previous generation",
+                    RuntimeWarning)
+        raise CheckpointNotFoundError(
+            f"every generation under {self.directory!r} failed "
+            f"verification: {errors}")
+
+    def _load_generation(self, gen: int, verify: bool) -> Checkpoint:
+        path = self._gen_path(gen)
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise CheckpointNotFoundError(
+                f"generation {gen} not found under {self.directory!r}")
+        except (json.JSONDecodeError, OSError) as e:
+            raise CheckpointCorruptError(
+                f"generation {gen}: unreadable manifest ({e})")
+        if manifest.get("format") != _FORMAT:
+            raise CheckpointCorruptError(
+                f"generation {gen}: unknown format "
+                f"{manifest.get('format')!r}")
+        from .retry import default_io_policy
+
+        retry = default_io_policy()
+        tensors: Dict[int, np.ndarray] = {}
+        for entry in manifest["tensors"]:
+            spath = os.path.join(path, entry["file"])
+            try:
+                # transient read flakes are retried; only a PERSISTENT
+                # failure condemns the generation and triggers fallback
+                data = retry.call(self._read_shard, spath)
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f"generation {gen}: shard {entry['file']} "
+                    f"unreadable after {retry.max_attempts} attempts "
+                    f"({e})")
+            if len(data) != entry["nbytes"]:
+                raise CheckpointCorruptError(
+                    f"generation {gen}: shard {entry['file']} is "
+                    f"{len(data)} bytes, manifest says {entry['nbytes']}")
+            if verify and not _verify_digest(entry["digest"], data):
+                raise CheckpointCorruptError(
+                    f"generation {gen}: shard {entry['file']} digest "
+                    f"mismatch (expected {entry['digest']})")
+            arr = np.frombuffer(data, dtype=_np_dtype(entry["dtype"]))
+            # copy: frombuffer views are read-only and pin the whole
+            # shard's bytes; restored leaves should be plain arrays
+            tensors[entry["id"]] = arr.reshape(entry["shape"]).copy()
+        value = _unflatten(manifest["tree"], tensors)
+        return Checkpoint(value=value, generation=gen,
+                          step=manifest.get("step"),
+                          meta=manifest.get("meta") or {}, path=path)
+
+
+# ---------------------------------------------------------------------------
+# convenience functions
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(directory: str, value, step: Optional[int] = None,
+                    **kwargs) -> int:
+    """One-shot `CheckpointManager(directory).save(...)`."""
+    mgr_kw = {k: kwargs.pop(k) for k in ("max_to_keep", "digest")
+              if k in kwargs}
+    return CheckpointManager(directory, **mgr_kw).save(value, step=step,
+                                                       **kwargs)
+
+
+def restore_checkpoint(directory: str,
+                       generation: Optional[int] = None,
+                       **kwargs) -> Checkpoint:
+    """One-shot `CheckpointManager(directory).restore(...)`."""
+    mgr_kw = {k: kwargs.pop(k) for k in ("max_to_keep", "digest")
+              if k in kwargs}
+    return CheckpointManager(directory, **mgr_kw).restore(
+        generation, **kwargs)

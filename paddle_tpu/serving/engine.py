@@ -25,6 +25,7 @@ weight-only int8/int4 serving compose with the engine unchanged.
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -36,6 +37,7 @@ import numpy as np
 from ..models.llama import (PagedKVManager, _make_decode_step,
                             _make_head_logits, _make_prefill, _sample_next,
                             make_paged_kv_helpers)
+from ..resilience import chaos
 
 
 @dataclass
@@ -49,6 +51,8 @@ class ServeRequest:
     tokens: list = field(default_factory=list)
     prefill_time: Optional[float] = None   # when the first token was ready
     finish_time: Optional[float] = None
+    failed: bool = False                   # retired by the watchdog
+    error: Optional[str] = None
     # host-side scheduling state (None until admitted)
     slot: Optional[int] = None
     pages: Optional[list] = None
@@ -57,6 +61,11 @@ class ServeRequest:
     @property
     def done(self) -> bool:
         return self.finish_time is not None
+
+
+class _AbandonedStep(RuntimeError):
+    """Raised inside a watchdog-abandoned step thread at its next
+    checkpoint: the main loop moved on, this thread must not commit."""
 
 
 class _Slot:
@@ -142,6 +151,14 @@ class ContinuousBatchingEngine:
                                donate_argnums=(1, 2))
         self.device_steps = 0   # decode-chunk invocations (for metrics)
         self.prefill_calls = 0  # batched-admission device calls
+        self.hung_retired = 0   # slots retired by the watchdog
+        self._watchdog = None   # armed by run(watchdog_timeout=...)
+        self._step_epoch = 0    # bumped on timeout; zombie steps abort
+        # makes ownership-check + host-state commit atomic against the
+        # timeout path's epoch-bump + victim-retire (a step completing
+        # exactly at the deadline must either fully commit before the
+        # bump or fully abort after it — never interleave)
+        self._commit_lock = threading.Lock()
 
     # ---- host-side accounting -------------------------------------------
 
@@ -160,14 +177,24 @@ class ContinuousBatchingEngine:
 
     def add_request(self, prompt, max_new: Optional[int] = None,
                     arrival_time: Optional[float] = None) -> ServeRequest:
+        """Validate + enqueue. Every reject happens HERE, before the
+        request owns a slot or pages — failing deep inside `_admit` /
+        prefill bucketing would wedge scheduling state."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not 1 <= len(prompt) <= self.max_prompt_len:
             raise ValueError(f"prompt length {len(prompt)} outside "
                              f"[1, {self.max_prompt_len}]")
+        if max_new is not None and int(max_new) != max_new:
+            raise TypeError(f"max_new must be an int, got {max_new!r}")
         req = ServeRequest(self._next_id, prompt,
-                           max_new if max_new is not None else self.max_new,
+                           int(max_new) if max_new is not None
+                           else self.max_new,
                            arrival_time if arrival_time is not None
                            else time.perf_counter())
+        if req.max_new <= 0:
+            raise ValueError(
+                f"max_new must be >= 1, got {req.max_new} (a request "
+                "that may emit no token cannot retire its slot)")
         if req.max_new > self.max_new:
             raise ValueError(f"max_new {req.max_new} > engine budget "
                              f"{self.max_new}")
@@ -327,12 +354,20 @@ class ContinuousBatchingEngine:
         return -(-len(req.prompt) // self.prompt_bucket) \
             * self.prompt_bucket
 
-    def _admit(self):
+    def _check_owner(self, token: Optional[int]):
+        """A watchdog-abandoned step thread must stop mutating shared
+        state the moment the main loop reclaims it (see run())."""
+        if token is not None and token != self._step_epoch:
+            raise _AbandonedStep(
+                "step abandoned by the watchdog; discarding its work")
+
+    def _admit(self, token: Optional[int] = None):
         """FIFO admission, batched: the head run of same-bucket waiting
         requests (bounded by free slots, free pages, and prefill_batch)
         prefills in ONE device call; partial batches pad with rows aimed
         at the scratch page."""
         while self.waiting:
+            self._check_owner(token)
             free_slots = [i for i, s in enumerate(self._slots)
                           if s.req is None]
             if not free_slots:
@@ -351,7 +386,6 @@ class ContinuousBatchingEngine:
                 batch.append(req)
             if not batch:
                 return  # head is blocked on pages
-            del self.waiting[:len(batch)]
             n_pre = sb // self.block_size
             bsz = 1
             while bsz < len(batch):
@@ -368,34 +402,47 @@ class ContinuousBatchingEngine:
                 pages[row] = req.pages[:n_pre]
             self._key, k = jax.random.split(self._key)
             self.prefill_calls += 1
-            firsts, self.kcs, self.vcs = fn(
+            out = fn(
                 self.p, self.kcs, self.vcs, jnp.asarray(ids),
                 jnp.asarray(s0s), jnp.asarray(pages), k,
                 jnp.asarray(self.temperature, jnp.float32),
                 jnp.asarray(self.top_p, jnp.float32))
-            firsts = np.asarray(firsts)
-            now = time.perf_counter()
-            for row, req in enumerate(batch):
-                slot_id = req.slot
-                slot = self._slots[slot_id]
-                first = int(firsts[row])
-                req.tokens.append(first)
-                req.prefill_time = now
-                slot.req = req
-                slot.length = len(req.prompt)
-                slot.emitted = 1
-                slot.done = self.eos is not None and first == self.eos
-                padded = req.pages + [req.pages[-1]] * \
-                    (self.table_width - len(req.pages))
-                self._tables[slot_id] = padded
-                self._tokens[slot_id] = first
-                if slot.done or req.max_new == 1:
-                    self._retire(slot_id)
+            # abandoned mid-prefill: commit NOTHING. The batch is still
+            # in `waiting` (popped only below), so the live loop
+            # re-admits it with fresh pages; this thread's page
+            # allocation leaks until drain — leaking beats racing the
+            # live thread for the free list. The lock makes check+commit
+            # atomic against the timeout path's epoch-bump+retire.
+            with self._commit_lock:
+                self._check_owner(token)
+                del self.waiting[:len(batch)]
+                firsts, self.kcs, self.vcs = out
+                firsts = np.asarray(firsts)
+                now = time.perf_counter()
+                for row, req in enumerate(batch):
+                    slot_id = req.slot
+                    slot = self._slots[slot_id]
+                    first = int(firsts[row])
+                    req.tokens.append(first)
+                    req.prefill_time = now
+                    slot.req = req
+                    slot.length = len(req.prompt)
+                    slot.emitted = 1
+                    slot.done = self.eos is not None and first == self.eos
+                    padded = req.pages + [req.pages[-1]] * \
+                        (self.table_width - len(req.pages))
+                    self._tables[slot_id] = padded
+                    self._tokens[slot_id] = first
+                    if slot.done or req.max_new == 1:
+                        self._retire(slot_id)
 
-    def _retire(self, slot_id: int):
+    def _retire(self, slot_id: int, failed: bool = False,
+                error: Optional[str] = None):
         slot = self._slots[slot_id]
         req = slot.req
         req.finish_time = time.perf_counter()
+        req.failed = failed
+        req.error = error
         self.finished.append(req)
         self.mgr.free(req.pages)
         req.pages = None
@@ -407,45 +454,117 @@ class ContinuousBatchingEngine:
     def step(self) -> int:
         """One scheduling iteration: admit -> decode chunk -> retire.
         Returns the number of live tokens produced."""
-        self._admit()
+        wd = self._watchdog
+        # ownership token: if the watchdog abandons this step, run()
+        # bumps _step_epoch and every later commit point in THIS thread
+        # raises _AbandonedStep instead of racing the live loop
+        token = self._step_epoch if wd is not None else None
+        if wd is not None:
+            wd.phase = "admit"
+        self._admit(token)
         live = np.asarray([s.req is not None for s in self._slots])
         if not live.any():
             return 0
+        if wd is not None:
+            wd.phase = "decode"
+        # chaos hang seam sits BEFORE the device call: a watchdog-
+        # abandoned step must unwind (ChaosHang) without ever touching
+        # the donated KV pools from a dead thread
+        chaos.maybe_hang("decode")
         lens = np.asarray([s.length for s in self._slots], np.int32)
         self._key, k = jax.random.split(self._key)
-        out, new_lens, done, self.kcs, self.vcs = self._decode(
+        res = self._decode(
             self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
             jnp.asarray(lens), jnp.asarray(self._tables),
             jnp.asarray(live), k,
             jnp.asarray(self.temperature, jnp.float32),
             jnp.asarray(self.top_p, jnp.float32))
-        self.device_steps += 1
-        out = np.asarray(out)
-        new_lens = np.asarray(new_lens)
-        done = np.asarray(done)
-        produced = 0
-        for slot_id, slot in enumerate(self._slots):
-            req = slot.req
-            if req is None:
-                continue
-            take = min(self.steps, req.max_new - slot.emitted)
-            toks = out[slot_id, :take].tolist()
-            if self.eos is not None and self.eos in toks:
-                toks = toks[:toks.index(self.eos) + 1]
-            req.tokens.extend(toks)
-            produced += len(toks)
-            slot.emitted += len(toks)
-            slot.length = int(new_lens[slot_id])
-            slot.done = bool(done[slot_id])
-            self._tokens[slot_id] = toks[-1] if toks else 0
-            if slot.done or slot.emitted >= req.max_new:
-                self._retire(slot_id)
+        with self._commit_lock:
+            self._check_owner(token)  # abandoned mid-decode: discard
+            out, new_lens, done, self.kcs, self.vcs = res
+            self.device_steps += 1
+            out = np.asarray(out)
+            new_lens = np.asarray(new_lens)
+            done = np.asarray(done)
+            produced = 0
+            for slot_id, slot in enumerate(self._slots):
+                req = slot.req
+                if req is None:
+                    continue
+                take = min(self.steps, req.max_new - slot.emitted)
+                toks = out[slot_id, :take].tolist()
+                if self.eos is not None and self.eos in toks:
+                    toks = toks[:toks.index(self.eos) + 1]
+                req.tokens.extend(toks)
+                produced += len(toks)
+                slot.emitted += len(toks)
+                slot.length = int(new_lens[slot_id])
+                slot.done = bool(done[slot_id])
+                self._tokens[slot_id] = toks[-1] if toks else 0
+                if slot.done or slot.emitted >= req.max_new:
+                    self._retire(slot_id)
         return produced
 
-    def run(self, max_iters: int = 100000):
-        while self.has_work and max_iters:
-            self.step()
-            max_iters -= 1
+    def run(self, max_iters: int = 100000,
+            watchdog_timeout: Optional[float] = None):
+        """Drain the queues. `watchdog_timeout` (seconds; default from
+        FLAGS_step_timeout_s / PADDLE_TPU_STEP_TIMEOUT_S, 0 = off)
+        bounds every scheduling step with a wall-clock deadline: a hung
+        step retires ONE victim slot (marked `failed`, its pages freed)
+        and the engine keeps serving the remaining requests instead of
+        wedging. A timeout with no live slot to blame re-raises — the
+        engine itself is stuck, not a request. Call `warm()` before
+        arming a tight deadline: a first-admit compile inside a
+        watchdogged step would eat the whole budget (and an abandoned
+        step mid-compile keeps running on its worker thread)."""
+        if watchdog_timeout is None:
+            from ..framework.flags import flag
+
+            watchdog_timeout = float(flag("step_timeout_s"))
+        wd = None
+        if watchdog_timeout and watchdog_timeout > 0:
+            from ..resilience.watchdog import StepTimeout, Watchdog
+
+            wd = Watchdog(watchdog_timeout, name="engine.step")
+        self._watchdog = wd
+        try:
+            while self.has_work and max_iters:
+                if wd is None:
+                    self.step()
+                else:
+                    try:
+                        wd.call(self.step)
+                    except StepTimeout as e:
+                        # reclaim ownership FIRST: the abandoned thread
+                        # aborts at its next _check_owner instead of
+                        # committing stale results under the live loop;
+                        # the lock serializes this against a commit in
+                        # flight RIGHT at the deadline (either it fully
+                        # lands before the bump, or fully aborts after).
+                        # An in-flight device call still finishes on the
+                        # zombie thread; with donation that shows up as
+                        # a loud deleted-buffer error, not corruption.
+                        with self._commit_lock:
+                            self._step_epoch += 1
+                            retired = self._retire_hung_slot(e)
+                        if not retired:
+                            raise
+                max_iters -= 1
+        finally:
+            self._watchdog = None
         if self.has_work:
             raise RuntimeError("engine did not drain within max_iters")
         return self.finished
+
+    def _retire_hung_slot(self, exc) -> bool:
+        """Degrade gracefully after a StepTimeout: fail the victim slot
+        (lowest-id live slot — deterministic, and FIFO admission makes
+        it the longest-running row), recycle its pages, keep the rest.
+        Returns False when no slot is live (nothing to blame)."""
+        live = [i for i, s in enumerate(self._slots) if s.req is not None]
+        if not live:
+            return False
+        victim = live[0]
+        self.hung_retired += 1
+        self._retire(victim, failed=True, error=str(exc))
+        return True

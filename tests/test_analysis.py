@@ -380,6 +380,76 @@ class TestHostSync:
 
 
 # ---------------------------------------------------------------------------
+# TPU601: checkpoint I/O smuggled into a jitted region
+# ---------------------------------------------------------------------------
+
+class TestCheckpointInJit:
+    def test_checkpoint_callback_is_error(self):
+        def save_checkpoint_shard(x):
+            return np.asarray(x)  # stand-in for a host-side ckpt write
+
+        def f(x):
+            return jax.pure_callback(
+                save_checkpoint_shard,
+                jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU601"])
+        found = diags(r, "TPU601")
+        assert found and found[0].severity == Severity.ERROR
+        assert "save_checkpoint_shard" in found[0].message
+
+    def test_block_until_ready_callback_is_error(self):
+        def block_until_ready_barrier(x):
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                block_until_ready_barrier,
+                jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU601"])
+        assert diags(r, "TPU601")
+
+    def test_snake_case_save_name_flagged(self):
+        def save_weights(x):  # \b alone would miss the underscore
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                save_weights, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU601"])
+        assert diags(r, "TPU601")
+
+    def test_innocent_callback_not_flagged(self):
+        def log_metrics(x):  # host logging: TPU501's business, not 601's
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                log_metrics, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU601"])
+        assert not diags(r, "TPU601")
+
+    def test_direct_save_under_trace_raises_at_trace_time(self):
+        import tempfile
+
+        from paddle_tpu.resilience import (CheckpointError,
+                                           CheckpointManager)
+
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(d)
+
+            def f(x):
+                mgr.save({"x": x})
+                return x
+
+            with pytest.raises(CheckpointError, match="TPU601"):
+                analysis.analyze(f, jnp.ones((4,)))
+
+
+# ---------------------------------------------------------------------------
 # pipeline plumbing: severity policy, custom rules, jit integration
 # ---------------------------------------------------------------------------
 
