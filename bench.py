@@ -190,6 +190,52 @@ def _op_bench(only=None):
             paired_slope_ms(drun, 2, 194, pairs=8), 4)
         del dp, dkcs, dvcs
 
+    if want("serving_decode_chunk"):
+        # the engine's decode hot loop under the gate (ISSUE 3): one
+        # steps_per_sync=16 chunk for 8 slots over the PAGED pools —
+        # the program ContinuousBatchingEngine re-dispatches for every
+        # scheduling sync, so a regression in the paged decode kernel,
+        # the scan, or the per-chunk dispatch glue shows up in the
+        # bench trajectory. Chunks are timed by chaining N donated
+        # invocations and syncing once (the slope cancels the fixed
+        # tunnel RTT, same as every other row).
+        from paddle_tpu.models import (LlamaConfig,
+                                       init_quant_serving_params)
+        from paddle_tpu.serving import ContinuousBatchingEngine
+        from bench_util import paired_slope_ms
+
+        scfg = LlamaConfig.llama_1b(dtype="bfloat16")
+        sp = init_quant_serving_params(scfg, "weight_only_int8", seed=0)
+        np.asarray(jax.tree.leaves(sp)[-1])
+        eng = ContinuousBatchingEngine(
+            scfg, sp, slots=8, prompt_bucket=128, max_prompt_len=128,
+            max_new_tokens=64, block_size=64, steps_per_sync=16,
+            prefill_batch=1, prefix_cache=False)
+        stables = jnp.full((eng.slots, eng.table_width), eng.scratch_page,
+                           jnp.int32)
+        slive = jnp.ones((eng.slots,), bool)
+        # budget == lens freezes every row at a representative mid-
+        # generation context (full per-step compute incl. paged
+        # attention over 96 cached tokens, writes aimed at the scratch
+        # page, constant cost per chunk — slope-stable)
+        slens = jnp.full((eng.slots,), 96, jnp.int32)
+        sone = jnp.asarray(1.0, jnp.float32)
+        skey = jax.random.PRNGKey(0)
+
+        def srun(n):
+            toks, lens = jnp.zeros((eng.slots,), jnp.int32), slens
+            for _ in range(int(n)):
+                out, lens, _, eng.kcs, eng.vcs = eng._decode(
+                    eng.p, eng.kcs, eng.vcs, toks, lens, slens, stables,
+                    slive, skey, sone, sone)
+                toks = out[:, -1]
+            return float(jnp.sum(lens))
+
+        srun(1)  # compile once
+        ops["serving_decode_chunk"] = round(
+            paired_slope_ms(srun, 1, 13, pairs=6), 4)
+        del sp, eng
+
     # eager dispatch overhead: one tiny op, eager, host-timed — tracks the
     # per-op cost of the eager tape + device round-trip over rounds
     # (reference: test/cpp/eager/performance_tests/benchmark_eager_cuda.cc).

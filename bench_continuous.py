@@ -7,6 +7,15 @@ the regime paged KV + continuous batching exist for: a static batch
 holds every slot until its longest row finishes, the engine retires rows
 at their own length and refills mid-stream.
 
+Traces:
+- uniform / high: ragged prompts, ragged (uniform or EOS-heavy) targets.
+- shared_prefix: every request opens with the same 128-token system
+  prompt + a ragged user suffix — the regime block-aligned prefix
+  caching exists for. Run with prefix caching off ("continuous"), on
+  ("continuous+prefix"), and on with the double-buffered scheduler
+  ("continuous+prefix+db"); a trailing summary line reports the TTFT /
+  throughput deltas the cache and the pipeline buy.
+
 Metrics (one JSON line per policy):
 - useful_tok_s: sum of requested tokens / wall-clock. Over the tunneled
   chip this includes ~90 ms host RTT per scheduling sync, which taxes
@@ -15,6 +24,12 @@ Metrics (one JSON line per policy):
   the tunnel-independent utilization number; static batching burns
   slot-steps on retired-but-held rows, the engine recycles them.
 - p50/p99 request latency (arrival -> finish), and TTFT for the engine.
+- prefix_hit_rate: prompt tokens served from the KV prefix cache.
+- blocked_syncs / sync_wait_s: decode readbacks where the host sat
+  blocked on the device, and the total seconds it did — the stall the
+  double-buffered scheduler (dispatch chunk N+1 before reading chunk
+  N) exists to hide. blocked_syncs_per_ktok normalizes per 1000 useful
+  tokens so policies with different token counts compare.
 
 Usage: python bench_continuous.py [n_requests] [seed]
 """
@@ -37,11 +52,20 @@ MAX_NEW = 64
 PROMPT_BUCKET = 128
 BLOCK = 64
 STEPS_PER_SYNC = 16
+SHARED_PREFIX_LEN = 2 * BLOCK   # block-aligned system prompt
 
 
 def make_trace(n, seed, rate_req_s, variance="uniform"):
     rng = np.random.default_rng(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_req_s, n))
+    if variance == "shared_prefix":
+        # common system prompt + ragged user suffixes: later requests'
+        # first 2 blocks hit the prefix cache
+        shared = rng.integers(1, 32000, (SHARED_PREFIX_LEN,)).tolist()
+        prompts = [shared + rng.integers(1, 32000, (int(l),)).tolist()
+                   for l in rng.integers(1, BLOCK, n)]
+        targets = rng.integers(8, MAX_NEW + 1, n).tolist()
+        return arrivals, prompts, targets
     prompts = [rng.integers(1, 32000, (int(l),)).tolist()
                for l in rng.integers(20, 121, n)]
     if variance == "high":
@@ -59,16 +83,20 @@ def pct(xs, q):
     return float(np.percentile(np.asarray(xs), q))
 
 
-def run_engine(cfg, p, arrivals, prompts, targets):
+def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
+               prefix_cache=False, double_buffer=False,
+               max_prompt_len=PROMPT_BUCKET, warm_buckets=None):
     eng = ContinuousBatchingEngine(
         cfg, p, slots=SLOTS, prompt_bucket=PROMPT_BUCKET,
-        max_prompt_len=PROMPT_BUCKET, max_new_tokens=MAX_NEW,
-        block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC)
+        max_prompt_len=max_prompt_len, max_new_tokens=MAX_NEW,
+        block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC,
+        prefix_cache=prefix_cache, double_buffer=double_buffer)
     # compile every (bucket, prefill-batch) program + the decode chunk
     # outside the clock
-    eng.warm([PROMPT_BUCKET])
+    eng.warm(warm_buckets or [max_prompt_len])
     eng.device_steps = 0  # warm chunk must not count in occupancy
 
+    step = eng._pipeline_step if double_buffer else eng.step
     t0 = time.perf_counter()
     queued = 0
     while queued < len(prompts) or eng.has_work:
@@ -80,14 +108,14 @@ def run_engine(cfg, p, arrivals, prompts, targets):
         if not eng.has_work:
             time.sleep(0.001)
             continue
-        eng.step()
+        step()
     wall = time.perf_counter() - t0
     lat = [r.finish_time - r.arrival_time for r in eng.finished]
     ttft = [r.prefill_time - r.arrival_time for r in eng.finished]
     useful = sum(len(r.tokens) for r in eng.finished)
     slot_steps = eng.device_steps * STEPS_PER_SYNC * SLOTS
     return {
-        "policy": "continuous", "wall_s": round(wall, 2),
+        "policy": policy, "wall_s": round(wall, 2),
         "useful_tokens": useful,
         "useful_tok_s": round(useful / wall, 1),
         "occupancy": round(useful / slot_steps, 3),
@@ -95,17 +123,23 @@ def run_engine(cfg, p, arrivals, prompts, targets):
         "p99_latency_s": round(pct(lat, 99), 3),
         "p50_ttft_s": round(pct(ttft, 50), 3),
         "sched_syncs": eng.device_steps,
+        "prefix_hit_rate": round(eng.prefix_hit_rate, 3),
+        "blocked_syncs": eng.blocked_syncs,
+        "blocked_syncs_per_ktok": round(1000 * eng.blocked_syncs
+                                        / max(useful, 1), 2),
+        "sync_wait_s": round(eng.sync_wait_s, 3),
     }
 
 
-def run_static(cfg, p, arrivals, prompts, targets):
+def run_static(cfg, p, arrivals, prompts, targets,
+               max_prompt_len=PROMPT_BUCKET):
     """Static batching baseline: requests queue into fixed batches of
     SLOTS in arrival order; a batch launches when full (or the trace is
     exhausted). One compiled program (max_new = MAX_NEW) serves every
     batch — the realistic static server, and it keeps mid-trace compiles
     off the clock; its cost is that every row decodes the full budget."""
-    fn = jax.jit(build_quant_generate(cfg, SLOTS, PROMPT_BUCKET, MAX_NEW))
-    warm_ids = jnp.ones((SLOTS, PROMPT_BUCKET), jnp.int32)
+    fn = jax.jit(build_quant_generate(cfg, SLOTS, max_prompt_len, MAX_NEW))
+    warm_ids = jnp.ones((SLOTS, max_prompt_len), jnp.int32)
     key = jax.random.PRNGKey(0)
     one = jnp.asarray(1.0, jnp.float32)
     np.asarray(fn(p, warm_ids, jnp.asarray(8, jnp.int32), key, one, one))
@@ -119,7 +153,7 @@ def run_static(cfg, p, arrivals, prompts, targets):
         now = time.perf_counter() - t0
         if now < ready:
             time.sleep(ready - now)
-        ids = np.zeros((SLOTS, PROMPT_BUCKET), np.int32)
+        ids = np.zeros((SLOTS, max_prompt_len), np.int32)
         for row, i in enumerate(batch):
             ids[row, :len(prompts[i])] = prompts[i]
         # one traced length serves the whole rectangle (bucketed program)
@@ -152,10 +186,50 @@ def main():
     for variance in ("uniform", "high"):
         arrivals, prompts, targets = make_trace(n, seed, rate_req_s=20.0,
                                                 variance=variance)
-        for runner in (run_engine, run_static):
-            row = runner(cfg, p, arrivals, prompts, targets)
+        for row in (
+            run_engine(cfg, p, arrivals, prompts, targets),
+            run_engine(cfg, p, arrivals, prompts, targets,
+                       policy="continuous+db", double_buffer=True),
+            run_static(cfg, p, arrivals, prompts, targets),
+        ):
             row["trace"] = variance
             print(json.dumps(row), flush=True)
+
+    # shared-prefix trace: prompts reach 128+63 tokens -> 256 bucket for
+    # cold prefills, 128 bucket for cache-hit suffixes
+    arrivals, prompts, targets = make_trace(n, seed, rate_req_s=20.0,
+                                            variance="shared_prefix")
+    mpl, buckets = 2 * PROMPT_BUCKET, [PROMPT_BUCKET, 2 * PROMPT_BUCKET]
+    rows = [
+        run_engine(cfg, p, arrivals, prompts, targets,
+                   max_prompt_len=mpl, warm_buckets=buckets),
+        run_engine(cfg, p, arrivals, prompts, targets,
+                   policy="continuous+prefix", prefix_cache=True,
+                   max_prompt_len=mpl, warm_buckets=buckets),
+        run_engine(cfg, p, arrivals, prompts, targets,
+                   policy="continuous+prefix+db", prefix_cache=True,
+                   double_buffer=True, max_prompt_len=mpl,
+                   warm_buckets=buckets),
+        run_static(cfg, p, arrivals, prompts, targets, max_prompt_len=mpl),
+    ]
+    for row in rows:
+        row["trace"] = "shared_prefix"
+        print(json.dumps(row), flush=True)
+    base, pref, db = rows[0], rows[1], rows[2]
+    print(json.dumps({
+        "trace": "shared_prefix", "summary": True,
+        "prefix_hit_rate": pref["prefix_hit_rate"],
+        "ttft_delta_s": round(base["p50_ttft_s"] - pref["p50_ttft_s"], 3),
+        "useful_tok_s_gain": round(
+            pref["useful_tok_s"] / max(base["useful_tok_s"], 1e-9), 3),
+        "occupancy_gain": round(
+            pref["occupancy"] / max(base["occupancy"], 1e-9), 3),
+        "db_blocked_syncs_per_ktok_delta": round(
+            pref["blocked_syncs_per_ktok"]
+            - db["blocked_syncs_per_ktok"], 2),
+        "db_sync_wait_delta_s": round(
+            pref["sync_wait_s"] - db["sync_wait_s"], 3),
+    }), flush=True)
 
 
 if __name__ == "__main__":

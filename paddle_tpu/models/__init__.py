@@ -9,7 +9,8 @@ Here the zoo is first-class: Llama is the flagship for benchmarks.
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
     PagedKVManager, build_paged_generate, build_quant_generate,
-    init_quant_serving_params, llama_sharding_rules, shard_llama,
+    hash_prefix_blocks, init_quant_serving_params, llama_sharding_rules,
+    shard_llama,
 )
 from .checkpoint import load_quant_serving_params  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, shard_gpt  # noqa: F401

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from collections import OrderedDict
 from typing import Optional, Tuple
 
 import jax
@@ -741,6 +742,92 @@ def _make_prefill(cfg, b, sb):
     return prefill
 
 
+def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
+    """Suffix prefill over a cached block-aligned prefix: compute hidden
+    states for the `sb` UNCACHED suffix tokens only, attending over the
+    prefix K/V gathered from the paged pools (already rotary-encoded at
+    their absolute positions when they were first cached) plus the
+    suffix itself, causally. This is the compute the prefix cache
+    exists to elide — a request whose first `prefix_lens[row]` tokens
+    hit the cache pays O(suffix) prefill instead of O(prompt).
+
+    Per-row state is traced, so ONE compiled program serves any mix of
+    prefix lengths (including 0) at this (suffix bucket, batch) shape:
+    `prefix_tables` [b, w_pre] maps the prefix's logical blocks to pool
+    pages (rows shorter than w_pre blocks pad with any valid page id —
+    masked), `prefix_lens` [b] is the cached token count (a multiple of
+    block_size), and suffix positions/rope offsets follow from it.
+
+    The mixed prefix+suffix attention is a masked jnp softmax — exact,
+    and fine at prefill batch sizes; streaming it through a Pallas
+    grid per (kv head, page) like the decode kernel (see PAPERS.md:
+    Ragged Paged Attention) is the known TPU follow-up.
+
+    Returns prefill(p, kcs, vcs, ids, prefix_tables, prefix_lens) ->
+    (h_final [b, sb, h], [(k_i, v_i)]) with rotary-applied suffix K/V
+    [b, sb, nkv, dh] per layer — the caller owns the page scatter."""
+    nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    group = nh // nkv
+    n_layers = cfg.num_hidden_layers
+    eps = cfg.rms_norm_eps
+    P_pre = w_pre * block_size
+    scale = 1.0 / math.sqrt(dh)
+
+    def prefill(p, kcs, vcs, ids, prefix_tables, prefix_lens):
+        h = p["llama.embed_tokens.weight"][ids]          # [b, sb, h]
+        pos_ids = prefix_lens[:, None] + jnp.arange(sb)[None, :]  # [b, sb]
+        # prefix column j is real iff j < prefix_lens[row]; suffix
+        # column t is visible to suffix query s iff t <= s
+        pref_valid = jnp.arange(P_pre)[None, :] < prefix_lens[:, None]
+        causal = jnp.arange(sb)[None, :] <= jnp.arange(sb)[:, None]
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(pref_valid[:, None, :], (b, sb, P_pre)),
+             jnp.broadcast_to(causal[None], (b, sb, sb))], axis=-1)
+        neg = jnp.asarray(-1e30, jnp.float32)
+        kvs = []
+        for i in range(n_layers):
+            pre = f"llama.layers.{i}."
+            x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
+            q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
+                b, sb, nh, dh)
+            k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
+                b, sb, nkv, dh)
+            v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
+                b, sb, nkv, dh)
+            q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
+                                    base=cfg.rope_theta)
+            kvs.append((k, v))
+            # gather the cached prefix pages: [b, w_pre, nkv, bs, dh]
+            # -> [b, P_pre, nkv, dh] in logical block order
+            pk = jnp.transpose(kcs[i][prefix_tables],
+                               (0, 1, 3, 2, 4)).reshape(b, P_pre, nkv, dh)
+            pv = jnp.transpose(vcs[i][prefix_tables],
+                               (0, 1, 3, 2, 4)).reshape(b, P_pre, nkv, dh)
+            keys = jnp.concatenate([pk.astype(q.dtype), k], axis=1)
+            vals = jnp.concatenate([pv.astype(q.dtype), v], axis=1)
+            q5 = q.reshape(b, sb, nkv, group, dh)
+            s = jnp.einsum("bsngd,btnd->bsngt",
+                           q5.astype(jnp.float32),
+                           keys.astype(jnp.float32)) * scale
+            s = jnp.where(mask[:, :, None, None, :], s, neg)
+            probs = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bsngt,btnd->bsngd", probs,
+                             vals.astype(jnp.float32))
+            attn = ctx.reshape(b, sb, nh, dh).astype(h.dtype)
+            h = h + _mm(attn.reshape(b, sb, nh * dh),
+                        p[pre + "self_attn.o_proj.weight"])
+            x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
+            gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+            up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+            h = h + _mm(jax.nn.silu(gate) * up,
+                        p[pre + "mlp.down_proj.weight"])
+        h = _k_rms(h, p["llama.norm.weight"], eps)
+        return h, kvs
+
+    return prefill
+
+
 def build_quant_generate(cfg, b, sb, max_new, max_seq=None,
                          eos_token_id=None, do_sample=False, top_k=0):
     """Model-free serving program over QUANTIZED weights only: prefill AND
@@ -813,6 +900,19 @@ def make_paged_kv_helpers(b, n_pre, nkv, dh, block_size, tables):
     return to_pages, kv_write
 
 
+def hash_prefix_blocks(tokens, block_size: int):
+    """Chained per-block prompt hashes: hash i covers tokens
+    [0, (i+1)*block_size) — a hit on hash i therefore implies the WHOLE
+    prefix through block i matches, so a cached-prefix walk can stop at
+    the first miss (the vLLM prefix-cache keying scheme)."""
+    hashes = []
+    h = block_size  # seed the chain with the geometry
+    for i in range(len(tokens) // block_size):
+        h = hash((h, tuple(tokens[i * block_size:(i + 1) * block_size])))
+        hashes.append(h)
+    return hashes
+
+
 class PagedKVManager:
     """Host-side KV page allocator for the paged generation path
     (reference: the block-table management serving engines drive above
@@ -821,16 +921,48 @@ class PagedKVManager:
 
     Pages are identified by integer ids into the [max_pages, H,
     block_size, D] cache pool; `alloc` hands out the lowest free ids
-    (freed pages are reused before fresh ones), `free` returns them."""
+    (freed pages are reused before fresh ones), `free` returns them.
+
+    Block-aligned prefix cache (refcounted): a page holding one FULL
+    block of a prompt's K/V may be registered under the chained hash of
+    that prefix (`insert_prefix`); later requests whose prompt starts
+    with the same blocks map the cached pages into their block tables
+    (`acquire_prefix`) instead of recomputing them. Every live mapping
+    holds a reference; `free` is refcount-aware — it releases the
+    reference and only makes the page reusable once no request maps it,
+    parking refcount-0 cached pages on an LRU list that `alloc_pages`
+    evicts (oldest first) when the strictly-free list runs short. A
+    referenced cached page is therefore never recycled, which is what
+    keeps a hung-slot retire from pulling a shared prefix out from
+    under the surviving slots."""
 
     def __init__(self, max_pages: int, block_size: int = 64):
         self.max_pages = int(max_pages)
         self.block_size = int(block_size)
         self._free = list(range(self.max_pages - 1, -1, -1))  # pop() = min
+        # prefix cache state: hash -> page; page -> [hash, refcount];
+        # refcount-0 cached pages in least-recently-released order
+        self._hash_to_page = {}
+        self._cached = {}
+        self._lru = OrderedDict()
+        self.prefix_evictions = 0
 
     @property
     def n_free(self) -> int:
+        """Strictly free pages (no eviction needed)."""
         return len(self._free)
+
+    @property
+    def n_available(self) -> int:
+        """Pages allocatable right now: free + evictable (refcount-0
+        cached). The admission bound — a referenced cached page is NOT
+        available."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def n_cached(self) -> int:
+        """Pages currently registered in the prefix cache (any refcount)."""
+        return len(self._cached)
 
     def pages_needed(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.block_size)
@@ -839,20 +971,125 @@ class PagedKVManager:
         return self.alloc_pages(self.pages_needed(n_tokens))
 
     def alloc_pages(self, n: int):
+        # pool tight: evict refcount-0 cached pages, least recently
+        # released first, dropping their hash mapping (future lookups
+        # miss and recompute)
+        evicted = False
+        while len(self._free) < n and self._lru:
+            page, _ = self._lru.popitem(last=False)
+            h, refs = self._cached.pop(page)
+            assert refs == 0, f"page {page} on LRU with refs {refs}"
+            del self._hash_to_page[h]
+            self._free.append(page)
+            self.prefix_evictions += 1
+            evicted = True
         if n > len(self._free):
             raise RuntimeError(
                 f"paged KV pool exhausted: need {n} pages, "
-                f"{len(self._free)} free of {self.max_pages}")
+                f"{len(self._free)} free of {self.max_pages} "
+                f"({len(self._cached)} cached, {len(self._lru)} evictable)")
+        if evicted:
+            # only evictions append out-of-order ids (free() re-sorts)
+            self._free.sort(reverse=True)
         return [self._free.pop() for _ in range(n)]
 
     def free(self, pages) -> None:
-        for p in pages:
+        """Refcount-aware release. Cached pages drop one reference and
+        park on the LRU at zero (still mapped — a future prefix hit
+        revives them); private pages return to the free list.
+
+        Pages are processed in REVERSE order: a request's page list is
+        block-ordered, so its deepest prefix blocks land oldest on the
+        LRU and evict first — evicting block 0 before block 1 would
+        orphan block 1's mapping (the chained-hash walk stops at the
+        first miss and could never reach it again)."""
+        for p in reversed(list(pages)):
             if not 0 <= p < self.max_pages:
                 raise ValueError(f"page id {p} out of range")
+            meta = self._cached.get(p)
+            if meta is not None:
+                if meta[1] <= 0:
+                    raise ValueError(
+                        f"over-release of cached page {p} (refcount 0)")
+                meta[1] -= 1
+                if meta[1] == 0:
+                    self._lru[p] = None
+                continue
             if p in self._free:
                 raise ValueError(f"double free of page {p}")
             self._free.append(p)
         self._free.sort(reverse=True)
+
+    # ---- prefix cache ---------------------------------------------------
+
+    def prefix_lookup(self, tokens, max_blocks: Optional[int] = None,
+                      hashes=None):
+        """Longest cached block-aligned prefix of `tokens` WITHOUT taking
+        references. Returns (n_blocks_hit, n_lru_hits) — the second
+        counts hits currently refcount-0, i.e. pages that will leave the
+        available pool when acquired (admission must budget for them).
+        `hashes` (from hash_prefix_blocks) skips re-hashing a prompt the
+        caller already hashed — the scheduler plans every waiting
+        request each step, so this sits on the admission hot path."""
+        hits = lru = 0
+        if hashes is None:
+            hashes = hash_prefix_blocks(tokens, self.block_size)
+        if max_blocks is not None:
+            hashes = hashes[:max_blocks]
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            hits += 1
+            if self._cached[page][1] == 0:
+                lru += 1
+        return hits, lru
+
+    def acquire_prefix(self, tokens, max_blocks: Optional[int] = None,
+                       hashes=None):
+        """Walk the chained block hashes of `tokens`, taking a reference
+        on every hit (pinning the page against eviction). Returns the
+        cached page ids, in block order; release each with free()."""
+        pages = []
+        if hashes is None:
+            hashes = hash_prefix_blocks(tokens, self.block_size)
+        if max_blocks is not None:
+            hashes = hashes[:max_blocks]
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            meta = self._cached[page]
+            if meta[1] == 0:
+                del self._lru[page]
+            meta[1] += 1
+            pages.append(page)
+        return pages
+
+    def insert_prefix(self, tokens, pages, start_block: int = 0,
+                      hashes=None) -> int:
+        """Register `pages` — one per full block of `tokens`, starting at
+        block `start_block`, already holding that block's K/V — under the
+        chained prefix hashes. A hash that is already mapped is SKIPPED
+        (first writer wins; the caller keeps its page as a private copy),
+        so two same-prefix requests prefilled in one batch never
+        double-insert. Each inserted page gains one reference owned by
+        the caller — release it with free(). Returns the insert count."""
+        if hashes is None:
+            hashes = hash_prefix_blocks(tokens, self.block_size)
+        inserted = 0
+        for h, page in zip(hashes[start_block:], pages):
+            if h in self._hash_to_page:
+                continue
+            if page in self._cached:
+                raise ValueError(
+                    f"page {page} already registered in the prefix cache")
+            if page in self._free:
+                raise ValueError(f"cannot insert free page {page}")
+            self._hash_to_page[h] = page
+            self._cached[page] = [h, 1]
+            inserted += 1
+        return inserted
 
     def tables_for_batch(self, seq_capacities):
         """Allocate per-sequence page lists and return (tables [B, max_n]

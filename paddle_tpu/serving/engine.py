@@ -18,7 +18,32 @@ the device only ever sees fixed shapes:
   every program, so pages are updated in place);
 - a block table [slots, table_width] mapping each slot's logical blocks
   to pool pages (retired/empty slots point at a reserved scratch page);
-- per-slot lengths/tokens/done flags.
+- per-slot lengths/tokens/budgets/done flags.
+
+Two serving optimisations ride on that substrate (see README.md in this
+directory for the full design):
+
+**Block-aligned prefix caching.** Every full `block_size`-token prompt
+block is hashed (chained, so a hit implies the whole prefix matches)
+into `PagedKVManager`'s refcounted page cache. An admitted request maps
+its cached prefix pages straight into its block table and prefills only
+the uncached suffix — suffix-bucketed, so prefill programs stay keyed
+by (bucket, batch) and compile counts don't grow with hit patterns.
+Retire paths release references; a page recycles only at refcount 0
+(LRU-evicted under pool pressure), so a hung-slot retire can never pull
+a shared prefix out from under a surviving slot.
+
+**Double-buffered scheduling.** In pipelined mode the engine dispatches
+decode chunk N+1 — its token/length inputs chained on chunk N's
+device-side outputs — BEFORE blocking on chunk N's host-visible
+results, hiding the per-sync host RTT behind device compute. Host-side
+changes (admission, retirement) override the chained values per slot at
+the next dispatch; a per-row budget length freezes rows on-device at
+prompt+max_new so a speculatively-dispatched chunk can never write past
+a request's reserved pages. Because the KV pools are donated through
+every program, device programs serialize in dispatch order — a stale
+chunk's writes for a retired row always land before any new owner of
+those pages scatters or reads them.
 
 Weights go through the `_decode_params` layout (`_mm`), so dense AND
 weight-only int8/int4 serving compose with the engine unchanged.
@@ -27,6 +52,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import namedtuple
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -35,8 +61,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import (PagedKVManager, _make_decode_step,
-                            _make_head_logits, _make_prefill, _sample_next,
-                            make_paged_kv_helpers)
+                            _make_head_logits, _make_prefill,
+                            _make_prefill_with_prefix, _sample_next,
+                            hash_prefix_blocks, make_paged_kv_helpers)
 from ..resilience import chaos
 
 
@@ -56,7 +83,12 @@ class ServeRequest:
     # host-side scheduling state (None until admitted)
     slot: Optional[int] = None
     pages: Optional[list] = None
-    bucket: Optional[int] = None
+    bucket: Optional[int] = None           # suffix bucket it prefilled at
+    n_prefix: int = 0                      # cached prefix blocks mapped in
+    cached_tokens: int = 0                 # prompt tokens served from cache
+    # chained block hashes, computed ONCE at add_request — _plan runs
+    # for every waiting request on every scheduling step
+    block_hashes: Optional[list] = None
 
     @property
     def done(self) -> bool:
@@ -78,6 +110,12 @@ class _Slot:
         self.done = False      # EOS seen inside a chunk
 
 
+# admission plan for one waiting request: suffix bucket, cached prefix
+# blocks, cached pages currently refcount-0 (they leave the available
+# pool on acquire), private pages to reserve, true suffix length
+_Plan = namedtuple("_Plan", "sb_suf n_cached n_lru need suffix_len")
+
+
 class ContinuousBatchingEngine:
     """vLLM-class continuous batching over `PagedKVManager`.
 
@@ -91,13 +129,21 @@ class ContinuousBatchingEngine:
         for req in eng.finished: print(req.tokens)
 
     Scheduling policy: FIFO admission; a request is admitted when a slot
-    is free AND the pool can hold its full capacity
-    (ceil((bucketed_prompt + max_new) / block_size) pages — conservative
-    reservation, so no preemption is ever needed). Prefill runs as its
-    own single-request program (compiled per prompt bucket); decode runs
+    is free AND the pool can hold its full per-request capacity
+    (cached prefix blocks map in for free; ceil((bucketed_suffix +
+    req.max_new) / block_size) private pages are reserved, so no
+    preemption is ever needed). Prefill runs batched per (suffix bucket,
+    cached-vs-cold) run of waiting requests; decode runs
     `steps_per_sync` tokens for ALL slots per invocation, then the host
-    retires EOS/finished rows and admits from the wait queue.
+    retires EOS/finished rows and admits from the wait queue. With
+    `double_buffer` the next chunk is dispatched before the previous
+    chunk's results are read back (see module docstring).
     """
+
+    # a commit wait longer than this counts as a blocked sync (the host
+    # sat idle waiting on the device) — the stat double buffering exists
+    # to shrink
+    stall_threshold_s = 1e-3
 
     def __init__(self, cfg, dec_params, *, slots: int = 8,
                  prompt_bucket: int = 64, max_prompt_len: int = 512,
@@ -106,7 +152,8 @@ class ContinuousBatchingEngine:
                  prefill_batch: int = 4,
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
                  top_k: int = 0, temperature: float = 1.0,
-                 top_p: float = 1.0, seed: int = 0, dtype=jnp.bfloat16):
+                 top_p: float = 1.0, seed: int = 0, dtype=jnp.bfloat16,
+                 prefix_cache: bool = True, double_buffer: bool = False):
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a whole number of "
@@ -127,9 +174,22 @@ class ContinuousBatchingEngine:
         self.top_k = int(top_k)
         self.temperature = temperature
         self.top_p = top_p
-        # capacity: every slot simultaneously full-length, +1 scratch page
+        self.prefix_cache = bool(prefix_cache)
+        self.double_buffer = bool(double_buffer)
+        # pool capacity: every slot simultaneously full-length at the
+        # ENGINE budget, +1 scratch page. Per-request reservations are
+        # never larger — _plan TRIMS a cached prefix until the hit
+        # path's total pages (cached blocks + bucketed-suffix capacity)
+        # fit the cold-path worst case, because a block-aligned but not
+        # bucket-aligned prefix widens the suffix bucket and could
+        # otherwise out-reserve the pool the cold path was sized for
+        # (admission would livelock) — so admission cannot deadlock and
+        # the cold-path width bounds every block table
         cap = self._capacity_pages(self.max_prompt_len)
         self.table_width = cap
+        # widest cached prefix any request can map (>= 1 suffix token
+        # always prefills, so the last block is never part of a prefix)
+        self._prefix_width = max(1, (self.max_prompt_len - 1) // block_size)
         if max_pages is None:
             max_pages = slots * cap + 1
         self.mgr = PagedKVManager(max_pages, block_size)
@@ -142,6 +202,7 @@ class ContinuousBatchingEngine:
         self._slots = [_Slot() for _ in range(slots)]
         self._tables = np.full((slots, cap), self.scratch_page, np.int32)
         self._tokens = np.zeros((slots,), np.int32)
+        self._budgets = np.zeros((slots,), np.int32)  # prompt + max_new
         self._key = jax.random.PRNGKey(seed)
         self.waiting: list[ServeRequest] = []
         self.finished: list[ServeRequest] = []
@@ -149,23 +210,44 @@ class ContinuousBatchingEngine:
         self._prefill_cache = {}
         self._decode = jax.jit(self._build_decode_chunk(),
                                donate_argnums=(1, 2))
-        self.device_steps = 0   # decode-chunk invocations (for metrics)
-        self.prefill_calls = 0  # batched-admission device calls
-        self.hung_retired = 0   # slots retired by the watchdog
-        self._watchdog = None   # armed by run(watchdog_timeout=...)
-        self._step_epoch = 0    # bumped on timeout; zombie steps abort
-        # makes ownership-check + host-state commit atomic against the
-        # timeout path's epoch-bump + victim-retire (a step completing
-        # exactly at the deadline must either fully commit before the
-        # bump or fully abort after it — never interleave)
+        self.device_steps = 0    # decode-chunk dispatches (for metrics)
+        self.prefill_calls = 0   # batched-admission device calls
+        self.hung_retired = 0    # slots retired by the watchdog
+        self.prefix_hit_tokens = 0   # prompt tokens served from cache
+        self.prompt_tokens = 0       # prompt tokens admitted in total
+        self.prefix_inserts = 0      # blocks registered into the cache
+        self.sync_wait_s = 0.0   # host time blocked on decode readbacks
+        self.blocked_syncs = 0   # readbacks that waited > stall threshold
+        self._watchdog = None    # armed by run(watchdog_timeout=...)
+        self._step_epoch = 0     # bumped on timeout; zombie steps abort
+        # double-buffer pipeline state: the uncommitted in-flight chunk,
+        # the device-side token/length carries chunk N+1 chains from,
+        # and the per-slot mask saying "host state changed since the
+        # last dispatch — override the chained value"
+        self._inflight = None
+        self._chain_tok = None
+        self._chain_lens = None
+        self._override = np.ones((slots,), bool)
+        # makes ownership-check + device dispatch + host-state commit
+        # atomic against the timeout path's epoch-bump + victim-retire
+        # (a step completing exactly at the deadline must either fully
+        # commit before the bump or fully abort after it — never
+        # interleave; a zombie thread must never dispatch against
+        # donated pools the live loop still owns)
         self._commit_lock = threading.Lock()
 
     # ---- host-side accounting -------------------------------------------
 
     def _capacity_pages(self, sb: int) -> int:
+        """Pages a request at bucket `sb` needs at the ENGINE-wide token
+        budget (pool sizing; per-request admission uses the request's
+        own max_new via _capacity_pages_for)."""
+        return self._capacity_pages_for(sb, self.max_new)
+
+    def _capacity_pages_for(self, sb: int, max_new: int) -> int:
         # same ceil-division as PagedKVManager.pages_needed (which is not
         # constructed yet when __init__ sizes the pool from this)
-        return -(-(sb + self.max_new) // self.block_size)
+        return -(-(sb + max_new) // self.block_size)
 
     @property
     def n_active(self) -> int:
@@ -174,6 +256,30 @@ class ContinuousBatchingEngine:
     @property
     def has_work(self) -> bool:
         return bool(self.waiting) or self.n_active > 0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from the prefix
+        cache instead of being prefilled."""
+        if not self.prompt_tokens:
+            return 0.0
+        return self.prefix_hit_tokens / self.prompt_tokens
+
+    def compile_stats(self) -> dict:
+        """jit cache sizes for every engine program — the steady-state
+        guard: after warm(), serving traffic must not grow any entry."""
+        stats = {"decode": self._jit_cache_size(self._decode)}
+        for key, fn in self._prefill_cache.items():
+            stats["prefill:" + ":".join(str(k) for k in key)] = \
+                self._jit_cache_size(fn)
+        return stats
+
+    @staticmethod
+    def _jit_cache_size(fn) -> int:
+        try:
+            return int(fn._cache_size())
+        except Exception:
+            return -1
 
     def add_request(self, prompt, max_new: Optional[int] = None,
                     arrival_time: Optional[float] = None) -> ServeRequest:
@@ -199,13 +305,19 @@ class ContinuousBatchingEngine:
             raise ValueError(f"max_new {req.max_new} > engine budget "
                              f"{self.max_new}")
         sb = -(-len(prompt) // self.prompt_bucket) * self.prompt_bucket
-        if self._capacity_pages(sb) > self.mgr.max_pages - 1:
-            # fail fast: this request could never be admitted even with
-            # the whole pool free (minus the scratch page)
+        # fail fast on the request's OWN budget (not the engine-wide
+        # max_new): a short-max_new request needs fewer pages, so it is
+        # servable in pools a worst-case reservation would reject
+        need = self._capacity_pages_for(sb, req.max_new)
+        if need > self.mgr.max_pages - 1:
+            # this request could never be admitted even with the whole
+            # pool free (minus the scratch page) and a cold cache
             raise ValueError(
-                f"request needs {self._capacity_pages(sb)} pages "
-                f"(bucketed prompt {sb} + max_new {self.max_new}) but the "
+                f"request needs {need} pages "
+                f"(bucketed prompt {sb} + max_new {req.max_new}) but the "
                 f"pool holds only {self.mgr.max_pages - 1}")
+        if self.prefix_cache:
+            req.block_hashes = hash_prefix_blocks(prompt, self.block_size)
         self._next_id += 1
         self.waiting.append(req)
         return req
@@ -244,17 +356,52 @@ class ContinuousBatchingEngine:
 
         return run
 
+    def _build_prefix_prefill(self, sb: int, bsz: int):
+        """Like _build_prefill, but for rows whose prompt head hit the
+        prefix cache: only the `sb`-bucketed suffix is computed, reading
+        the cached prefix K/V through per-row prefix tables. One compile
+        per (suffix bucket, batch) pair — prefix length is traced, so
+        every hit depth shares the program."""
+        cfg = self.cfg
+        bs = self.block_size
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        n_pre = sb // bs
+        base = _make_prefill_with_prefix(cfg, bsz, sb, self._prefix_width,
+                                         bs)
+        head_logits = _make_head_logits(cfg)
+        do_sample, top_k = self.do_sample, self.top_k
+        to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
+
+        def run(p, kcs, vcs, ids, s0_vec, pages, ptables, plens, key,
+                temperature, top_p):
+            h, kvs = base(p, kcs, vcs, ids, ptables, plens)
+            for i, (k, v) in enumerate(kvs):
+                kcs[i] = kcs[i].at[pages].set(
+                    to_pages(k).astype(kcs[i].dtype))
+                vcs[i] = vcs[i].at[pages].set(
+                    to_pages(v).astype(vcs[i].dtype))
+            h_last = h[jnp.arange(bsz), s0_vec - 1][:, None, :]
+            logits = head_logits(h_last, p)[:, -1]
+            first = _sample_next(logits.astype(jnp.float32), key,
+                                 do_sample, temperature, top_k, top_p)
+            return first, kcs, vcs
+
+        return run
+
     def _build_decode_chunk(self):
         """`steps` decode tokens for every slot in one program. Retired /
         free rows point their table at the scratch page and freeze their
-        length, so they compute (fixed shape) but touch nothing live."""
+        length, so they compute (fixed shape) but touch nothing live.
+        `budgets` [slots] freezes each row on-device at prompt+max_new —
+        the guarantee that a speculatively-dispatched chunk (double
+        buffering) can never write past a request's reserved pages."""
         from ..kernels.decode_attention import paged_decode_attention
 
         cfg, b, bs = self.cfg, self.slots, self.block_size
         steps = self.steps
         do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
 
-        def run(p, kcs, vcs, toks, lens, tables, live, key,
+        def run(p, kcs, vcs, toks, lens, budgets, tables, live, key,
                 temperature, top_p):
             _, kv_write = make_paged_kv_helpers(
                 b, 0, cfg.num_key_value_heads, cfg.head_dim, bs, tables)
@@ -272,7 +419,7 @@ class ContinuousBatchingEngine:
                 key_, ks = jax.random.split(key_)
                 nxt = _sample_next(logits.astype(jnp.float32), ks,
                                    do_sample, temperature, top_k, top_p)
-                frozen = done | ~live
+                frozen = done | ~live | (lens_ >= budgets)
                 if eos is not None:
                     nxt = jnp.where(frozen, eos, nxt)
                     done = done | (nxt == eos)
@@ -296,10 +443,17 @@ class ContinuousBatchingEngine:
     def _get_prefill(self, sb: int, bsz: int):
         """The single compile point for (bucket, batch) prefill programs
         (warm and _admit must never diverge in jit options)."""
-        key = (sb, bsz)
+        key = ("cold", sb, bsz)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._build_prefill(sb, bsz), donate_argnums=(1, 2))
+        return self._prefill_cache[key]
+
+    def _get_prefix_prefill(self, sb: int, bsz: int):
+        key = ("prefix", sb, bsz)
+        if key not in self._prefill_cache:
+            self._prefill_cache[key] = jax.jit(
+                self._build_prefix_prefill(sb, bsz), donate_argnums=(1, 2))
         return self._prefill_cache[key]
 
     def _max_prefill_bsz(self) -> int:
@@ -312,10 +466,13 @@ class ContinuousBatchingEngine:
 
     def warm(self, buckets=None):
         """Compile (and cache) every program the engine can need for the
-        given prompt buckets — each power-of-two prefill batch plus the
-        decode chunk — by running them against the scratch page. Call
-        before serving latency-sensitive traffic; mid-stream compiles
-        would otherwise land on the first matching admit."""
+        given prompt buckets — each power-of-two prefill batch (cold AND
+        cached-prefix variants) plus the decode chunk — by running them
+        against the scratch page. Call before serving latency-sensitive
+        traffic; mid-stream compiles would otherwise land on the first
+        matching admit. NOTE: buckets must cover the SUFFIX buckets
+        cache-hit requests will prefill at, not just full prompt
+        buckets (a hit's suffix is shorter than its prompt)."""
         buckets = [self.max_prompt_len] if buckets is None else buckets
         cap = self._max_prefill_bsz()
         for sb in buckets:
@@ -333,6 +490,23 @@ class ContinuousBatchingEngine:
                     jnp.full((bsz, n_pre), self.scratch_page, jnp.int32),
                     k, jnp.asarray(self.temperature, jnp.float32),
                     jnp.asarray(self.top_p, jnp.float32))
+                if self.prefix_cache:
+                    # prefix length 0 masks the whole (scratch) prefix:
+                    # the warm run computes garbage, touches only the
+                    # scratch page, and caches the compiled program
+                    self._key, k = jax.random.split(self._key)
+                    _, self.kcs, self.vcs = self._get_prefix_prefill(
+                        sb, bsz)(
+                        self.p, self.kcs, self.vcs,
+                        jnp.zeros((bsz, sb), jnp.int32),
+                        jnp.ones((bsz,), jnp.int32),
+                        jnp.full((bsz, n_pre), self.scratch_page,
+                                 jnp.int32),
+                        jnp.full((bsz, self._prefix_width),
+                                 self.scratch_page, jnp.int32),
+                        jnp.zeros((bsz,), jnp.int32),
+                        k, jnp.asarray(self.temperature, jnp.float32),
+                        jnp.asarray(self.top_p, jnp.float32))
                 if bsz >= cap:
                     break
                 bsz *= 2
@@ -343,16 +517,13 @@ class ContinuousBatchingEngine:
                                   self.scratch_page, jnp.int32)
         out = self._decode(
             self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
+            jnp.zeros((self.slots,), jnp.int32),
             jnp.zeros((self.slots,), jnp.int32), scratch_tables,
             jnp.zeros((self.slots,), bool), k,
             jnp.asarray(self.temperature, jnp.float32),
             jnp.asarray(self.top_p, jnp.float32))
         _, _, _, self.kcs, self.vcs = out
         np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
-
-    def _bucket(self, req) -> int:
-        return -(-len(req.prompt) // self.prompt_bucket) \
-            * self.prompt_bucket
 
     def _check_owner(self, token: Optional[int]):
         """A watchdog-abandoned step thread must stop mutating shared
@@ -361,65 +532,143 @@ class ContinuousBatchingEngine:
             raise _AbandonedStep(
                 "step abandoned by the watchdog; discarding its work")
 
+    def _plan(self, req: ServeRequest) -> _Plan:
+        """Admission plan: cached-prefix split + page reservation for
+        one waiting request (pure lookup — takes no references)."""
+        bs = self.block_size
+        L = len(req.prompt)
+        cold_sb = -(-L // self.prompt_bucket) * self.prompt_bucket
+        cold_total = self._capacity_pages_for(cold_sb, req.max_new)
+        n_cached = 0
+        if self.prefix_cache:
+            # cap so at least one suffix token always prefills (the
+            # first-token logits must be computed even on a full hit)
+            max_blocks = (L - 1) // bs
+            if max_blocks > 0:
+                n_cached, _ = self.mgr.prefix_lookup(
+                    req.prompt, max_blocks, hashes=req.block_hashes)
+        while True:
+            suffix_len = L - n_cached * bs
+            sb_suf = -(-suffix_len // self.prompt_bucket) \
+                * self.prompt_bucket
+            need = self._capacity_pages_for(sb_suf, req.max_new)
+            # a prefix that is block- but not bucket-aligned widens the
+            # suffix bucket; trim it until the hit path's total resident
+            # pages never exceed the cold path's (the bound the pool and
+            # table_width are sized to — otherwise admission could
+            # out-reserve the pool and livelock)
+            if n_cached == 0 or n_cached + need <= cold_total:
+                break
+            n_cached -= 1
+        n_lru = 0
+        if n_cached:
+            n_lru = self.mgr.prefix_lookup(req.prompt, n_cached,
+                                           hashes=req.block_hashes)[1]
+        return _Plan(sb_suf, n_cached, n_lru, need, suffix_len)
+
     def _admit(self, token: Optional[int] = None):
-        """FIFO admission, batched: the head run of same-bucket waiting
-        requests (bounded by free slots, free pages, and prefill_batch)
-        prefills in ONE device call; partial batches pad with rows aimed
-        at the scratch page."""
+        """FIFO admission, batched: the head run of waiting requests
+        sharing a (suffix bucket, cached-vs-cold) key — bounded by free
+        slots, available pages, and prefill_batch — prefills in ONE
+        device call; partial batches pad with rows aimed at the scratch
+        page. Cache-hit rows map their cached prefix pages into their
+        block tables and prefill only the suffix; cold rows take the
+        flash-attention prefill path unchanged. After commit, every
+        freshly computed full prompt block is inserted into the prefix
+        cache for future requests."""
+        bs = self.block_size
         while self.waiting:
             self._check_owner(token)
             free_slots = [i for i, s in enumerate(self._slots)
                           if s.req is None]
             if not free_slots:
                 return
-            sb = self._bucket(self.waiting[0])
-            batch = []
-            pages_left = self.mgr.n_free
-            need = self._capacity_pages(sb)
+            head = self._plan(self.waiting[0])
+            key = (head.sb_suf, head.n_cached > 0)
+            batch, plans = [], []
+            # available = free + evictable; acquiring a refcount-0
+            # cached page also consumes availability (n_lru)
+            avail = self.mgr.n_available
+            limit = min(len(free_slots), self.prefill_batch)
             for req in self.waiting:
-                if (self._bucket(req) != sb or not free_slots[len(batch):]
-                        or len(batch) >= self.prefill_batch):
+                if len(batch) >= limit:
                     break
-                if need > pages_left:
+                plan = head if not batch else self._plan(req)
+                if (plan.sb_suf, plan.n_cached > 0) != key:
+                    break
+                if plan.need + plan.n_lru > avail:
                     break  # FIFO: a short request must not starve the head
-                pages_left -= need
+                avail -= plan.need + plan.n_lru
                 batch.append(req)
+                plans.append(plan)
             if not batch:
                 return  # head is blocked on pages
-            n_pre = sb // self.block_size
+            sb_suf, has_prefix = key
+            n_pre = sb_suf // bs
             bsz = 1
             while bsz < len(batch):
                 bsz *= 2
-            fn = self._get_prefill(sb, bsz)
-            ids = np.zeros((bsz, sb), np.int32)
+            ids = np.zeros((bsz, sb_suf), np.int32)
             s0s = np.ones((bsz,), np.int32)
             pages = np.full((bsz, n_pre), self.scratch_page, np.int32)
-            for row, req in enumerate(batch):
-                req.slot, req.bucket = free_slots[row], sb
-                req.pages = self.mgr.alloc_pages(need)
-                ids[row, :len(req.prompt)] = req.prompt
-                s0s[row] = len(req.prompt)
-                pages[row] = req.pages[:n_pre]
-            self._key, k = jax.random.split(self._key)
-            self.prefill_calls += 1
-            out = fn(
-                self.p, self.kcs, self.vcs, jnp.asarray(ids),
-                jnp.asarray(s0s), jnp.asarray(pages), k,
-                jnp.asarray(self.temperature, jnp.float32),
-                jnp.asarray(self.top_p, jnp.float32))
+            ptbl = np.full((bsz, self._prefix_width), self.scratch_page,
+                           np.int32)
+            plens = np.zeros((bsz,), np.int32)
+            with self._commit_lock:
+                self._check_owner(token)
+                # pin every row's cached prefix BEFORE any alloc —
+                # alloc_pages evicts refcount-0 cached pages, and a
+                # pinned page can never be the victim
+                acquired = [self.mgr.acquire_prefix(
+                                req.prompt, plan.n_cached,
+                                hashes=req.block_hashes)
+                            if plan.n_cached else []
+                            for req, plan in zip(batch, plans)]
+                for row, (req, plan) in enumerate(zip(batch, plans)):
+                    cached = acquired[row]
+                    priv = self.mgr.alloc_pages(plan.need)
+                    req.slot, req.bucket = free_slots[row], sb_suf
+                    req.pages = cached + priv
+                    req.n_prefix = len(cached)
+                    req.cached_tokens = len(cached) * bs
+                    suffix = req.prompt[req.cached_tokens:]
+                    ids[row, :len(suffix)] = suffix
+                    s0s[row] = len(suffix)
+                    pages[row] = priv[:n_pre]
+                    if cached:
+                        ptbl[row, :len(cached)] = cached
+                        plens[row] = req.cached_tokens
+                self._key, k = jax.random.split(self._key)
+                self.prefill_calls += 1
+                if has_prefix:
+                    fn = self._get_prefix_prefill(sb_suf, bsz)
+                    out = fn(self.p, self.kcs, self.vcs, jnp.asarray(ids),
+                             jnp.asarray(s0s), jnp.asarray(pages),
+                             jnp.asarray(ptbl), jnp.asarray(plens), k,
+                             jnp.asarray(self.temperature, jnp.float32),
+                             jnp.asarray(self.top_p, jnp.float32))
+                else:
+                    fn = self._get_prefill(sb_suf, bsz)
+                    out = fn(self.p, self.kcs, self.vcs, jnp.asarray(ids),
+                             jnp.asarray(s0s), jnp.asarray(pages), k,
+                             jnp.asarray(self.temperature, jnp.float32),
+                             jnp.asarray(self.top_p, jnp.float32))
+                firsts_dev, self.kcs, self.vcs = out
+            # blocking readback OUTSIDE the lock: a hung device wait
+            # must never hold the lock the timeout path needs
+            firsts = np.asarray(firsts_dev)
             # abandoned mid-prefill: commit NOTHING. The batch is still
             # in `waiting` (popped only below), so the live loop
             # re-admits it with fresh pages; this thread's page
-            # allocation leaks until drain — leaking beats racing the
-            # live thread for the free list. The lock makes check+commit
-            # atomic against the timeout path's epoch-bump+retire.
+            # allocation (and prefix references) leak until drain —
+            # leaking beats racing the live thread for the free list.
+            # The lock makes check+commit atomic against the timeout
+            # path's epoch-bump+retire.
             with self._commit_lock:
                 self._check_owner(token)
                 del self.waiting[:len(batch)]
-                firsts, self.kcs, self.vcs = out
-                firsts = np.asarray(firsts)
                 now = time.perf_counter()
-                for row, req in enumerate(batch):
+                for row, (req, plan) in enumerate(zip(batch, plans)):
                     slot_id = req.slot
                     slot = self._slots[slot_id]
                     first = int(firsts[row])
@@ -433,6 +682,22 @@ class ContinuousBatchingEngine:
                         (self.table_width - len(req.pages))
                     self._tables[slot_id] = padded
                     self._tokens[slot_id] = first
+                    self._budgets[slot_id] = len(req.prompt) + req.max_new
+                    self._override[slot_id] = True
+                    self.prompt_tokens += len(req.prompt)
+                    self.prefix_hit_tokens += req.cached_tokens
+                    if self.prefix_cache:
+                        # register every freshly computed FULL prompt
+                        # block (its K/V is prefix-deterministic; decode
+                        # writes start at position len(prompt), never
+                        # inside it) — first writer wins on hash races
+                        full = len(req.prompt) // bs
+                        if full > req.n_prefix:
+                            self.prefix_inserts += self.mgr.insert_prefix(
+                                req.prompt,
+                                req.pages[req.n_prefix:full],
+                                start_block=req.n_prefix,
+                                hashes=req.block_hashes)
                     if slot.done or req.max_new == 1:
                         self._retire(slot_id)
 
@@ -444,52 +709,91 @@ class ContinuousBatchingEngine:
         req.failed = failed
         req.error = error
         self.finished.append(req)
+        # refcount-aware: private pages recycle now; shared prefix pages
+        # only once NO live slot maps them (then LRU, evict on pressure)
         self.mgr.free(req.pages)
         req.pages = None
         slot.req, slot.length, slot.emitted, slot.done = None, 0, 0, False
         # the row MUST stop pointing at freed pages before they recycle
         self._tables[slot_id] = self.scratch_page
         self._tokens[slot_id] = 0
+        self._budgets[slot_id] = 0
+        self._override[slot_id] = True
 
-    def step(self) -> int:
-        """One scheduling iteration: admit -> decode chunk -> retire.
-        Returns the number of live tokens produced."""
-        wd = self._watchdog
-        # ownership token: if the watchdog abandons this step, run()
-        # bumps _step_epoch and every later commit point in THIS thread
-        # raises _AbandonedStep instead of racing the live loop
-        token = self._step_epoch if wd is not None else None
-        if wd is not None:
-            wd.phase = "admit"
-        self._admit(token)
+    def _dispatch_chunk(self, token: Optional[int] = None,
+                        chain: bool = False):
+        """Enqueue one decode chunk WITHOUT waiting for its results.
+        Returns the pending-chunk record (None if no slot is live).
+        With `chain`, token/length inputs ride the previous chunk's
+        device outputs except where the host changed a slot since the
+        last dispatch (admission/retire set `_override`); without it,
+        inputs come from host state and the chain is invalidated."""
         live = np.asarray([s.req is not None for s in self._slots])
         if not live.any():
-            return 0
-        if wd is not None:
-            wd.phase = "decode"
-        # chaos hang seam sits BEFORE the device call: a watchdog-
-        # abandoned step must unwind (ChaosHang) without ever touching
-        # the donated KV pools from a dead thread
+            return None
+        if self._watchdog is not None:
+            self._watchdog.phase = "decode"
+        # chaos hang seam sits BEFORE the device call and BEFORE the
+        # lock: a watchdog-abandoned step must unwind (ChaosHang) or
+        # abort at the owner check without ever dispatching against the
+        # donated KV pools from a dead thread
         chaos.maybe_hang("decode")
-        lens = np.asarray([s.length for s in self._slots], np.int32)
-        self._key, k = jax.random.split(self._key)
-        res = self._decode(
-            self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
-            jnp.asarray(lens), jnp.asarray(self._tables),
-            jnp.asarray(live), k,
-            jnp.asarray(self.temperature, jnp.float32),
-            jnp.asarray(self.top_p, jnp.float32))
         with self._commit_lock:
-            self._check_owner(token)  # abandoned mid-decode: discard
+            self._check_owner(token)
+            self._key, k = jax.random.split(self._key)
+            host_toks = jnp.asarray(self._tokens)
+            host_lens = jnp.asarray(np.asarray(
+                [s.length for s in self._slots], np.int32))
+            if chain and self._chain_tok is not None \
+                    and not self._override.all():
+                ov = jnp.asarray(self._override)
+                toks_in = jnp.where(ov, host_toks, self._chain_tok)
+                lens_in = jnp.where(ov, host_lens, self._chain_lens)
+            else:
+                toks_in, lens_in = host_toks, host_lens
+            res = self._decode(
+                self.p, self.kcs, self.vcs, toks_in, lens_in,
+                jnp.asarray(self._budgets), jnp.asarray(self._tables),
+                jnp.asarray(live), k,
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
             out, new_lens, done, self.kcs, self.vcs = res
             self.device_steps += 1
-            out = np.asarray(out)
-            new_lens = np.asarray(new_lens)
-            done = np.asarray(done)
+            if chain:
+                self._chain_tok = out[:, -1]
+                self._chain_lens = new_lens
+                self._override[:] = False
+            else:
+                # host state is authoritative after a synchronous step;
+                # a later pipelined dispatch must not chain a stale chunk
+                self._chain_tok = None
+                self._chain_lens = None
+                self._override[:] = True
+            return {"out": out, "lens": new_lens, "done": done,
+                    "reqs": [s.req for s in self._slots]}
+
+    def _commit_chunk(self, rec, token: Optional[int] = None) -> int:
+        """Block on a dispatched chunk's host-visible outputs and commit
+        them: extend token lists, advance lengths, retire EOS/finished
+        rows. Rows whose slot changed hands since the chunk was
+        dispatched (double buffering: retired then re-admitted) are
+        skipped — their device work was speculative waste, their writes
+        are confined to pages that are overwritten before any new owner
+        reads them. Returns live tokens produced."""
+        t0 = time.perf_counter()
+        out = np.asarray(rec["out"])          # the blocking host sync
+        new_lens = np.asarray(rec["lens"])
+        done = np.asarray(rec["done"])
+        wait = time.perf_counter() - t0
+        with self._commit_lock:
+            self._check_owner(token)  # abandoned mid-wait: discard
+            self.sync_wait_s += wait
+            if wait > self.stall_threshold_s:
+                self.blocked_syncs += 1
             produced = 0
             for slot_id, slot in enumerate(self._slots):
-                req = slot.req
-                if req is None:
+                req = rec["reqs"][slot_id]
+                if req is None or slot.req is not req or req.done:
                     continue
                 take = min(self.steps, req.max_new - slot.emitted)
                 toks = out[slot_id, :take].tolist()
@@ -503,24 +807,72 @@ class ContinuousBatchingEngine:
                 self._tokens[slot_id] = toks[-1] if toks else 0
                 if slot.done or slot.emitted >= req.max_new:
                     self._retire(slot_id)
-        return produced
+            return produced
+
+    def step(self) -> int:
+        """One synchronous scheduling iteration: admit -> decode chunk
+        -> wait -> retire. Returns the number of live tokens produced."""
+        wd = self._watchdog
+        # ownership token: if the watchdog abandons this step, run()
+        # bumps _step_epoch and every later commit point in THIS thread
+        # raises _AbandonedStep instead of racing the live loop
+        token = self._step_epoch if wd is not None else None
+        if wd is not None:
+            wd.phase = "admit"
+        self._admit(token)
+        rec = self._dispatch_chunk(token, chain=False)
+        if rec is None:
+            return 0
+        return self._commit_chunk(rec, token)
+
+    def _pipeline_step(self) -> int:
+        """One double-buffered iteration: admit, dispatch chunk N+1,
+        THEN block on chunk N — the host-side commit work (token
+        readback, retirement, next admission's planning) overlaps chunk
+        N+1's device time instead of serializing with it. Admissions and
+        retirements take effect one chunk later than in synchronous
+        mode; budgets and the slot-ownership snapshot keep the
+        speculative chunk harmless (see module docstring)."""
+        wd = self._watchdog
+        token = self._step_epoch if wd is not None else None
+        if wd is not None:
+            wd.phase = "admit"
+        self._admit(token)
+        rec = self._dispatch_chunk(token, chain=True)
+        with self._commit_lock:
+            self._check_owner(token)
+            prev, self._inflight = self._inflight, rec
+        if prev is not None:
+            if wd is not None:
+                wd.phase = "commit"
+            return self._commit_chunk(prev, token)
+        return 0
 
     def run(self, max_iters: int = 100000,
-            watchdog_timeout: Optional[float] = None):
+            watchdog_timeout: Optional[float] = None,
+            double_buffer: Optional[bool] = None):
         """Drain the queues. `watchdog_timeout` (seconds; default from
         FLAGS_step_timeout_s / PADDLE_TPU_STEP_TIMEOUT_S, 0 = off)
         bounds every scheduling step with a wall-clock deadline: a hung
-        step retires ONE victim slot (marked `failed`, its pages freed)
-        and the engine keeps serving the remaining requests instead of
-        wedging. A timeout with no live slot to blame re-raises — the
-        engine itself is stuck, not a request. Call `warm()` before
-        arming a tight deadline: a first-admit compile inside a
-        watchdogged step would eat the whole budget (and an abandoned
-        step mid-compile keeps running on its worker thread)."""
+        step retires ONE victim slot (marked `failed`, its pages freed —
+        but a cached prefix page another live slot maps stays pinned by
+        its refcount) and the engine keeps serving the remaining
+        requests instead of wedging. A timeout with no live slot to
+        blame re-raises — the engine itself is stuck, not a request. In
+        double-buffered mode a timeout also DROPS the uncommitted
+        in-flight chunk: its tokens were never committed, so the
+        surviving rows simply regenerate them from the last committed
+        host state (the overwritten KV slots were never read). Call
+        `warm()` before arming a tight deadline: a first-admit compile
+        inside a watchdogged step would eat the whole budget (and an
+        abandoned step mid-compile keeps running on its worker
+        thread)."""
         if watchdog_timeout is None:
             from ..framework.flags import flag
 
             watchdog_timeout = float(flag("step_timeout_s"))
+        db = self.double_buffer if double_buffer is None else double_buffer
+        step_fn = self._pipeline_step if db else self.step
         wd = None
         if watchdog_timeout and watchdog_timeout > 0:
             from ..resilience.watchdog import StepTimeout, Watchdog
@@ -530,28 +882,38 @@ class ContinuousBatchingEngine:
         try:
             while self.has_work and max_iters:
                 if wd is None:
-                    self.step()
+                    step_fn()
                 else:
                     try:
-                        wd.call(self.step)
+                        wd.call(step_fn)
                     except StepTimeout as e:
                         # reclaim ownership FIRST: the abandoned thread
                         # aborts at its next _check_owner instead of
-                        # committing stale results under the live loop;
+                        # committing stale results (or dispatching
+                        # against donated pools) under the live loop;
                         # the lock serializes this against a commit in
                         # flight RIGHT at the deadline (either it fully
                         # lands before the bump, or fully aborts after).
-                        # An in-flight device call still finishes on the
-                        # zombie thread; with donation that shows up as
-                        # a loud deleted-buffer error, not corruption.
                         with self._commit_lock:
                             self._step_epoch += 1
+                            self._inflight = None
+                            self._chain_tok = None
+                            self._chain_lens = None
+                            self._override[:] = True
                             retired = self._retire_hung_slot(e)
                         if not retired:
                             raise
                 max_iters -= 1
         finally:
             self._watchdog = None
+            # a drained pipeline may exit with one uncommitted
+            # speculative chunk (every row in it already retired);
+            # drop it so a later run() never commits a stale record
+            with self._commit_lock:
+                self._inflight = None
+                self._chain_tok = None
+                self._chain_lens = None
+                self._override[:] = True
         if self.has_work:
             raise RuntimeError("engine did not drain within max_iters")
         return self.finished

@@ -75,6 +75,43 @@ def test_rebaseline_marker_skips_one_comparison(tmp_path, monkeypatch):
     assert len(warned) == 1
 
 
+def test_serving_decode_chunk_entry_is_gated(tmp_path, monkeypatch):
+    """The engine's decode hot loop rides the op gate (ISSUE 3): a
+    `serving_decode_chunk` row records into OPBENCH.json, is NOT
+    informational (a chunk regression must flag), and goes through the
+    re-measure-before-fail pass like every other gated op."""
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    path = str(tmp_path / "OPBENCH.json")
+    monkeypatch.setattr(bench, "ACKNOWLEDGED_REGRESSIONS", {})
+    assert "serving_decode_chunk" not in bench.INFORMATIONAL_OPS
+    # first run records the row
+    assert bench._op_regressions({"serving_decode_chunk": 30.0},
+                                 path=path) == []
+    with open(path) as f:
+        assert json.load(f)["ops"]["serving_decode_chunk"] == 30.0
+    # a 33% chunk regression re-measures ONLY the suspect (never the
+    # whole table) and, still slow, fails the gate
+    measured = []
+    monkeypatch.setattr(bench, "_op_bench",
+                        lambda only=None: (measured.append(set(only)),
+                                           {"serving_decode_chunk":
+                                            39.5})[1])
+    warned = bench._op_regressions({"serving_decode_chunk": 40.0},
+                                   path=path)
+    assert measured == [{"serving_decode_chunk"}]
+    assert len(warned) == 1 and "serving_decode_chunk" in warned[0]
+    with open(path) as f:
+        # the better of the two measurements is what lands in the table
+        assert json.load(f)["ops"]["serving_decode_chunk"] == 39.5
+    # a re-measure that comes back healthy clears the flag
+    monkeypatch.setattr(bench, "_op_bench",
+                        lambda only=None: {"serving_decode_chunk": 30.1})
+    assert bench._op_regressions({"serving_decode_chunk": 40.0},
+                                 path=path) == []
+
+
 def test_corrupt_previous_file_tolerated(tmp_path):
     sys.path.insert(0, "/root/repo")
     import bench

@@ -725,6 +725,51 @@ class TestEngineWatchdog(unittest.TestCase):
         # pages all recycled: victim's pages were freed, pool drains
         self.assertEqual(eng.mgr.n_free, eng.mgr.max_pages - 1)
 
+    def test_hang_retire_never_frees_shared_prefix_page(self):
+        """Chaos hang:decode + watchdog retire of the slot that OWNS a
+        cached prefix block must not recycle the page — a surviving
+        slot still maps it (refcount), and its tokens must come out
+        exactly as on an uncached engine."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import ContinuousBatchingEngine
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  num_key_value_heads=2)
+        paddle.seed(21)
+        params = dict(LlamaForCausalLM(cfg).raw_state())
+        rng = np.random.default_rng(3)
+        shared = rng.integers(1, cfg.vocab_size, (8,)).tolist()
+        pa = shared + rng.integers(1, cfg.vocab_size, (5,)).tolist()
+        pb = shared + rng.integers(1, cfg.vocab_size, (4,)).tolist()
+
+        def engine(prefix):
+            return ContinuousBatchingEngine(
+                cfg, params, slots=2, prompt_bucket=8, max_prompt_len=16,
+                max_new_tokens=4, block_size=8, steps_per_sync=2,
+                prefix_cache=prefix)
+
+        ref = engine(False)
+        ref_b = ref.add_request(pb)
+        ref.run(max_iters=100)
+
+        eng = engine(True)
+        ra = eng.add_request(pa)
+        eng.warm(buckets=[8, 16])  # compiles land before the deadline
+        eng.step()                 # A prefills, inserts the shared block
+        rb = eng.add_request(pb)   # admitted next step: hits the block
+        chaos.install("hang:decode:20")
+        eng.run(watchdog_timeout=2.0)
+        self.assertTrue(ra.failed)         # victim: lowest live slot (A)
+        self.assertFalse(rb.failed)
+        self.assertEqual(rb.cached_tokens, 8)  # B really shared A's page
+        self.assertEqual(eng.hung_retired, 1)
+        # A's retire released its reference; B's kept the page alive —
+        # a recycled page would have corrupted B's prefix K/V
+        self.assertEqual(rb.tokens, ref_b.tokens)
+        # drain: pool whole again, the shared block still cached
+        self.assertEqual(eng.mgr.n_available, eng.mgr.max_pages - 1)
+        self.assertGreaterEqual(eng.mgr.n_cached, 1)
+
     def test_timeout_with_no_live_slot_reraises(self):
         from paddle_tpu.resilience.watchdog import StepTimeout
 
