@@ -139,6 +139,49 @@ def _op_bench(only=None):
         timed("decode_attention",
               lambda x: decode_attention(x, kc, vc, lens), qd)
 
+    if want("prefix_prefill", "prefix_prefill_ref"):
+        # deep-prefix suffix prefill (ISSUE 4): a 1024-token cached
+        # prefix (16 pages) streamed from the paged pools + a
+        # 128-token bucketed suffix at the bench GQA ratio. The gated
+        # `prefix_prefill` row times the ragged paged Pallas kernel;
+        # `prefix_prefill_ref` times the masked-softmax gather fallback
+        # at the identical shape (informational — it exists so OPBENCH
+        # trends show the gather-bound vs bandwidth-bound gap, not to
+        # gate the fallback)
+        from paddle_tpu.kernels.prefix_prefill import (
+            prefix_prefill_attention, prefix_prefill_reference)
+
+        PB, PSB, PNH, PNKV, PDH, PBS, PW = 4, 128, 16, 4, 128, 64, 16
+        n_pages = PB * PW + 1
+        pq = jnp.asarray(rng.normal(size=(PB, PSB, PNH, PDH)),
+                         jnp.bfloat16)
+        pks = jnp.asarray(rng.normal(size=(PB, PSB, PNKV, PDH)),
+                          jnp.bfloat16)
+        pvs = jnp.asarray(rng.normal(size=(PB, PSB, PNKV, PDH)),
+                          jnp.bfloat16)
+        pkc = jnp.asarray(rng.normal(size=(n_pages, PNKV, PBS, PDH)),
+                          jnp.bfloat16)
+        pvc = jnp.asarray(rng.normal(size=(n_pages, PNKV, PBS, PDH)),
+                          jnp.bfloat16)
+        ptbl = jnp.asarray(
+            rng.permutation(n_pages - 1)[:PB * PW].reshape(PB, PW) + 1,
+            jnp.int32)
+        pplens = jnp.full((PB,), PW * PBS, jnp.int32)
+        pslens = jnp.full((PB,), PSB, jnp.int32)
+        timed("prefix_prefill",
+              lambda x: prefix_prefill_attention(
+                  x, pks, pvs, pkc, pvc, ptbl, pplens, pslens), pq)
+
+        def _pp_ref(x):
+            # the _make_prefill_with_prefix fallback math (the shared
+            # prefix_prefill_reference): gather every prefix page to
+            # query width, one masked softmax
+            return prefix_prefill_reference(
+                x, pks, pvs, pkc, pvc, ptbl, pplens).astype(x.dtype)
+
+        timed("prefix_prefill_ref", _pp_ref, pq)
+        del pkc, pvc
+
     if want("all_reduce_4mb"):
         # all_reduce across the visible devices — INFORMATIONAL only (see
         # INFORMATIONAL_OPS): on 1 chip psum is a self-copy, and the slope
@@ -258,8 +301,11 @@ def _op_bench(only=None):
 
 # recorded in OPBENCH.json for trend-watching but excluded from the
 # regression gate: on this single-chip tunneled setup their values
-# measure the environment (tunnel RTT, self-copy psum), not the kernels.
-INFORMATIONAL_OPS = {"all_reduce_4mb", "eager_dispatch_add"}
+# measure the environment (tunnel RTT, self-copy psum), not the kernels
+# — and prefix_prefill_ref is the masked-softmax fallback timed only as
+# the comparison line for the gated prefix_prefill kernel row.
+INFORMATIONAL_OPS = {"all_reduce_4mb", "eager_dispatch_add",
+                     "prefix_prefill_ref"}
 
 
 # regressions consciously accepted, with a dated reason — an entry here is

@@ -15,6 +15,16 @@ Traces:
   ("continuous+prefix"), and on with the double-buffered scheduler
   ("continuous+prefix+db"); a trailing summary line reports the TTFT /
   throughput deltas the cache and the pipeline buy.
+- deep_prefix: a ~1k-token shared system prompt (16 KV pages) + ragged
+  user suffixes — the regime the ragged paged prefix-prefill KERNEL
+  exists for (ISSUE 4): with a prefix this deep the fallback's
+  per-layer gather of the whole cached prefix dominates suffix
+  prefill. Run cold ("continuous"), with the cache through the
+  masked-softmax fallback ("continuous+prefix+jnp",
+  FLAGS_prefix_prefill_kernel=0), and through the Pallas kernel
+  ("continuous+prefix+kernel", the default); the summary line reports
+  per-policy TTFT deltas so the gather-bound -> bandwidth-bound win is
+  visible end-to-end, not just in the OPBENCH row.
 
 Metrics (one JSON line per policy):
 - useful_tok_s: sum of requested tokens / wall-clock. Over the tunneled
@@ -53,15 +63,19 @@ PROMPT_BUCKET = 128
 BLOCK = 64
 STEPS_PER_SYNC = 16
 SHARED_PREFIX_LEN = 2 * BLOCK   # block-aligned system prompt
+DEEP_PREFIX_LEN = 16 * BLOCK    # ~1k-token system prompt (16 pages)
 
 
 def make_trace(n, seed, rate_req_s, variance="uniform"):
     rng = np.random.default_rng(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_req_s, n))
-    if variance == "shared_prefix":
+    if variance in ("shared_prefix", "deep_prefix"):
         # common system prompt + ragged user suffixes: later requests'
-        # first 2 blocks hit the prefix cache
-        shared = rng.integers(1, 32000, (SHARED_PREFIX_LEN,)).tolist()
+        # leading blocks hit the prefix cache (2 blocks shared_prefix,
+        # 16 blocks deep_prefix)
+        pre = SHARED_PREFIX_LEN if variance == "shared_prefix" \
+            else DEEP_PREFIX_LEN
+        shared = rng.integers(1, 32000, (pre,)).tolist()
         prompts = [shared + rng.integers(1, 32000, (int(l),)).tolist()
                    for l in rng.integers(1, BLOCK, n)]
         targets = rng.integers(8, MAX_NEW + 1, n).tolist()
@@ -85,31 +99,47 @@ def pct(xs, q):
 
 def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
                prefix_cache=False, double_buffer=False,
-               max_prompt_len=PROMPT_BUCKET, warm_buckets=None):
-    eng = ContinuousBatchingEngine(
-        cfg, p, slots=SLOTS, prompt_bucket=PROMPT_BUCKET,
-        max_prompt_len=max_prompt_len, max_new_tokens=MAX_NEW,
-        block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC,
-        prefix_cache=prefix_cache, double_buffer=double_buffer)
-    # compile every (bucket, prefill-batch) program + the decode chunk
-    # outside the clock
-    eng.warm(warm_buckets or [max_prompt_len])
-    eng.device_steps = 0  # warm chunk must not count in occupancy
+               max_prompt_len=PROMPT_BUCKET, warm_buckets=None,
+               warm_prefix_widths=None, prefix_kernel=True,
+               prefill_batch=4):
+    import paddle_tpu as paddle
 
-    step = eng._pipeline_step if double_buffer else eng.step
-    t0 = time.perf_counter()
-    queued = 0
-    while queued < len(prompts) or eng.has_work:
-        now = time.perf_counter() - t0
-        while queued < len(prompts) and arrivals[queued] <= now:
-            eng.add_request(prompts[queued], max_new=targets[queued],
-                            arrival_time=t0 + arrivals[queued])
-            queued += 1
-        if not eng.has_work:
-            time.sleep(0.001)
-            continue
-        step()
-    wall = time.perf_counter() - t0
+    # the flag is read at program-BUILD time; keep it set for the whole
+    # run (a cache-miss key would lazily build mid-serve) and restore
+    # the PRIOR value after — it is process-global and the operator may
+    # have opted out via PADDLE_TPU_PREFIX_PREFILL_KERNEL=0
+    prev_flag = paddle.get_flags("prefix_prefill_kernel")[
+        "FLAGS_prefix_prefill_kernel"]
+    paddle.set_flags({"prefix_prefill_kernel": bool(prefix_kernel)})
+    try:
+        eng = ContinuousBatchingEngine(
+            cfg, p, slots=SLOTS, prompt_bucket=PROMPT_BUCKET,
+            max_prompt_len=max_prompt_len, max_new_tokens=MAX_NEW,
+            block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC,
+            prefill_batch=prefill_batch, prefix_cache=prefix_cache,
+            double_buffer=double_buffer)
+        # compile every (bucket, prefill-batch) program + the decode
+        # chunk outside the clock
+        eng.warm(warm_buckets or [max_prompt_len],
+                 prefix_widths=warm_prefix_widths)
+        eng.device_steps = 0  # warm chunk must not count in occupancy
+
+        step = eng._pipeline_step if double_buffer else eng.step
+        t0 = time.perf_counter()
+        queued = 0
+        while queued < len(prompts) or eng.has_work:
+            now = time.perf_counter() - t0
+            while queued < len(prompts) and arrivals[queued] <= now:
+                eng.add_request(prompts[queued], max_new=targets[queued],
+                                arrival_time=t0 + arrivals[queued])
+                queued += 1
+            if not eng.has_work:
+                time.sleep(0.001)
+                continue
+            step()
+        wall = time.perf_counter() - t0
+    finally:
+        paddle.set_flags({"prefix_prefill_kernel": prev_flag})
     lat = [r.finish_time - r.arrival_time for r in eng.finished]
     ttft = [r.prefill_time - r.arrival_time for r in eng.finished]
     useful = sum(len(r.tokens) for r in eng.finished)
@@ -229,6 +259,52 @@ def main():
             - db["blocked_syncs_per_ktok"], 2),
         "db_sync_wait_delta_s": round(
             pref["sync_wait_s"] - db["sync_wait_s"], 3),
+    }), flush=True)
+
+    # deep-prefix trace (ISSUE 4): a 16-page shared prefix makes the
+    # fallback's per-layer prefix gather the dominant prefill cost;
+    # the Pallas kernel streams it page-by-page instead. The first
+    # request is always a cold 1152-bucket prefill; every later
+    # request hits all 16 blocks and prefills a 128-token suffix.
+    arrivals, prompts, targets = make_trace(n, seed, rate_req_s=8.0,
+                                            variance="deep_prefix")
+    mpl = DEEP_PREFIX_LEN + PROMPT_BUCKET
+    cold_bucket = -(-mpl // PROMPT_BUCKET) * PROMPT_BUCKET
+    # warm only the width rung deep_prefix hits — the full ladder would
+    # add dead full-model compiles to the bench. Derived, so retuning
+    # DEEP_PREFIX_LEN cannot silently push the first hit's compile
+    # inside the timed serving loop
+    hit_width = DEEP_PREFIX_LEN // BLOCK
+    rows = [
+        run_engine(cfg, p, arrivals, prompts, targets,
+                   max_prompt_len=mpl, warm_buckets=[cold_bucket],
+                   prefill_batch=1),
+        run_engine(cfg, p, arrivals, prompts, targets,
+                   policy="continuous+prefix+jnp", prefix_cache=True,
+                   prefix_kernel=False, max_prompt_len=mpl,
+                   warm_buckets=[PROMPT_BUCKET, cold_bucket],
+                   warm_prefix_widths=[hit_width], prefill_batch=1),
+        run_engine(cfg, p, arrivals, prompts, targets,
+                   policy="continuous+prefix+kernel", prefix_cache=True,
+                   prefix_kernel=True, max_prompt_len=mpl,
+                   warm_buckets=[PROMPT_BUCKET, cold_bucket],
+                   warm_prefix_widths=[hit_width], prefill_batch=1),
+    ]
+    for row in rows:
+        row["trace"] = "deep_prefix"
+        print(json.dumps(row), flush=True)
+    cold, jnp_row, kern = rows
+    print(json.dumps({
+        "trace": "deep_prefix", "summary": True,
+        "prefix_hit_rate": kern["prefix_hit_rate"],
+        "ttft_delta_s_prefix_vs_cold": round(
+            cold["p50_ttft_s"] - kern["p50_ttft_s"], 3),
+        "ttft_delta_s_kernel_vs_jnp": round(
+            jnp_row["p50_ttft_s"] - kern["p50_ttft_s"], 3),
+        "useful_tok_s_gain_kernel_vs_jnp": round(
+            kern["useful_tok_s"] / max(jnp_row["useful_tok_s"], 1e-9), 3),
+        "useful_tok_s_gain_vs_cold": round(
+            kern["useful_tok_s"] / max(cold["useful_tok_s"], 1e-9), 3),
     }), flush=True)
 
 

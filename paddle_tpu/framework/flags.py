@@ -89,6 +89,16 @@ define_flag("tpu_lint_fail_on", "error",
             "(also: PADDLE_TPU_LINT_FAIL_ON)",
             env_aliases=("PADDLE_TPU_LINT_FAIL_ON",))
 
+# --- serving kernels ---
+define_flag("prefix_prefill_kernel", True,
+            "serve cached-prefix suffix prefills through the ragged "
+            "paged Pallas kernel (kernels/prefix_prefill.py); off = "
+            "masked-softmax gather fallback. Read when the prefill "
+            "program is BUILT, so flip it before constructing (or "
+            "warming) an engine "
+            "(also: PADDLE_TPU_PREFIX_PREFILL_KERNEL)",
+            env_aliases=("PADDLE_TPU_PREFIX_PREFILL_KERNEL",))
+
 # --- resilience (paddle_tpu.resilience) ---
 define_flag("tpu_chaos", "",
             "fault-injection spec, e.g. 'io_error:0.1,preempt_at:200,"

@@ -14,7 +14,8 @@ from .flash_attention import flash_attention_fwd, flash_attention  # noqa: F401
 from .rms_norm import rms_norm as fused_rms_norm  # noqa: F401
 from .rope import apply_rotary_emb  # noqa: F401
 
-# importing the kernel modules populates KERNEL_CONSTRAINTS; decode and
-# int4 register theirs on import too
+# importing the kernel modules populates KERNEL_CONSTRAINTS; decode,
+# prefix-prefill and int4 register theirs on import too
 from . import decode_attention as _decode_attention  # noqa: F401
 from . import int4_matmul as _int4_matmul  # noqa: F401
+from .prefix_prefill import prefix_prefill_attention  # noqa: F401

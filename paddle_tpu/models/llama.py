@@ -758,33 +758,42 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
     masked), `prefix_lens` [b] is the cached token count (a multiple of
     block_size), and suffix positions/rope offsets follow from it.
 
-    The mixed prefix+suffix attention is a masked jnp softmax — exact,
-    and fine at prefill batch sizes; streaming it through a Pallas
-    grid per (kv head, page) like the decode kernel (see PAPERS.md:
-    Ragged Paged Attention) is the known TPU follow-up.
+    The mixed prefix+suffix attention has two implementations:
 
-    Returns prefill(p, kcs, vcs, ids, prefix_tables, prefix_lens) ->
-    (h_final [b, sb, h], [(k_i, v_i)]) with rotary-applied suffix K/V
-    [b, sb, nkv, dh] per layer — the caller owns the page scatter."""
+    - **Pallas kernel** (FLAGS_prefix_prefill_kernel, default on): the
+      ragged paged prefix-prefill grid (kernels/prefix_prefill.py) —
+      one (kv head, page) tile streamed from the pools per step with
+      online-softmax carry, like the paged decode kernel (PAPERS.md:
+      Ragged Paged Attention). Bandwidth-bound: the gathered
+      [b, w_pre, nkv, bs, dh] prefix tensor never exists.
+    - **masked jnp softmax fallback**: exact but gather-bound — kept
+      for unsupported shapes (suffix bucket not a whole number of KV
+      pages, or an empty prefix table) and as the numerics oracle.
+
+    The flag is read when this factory runs (program-build time), so a
+    jitted program keeps the path it was compiled with.
+
+    Returns prefill(p, kcs, vcs, ids, prefix_tables, prefix_lens,
+    suffix_lens=None) -> (h_final [b, sb, h], [(k_i, v_i)]) with
+    rotary-applied suffix K/V [b, sb, nkv, dh] per layer — the caller
+    owns the page scatter. `suffix_lens` [b] (true suffix lengths) lets
+    the kernel skip and zero pad query rows; the fallback ignores it
+    (pad rows beyond it are don't-care either way: their K/V land past
+    the decode watermark and are masked until overwritten)."""
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
-    group = nh // nkv
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
-    P_pre = w_pre * block_size
     scale = 1.0 / math.sqrt(dh)
+    from ..framework.flags import flag as _flag
 
-    def prefill(p, kcs, vcs, ids, prefix_tables, prefix_lens):
+    use_kernel = (bool(_flag("prefix_prefill_kernel"))
+                  and sb % block_size == 0 and w_pre >= 1)
+
+    def prefill(p, kcs, vcs, ids, prefix_tables, prefix_lens,
+                suffix_lens=None):
         h = p["llama.embed_tokens.weight"][ids]          # [b, sb, h]
         pos_ids = prefix_lens[:, None] + jnp.arange(sb)[None, :]  # [b, sb]
-        # prefix column j is real iff j < prefix_lens[row]; suffix
-        # column t is visible to suffix query s iff t <= s
-        pref_valid = jnp.arange(P_pre)[None, :] < prefix_lens[:, None]
-        causal = jnp.arange(sb)[None, :] <= jnp.arange(sb)[:, None]
-        mask = jnp.concatenate(
-            [jnp.broadcast_to(pref_valid[:, None, :], (b, sb, P_pre)),
-             jnp.broadcast_to(causal[None], (b, sb, sb))], axis=-1)
-        neg = jnp.asarray(-1e30, jnp.float32)
         kvs = []
         for i in range(n_layers):
             pre = f"llama.layers.{i}."
@@ -798,23 +807,20 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
             kvs.append((k, v))
-            # gather the cached prefix pages: [b, w_pre, nkv, bs, dh]
-            # -> [b, P_pre, nkv, dh] in logical block order
-            pk = jnp.transpose(kcs[i][prefix_tables],
-                               (0, 1, 3, 2, 4)).reshape(b, P_pre, nkv, dh)
-            pv = jnp.transpose(vcs[i][prefix_tables],
-                               (0, 1, 3, 2, 4)).reshape(b, P_pre, nkv, dh)
-            keys = jnp.concatenate([pk.astype(q.dtype), k], axis=1)
-            vals = jnp.concatenate([pv.astype(q.dtype), v], axis=1)
-            q5 = q.reshape(b, sb, nkv, group, dh)
-            s = jnp.einsum("bsngd,btnd->bsngt",
-                           q5.astype(jnp.float32),
-                           keys.astype(jnp.float32)) * scale
-            s = jnp.where(mask[:, :, None, None, :], s, neg)
-            probs = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bsngt,btnd->bsngd", probs,
-                             vals.astype(jnp.float32))
-            attn = ctx.reshape(b, sb, nh, dh).astype(h.dtype)
+            if use_kernel:
+                from ..kernels.prefix_prefill import \
+                    prefix_prefill_attention
+
+                attn = prefix_prefill_attention(
+                    q, k, v, kcs[i], vcs[i], prefix_tables, prefix_lens,
+                    suffix_lens, scale=scale).astype(h.dtype)
+            else:
+                from ..kernels.prefix_prefill import \
+                    prefix_prefill_reference
+
+                attn = prefix_prefill_reference(
+                    q, k, v, kcs[i], vcs[i], prefix_tables, prefix_lens,
+                    scale=scale).astype(h.dtype)
             h = h + _mm(attn.reshape(b, sb, nh * dh),
                         p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)

@@ -28,7 +28,10 @@ block is hashed (chained, so a hit implies the whole prefix matches)
 into `PagedKVManager`'s refcounted page cache. An admitted request maps
 its cached prefix pages straight into its block table and prefills only
 the uncached suffix — suffix-bucketed, so prefill programs stay keyed
-by (bucket, batch) and compile counts don't grow with hit patterns.
+by (bucket, batch, prefix-width rung) over a small warm-able ladder and
+compile counts don't grow with hit patterns. The suffix attends over
+the cached prefix through the ragged paged prefix-prefill Pallas
+kernel by default (FLAGS_prefix_prefill_kernel; jnp fallback retained).
 Retire paths release references; a page recycles only at refcount 0
 (LRU-evicted under pool pressure), so a hung-slot retire can never pull
 a shared prefix out from under a surviving slot.
@@ -356,25 +359,30 @@ class ContinuousBatchingEngine:
 
         return run
 
-    def _build_prefix_prefill(self, sb: int, bsz: int):
+    def _build_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
         """Like _build_prefill, but for rows whose prompt head hit the
         prefix cache: only the `sb`-bucketed suffix is computed, reading
-        the cached prefix K/V through per-row prefix tables. One compile
-        per (suffix bucket, batch) pair — prefix length is traced, so
-        every hit depth shares the program."""
+        the cached prefix K/V through per-row prefix tables (the Pallas
+        prefix-prefill kernel by default; see _make_prefill_with_prefix
+        and FLAGS_prefix_prefill_kernel). One compile per (suffix
+        bucket, batch, prefix width) key — prefix LENGTH stays traced,
+        so every hit depth under the width shares the program, and the
+        width itself is bucketed to the small `_prefix_width_ladder`
+        (page-multiple padded) instead of always paying for the deepest
+        possible prefix: neither the fallback's gather nor the kernel's
+        streaming axis touches table columns the batch cannot fill."""
         cfg = self.cfg
         bs = self.block_size
         nkv, dh = cfg.num_key_value_heads, cfg.head_dim
         n_pre = sb // bs
-        base = _make_prefill_with_prefix(cfg, bsz, sb, self._prefix_width,
-                                         bs)
+        base = _make_prefill_with_prefix(cfg, bsz, sb, w_pre, bs)
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
         to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
 
         def run(p, kcs, vcs, ids, s0_vec, pages, ptables, plens, key,
                 temperature, top_p):
-            h, kvs = base(p, kcs, vcs, ids, ptables, plens)
+            h, kvs = base(p, kcs, vcs, ids, ptables, plens, s0_vec)
             for i, (k, v) in enumerate(kvs):
                 kcs[i] = kcs[i].at[pages].set(
                     to_pages(k).astype(kcs[i].dtype))
@@ -449,12 +457,34 @@ class ContinuousBatchingEngine:
                 self._build_prefill(sb, bsz), donate_argnums=(1, 2))
         return self._prefill_cache[key]
 
-    def _get_prefix_prefill(self, sb: int, bsz: int):
-        key = ("prefix", sb, bsz)
+    def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
+        key = ("prefix", sb, bsz, w_pre)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
-                self._build_prefix_prefill(sb, bsz), donate_argnums=(1, 2))
+                self._build_prefix_prefill(sb, bsz, w_pre),
+                donate_argnums=(1, 2))
         return self._prefill_cache[key]
+
+    def _prefix_width_ladder(self) -> list:
+        """The prefix-table widths (in pages) prefix-prefill programs
+        compile at: powers of two of the pages-per-prompt-bucket
+        quantum, capped at `_prefix_width`. A batch's width is padded
+        UP to the next rung (`_prefix_width_for`), so program keys stay
+        a small warm-able set while shallow-prefix batches stop paying
+        the deepest-possible-prefix table width."""
+        ppb = max(1, self.prompt_bucket // self.block_size)
+        widths, w = [], ppb
+        while w < self._prefix_width:
+            widths.append(w)
+            w *= 2
+        widths.append(self._prefix_width)
+        return widths
+
+    def _prefix_width_for(self, n_blocks: int) -> int:
+        for w in self._prefix_width_ladder():
+            if w >= n_blocks:
+                return w
+        return self._prefix_width
 
     def _max_prefill_bsz(self) -> int:
         """_admit can never batch beyond the slot count — warming larger
@@ -464,7 +494,7 @@ class ContinuousBatchingEngine:
             bsz *= 2
         return bsz
 
-    def warm(self, buckets=None):
+    def warm(self, buckets=None, prefix_widths=None):
         """Compile (and cache) every program the engine can need for the
         given prompt buckets — each power-of-two prefill batch (cold AND
         cached-prefix variants) plus the decode chunk — by running them
@@ -472,8 +502,21 @@ class ContinuousBatchingEngine:
         traffic; mid-stream compiles would otherwise land on the first
         matching admit. NOTE: buckets must cover the SUFFIX buckets
         cache-hit requests will prefill at, not just full prompt
-        buckets (a hit's suffix is shorter than its prompt)."""
+        buckets (a hit's suffix is shorter than its prompt).
+        `prefix_widths` narrows the cached-prefix variants to specific
+        `_prefix_width_ladder` rungs (benches that know their hit depth
+        skip the full ladder); default warms every rung."""
         buckets = [self.max_prompt_len] if buckets is None else buckets
+        if prefix_widths is None:
+            prefix_widths = self._prefix_width_ladder()
+        else:
+            bad = [w for w in prefix_widths
+                   if w not in self._prefix_width_ladder()]
+            if bad:
+                raise ValueError(
+                    f"prefix widths {bad} are not on the ladder "
+                    f"{self._prefix_width_ladder()}; _admit only ever "
+                    "uses ladder rungs, so warming others is dead")
         cap = self._max_prefill_bsz()
         for sb in buckets:
             if sb % self.prompt_bucket:
@@ -494,19 +537,20 @@ class ContinuousBatchingEngine:
                     # prefix length 0 masks the whole (scratch) prefix:
                     # the warm run computes garbage, touches only the
                     # scratch page, and caches the compiled program
-                    self._key, k = jax.random.split(self._key)
-                    _, self.kcs, self.vcs = self._get_prefix_prefill(
-                        sb, bsz)(
-                        self.p, self.kcs, self.vcs,
-                        jnp.zeros((bsz, sb), jnp.int32),
-                        jnp.ones((bsz,), jnp.int32),
-                        jnp.full((bsz, n_pre), self.scratch_page,
-                                 jnp.int32),
-                        jnp.full((bsz, self._prefix_width),
-                                 self.scratch_page, jnp.int32),
-                        jnp.zeros((bsz,), jnp.int32),
-                        k, jnp.asarray(self.temperature, jnp.float32),
-                        jnp.asarray(self.top_p, jnp.float32))
+                    for w in prefix_widths:
+                        self._key, k = jax.random.split(self._key)
+                        _, self.kcs, self.vcs = self._get_prefix_prefill(
+                            sb, bsz, w)(
+                            self.p, self.kcs, self.vcs,
+                            jnp.zeros((bsz, sb), jnp.int32),
+                            jnp.ones((bsz,), jnp.int32),
+                            jnp.full((bsz, n_pre), self.scratch_page,
+                                     jnp.int32),
+                            jnp.full((bsz, w), self.scratch_page,
+                                     jnp.int32),
+                            jnp.zeros((bsz,), jnp.int32),
+                            k, jnp.asarray(self.temperature, jnp.float32),
+                            jnp.asarray(self.top_p, jnp.float32))
                 if bsz >= cap:
                     break
                 bsz *= 2
@@ -611,8 +655,13 @@ class ContinuousBatchingEngine:
             ids = np.zeros((bsz, sb_suf), np.int32)
             s0s = np.ones((bsz,), np.int32)
             pages = np.full((bsz, n_pre), self.scratch_page, np.int32)
-            ptbl = np.full((bsz, self._prefix_width), self.scratch_page,
-                           np.int32)
+            # prefix-table width: the ladder rung covering the DEEPEST
+            # hit in this batch, not the deepest prefix the engine
+            # could ever cache — shallow-hit batches stop streaming
+            # (kernel) / gathering (fallback) pad table columns
+            w_call = self._prefix_width_for(
+                max(plan.n_cached for plan in plans)) if has_prefix else 1
+            ptbl = np.full((bsz, w_call), self.scratch_page, np.int32)
             plens = np.zeros((bsz,), np.int32)
             with self._commit_lock:
                 self._check_owner(token)
@@ -641,7 +690,7 @@ class ContinuousBatchingEngine:
                 self._key, k = jax.random.split(self._key)
                 self.prefill_calls += 1
                 if has_prefix:
-                    fn = self._get_prefix_prefill(sb_suf, bsz)
+                    fn = self._get_prefix_prefill(sb_suf, bsz, w_call)
                     out = fn(self.p, self.kcs, self.vcs, jnp.asarray(ids),
                              jnp.asarray(s0s), jnp.asarray(pages),
                              jnp.asarray(ptbl), jnp.asarray(plens), k,

@@ -142,6 +142,52 @@ class TestKernelConstraints:
         assert kernels.constraint_for_kernel_fn("_fwd_kernel") is c
 
 
+class TestPrefixPrefillConstraint:
+    """TPU102 self-check for the ragged paged prefix-prefill kernel
+    (ISSUE 4): the registered KernelConstraint must fire on a BLOCK_S
+    that is not a whole number of KV pages — the shape the wrapper's
+    fitting helper never produces, but an explicit override can."""
+
+    def _trace(self, block_s):
+        from paddle_tpu.kernels import prefix_prefill as pp
+
+        def att(q, ks, vs, kc, vc, tbl, plens, slens):
+            return pp.prefix_prefill_attention(
+                q, ks, vs, kc, vc, tbl, plens, slens, block_s=block_s)
+
+        f32 = jnp.float32
+        return analysis.analyze(
+            att,
+            jax.ShapeDtypeStruct((1, 16, 2, 128), f32),   # q
+            jax.ShapeDtypeStruct((1, 16, 1, 128), f32),   # k_suf
+            jax.ShapeDtypeStruct((1, 16, 1, 128), f32),   # v_suf
+            jax.ShapeDtypeStruct((4, 1, 8, 128), f32),    # key pool
+            jax.ShapeDtypeStruct((4, 1, 8, 128), f32),    # value pool
+            jax.ShapeDtypeStruct((1, 2), jnp.int32),      # tables
+            jax.ShapeDtypeStruct((1,), jnp.int32),        # prefix lens
+            jax.ShapeDtypeStruct((1,), jnp.int32),        # suffix lens
+            rules=["TPU102"])
+
+    def test_misaligned_block_s_flagged(self):
+        # block_s=4 divides the 16-token suffix but is HALF a KV page:
+        # the streaming grid degrades to sub-page DMAs
+        found = diags(self._trace(block_s=4), "TPU102")
+        assert found and any("BLOCK_S 4" in d.message for d in found)
+        assert all(d.severity == Severity.WARNING for d in found)
+
+    def test_page_granular_block_s_clean(self):
+        assert not diags(self._trace(block_s=8), "TPU102")
+
+    def test_registry_blocks_match_module(self):
+        from paddle_tpu import kernels
+        from paddle_tpu.kernels import prefix_prefill as pp
+
+        c = kernels.KERNEL_CONSTRAINTS["prefix_prefill"]
+        assert c.blocks["block_q"] == pp.BLOCK_Q
+        assert c.blocks["block_s"] == pp.BLOCK_S
+        assert "_prefix_prefill_kernel" in c.kernel_fns
+
+
 # ---------------------------------------------------------------------------
 # TPU201: recompilation risk
 # ---------------------------------------------------------------------------

@@ -457,6 +457,47 @@ class TestCompileGuard(unittest.TestCase):
         self.assertGreater(eng.prefix_hit_tokens, 0)  # hits exercised
         self.assertEqual(eng.compile_stats(), before)
 
+    def test_zero_recompiles_kernel_path_across_prefix_widths(self):
+        """The prefix-prefill KERNEL path (FLAGS_prefix_prefill_kernel,
+        default on) under the same guard, with hits at DIFFERENT prefix
+        depths: prefix programs are keyed by width rung
+        (`_prefix_width_ladder`), warm covers the ladder, and traffic
+        landing on several rungs must not add a single compile."""
+        import paddle_tpu as paddle
+
+        self.assertTrue(
+            paddle.get_flags("prefix_prefill_kernel")
+            ["FLAGS_prefix_prefill_kernel"],
+            "kernel path must be the default this guard covers")
+        cfg, model, params = _tiny_setup()
+        rng = np.random.default_rng(13)
+        eng = ContinuousBatchingEngine(
+            cfg, params, slots=2, prompt_bucket=8, max_prompt_len=24,
+            max_new_tokens=4, block_size=8, steps_per_sync=2,
+            prefill_batch=1, prefix_cache=True)
+        self.assertEqual(eng._prefix_width_ladder(), [1, 2])
+        eng.warm(buckets=[8, 16, 24])
+        before = eng.compile_stats()
+        self.assertNotIn(-1, before.values())
+        base = rng.integers(1, cfg.vocab_size, (17,)).tolist()
+        prompts = [
+            base,                                           # cold insert
+            base[:16] + rng.integers(1, cfg.vocab_size,     # depth 2
+                                     (5,)).tolist(),
+            base[:8] + rng.integers(1, cfg.vocab_size,      # depth 1
+                                    (11,)).tolist(),
+        ]
+        for pr in prompts:
+            eng.add_request(pr)
+        eng.run(max_iters=200)
+        self.assertEqual(len(eng.finished), len(prompts))
+        depths = sorted(r.cached_tokens for r in eng.finished)
+        self.assertEqual(depths, [0, 8, 16])  # both rungs exercised
+        self.assertEqual(eng.compile_stats(), before)
+        # both width rungs exist as distinct cached programs
+        keys = [k for k in eng._prefill_cache if k[0] == "prefix"]
+        self.assertEqual(sorted({k[3] for k in keys}), [1, 2])
+
 
 if __name__ == "__main__":
     unittest.main()

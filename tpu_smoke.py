@@ -180,6 +180,46 @@ def check_decode_paged_gqa():
             if err > 5e-2 else None)
 
 
+def check_prefix_prefill():
+    """Ragged paged prefix-prefill on silicon (ISSUE 4): suffix queries
+    over a scattered 4-page cached prefix + causal suffix, ragged
+    per-row prefix AND suffix lengths, GQA 16:4 — against the gathered
+    masked-softmax oracle the jnp fallback path uses."""
+    from paddle_tpu.kernels.prefix_prefill import prefix_prefill_attention
+
+    rng = np.random.default_rng(7)
+    B, SB, HQ, HK, D, BS, W = 2, 128, 16, 4, 128, 64, 4
+    max_pages = B * W + 1
+    q = jnp.asarray(rng.normal(size=(B, SB, HQ, D)), jnp.bfloat16)
+    ks = jnp.asarray(rng.normal(size=(B, SB, HK, D)), jnp.bfloat16)
+    vs = jnp.asarray(rng.normal(size=(B, SB, HK, D)), jnp.bfloat16)
+    kc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)),
+                     jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)),
+                     jnp.bfloat16)
+    tables = jnp.asarray([[j * B + i + 1 for j in range(W)]
+                          for i in range(B)], jnp.int32)
+    plens = jnp.asarray([4 * BS, 1 * BS], jnp.int32)   # ragged depths
+    slens = jnp.asarray([SB, 70], jnp.int32)           # pad q rows row 1
+    out = jax.jit(lambda a: prefix_prefill_attention(
+        a, ks, vs, kc, vc, tables, plens, slens))(q)
+    if not bool(jnp.isfinite(out.astype(jnp.float32)).all()):
+        return "prefix prefill emitted non-finite values"
+
+    # the shared masked-softmax oracle (= the serving fallback path),
+    # compiled on the same device
+    from paddle_tpu.kernels.prefix_prefill import prefix_prefill_reference
+
+    ref = jax.jit(lambda a: prefix_prefill_reference(
+        a, ks, vs, kc, vc, tables, plens))(q)
+    err = 0.0
+    for row, sl in enumerate([SB, 70]):
+        err = max(err, float(jnp.max(jnp.abs(
+            out[row, :sl].astype(jnp.float32) - ref[row, :sl]))))
+    return (f"prefix prefill max err {err:.4f} > 5e-2"
+            if err > 5e-2 else None)
+
+
 def check_int4_matmul():
     from paddle_tpu.kernels.int4_matmul import _xla_fallback, int4_matmul
 
@@ -239,6 +279,7 @@ CHECKS = [
     ("decode_contiguous", check_decode_contiguous),
     ("decode_paged", check_decode_paged),
     ("decode_paged_gqa", check_decode_paged_gqa),
+    ("prefix_prefill", check_prefix_prefill),
     ("int4_matmul", check_int4_matmul),
     ("rms_norm", check_rms_norm),
     ("jit_generate", check_jit_generate),
