@@ -18,6 +18,14 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# per-op metadata rows (NOT timings, never gated): populated by
+# _op_bench alongside the ms table and written into OPBENCH.json's
+# `info` key — e.g. kv_bytes_per_token for the paged decode /
+# prefix-prefill rows, so the int8-vs-bf16 bandwidth ratio is recorded
+# next to the latencies it explains
+OP_INFO = {}
+
+
 def _op_bench(only=None):
     """Per-op latency table (reference: tools/ci_op_benchmark.sh +
     check_op_benchmark_result.py — the regression gate over op kernels).
@@ -139,7 +147,45 @@ def _op_bench(only=None):
         timed("decode_attention",
               lambda x: decode_attention(x, kc, vc, lens), qd)
 
-    if want("prefix_prefill", "prefix_prefill_ref"):
+    if want("paged_decode", "paged_decode_int8"):
+        # paged GQA decode over a 16-page (1024-token) striped cache,
+        # bf16 vs int8 pools at the identical shape (ISSUE 5): decode is
+        # bandwidth-bound on KV bytes, so the int8 row's win should
+        # track its kv_bytes_per_token ratio (recorded in OPBENCH's
+        # `info`; the acceptance bar is <= 0.55x the bf16 bytes — half
+        # the pool + the f32 scale rows)
+        from paddle_tpu.kernels.decode_attention import (
+            paged_decode_attention)
+        from paddle_tpu.models import quantize_kv_pages
+
+        GB, GHQ, GHK, GD, GBS, GW = 8, 16, 4, 128, 64, 16
+        g_pages = GB * GW + 1
+        gkc = jnp.asarray(rng.normal(size=(g_pages, GHK, GBS, GD)),
+                          jnp.bfloat16)
+        gvc = jnp.asarray(rng.normal(size=(g_pages, GHK, GBS, GD)),
+                          jnp.bfloat16)
+        gq = jnp.asarray(rng.normal(size=(GB, GHQ, GD)), jnp.bfloat16)
+        gtbl = jnp.asarray(
+            rng.permutation(g_pages - 1)[:GB * GW].reshape(GB, GW) + 1,
+            jnp.int32)
+        glens = jnp.full((GB,), GW * GBS - 1, jnp.int32)
+        timed("paged_decode",
+              lambda x: paged_decode_attention(x, gkc, gvc, gtbl, glens),
+              gq)
+        OP_INFO["paged_decode"] = {
+            "kv_bytes_per_token": 2 * GHK * GD * 2}
+        gkq, gks = quantize_kv_pages(gkc)
+        gvq, gvs = quantize_kv_pages(gvc)
+        timed("paged_decode_int8",
+              lambda x: paged_decode_attention(
+                  x, gkq, gvq, gtbl, glens, k_scale=gks, v_scale=gvs),
+              gq)
+        OP_INFO["paged_decode_int8"] = {
+            "kv_bytes_per_token": round(
+                2 * GHK * GD * 1 + 2 * GHK * 4 / GBS, 2)}
+        del gkc, gvc, gkq, gvq
+
+    if want("prefix_prefill", "prefix_prefill_ref", "prefix_prefill_int8"):
         # deep-prefix suffix prefill (ISSUE 4): a 1024-token cached
         # prefix (16 pages) streamed from the paged pools + a
         # 128-token bucketed suffix at the bench GQA ratio. The gated
@@ -171,6 +217,8 @@ def _op_bench(only=None):
         timed("prefix_prefill",
               lambda x: prefix_prefill_attention(
                   x, pks, pvs, pkc, pvc, ptbl, pplens, pslens), pq)
+        OP_INFO["prefix_prefill"] = {
+            "kv_bytes_per_token": 2 * PNKV * PDH * 2}
 
         def _pp_ref(x):
             # the _make_prefill_with_prefix fallback math (the shared
@@ -180,7 +228,21 @@ def _op_bench(only=None):
                 x, pks, pvs, pkc, pvc, ptbl, pplens).astype(x.dtype)
 
         timed("prefix_prefill_ref", _pp_ref, pq)
-        del pkc, pvc
+
+        # int8 pools at the identical shape (ISSUE 5): the prefix phase
+        # streams half the bytes per cached token + the f32 scale tiles
+        from paddle_tpu.models import quantize_kv_pages
+
+        pkq, pksc = quantize_kv_pages(pkc)
+        pvq, pvsc = quantize_kv_pages(pvc)
+        timed("prefix_prefill_int8",
+              lambda x: prefix_prefill_attention(
+                  x, pks, pvs, pkq, pvq, ptbl, pplens, pslens,
+                  k_scale=pksc, v_scale=pvsc), pq)
+        OP_INFO["prefix_prefill_int8"] = {
+            "kv_bytes_per_token": round(
+                2 * PNKV * PDH * 1 + 2 * PNKV * 4 / PBS, 2)}
+        del pkc, pvc, pkq, pvq
 
     if want("all_reduce_4mb"):
         # all_reduce across the visible devices — INFORMATIONAL only (see
@@ -390,7 +452,8 @@ def _op_regressions(ops, path="OPBENCH.json", threshold=0.10):
     with open(path, "w") as f:
         json.dump({"ops": dict(ops, **sentinel),
                    "best": dict(new_best, **sentinel),
-                   "prev": prev, "acknowledged": marker}, f, indent=1)
+                   "prev": prev, "acknowledged": marker,
+                   "info": dict(OP_INFO)}, f, indent=1)
     if warned:
         import sys
         print("OP REGRESSION (>10% and >0.1 ms vs best recorded, "

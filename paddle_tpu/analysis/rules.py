@@ -5,6 +5,10 @@ Rule catalog (see analysis/README.md for the long-form docs):
   TPU101 tile-alignment       matmul operand dims vs the dtype tile
   TPU102 kernel-constraints   pallas_call shapes vs the declared
                               KernelConstraint registry in kernels/
+  TPU103 kv-cache-dtype       KV-cache pools streamed in f32 (2x the
+                              bf16 bytes on the bandwidth-bound decode
+                              path), or an int8 pool consumed without
+                              its absmax scale operands
   TPU201 recompile-risk       weak-typed python scalars baked into the
                               graph as literals (every new value retraces)
   TPU202 const-bloat          large arrays captured as compile-time
@@ -33,7 +37,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Type
 import numpy as np
 
 from ..kernels.constraints import (
-    LANE, constraint_for_kernel_fn, min_tile,
+    LANE, constraint_for_kernel_fn, min_tile, missing_scale_finding,
 )
 from .diagnostics import Diagnostic, Severity
 from .graph import EqnCtx, Graph
@@ -189,6 +193,81 @@ def _pallas_kernel_name(eqn):
     if name:
         return str(name), str(info)
     return str(eqn.params.get("name", "")), ""
+
+
+# ---------------------------------------------------------------------------
+# TPU103: KV-cache pool dtype hygiene
+# ---------------------------------------------------------------------------
+
+def _kv_pool_findings(shapes, dtypes):
+    """(severity, message) findings for one KV-streaming pallas_call's
+    operand shapes/dtypes — module-level so tests can probe the shape
+    logic directly. The rank>=3 operand tail is q followed by the
+    streamed caches (the layout every registered KV kernel shares);
+    the scale-presence check is the SAME
+    `kernels.constraints.missing_scale_finding` the q8 kernel checkers
+    run, so lint and kernels can never disagree about the layout."""
+    arrs = [(s, d) for s, d in zip(shapes, dtypes) if len(s) >= 3]
+    if len(arrs) < 3:
+        return []
+    out = []
+    pools = arrs[1:]
+    n_f32 = sum(1 for s, d in pools if d == "float32")
+    if n_f32 >= 2:
+        sz = max(int(np.prod(s)) for s, d in pools if d == "float32")
+        out.append(("warning",
+                    f"KV cache pools streamed in float32 ({n_f32} "
+                    f"operands, largest {sz} elements): decode / "
+                    "prefix-prefill are bandwidth-bound, so f32 pools "
+                    "pay 2x the bf16 bytes (4x int8) every step"))
+    finding = missing_scale_finding(shapes, dtypes)
+    if finding is not None:
+        out.append(finding)
+    return out
+
+
+@register_rule
+class KVCacheDtypeRule(Rule):
+    """KV-cache pool dtype hygiene at the streaming kernels (the paged
+    decode / prefix-prefill pallas calls registered in the
+    KernelConstraint registry):
+
+    - pools streamed in f32: the serving hot loops are HBM-bandwidth
+      bound on KV bytes, so an f32 pool silently doubles the bf16 cost
+      (quadruples int8) of EVERY decode step — serve bf16, or int8 via
+      FLAGS_kv_cache_dtype=int8;
+    - an int8 (quantized) pool consumed without its f32 scale operands:
+      symmetric-absmax values without their scales are garbage.
+    """
+
+    id = "TPU103"
+    name = "kv-cache-dtype"
+    default_severity = Severity.WARNING
+    KV_KERNELS = ("decode_attention", "prefix_prefill")
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        found: Dict[tuple, list] = {}
+        for ctx in graph.eqns():
+            if ctx.primitive != "pallas_call":
+                continue
+            kernel_name, kernel_src = _pallas_kernel_name(ctx.eqn)
+            constraint = constraint_for_kernel_fn(kernel_name, kernel_src)
+            if constraint is None \
+                    or not constraint.name.startswith(self.KV_KERNELS):
+                continue
+            shapes = [tuple(v.aval.shape) for v in ctx.eqn.invars]
+            dtypes = [str(v.aval.dtype) for v in ctx.eqn.invars]
+            for sev_name, msg in _kv_pool_findings(shapes, dtypes):
+                key = (kernel_name, msg, Severity[sev_name.upper()])
+                found.setdefault(key, []).append(ctx.path)
+        for (kname, msg, sev), paths in found.items():
+            sites = "" if len(paths) == 1 else f" ({len(paths)} sites)"
+            yield self.diag(
+                f"{kname}: {msg}{sites}", where=paths[0],
+                hint="allocate serving KV pools in bfloat16, or int8 + "
+                     "scales via FLAGS_kv_cache_dtype=int8 "
+                     "(PADDLE_TPU_KV_CACHE_DTYPE)",
+                severity=sev)
 
 
 # ---------------------------------------------------------------------------

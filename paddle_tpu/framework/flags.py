@@ -99,6 +99,17 @@ define_flag("prefix_prefill_kernel", True,
             "(also: PADDLE_TPU_PREFIX_PREFILL_KERNEL)",
             env_aliases=("PADDLE_TPU_PREFIX_PREFILL_KERNEL",))
 
+define_flag("kv_cache_dtype", "bf16",
+            "element type of the PAGED serving KV pools: 'bf16' "
+            "(default) or 'int8' (symmetric per-(page, kv-head) absmax "
+            "quantization — halves the HBM bytes every decode / "
+            "prefix-prefill step streams AND doubles the pages a byte "
+            "budget holds before LRU eviction). Read when a paged "
+            "program / engine is BUILT, so flip it before constructing "
+            "(or warming) an engine "
+            "(also: PADDLE_TPU_KV_CACHE_DTYPE)",
+            env_aliases=("PADDLE_TPU_KV_CACHE_DTYPE",))
+
 # --- resilience (paddle_tpu.resilience) ---
 define_flag("tpu_chaos", "",
             "fault-injection spec, e.g. 'io_error:0.1,preempt_at:200,"

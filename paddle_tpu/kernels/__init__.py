@@ -8,7 +8,8 @@ incubate fused op family). Each kernel ships:
 """
 from .constraints import (  # noqa: F401
     KERNEL_CONSTRAINTS, KernelConstraint, LANE, SUBLANE,
-    constraint_for_kernel_fn, min_tile, register_constraint,
+    VMEM_BUDGET_BYTES, constraint_for_kernel_fn, fit_vmem_block,
+    min_tile, register_constraint, vmem_row_cap,
 )
 from .flash_attention import flash_attention_fwd, flash_attention  # noqa: F401
 from .rms_norm import rms_norm as fused_rms_norm  # noqa: F401

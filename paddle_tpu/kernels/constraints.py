@@ -26,6 +26,48 @@ import numpy as np
 # minor-most (lane) dimension of every TPU vector register / VMEM tile
 LANE = 128
 
+# shared scoped-VMEM budget the streaming kernels size their blocks
+# against: pairs of k+v blocks must double-buffer inside scoped VMEM, so
+# keep a safety margin under the ~16 MB budget (measured: h=32, block
+# 512, d=128 OOMs scoped vmem by 48 KB at max_seq 2048 without it)
+VMEM_BUDGET_BYTES = 12 << 20
+
+
+def vmem_row_cap(row_bytes: int, *, n_buffers: int = 4,
+                 reserve_bytes: int = 0,
+                 budget: int = VMEM_BUDGET_BYTES) -> int:
+    """Rows of `row_bytes` bytes that fit `n_buffers`-way buffered under
+    the scoped-VMEM budget (minus `reserve_bytes` of fixed kernel
+    state) — the cap side of `fit_vmem_block` for callers with their own
+    granularity rule (e.g. whole-page multiples)."""
+    return max(1, (budget - reserve_bytes) // (n_buffers * row_bytes))
+
+
+def fit_vmem_block(block: int, extent: int, row_bytes: int, *,
+                   n_buffers: int = 4, reserve_bytes: int = 0,
+                   budget: int = VMEM_BUDGET_BYTES) -> int:
+    """Largest divisor of `extent` that is <= the requested `block` AND
+    keeps `n_buffers` resident copies of a [bs, row_bytes] tile under
+    the scoped-VMEM budget — the one block-fitting rule every streaming
+    kernel shares (decode attention, prefix prefill, flash fast path).
+
+    `row_bytes` is bytes per block ROW (trailing dims x element size),
+    which is how the int8 paths halve their footprint relative to bf16:
+    pass the pool dtype's itemsize, not a hardcoded 2. `n_buffers`
+    defaults to 4 (2 operands x 2 double-buffered copies).
+    `reserve_bytes` carves out fixed VMEM the kernel also holds (scale
+    rows, scratch). `row_bytes=0` disables the cap (pure
+    largest-divisor clamp)."""
+    if row_bytes > 0:
+        cap = vmem_row_cap(row_bytes, n_buffers=n_buffers,
+                           reserve_bytes=reserve_bytes, budget=budget)
+    else:
+        cap = extent
+    bs = max(1, min(block, extent, cap))
+    while extent % bs:
+        bs -= 1
+    return bs
+
 # second-minor (sublane) tile dimension by dtype
 SUBLANE: Dict[str, int] = {
     "float32": 8,
@@ -44,6 +86,27 @@ def min_tile(dtype) -> Tuple[int, int]:
     """(sublane, lane) minimum tile for `dtype`; unknown dtypes get the
     fp32 tile (the most permissive)."""
     return SUBLANE.get(str(np.dtype(dtype)), 8), LANE
+
+
+def missing_scale_finding(shapes, dtypes):
+    """The ONE int8-pool-without-scales check (shared by the q8 kernel
+    checkers in decode_attention/prefix_prefill and the TPU103 lint
+    rule — a scale-layout change edits exactly here): quantized pools
+    are the rank>=3 int8 operands, their absmax scales the small
+    rank<=2 f32 operands; an int8 pool travelling with fewer than two
+    scale operands (one each for K and V) is consumed scale-less.
+    Returns a ("warning", message) finding or None."""
+    n_pools = sum(1 for s, dt in zip(shapes, dtypes)
+                  if len(s) >= 3 and dt == "int8")
+    n_scales = sum(1 for s, dt in zip(shapes, dtypes)
+                   if 1 <= len(s) <= 2 and dt == "float32")
+    if n_pools and n_scales < 2:
+        return ("warning",
+                f"{n_pools} int8 KV pool operand(s) but only "
+                f"{n_scales} f32 scale operand(s): a quantized pool "
+                "consumed without its per-(page, kv-head) absmax "
+                "scales dequantizes to garbage")
+    return None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,29 +23,26 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_compat import CompilerParams as _CompilerParams
 
-from .constraints import KernelConstraint, LANE, register_constraint
+from .constraints import (KernelConstraint, LANE, VMEM_BUDGET_BYTES,
+                          fit_vmem_block, missing_scale_finding,
+                          register_constraint)
 
 _NEG_INF = -1e30
 
 # default kv-block length each grid step streams through VMEM
 BLOCK_S = 512
-# pairs of k+v blocks must double-buffer inside scoped VMEM; keep a
-# safety margin under the ~16 MB budget (measured: h=32, block 512,
-# d=128 OOMs scoped vmem by 48 KB at max_seq 2048 without it)
-VMEM_BUDGET_BYTES = 12 << 20
 # below this block length the grid degenerates (near-prime max_seq) and
 # the kernel warns to pad the cache
 MIN_BLOCK_S = 32
 
 
-def _fitted_block(block_s: int, max_seq: int, h: int, d: int) -> int:
+def _fitted_block(block_s: int, max_seq: int, h: int, d: int,
+                  itemsize: int = 2) -> int:
     """Largest divisor of max_seq under both the requested block and the
-    VMEM double-buffering cap — the block the contiguous kernel runs."""
-    cap = max(1, VMEM_BUDGET_BYTES // (8 * h * d))
-    bs = min(block_s, max_seq, cap)
-    while max_seq % bs:
-        bs -= 1
-    return bs
+    VMEM double-buffering cap — the block the contiguous kernel runs.
+    Thin shape adapter over the shared `constraints.fit_vmem_block`
+    (`itemsize` lets int8 caches fit 2x the rows of bf16)."""
+    return fit_vmem_block(block_s, max_seq, h * d * itemsize)
 
 
 def _check_decode_shapes(shapes, dtypes):
@@ -77,6 +74,29 @@ CONSTRAINT = register_constraint(KernelConstraint(
     note="bandwidth-bound single-token decode; cache length should admit "
          f"a divisor >= {MIN_BLOCK_S} under the VMEM double-buffer cap",
     checker=_check_decode_shapes,
+    source="decode_attention.py",
+))
+
+
+def _check_q8_decode_shapes(shapes, dtypes):
+    """Checker for the int8 paged decode calls: the quantized pools MUST
+    travel with two f32 scale operands (per (page, kv head) absmax), and
+    the lane check from the bf16 checker still applies."""
+    out = list(_check_decode_shapes(shapes, dtypes))
+    finding = missing_scale_finding(shapes, dtypes)
+    if finding is not None:
+        out.append(finding)
+    return out
+
+
+CONSTRAINT_Q8 = register_constraint(KernelConstraint(
+    name="decode_attention_q8",
+    kernel_fns=("_paged_decode_q8_kernel", "_paged_gqa_q8_kernel"),
+    blocks={"block_s": BLOCK_S, "min_block_s": MIN_BLOCK_S},
+    note="int8 paged decode streams quantized page tiles + their "
+         "per-(page, kv head) f32 absmax scale rows; the dequantized "
+         "bf16 pool never materializes",
+    checker=_check_q8_decode_shapes,
     source="decode_attention.py",
 ))
 
@@ -238,17 +258,73 @@ def _paged_decode_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
+def _paged_decode_q8_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref,
+                            ksc_ref, vsc_ref, o_ref, m_scr, l_scr,
+                            acc_scr, *, block_size: int, scale: float):
+    """int8 equal-heads paged decode: `_paged_decode_kernel`'s grid with
+    int8 page tiles [H, block, D] and a per-head f32 scale row [1, H]
+    riding each step. Scales vary across the head axis inside the tile,
+    so scores rescale per head row after the reduce and the weighted
+    sum rescales by the v scale row."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    valid_until = len_ref[b]
+
+    @pl.when(j * block_size <= valid_until)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)               # [H, D]
+        k = k_ref[0].astype(jnp.float32)               # [H, block, D]
+        s = jnp.sum(q[:, None, :] * k, axis=-1) * scale
+        s = s * ksc_ref[0][:, None]                    # per-head dequant
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= valid_until, s, _NEG_INF)
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.sum(p[:, :, None] * v_ref[0].astype(jnp.float32),
+                     axis=1)                           # [H, D]
+        pv = pv * vsc_ref[0][:, None]
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nb - 1)
+    def _final():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
 def _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, block_size: int, scale: float):
+                   acc_scr, *, block_size: int, scale: float,
+                   ksc_ref=None, vsc_ref=None):
     """Shared grouped-query decode body for grid (B, Hkv, n_blocks):
     each step streams ONE kv block of ONE kv head and scores the whole
     query group against it — the block never leaves VMEM at query-head
     width (reference GQA decode: block_attn.h with gqa_group_size). The
     paged and contiguous kernels differ only in how their k/v index maps
-    pick the block."""
+    pick the block.
+
+    With `ksc_ref`/`vsc_ref` (the int8 paged path) the k/v blocks are
+    symmetric-absmax int8 and each step also carries that (page, kv
+    head)'s f32 scale as a (1, 1) tile: scores rescale by the k scale
+    AFTER the dot (the scale is uniform over the tile, so the dequant
+    never materializes a widened block) and the weighted sum rescales by
+    the v scale — the f32 accumulation the bf16 path already does."""
     b = pl.program_id(0)
     j = pl.program_id(2)
     nb = pl.num_programs(2)
+    quant = ksc_ref is not None
 
     @pl.when(j == 0)
     def _init():
@@ -262,12 +338,19 @@ def _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     def _compute():
         q = q_ref[0]                                   # [group, D]
         k = k_ref[0]                                   # [block_size, D]
+        if quant:
+            # int8 tiles score through the f32 path; one scalar multiply
+            # folds the absmax scale into the softmax scale
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32)
         # grouped decode has real matmuls (group >= 2 rows), so the MXU
         # does the scoring — unlike the equal-heads kernels' batched
         # matvec, these 2-D dots lower cleanly at any D
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [group, bs]
+        if quant:
+            s = s * ksc_ref[0, 0]
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(pos <= valid_until, s, _NEG_INF)
@@ -280,6 +363,8 @@ def _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         pv = jax.lax.dot_general(
             p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [group, D]
+        if quant:
+            pv = pv * vsc_ref[0, 0]
         acc_scr[...] = acc_scr[...] * corr + pv
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -295,6 +380,18 @@ def _paged_gqa_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # tables_ref is consumed by the BlockSpec index maps, not the body
     _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                    acc_scr, block_size=block_size, scale=scale)
+
+
+def _paged_gqa_q8_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref,
+                         ksc_ref, vsc_ref, o_ref, m_scr, l_scr, acc_scr,
+                         *, block_size: int, scale: float):
+    """int8 paged GQA decode: the `_gqa_grid_body` grid streaming int8
+    (kv head, page) tiles plus their (1, 1) f32 absmax scales — the
+    dequantized bf16 pool never materializes, HBM reads stay at int8
+    width."""
+    _gqa_grid_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, block_size=block_size, scale=scale,
+                   ksc_ref=ksc_ref, vsc_ref=vsc_ref)
 
 
 def gqa_decode_attention(q: jax.Array, k_cache: jax.Array,
@@ -317,10 +414,9 @@ def gqa_decode_attention(q: jax.Array, k_cache: jax.Array,
         scale = 1.0 / math.sqrt(d)
     # largest divisor of max_seq <= block_s keeps the collapsed view a
     # whole number of blocks (any divisor lowers: the block equals the
-    # collapsed trailing dims)
-    bs = min(block_s, max_seq)
-    while max_seq % bs:
-        bs -= 1
+    # collapsed trailing dims; row_bytes=0 = no VMEM cap — one kv head's
+    # block is small at every supported shape)
+    bs = fit_vmem_block(block_s, max_seq, 0)
     if bs < min(MIN_BLOCK_S, max_seq):
         import warnings
 
@@ -374,17 +470,22 @@ def _gqa_contig_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                    acc_scr, block_size=block_size, scale=scale)
 
 
-def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale):
+def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale,
+                      k_scale=None, v_scale=None):
     """Refs stay rank-3 (Mosaic cannot shape-cast 4-D blocks): q/out
     collapse (hkv, group) into one axis indexed at h*group; the pools
     collapse (page, hkv) so page selection becomes tbl[b, j]*hkv + h —
-    both are metadata-only row-major collapses, no data movement."""
+    both are metadata-only row-major collapses, no data movement. With
+    `k_scale`/`v_scale` [max_pages, hkv] (int8 pools) the collapse also
+    flattens the scales to [max_pages*hkv, 1] so each grid step's (1, 1)
+    scale tile rides the same tbl[b, j]*hkv + h row as its page."""
     b, hq, d = q.shape
     hkv = key_cache.shape[1]
     group = hq // hkv
     block_size = key_cache.shape[2]
     n_blocks = block_tables.shape[1]
     max_pages = key_cache.shape[0]
+    quant = k_scale is not None
     # blocks must exactly span trailing array dims unless 8/128-divisible,
     # so q/out collapse to [b*hkv, group, d] (block = one full row) and
     # the pools to [pages*hkv, block_size, d] (block = one page x one kv
@@ -392,27 +493,39 @@ def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale):
     qg = q.reshape(b * hkv, group, d)
     kc = key_cache.reshape(max_pages * hkv, block_size, d)
     vc = value_cache.reshape(max_pages * hkv, block_size, d)
-    kernel = functools.partial(_paged_gqa_kernel, block_size=block_size,
-                               scale=scale)
+
+    def pool_map(b_, h, j, tbl, lens_, hkv=hkv):
+        return (tbl[b_, j] * hkv + h, 0, 0)
+
+    def scale_map(b_, h, j, tbl, lens_, hkv=hkv):
+        return (tbl[b_, j] * hkv + h, 0)
+
+    def q_map(b_, h, j, tbl, lens_, hkv=hkv):
+        return (b_ * hkv + h, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, group, d), q_map),
+        pl.BlockSpec((1, block_size, d), pool_map),
+        pl.BlockSpec((1, block_size, d), pool_map),
+    ]
+    operands = [qg, kc, vc]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1), scale_map),
+                     pl.BlockSpec((1, 1), scale_map)]
+        operands += [k_scale.astype(jnp.float32).reshape(-1, 1),
+                     v_scale.astype(jnp.float32).reshape(-1, 1)]
+        kernel = functools.partial(_paged_gqa_q8_kernel,
+                                   block_size=block_size, scale=scale)
+    else:
+        kernel = functools.partial(_paged_gqa_kernel,
+                                   block_size=block_size, scale=scale)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, hkv, n_blocks),
-            in_specs=[
-                pl.BlockSpec((1, group, d),
-                             lambda b, h, j, tbl, lens, hkv=hkv:
-                             (b * hkv + h, 0, 0)),
-                pl.BlockSpec((1, block_size, d),
-                             lambda b, h, j, tbl, lens, hkv=hkv:
-                             (tbl[b, j] * hkv + h, 0, 0)),
-                pl.BlockSpec((1, block_size, d),
-                             lambda b, h, j, tbl, lens, hkv=hkv:
-                             (tbl[b, j] * hkv + h, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, group, d),
-                lambda b, h, j, tbl, lens, hkv=hkv: (b * hkv + h, 0, 0)),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, group, d), q_map),
             scratch_shapes=[
                 pltpu.VMEM((group, 128), jnp.float32),
                 pltpu.VMEM((group, 128), jnp.float32),
@@ -423,15 +536,16 @@ def _paged_decode_gqa(q, key_cache, value_cache, block_tables, lens, scale):
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=not _on_tpu(),
-    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),
-      qg, kc, vc)
+    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32), *operands)
     return out.reshape(b, hq, d)
 
 
 def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
                            value_cache: jax.Array, block_tables: jax.Array,
                            lens: jax.Array,
-                           scale: float | None = None) -> jax.Array:
+                           scale: float | None = None, *,
+                           k_scale: jax.Array | None = None,
+                           v_scale: jax.Array | None = None) -> jax.Array:
     """One decode step over a paged cache (reference: block_attn.h).
 
     q: [B, Hq, D]; key_cache/value_cache: [max_pages, Hkv, block_size, D]
@@ -440,11 +554,25 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
     ids covering positions [0, n_blocks*block_size); lens: [B]
     previous-token counts (current token already written at position
     lens[b]). Returns [B, Hq, D].
+
+    int8 pools (``FLAGS_kv_cache_dtype=int8``): pass the per-(page, kv
+    head) f32 absmax scale arrays as ``k_scale``/``v_scale``
+    [max_pages, Hkv] — each grid step then streams the int8 tile plus
+    its scale and rescales inside the f32 accumulation; the dequantized
+    bf16 pool never materializes.
     """
     b, h, d = q.shape
     hkv = key_cache.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    quant = key_cache.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "int8 KV pools need their per-(page, kv head) k_scale / "
+            "v_scale arrays — a quantized pool without scales decodes "
+            "garbage (TPU103 lints this)")
+    if not quant and (k_scale is not None or v_scale is not None):
+        raise ValueError("k_scale/v_scale only apply to int8 KV pools")
     if h != hkv or d % LANE:
         # grouped queries — or narrow head dims, where the equal-heads
         # kernel's [H, 1, D] broadcast fails to lower (see
@@ -452,11 +580,29 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
         if h % hkv:
             raise ValueError(f"Hq {h} not a multiple of Hkv {hkv}")
         return _paged_decode_gqa(q, key_cache, value_cache, block_tables,
-                                 lens, scale)
+                                 lens, scale, k_scale, v_scale)
     block_size = key_cache.shape[2]
     n_blocks = block_tables.shape[1]
-    kernel = functools.partial(_paged_decode_kernel, block_size=block_size,
-                               scale=scale)
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda b, j, tbl, lens: (b, 0, 0)),
+        pl.BlockSpec((1, h, block_size, d),
+                     lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+        pl.BlockSpec((1, h, block_size, d),
+                     lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+    ]
+    operands = [q, key_cache, value_cache]
+    if quant:
+        in_specs += [pl.BlockSpec((1, h),
+                                  lambda b, j, tbl, lens: (tbl[b, j], 0)),
+                     pl.BlockSpec((1, h),
+                                  lambda b, j, tbl, lens: (tbl[b, j], 0))]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+        kernel = functools.partial(_paged_decode_q8_kernel,
+                                   block_size=block_size, scale=scale)
+    else:
+        kernel = functools.partial(_paged_decode_kernel,
+                                   block_size=block_size, scale=scale)
     # page selection: the k/v BlockSpec index maps read the prefetched
     # block table — each grid step streams exactly one page of one sequence
     return pl.pallas_call(
@@ -464,13 +610,7 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_blocks),
-            in_specs=[
-                pl.BlockSpec((1, h, d), lambda b, j, tbl, lens: (b, 0, 0)),
-                pl.BlockSpec((1, h, block_size, d),
-                             lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
-                pl.BlockSpec((1, h, block_size, d),
-                             lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, h, d), lambda b, j, tbl, lens: (b, 0, 0)),
             scratch_shapes=[
@@ -483,5 +623,4 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=not _on_tpu(),
-    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),
-      q, key_cache, value_cache)
+    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32), *operands)

@@ -37,8 +37,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_compat import CompilerParams as _CompilerParams
 
-from .constraints import KernelConstraint, LANE, register_constraint
-from .decode_attention import VMEM_BUDGET_BYTES, _fitted_block, _on_tpu
+from .constraints import (KernelConstraint, LANE, fit_vmem_block,
+                          missing_scale_finding, register_constraint,
+                          vmem_row_cap)
+from .decode_attention import _on_tpu
 
 _NEG_INF = -1e30
 
@@ -51,15 +53,20 @@ BLOCK_Q = 128
 BLOCK_S = 512
 
 
-def fit_blocks(sb: int, page: int, group: int, dh: int):
+def fit_blocks(sb: int, page: int, group: int, dh: int, *,
+               kv_itemsize: int = 2):
     """(block_q, block_s) for a bucketed suffix of length `sb` over KV
-    pages of `page` tokens — the `_fitted_block` VMEM-cap logic applied
-    to both axes: block_q is the largest divisor of `sb` under the
-    double-buffered cap at query-group width; block_s is the largest
-    whole-page multiple dividing `sb` under the same cap (the prefix
-    phase is pinned at one page per step by the pool layout)."""
-    bq = _fitted_block(BLOCK_Q, sb, group, dh)
-    cap = max(1, VMEM_BUDGET_BYTES // (8 * dh))
+    pages of `page` tokens — the shared `constraints.fit_vmem_block`
+    logic applied to both axes: block_q is the largest divisor of `sb`
+    under the double-buffered cap at query-group width; block_s is the
+    largest whole-page multiple dividing `sb` under the same cap (the
+    prefix phase is pinned at one page per step by the pool layout).
+    `kv_itemsize` is the POOL element size: int8 pools halve the bytes
+    per streamed row, so the cap admits 2x the rows — minus a small
+    reserve for the (1, 1) f32 scale tiles that ride each int8 step."""
+    bq = fit_vmem_block(BLOCK_Q, sb, group * dh * 2)
+    reserve = 0 if kv_itemsize >= 2 else 4096  # scale refs + padding
+    cap = vmem_row_cap(dh * kv_itemsize, reserve_bytes=reserve)
     m = max(1, sb // page)
     k = max(1, min(BLOCK_S, cap) // page)
     k = min(k, m)
@@ -106,12 +113,38 @@ CONSTRAINT = register_constraint(KernelConstraint(
 ))
 
 
+def _check_q8_prefix_prefill_shapes(shapes, dtypes):
+    """int8 variant: the rank-3 tail reads identically (the rank-2 f32
+    scale operands drop out of the filter), plus the quantized pools
+    must travel with two scale operands (the shared
+    `constraints.missing_scale_finding` check)."""
+    out = list(_check_prefix_prefill_shapes(shapes, dtypes))
+    finding = missing_scale_finding(shapes, dtypes)
+    if finding is not None:
+        out.append(finding)
+    return out
+
+
+CONSTRAINT_Q8 = register_constraint(KernelConstraint(
+    name="prefix_prefill_q8",
+    kernel_fns=("_prefix_prefill_q8_kernel",),
+    blocks={"block_q": BLOCK_Q, "block_s": BLOCK_S},
+    note="int8-pool prefix prefill streams quantized (kv head, page) "
+         "tiles + their f32 absmax scales; suffix tiles stay "
+         "whole-page multiples like the bf16 grid",
+    checker=_check_q8_prefix_prefill_shapes,
+    source="prefix_prefill.py",
+))
+
+
 def prefix_prefill_reference(q: jax.Array, k_suf: jax.Array,
                              v_suf: jax.Array, key_cache: jax.Array,
                              value_cache: jax.Array,
                              prefix_tables: jax.Array,
                              prefix_lens: jax.Array, *,
-                             scale: float | None = None) -> jax.Array:
+                             scale: float | None = None,
+                             k_scale: jax.Array | None = None,
+                             v_scale: jax.Array | None = None) -> jax.Array:
     """The exact masked-softmax math the Pallas kernel replaces — and
     the SINGLE source of it: models.llama._make_prefill_with_prefix
     calls this per layer on its fallback path, and the kernel parity
@@ -120,19 +153,39 @@ def prefix_prefill_reference(q: jax.Array, k_suf: jax.Array,
     ([b, w_pre, nkv, page, dh]) — exact, gather-bound. Same operand
     layout as `prefix_prefill_attention` (minus suffix_lens: every
     query row is computed; pad rows are don't-care garbage here where
-    the kernel emits zeros). Returns [b, sb, nh, dh] in f32."""
+    the kernel emits zeros). int8 pools dequantize in f32 against their
+    per-(page, kv head) ``k_scale``/``v_scale`` [max_pages, nkv] before
+    the gather's transpose — the oracle covers both pool dtypes.
+    Returns [b, sb, nh, dh] in f32."""
     b, sb, nh, dh = q.shape
     nkv, page = key_cache.shape[1], key_cache.shape[2]
     P = prefix_tables.shape[1] * page
     group = nh // nkv
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
-    pk = jnp.transpose(key_cache[prefix_tables],
-                       (0, 1, 3, 2, 4)).reshape(b, P, nkv, dh)
-    pv = jnp.transpose(value_cache[prefix_tables],
-                       (0, 1, 3, 2, 4)).reshape(b, P, nkv, dh)
-    keys = jnp.concatenate([pk.astype(q.dtype), k_suf], axis=1)
-    vals = jnp.concatenate([pv.astype(q.dtype), v_suf], axis=1)
+    quant = key_cache.dtype == jnp.int8
+    gk = key_cache[prefix_tables]       # [b, w_pre, nkv, page, dh]
+    gv = value_cache[prefix_tables]
+    if quant:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "int8 KV pools need k_scale/v_scale (TPU103 lints a "
+                "quantized pool consumed without its scales)")
+        gk = gk.astype(jnp.float32) \
+            * k_scale[prefix_tables][..., None, None]
+        gv = gv.astype(jnp.float32) \
+            * v_scale[prefix_tables][..., None, None]
+    pk = jnp.transpose(gk, (0, 1, 3, 2, 4)).reshape(b, P, nkv, dh)
+    pv = jnp.transpose(gv, (0, 1, 3, 2, 4)).reshape(b, P, nkv, dh)
+    # dequantized int8 pages stay f32 all the way into the einsum — a
+    # bf16 round-trip here (q.dtype) would diverge from the kernel,
+    # whose dequant lives INSIDE the f32 accumulation, and break the
+    # kernel-on-vs-off token-identity contract at bf16 serving dtypes
+    cat_dtype = jnp.float32 if quant else q.dtype
+    keys = jnp.concatenate([pk.astype(cat_dtype),
+                            k_suf.astype(cat_dtype)], axis=1)
+    vals = jnp.concatenate([pv.astype(cat_dtype),
+                            v_suf.astype(cat_dtype)], axis=1)
     # prefix column t is real iff t < prefix_lens[row]; suffix column
     # t is visible to suffix query s iff t <= s
     pref_valid = jnp.arange(P)[None, :] < prefix_lens[:, None]
@@ -151,17 +204,37 @@ def prefix_prefill_reference(q: jax.Array, k_suf: jax.Array,
     return ctx.reshape(b, sb, nh, dh)
 
 
+def _prefix_prefill_q8_kernel(tbl_ref, plen_ref, slen_ref, q_ref, kp_ref,
+                              vp_ref, ksc_ref, vsc_ref, ks_ref, vs_ref,
+                              o_ref, m_scr, l_scr, acc_scr, *, page: int,
+                              block_q: int, block_s: int, group: int,
+                              w_pre: int, scale: float):
+    """int8-pool prefix prefill: `_prefix_prefill_kernel`'s grid where
+    each prefix-phase step streams the int8 (kv head, page) tile PLUS
+    its (1, 1) f32 absmax scale, rescaling scores and weighted values
+    inside the f32 accumulation — the dequantized bf16 pool never
+    materializes. The suffix phase (fresh bf16 K/V, not from the pool)
+    is untouched."""
+    _prefix_prefill_kernel(tbl_ref, plen_ref, slen_ref, q_ref, kp_ref,
+                           vp_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+                           acc_scr, page=page, block_q=block_q,
+                           block_s=block_s, group=group, w_pre=w_pre,
+                           scale=scale, ksc_ref=ksc_ref, vsc_ref=vsc_ref)
+
+
 def _prefix_prefill_kernel(tbl_ref, plen_ref, slen_ref, q_ref, kp_ref,
                            vp_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
                            acc_scr, *, page: int, block_q: int,
                            block_s: int, group: int, w_pre: int,
-                           scale: float):
+                           scale: float, ksc_ref=None, vsc_ref=None):
     """Grid (b, nkv, nq, j) with j the kv streaming axis: j < w_pre
     streams prefix page tbl[b, j] from the pool, j >= w_pre streams
     in-suffix block j - w_pre. Blocks: q/out [block_q*group, dh]
     (row r = query position q_start + r // group, head h*group +
     r % group), pool tiles [page, dh], suffix tiles [block_s, dh].
-    Online softmax carries across j; scratch re-inits at j == 0."""
+    Online softmax carries across j; scratch re-inits at j == 0.
+    `ksc_ref`/`vsc_ref` (int8 pools, via `_prefix_prefill_q8_kernel`)
+    carry the streamed page's f32 absmax scale."""
     b = pl.program_id(0)
     qi = pl.program_id(2)
     j = pl.program_id(3)
@@ -205,10 +278,17 @@ def _prefix_prefill_kernel(tbl_ref, plen_ref, slen_ref, q_ref, kp_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if ksc_ref is not None:
+            # int8 page tile: one scalar multiply folds the page's
+            # absmax scale into the scores (uniform over the tile)
+            s = s * ksc_ref[0, 0]
         kpos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where((kpos < plen) & (qpos(s.shape[1]) < slen),
                       s, _NEG_INF)
-        accum(s, vp_ref[0].astype(jnp.float32))
+        v = vp_ref[0].astype(jnp.float32)
+        if vsc_ref is not None:
+            v = v * vsc_ref[0, 0]
+        accum(s, v)
 
     # ---- suffix phase: causal over the suffix itself, masked by
     # suffix_lens; blocks fully beyond this q tile's causal reach (or
@@ -251,7 +331,9 @@ def prefix_prefill_attention(q: jax.Array, k_suf: jax.Array,
                              suffix_lens: jax.Array | None = None, *,
                              scale: float | None = None,
                              block_q: int | None = None,
-                             block_s: int | None = None) -> jax.Array:
+                             block_s: int | None = None,
+                             k_scale: jax.Array | None = None,
+                             v_scale: jax.Array | None = None) -> jax.Array:
     """Suffix-query attention over a cached paged prefix + the causal
     suffix, without materializing the gathered prefix.
 
@@ -263,6 +345,11 @@ def prefix_prefill_attention(q: jax.Array, k_suf: jax.Array,
     counts (multiples of the page size); suffix_lens: [b] true suffix
     lengths in [1, sb] (None = all rows full). Returns [b, sb, nh, dh]
     in q's dtype; rows at positions >= suffix_lens[b] are zeros.
+
+    int8 pools (``FLAGS_kv_cache_dtype=int8``): pass the per-(page, kv
+    head) f32 absmax scales as ``k_scale``/``v_scale`` [max_pages, nkv];
+    each prefix-phase step then streams the int8 page tile plus its
+    (1, 1) scale and dequantizes inside the f32 accumulation.
 
     Explicit `block_q`/`block_s` override the `fit_blocks` choice (they
     must divide sb); a block_s that is not a whole number of pages
@@ -281,10 +368,19 @@ def prefix_prefill_attention(q: jax.Array, k_suf: jax.Array,
     if w_pre < 1:
         raise ValueError("prefix_tables must be at least one page wide "
                          "(pad with the scratch page and prefix_lens 0)")
+    quant = key_cache.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "int8 KV pools need their per-(page, kv head) k_scale / "
+            "v_scale arrays — a quantized pool without scales decodes "
+            "garbage (TPU103 lints this)")
+    if not quant and (k_scale is not None or v_scale is not None):
+        raise ValueError("k_scale/v_scale only apply to int8 KV pools")
     group = nh // nkv
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
-    fit_q, fit_s = fit_blocks(sb, page, group, dh)
+    fit_q, fit_s = fit_blocks(sb, page, group, dh,
+                              kv_itemsize=1 if quant else 2)
     block_q = fit_q if block_q is None else block_q
     block_s = fit_s if block_s is None else block_s
     if sb % block_q or sb % block_s:
@@ -329,18 +425,33 @@ def prefix_prefill_attention(q: jax.Array, k_suf: jax.Array,
         js = jnp.minimum(js, jnp.maximum((slens[b_] - 1) // block_s, 0))
         return ((b_ * nkv + h) * n_suf + js, 0, 0)
 
-    kernel = functools.partial(
-        _prefix_prefill_kernel, page=page, block_q=block_q,
-        block_s=block_s, group=group, w_pre=w_pre, scale=scale)
+    def scale_map(b_, h, qi, j, tbl, plens, slens):
+        # the (1, 1) scale tile rides the same pinned page row as the
+        # int8 pool tile it dequantizes
+        jp = jnp.minimum(j, jnp.maximum(plens[b_] // page - 1, 0))
+        return (tbl[b_, jp] * nkv + h, 0)
+
+    pool_specs = [pl.BlockSpec((1, page, dh), pool_map),
+                  pl.BlockSpec((1, page, dh), pool_map)]
+    pool_operands = [kp, vp]
+    if quant:
+        pool_specs += [pl.BlockSpec((1, 1), scale_map),
+                       pl.BlockSpec((1, 1), scale_map)]
+        pool_operands += [k_scale.astype(jnp.float32).reshape(-1, 1),
+                          v_scale.astype(jnp.float32).reshape(-1, 1)]
+        kernel = functools.partial(
+            _prefix_prefill_q8_kernel, page=page, block_q=block_q,
+            block_s=block_s, group=group, w_pre=w_pre, scale=scale)
+    else:
+        kernel = functools.partial(
+            _prefix_prefill_kernel, page=page, block_q=block_q,
+            block_s=block_s, group=group, w_pre=w_pre, scale=scale)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(b, nkv, nq, w_pre + n_suf),
-            in_specs=[
-                pl.BlockSpec((1, bqg, dh), q_map),
-                pl.BlockSpec((1, page, dh), pool_map),
-                pl.BlockSpec((1, page, dh), pool_map),
+            in_specs=[pl.BlockSpec((1, bqg, dh), q_map)] + pool_specs + [
                 pl.BlockSpec((1, block_s, dh), suf_map),
                 pl.BlockSpec((1, block_s, dh), suf_map),
             ],
@@ -357,6 +468,6 @@ def prefix_prefill_attention(q: jax.Array, k_suf: jax.Array,
                                  "arbitrary")),
         interpret=not _on_tpu(),
     )(prefix_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
-      suffix_lens.astype(jnp.int32), qg, kp, vp, ks, vs)
+      suffix_lens.astype(jnp.int32), qg, *pool_operands, ks, vs)
     out = out.reshape(b, nkv, sb, group, dh)
     return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(b, sb, nh, dh)

@@ -506,8 +506,14 @@ class LlamaForCausalLM(Layer):
                              "cache_layout='paged'")
         params = dict(self.raw_state())
         dec_params = self._decode_params(params, quant)
+        # the paged program bakes the pool dtype in at build time, so the
+        # flag joins the cache key (flipping it must not serve a stale
+        # bf16 — or int8 — compiled program)
+        kv_dtype = resolve_kv_cache_dtype() if cache_layout == "paged" \
+            else None
         sig = (b, sb, max_new_tokens, eos_token_id, do_sample, int(top_k),
-               quant, prefill_with_quant, cache_layout, kv_block_size)
+               quant, prefill_with_quant, cache_layout, kv_block_size,
+               kv_dtype)
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = {}
@@ -779,7 +785,12 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
     owns the page scatter. `suffix_lens` [b] (true suffix lengths) lets
     the kernel skip and zero pad query rows; the fallback ignores it
     (pad rows beyond it are don't-care either way: their K/V land past
-    the decode watermark and are masked until overwritten)."""
+    the decode watermark and are masked until overwritten).
+
+    int8 pools (FLAGS_kv_cache_dtype): pass kcs/vcs entries as
+    (int8 pool, f32 scale [max_pages, nkv]) tuples — both the kernel
+    and the fallback dequantize against the scales (the fallback in
+    f32 at the gather, the kernel inside its accumulation)."""
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     n_layers = cfg.num_hidden_layers
@@ -807,20 +818,26 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
             kvs.append((k, v))
+            kc_i, ksc_i = kcs[i] if isinstance(kcs[i], tuple) \
+                else (kcs[i], None)
+            vc_i, vsc_i = vcs[i] if isinstance(vcs[i], tuple) \
+                else (vcs[i], None)
             if use_kernel:
                 from ..kernels.prefix_prefill import \
                     prefix_prefill_attention
 
                 attn = prefix_prefill_attention(
-                    q, k, v, kcs[i], vcs[i], prefix_tables, prefix_lens,
-                    suffix_lens, scale=scale).astype(h.dtype)
+                    q, k, v, kc_i, vc_i, prefix_tables, prefix_lens,
+                    suffix_lens, scale=scale, k_scale=ksc_i,
+                    v_scale=vsc_i).astype(h.dtype)
             else:
                 from ..kernels.prefix_prefill import \
                     prefix_prefill_reference
 
                 attn = prefix_prefill_reference(
-                    q, k, v, kcs[i], vcs[i], prefix_tables, prefix_lens,
-                    scale=scale).astype(h.dtype)
+                    q, k, v, kc_i, vc_i, prefix_tables, prefix_lens,
+                    scale=scale, k_scale=ksc_i,
+                    v_scale=vsc_i).astype(h.dtype)
             h = h + _mm(attn.reshape(b, sb, nh * dh),
                         p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
@@ -906,6 +923,96 @@ def make_paged_kv_helpers(b, n_pre, nkv, dh, block_size, tables):
     return to_pages, kv_write
 
 
+# ---------------------------------------------------------------------------
+# int8 KV cache (FLAGS_kv_cache_dtype): symmetric per-(page, kv-head)
+# absmax quantization of the paged pools — quantize on the K/V page
+# scatter, dequantize inside the Pallas kernels (decode_attention /
+# prefix_prefill stream the int8 tiles + their scale rows)
+# ---------------------------------------------------------------------------
+
+KV_CACHE_DTYPES = ("bf16", "int8")
+
+
+def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str] = None) -> str:
+    """'bf16' | 'int8', from the argument or FLAGS_kv_cache_dtype /
+    PADDLE_TPU_KV_CACHE_DTYPE. Read at program-BUILD time (like
+    FLAGS_prefix_prefill_kernel): flip it before constructing or
+    warming an engine."""
+    if kv_cache_dtype is None:
+        from ..framework.flags import flag as _flag
+
+        kv_cache_dtype = str(_flag("kv_cache_dtype"))
+    if kv_cache_dtype not in KV_CACHE_DTYPES:
+        raise ValueError(
+            f"kv_cache_dtype must be one of {KV_CACHE_DTYPES}, got "
+            f"{kv_cache_dtype!r}")
+    return kv_cache_dtype
+
+
+def quantize_kv_pages(kv):
+    """Symmetric absmax int8 quantization of whole K/V pages.
+
+    kv: [..., block_size, dh] with the per-(page, kv-head) reduction
+    over the trailing two axes (callers pass [b, n_pre, nkv, block, dh]
+    page stacks). The absmax is computed in f32 BEFORE any bf16
+    round-trip. Returns (int8 same shape, scale [...] f32) with
+    scale = absmax / 127 — dequant is q * scale; an all-zero page keeps
+    scale 0 (dequantizes to exact zeros)."""
+    kf = kv.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(kf), axis=(-2, -1)) / 127.0
+    safe = jnp.where(amax > 0, amax, 1.0)
+    q = jnp.round(kf / safe[..., None, None]).astype(jnp.int8)
+    return q, amax
+
+
+def make_paged_kv_q8_helpers(b, n_pre, nkv, dh, block_size, tables):
+    """int8 twins of `make_paged_kv_helpers`, operating on
+    (pool int8 [max_pages, nkv, block, dh], scale f32 [max_pages, nkv])
+    pairs:
+
+    - `to_pages_q8(kv)` -> (int8 pages, scales): the prefill transpose
+      fused with quantize-on-scatter;
+    - `kv_write_q8(kct, vct, k, v, lens)` with kct/vct = (pool, scale)
+      tuples: the per-token decode commit. The page's absmax scale is
+      monotone — a token louder than the page's current absmax grows the
+      scale and the already-stored rows rescale in the same read-modify-
+      write (one page per token per layer, noise next to the full-cache
+      stream each decode step already pays); `slot == 0` resets the
+      scale, so a recycled page can never poison its new owner with a
+      stale (possibly huge) absmax."""
+    to_pages, _ = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
+                                        tables)
+
+    def to_pages_q8(kv):
+        return quantize_kv_pages(to_pages(kv))
+
+    def _commit_token(pool, scales, tok, page, slot):
+        tokf = tok.astype(jnp.float32)                     # [b, nkv, dh]
+        tok_amax = jnp.max(jnp.abs(tokf), axis=-1) / 127.0  # [b, nkv]
+        # fresh page (slot 0): whatever scale the page's previous owner
+        # left behind is dead — start the absmax chain from this token
+        old = jnp.where((slot == 0)[:, None], 0.0, scales[page])
+        new = jnp.maximum(old, tok_amax)
+        safe = jnp.where(new > 0, new, 1.0)
+        ratio = old / safe                                  # <= 1
+        pg = jnp.round(pool[page].astype(jnp.float32)
+                       * ratio[..., None, None])
+        q = jnp.round(tokf / safe[..., None])
+        pg = pg.at[jnp.arange(b), :, slot, :].set(q)
+        pool = pool.at[page].set(
+            jnp.clip(pg, -127, 127).astype(jnp.int8))
+        return pool, scales.at[page].set(new)
+
+    def kv_write_q8(kct, vct, k, v, lens):
+        page = tables[jnp.arange(b), lens // block_size]
+        slot = lens % block_size
+        kc, ksc = _commit_token(*kct, k[:, 0], page, slot)
+        vc, vsc = _commit_token(*vct, v[:, 0], page, slot)
+        return (kc, ksc), (vc, vsc)
+
+    return to_pages_q8, kv_write_q8
+
+
 def hash_prefix_blocks(tokens, block_size: int):
     """Chained per-block prompt hashes: hash i covers tokens
     [0, (i+1)*block_size) — a hit on hash i therefore implies the WHOLE
@@ -952,6 +1059,54 @@ class PagedKVManager:
         self._cached = {}
         self._lru = OrderedDict()
         self.prefix_evictions = 0
+        self._geometry = None  # set_pool_geometry
+
+    # ---- pool byte accounting -------------------------------------------
+
+    @staticmethod
+    def page_bytes(block_size: int, *, n_layers: int, num_kv_heads: int,
+                   head_dim: int, kv_cache_dtype: str = "bf16") -> int:
+        """Device bytes ONE page costs across all layers: K + V pools
+        (2 x nkv x block x dh x itemsize per layer) plus, for int8, the
+        per-(page, kv-head) f32 absmax scale rows (2 x nkv x 4)."""
+        itemsize = 1 if kv_cache_dtype == "int8" else 2
+        per_layer = 2 * num_kv_heads * block_size * head_dim * itemsize
+        if kv_cache_dtype == "int8":
+            per_layer += 2 * num_kv_heads * 4
+        return per_layer * n_layers
+
+    @classmethod
+    def pages_for_bytes(cls, budget_bytes: int, block_size: int, *,
+                        n_layers: int, num_kv_heads: int, head_dim: int,
+                        kv_cache_dtype: str = "bf16") -> int:
+        """Pages a device byte budget holds — the capacity side of the
+        int8 win: at the same budget an int8 pool holds ~2x the pages
+        (so ~2x the cacheable prefix blocks before LRU eviction)."""
+        per_page = cls.page_bytes(block_size, n_layers=n_layers,
+                                  num_kv_heads=num_kv_heads,
+                                  head_dim=head_dim,
+                                  kv_cache_dtype=kv_cache_dtype)
+        return max(0, int(budget_bytes) // per_page)
+
+    def set_pool_geometry(self, *, n_layers: int, num_kv_heads: int,
+                          head_dim: int, kv_cache_dtype: str = "bf16"):
+        """Record the pool geometry this manager's page ids index into,
+        enabling `kv_pool_bytes()` (benches attribute capacity-driven
+        hit-rate changes with it)."""
+        resolve_kv_cache_dtype(kv_cache_dtype)
+        self._geometry = dict(n_layers=int(n_layers),
+                              num_kv_heads=int(num_kv_heads),
+                              head_dim=int(head_dim),
+                              kv_cache_dtype=kv_cache_dtype)
+
+    def kv_pool_bytes(self) -> int:
+        """Total device bytes of the K/V pools (+ int8 scale arrays)
+        this manager allocates pages of. Requires `set_pool_geometry`."""
+        if self._geometry is None:
+            raise RuntimeError(
+                "kv_pool_bytes() needs set_pool_geometry(...) first")
+        return self.max_pages * self.page_bytes(self.block_size,
+                                                **self._geometry)
 
     @property
     def n_free(self) -> int:
@@ -1131,7 +1286,11 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
 
     Weights are read through `_mm`, so the dec_params dict may hold
     dense OR nn.quant-quantized projections (int8/int4 serving composes
-    with paging for free). Returns
+    with paging for free). With FLAGS_kv_cache_dtype=int8 (read when
+    this factory runs — program-BUILD time) the pools are int8 +
+    per-(page, kv-head) f32 absmax scales: prefill quantizes on the
+    page scatter, decode commits re-quantize per token, and the Pallas
+    kernels dequantize in-kernel. Returns
     run(dec_params, ids, s0_vec, tables, key, temperature, top_p).
     """
     from ..kernels.decode_attention import paged_decode_attention
@@ -1145,6 +1304,7 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     total = sb + max_new
     pages_per_seq = -(-total // block_size)
     n_pre = sb // block_size
+    quant_kv = resolve_kv_cache_dtype() == "int8"
 
     head_logits = _make_head_logits(cfg)
     base_prefill = _make_prefill(cfg, b, sb)
@@ -1152,19 +1312,38 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     def prefill(p, ids, tables, pools):
         to_pages, _ = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
                                             tables)
+        to_pages_q8, _ = make_paged_kv_q8_helpers(b, n_pre, nkv, dh,
+                                                  block_size, tables)
         h, kvs = base_prefill(p, ids)
         for i, (k, v) in enumerate(kvs):
             kc, vc = pools[i]
             # scatter this layer's prefill K/V into the allocated pages
-            pools[i] = (
-                kc.at[tables[:, :n_pre]].set(to_pages(k).astype(kc.dtype)),
-                vc.at[tables[:, :n_pre]].set(to_pages(v).astype(vc.dtype)))
+            if quant_kv:
+                (kcp, ksc), (vcp, vsc) = kc, vc
+                qk, sk_ = to_pages_q8(k)
+                qv, sv_ = to_pages_q8(v)
+                pools[i] = (
+                    (kcp.at[tables[:, :n_pre]].set(qk),
+                     ksc.at[tables[:, :n_pre]].set(sk_)),
+                    (vcp.at[tables[:, :n_pre]].set(qv),
+                     vsc.at[tables[:, :n_pre]].set(sv_)))
+            else:
+                pools[i] = (
+                    kc.at[tables[:, :n_pre]].set(
+                        to_pages(k).astype(kc.dtype)),
+                    vc.at[tables[:, :n_pre]].set(
+                        to_pages(v).astype(vc.dtype)))
         return h, pools
 
     def paged_attn(q1, kc, vc, tables, lens):
         """q1 [b, nh, dh]; lens [b] = cached positions (current token
         already written at lens[b]). The Pallas kernel covers both equal
-        and grouped heads (GQA grid: one page x one kv head per step)."""
+        and grouped heads (GQA grid: one page x one kv head per step).
+        int8 pools arrive as (pool, scale) tuples."""
+        if isinstance(kc, tuple):
+            (kcp, ksc), (vcp, vsc) = kc, vc
+            return paged_decode_attention(q1, kcp, vcp, tables, lens,
+                                          k_scale=ksc, v_scale=vsc)
         return paged_decode_attention(q1, kc, vc, tables, lens)
 
     def make_decode_step(tables):
@@ -1173,6 +1352,9 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
         `pos` is the per-sequence [b] length vector (ragged batch)."""
         _, kv_write = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
                                             tables)
+        if quant_kv:
+            _, kv_write = make_paged_kv_q8_helpers(b, n_pre, nkv, dh,
+                                                   block_size, tables)
 
         def kv_attend(q1, kc, vc, lens):
             return paged_attn(q1, kc, vc, tables, lens)
@@ -1183,9 +1365,16 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     def run(p_dec, ids, s0_vec, tables, key, temperature, top_p):
         dtype = p_dec["llama.embed_tokens.weight"].dtype
         max_pages = b * pages_per_seq
-        pools = [(jnp.zeros((max_pages, nkv, block_size, dh), dtype),
-                  jnp.zeros((max_pages, nkv, block_size, dh), dtype))
-                 for _ in range(n_layers)]
+        if quant_kv:
+            def pool():
+                return (jnp.zeros((max_pages, nkv, block_size, dh),
+                                  jnp.int8),
+                        jnp.zeros((max_pages, nkv), jnp.float32))
+            pools = [(pool(), pool()) for _ in range(n_layers)]
+        else:
+            pools = [(jnp.zeros((max_pages, nkv, block_size, dh), dtype),
+                      jnp.zeros((max_pages, nkv, block_size, dh), dtype))
+                     for _ in range(n_layers)]
         h, pools = prefill(p_dec, ids, tables, pools)
         # each row's own last-position logits (ragged batch)
         h_last = h[jnp.arange(b), s0_vec - 1][:, None, :]

@@ -48,8 +48,17 @@ every program, device programs serialize in dispatch order — a stale
 chunk's writes for a retired row always land before any new owner of
 those pages scatters or reads them.
 
+**int8 KV cache** (FLAGS_kv_cache_dtype=int8, default bf16): the paged
+pools become (int8, per-(page, kv-head) f32 absmax scale) pairs —
+quantized on the K/V page scatter, dequantized inside the Pallas
+kernels, halving the HBM bytes every decode / prefix-prefill step
+streams and doubling the pages (and therefore cacheable prefix blocks,
+`n_cacheable_pages`) a byte budget holds. Page-count capacity math is
+unchanged; `kv_pool_bytes=` sizes the pool by bytes instead.
+
 Weights go through the `_decode_params` layout (`_mm`), so dense AND
-weight-only int8/int4 serving compose with the engine unchanged.
+weight-only int8/int4 serving compose with the engine unchanged (and
+with the int8 KV cache: weight quant and KV quant are independent).
 """
 from __future__ import annotations
 
@@ -66,7 +75,9 @@ import numpy as np
 from ..models.llama import (PagedKVManager, _make_decode_step,
                             _make_head_logits, _make_prefill,
                             _make_prefill_with_prefix, _sample_next,
-                            hash_prefix_blocks, make_paged_kv_helpers)
+                            hash_prefix_blocks, make_paged_kv_helpers,
+                            make_paged_kv_q8_helpers,
+                            resolve_kv_cache_dtype)
 from ..resilience import chaos
 
 
@@ -156,7 +167,18 @@ class ContinuousBatchingEngine:
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
                  top_k: int = 0, temperature: float = 1.0,
                  top_p: float = 1.0, seed: int = 0, dtype=jnp.bfloat16,
-                 prefix_cache: bool = True, double_buffer: bool = False):
+                 prefix_cache: bool = True, double_buffer: bool = False,
+                 kv_cache_dtype: Optional[str] = None,
+                 kv_pool_bytes: Optional[int] = None):
+        """`kv_cache_dtype` ('bf16' | 'int8'; default from
+        FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
+        paged-pool element type: int8 pools halve the HBM bytes every
+        decode / prefix-prefill step streams and carry per-(page, kv
+        head) f32 absmax scales (quantized on the page scatter,
+        dequantized in-kernel). `kv_pool_bytes` sizes the pool by a
+        DEVICE BYTE budget instead of `max_pages` — at the same budget
+        an int8 pool holds ~2x the pages, i.e. ~2x `n_cacheable_pages`
+        before LRU eviction."""
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a whole number of "
@@ -179,6 +201,10 @@ class ContinuousBatchingEngine:
         self.top_p = top_p
         self.prefix_cache = bool(prefix_cache)
         self.double_buffer = bool(double_buffer)
+        # pool dtype is baked into every program at build time (like
+        # FLAGS_prefix_prefill_kernel); it also joins the program-cache
+        # keys so the compile-point helpers can never mix dtypes
+        self.kv_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
         # pool capacity: every slot simultaneously full-length at the
         # ENGINE budget, +1 scratch page. Per-request reservations are
         # never larger — _plan TRIMS a cached prefix until the hit
@@ -193,15 +219,43 @@ class ContinuousBatchingEngine:
         # widest cached prefix any request can map (>= 1 suffix token
         # always prefills, so the last block is never part of a prefix)
         self._prefix_width = max(1, (self.max_prompt_len - 1) // block_size)
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        if kv_pool_bytes is not None:
+            if max_pages is not None:
+                raise ValueError(
+                    "pass max_pages OR kv_pool_bytes, not both")
+            max_pages = PagedKVManager.pages_for_bytes(
+                kv_pool_bytes, block_size,
+                n_layers=cfg.num_hidden_layers, num_kv_heads=nkv,
+                head_dim=dh, kv_cache_dtype=self.kv_dtype)
+            if max_pages < cap + 2:
+                raise ValueError(
+                    f"kv_pool_bytes {kv_pool_bytes} holds only "
+                    f"{max_pages} pages at kv_cache_dtype="
+                    f"{self.kv_dtype}; need at least {cap + 2} "
+                    "(one full request + scratch + one cacheable page)")
         if max_pages is None:
             max_pages = slots * cap + 1
         self.mgr = PagedKVManager(max_pages, block_size)
+        self.mgr.set_pool_geometry(n_layers=cfg.num_hidden_layers,
+                                   num_kv_heads=nkv, head_dim=dh,
+                                   kv_cache_dtype=self.kv_dtype)
         self.scratch_page = self.mgr.alloc_pages(1)[0]  # retired rows' sink
-        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
-        self.kcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
-                    for _ in range(cfg.num_hidden_layers)]
-        self.vcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
-                    for _ in range(cfg.num_hidden_layers)]
+        if self.kv_dtype == "int8":
+            # (int8 pool, per-(page, kv head) f32 absmax scale) pairs —
+            # every program threads the pair, so donation keeps scales
+            # in place exactly like the pools
+            def _pool():
+                return (jnp.zeros((max_pages, nkv, block_size, dh),
+                                  jnp.int8),
+                        jnp.zeros((max_pages, nkv), jnp.float32))
+            self.kcs = [_pool() for _ in range(cfg.num_hidden_layers)]
+            self.vcs = [_pool() for _ in range(cfg.num_hidden_layers)]
+        else:
+            self.kcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
+                        for _ in range(cfg.num_hidden_layers)]
+            self.vcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
+                        for _ in range(cfg.num_hidden_layers)]
         self._slots = [_Slot() for _ in range(slots)]
         self._tables = np.full((slots, cap), self.scratch_page, np.int32)
         self._tokens = np.zeros((slots,), np.int32)
@@ -255,6 +309,17 @@ class ContinuousBatchingEngine:
     @property
     def n_active(self) -> int:
         return sum(1 for s in self._slots if s.req is not None)
+
+    @property
+    def n_cacheable_pages(self) -> int:
+        """Pages that can hold K/V content (everything but the scratch
+        page) — the ceiling on resident prefix-cache blocks. Capacity
+        math is UNCHANGED in pages across pool dtypes
+        (`_capacity_pages_for` counts pages, not bytes); what int8
+        changes is how many pages a byte budget buys: at the same
+        `kv_pool_bytes`, an int8 pool holds ~2x of these before LRU
+        eviction."""
+        return self.mgr.max_pages - 1
 
     @property
     def has_work(self) -> bool:
@@ -341,16 +406,12 @@ class ContinuousBatchingEngine:
         base = _make_prefill(cfg, bsz, sb)
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
-        # shared page transform (tables unused by the prefill half)
-        to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
+        scatter = self._page_scatter(bsz, n_pre)
 
         def run(p, kcs, vcs, ids, s0_vec, pages, key, temperature, top_p):
             h, kvs = base(p, ids)
             for i, (k, v) in enumerate(kvs):
-                kcs[i] = kcs[i].at[pages].set(
-                    to_pages(k).astype(kcs[i].dtype))
-                vcs[i] = vcs[i].at[pages].set(
-                    to_pages(v).astype(vcs[i].dtype))
+                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v, pages)
             h_last = h[jnp.arange(bsz), s0_vec - 1][:, None, :]
             logits = head_logits(h_last, p)[:, -1]
             first = _sample_next(logits.astype(jnp.float32), key,
@@ -358,6 +419,32 @@ class ContinuousBatchingEngine:
             return first, kcs, vcs
 
         return run
+
+    def _page_scatter(self, bsz: int, n_pre: int):
+        """The prefill K/V page scatter shared by the cold and
+        cached-prefix prefill programs — THE quantize-on-scatter seam:
+        the int8 path computes each page's absmax in f32 and stores the
+        int8 page + its scale row in the same update."""
+        cfg = self.cfg
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        bs = self.block_size
+        to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
+        if self.kv_dtype != "int8":
+            def scatter(kc, vc, k, v, pages):
+                return (kc.at[pages].set(to_pages(k).astype(kc.dtype)),
+                        vc.at[pages].set(to_pages(v).astype(vc.dtype)))
+            return scatter
+        to_pages_q8, _ = make_paged_kv_q8_helpers(bsz, n_pre, nkv, dh,
+                                                  bs, None)
+
+        def scatter_q8(kct, vct, k, v, pages):
+            (kc, ksc), (vc, vsc) = kct, vct
+            qk, sk = to_pages_q8(k)
+            qv, sv = to_pages_q8(v)
+            return ((kc.at[pages].set(qk), ksc.at[pages].set(sk)),
+                    (vc.at[pages].set(qv), vsc.at[pages].set(sv)))
+
+        return scatter_q8
 
     def _build_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
         """Like _build_prefill, but for rows whose prompt head hit the
@@ -373,21 +460,17 @@ class ContinuousBatchingEngine:
         streaming axis touches table columns the batch cannot fill."""
         cfg = self.cfg
         bs = self.block_size
-        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
         n_pre = sb // bs
         base = _make_prefill_with_prefix(cfg, bsz, sb, w_pre, bs)
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
-        to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
+        scatter = self._page_scatter(bsz, n_pre)
 
         def run(p, kcs, vcs, ids, s0_vec, pages, ptables, plens, key,
                 temperature, top_p):
             h, kvs = base(p, kcs, vcs, ids, ptables, plens, s0_vec)
             for i, (k, v) in enumerate(kvs):
-                kcs[i] = kcs[i].at[pages].set(
-                    to_pages(k).astype(kcs[i].dtype))
-                vcs[i] = vcs[i].at[pages].set(
-                    to_pages(v).astype(vcs[i].dtype))
+                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v, pages)
             h_last = h[jnp.arange(bsz), s0_vec - 1][:, None, :]
             logits = head_logits(h_last, p)[:, -1]
             first = _sample_next(logits.astype(jnp.float32), key,
@@ -408,14 +491,28 @@ class ContinuousBatchingEngine:
         cfg, b, bs = self.cfg, self.slots, self.block_size
         steps = self.steps
         do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
+        quant = self.kv_dtype == "int8"
 
         def run(p, kcs, vcs, toks, lens, budgets, tables, live, key,
                 temperature, top_p):
-            _, kv_write = make_paged_kv_helpers(
-                b, 0, cfg.num_key_value_heads, cfg.head_dim, bs, tables)
+            if quant:
+                _, kv_write = make_paged_kv_q8_helpers(
+                    b, 0, cfg.num_key_value_heads, cfg.head_dim, bs,
+                    tables)
 
-            def kv_attend(q1, kc, vc, lens_):
-                return paged_decode_attention(q1, kc, vc, tables, lens_)
+                def kv_attend(q1, kct, vct, lens_):
+                    (kc, ksc), (vc, vsc) = kct, vct
+                    return paged_decode_attention(q1, kc, vc, tables,
+                                                  lens_, k_scale=ksc,
+                                                  v_scale=vsc)
+            else:
+                _, kv_write = make_paged_kv_helpers(
+                    b, 0, cfg.num_key_value_heads, cfg.head_dim, bs,
+                    tables)
+
+                def kv_attend(q1, kc, vc, lens_):
+                    return paged_decode_attention(q1, kc, vc, tables,
+                                                  lens_)
 
             decode_step = _make_decode_step(cfg, b, kv_write=kv_write,
                                             kv_attend=kv_attend)
@@ -450,15 +547,18 @@ class ContinuousBatchingEngine:
 
     def _get_prefill(self, sb: int, bsz: int):
         """The single compile point for (bucket, batch) prefill programs
-        (warm and _admit must never diverge in jit options)."""
-        key = ("cold", sb, bsz)
+        (warm and _admit must never diverge in jit options). The pool
+        dtype rides every key: an engine only ever builds programs at
+        its own kv_cache_dtype, and the key makes that self-evident in
+        compile_stats()."""
+        key = ("cold", sb, bsz, self.kv_dtype)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._build_prefill(sb, bsz), donate_argnums=(1, 2))
         return self._prefill_cache[key]
 
     def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
-        key = ("prefix", sb, bsz, w_pre)
+        key = ("prefix", sb, bsz, w_pre, self.kv_dtype)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._build_prefix_prefill(sb, bsz, w_pre),

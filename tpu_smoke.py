@@ -220,6 +220,82 @@ def check_prefix_prefill():
             if err > 5e-2 else None)
 
 
+def check_kv_quant():
+    """int8 paged KV cache on silicon (ISSUE 5): the dequantize-in-kernel
+    paged GQA decode and prefix-prefill paths against (a) the same math
+    over explicitly dequantized pools (kernel-roundoff tight) and (b)
+    the original bf16 pools (absmax-quantization tolerance) — so a
+    Mosaic lowering bug in the scale plumbing can't hide inside the
+    quant tolerance."""
+    from paddle_tpu.kernels.decode_attention import paged_decode_attention
+    from paddle_tpu.kernels.prefix_prefill import (
+        prefix_prefill_attention, prefix_prefill_reference)
+    from paddle_tpu.models import quantize_kv_pages
+
+    rng = np.random.default_rng(8)
+    B, HQ, HK, D, BS, NBLK = 4, 16, 4, 128, 64, 4
+    max_pages = B * NBLK + 1
+    kc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)), jnp.bfloat16)
+    q = jnp.asarray(rng.normal(size=(B, HQ, D)), jnp.bfloat16)
+    tables = jnp.asarray([[j * B + i + 1 for j in range(NBLK)]
+                          for i in range(B)], jnp.int32)
+    lens = jnp.asarray([60, 255, 128, 200], jnp.int32)
+    kq, ks = quantize_kv_pages(kc)
+    vq, vs = quantize_kv_pages(vc)
+    out = jax.jit(lambda a: paged_decode_attention(
+        a, kq, vq, tables, lens, k_scale=ks, v_scale=vs))(q)
+
+    g = HQ // HK
+    kd = kq.astype(jnp.float32) * ks[:, :, None, None]
+    vd = vq.astype(jnp.float32) * vs[:, :, None, None]
+
+    def oracle(kl_src, vl_src):
+        kl = jnp.transpose(kl_src[tables], (0, 2, 1, 3, 4)).reshape(
+            B, HK, NBLK * BS, D).astype(jnp.float32)
+        vl = jnp.transpose(vl_src[tables], (0, 2, 1, 3, 4)).reshape(
+            B, HK, NBLK * BS, D).astype(jnp.float32)
+        qg = q.astype(jnp.float32).reshape(B, HK, g, D)
+        s = jnp.einsum("bkgd,bksd->bkgs", qg, kl) / math.sqrt(D)
+        valid = jnp.arange(NBLK * BS)[None, None, None, :] <= \
+            lens[:, None, None, None]
+        p = jax.nn.softmax(jnp.where(valid, s, -1e30), axis=-1)
+        return jnp.einsum("bkgs,bksd->bkgd", p, vl).reshape(B, HQ, D)
+
+    ref_dq = jax.jit(lambda: oracle(kd, vd))()
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref_dq)))
+    if err > 5e-2:
+        return f"int8 paged decode vs dequant oracle err {err:.4f} > 5e-2"
+    ref = jax.jit(lambda: oracle(kc, vc))()
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    if err > 1e-1:
+        return f"int8 paged decode quant err {err:.4f} > 1e-1"
+
+    # prefix prefill: int8 kernel vs the int8-aware reference
+    SB, W = 128, 4
+    qs = jnp.asarray(rng.normal(size=(B, SB, HQ, D)), jnp.bfloat16)
+    ksuf = jnp.asarray(rng.normal(size=(B, SB, HK, D)), jnp.bfloat16)
+    vsuf = jnp.asarray(rng.normal(size=(B, SB, HK, D)), jnp.bfloat16)
+    ptbl = jnp.asarray([[j * B + i + 1 for j in range(W)]
+                        for i in range(B)], jnp.int32)
+    plens = jnp.asarray([4 * BS, 1 * BS, 0, 2 * BS], jnp.int32)
+    slens = jnp.asarray([SB, 70, 40, SB], jnp.int32)
+    outp = jax.jit(lambda a: prefix_prefill_attention(
+        a, ksuf, vsuf, kq, vq, ptbl, plens, slens,
+        k_scale=ks, v_scale=vs))(qs)
+    if not bool(jnp.isfinite(outp.astype(jnp.float32)).all()):
+        return "int8 prefix prefill emitted non-finite values"
+    refp = jax.jit(lambda a: prefix_prefill_reference(
+        a, ksuf, vsuf, kq, vq, ptbl, plens,
+        k_scale=ks, v_scale=vs))(qs)
+    err = 0.0
+    for row, sl in enumerate([SB, 70, 40, SB]):
+        err = max(err, float(jnp.max(jnp.abs(
+            outp[row, :sl].astype(jnp.float32) - refp[row, :sl]))))
+    return (f"int8 prefix prefill max err {err:.4f} > 5e-2"
+            if err > 5e-2 else None)
+
+
 def check_int4_matmul():
     from paddle_tpu.kernels.int4_matmul import _xla_fallback, int4_matmul
 
@@ -280,6 +356,7 @@ CHECKS = [
     ("decode_paged", check_decode_paged),
     ("decode_paged_gqa", check_decode_paged_gqa),
     ("prefix_prefill", check_prefix_prefill),
+    ("kv_quant", check_kv_quant),
     ("int4_matmul", check_int4_matmul),
     ("rms_norm", check_rms_norm),
     ("jit_generate", check_jit_generate),
