@@ -26,6 +26,29 @@ import numpy as np
 OP_INFO = {}
 
 
+def _count_step_kernels(step_fn, *args):
+    """Kernel-launch count of ONE decode step: pallas_call + dot_general
+    equations in its jaxpr, sub-jaxprs included (the number TPU105
+    budgets and the decode megakernel exists to collapse). Recorded in
+    OPBENCH `info` so the megakernel row's win is attributable to fewer
+    launches, not a faster attention kernel."""
+    def walk(jaxpr):
+        n = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in ("pallas_call", "dot_general"):
+                n += 1
+                continue  # kernel bodies are not separate launches
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (tuple, list)) else (v,)
+                for item in vals:
+                    sub = getattr(item, "jaxpr", item)
+                    if hasattr(sub, "eqns"):
+                        n += walk(sub)
+        return n
+
+    return walk(jax.make_jaxpr(step_fn)(*args).jaxpr)
+
+
 def _op_bench(only=None):
     """Per-op latency table (reference: tools/ci_op_benchmark.sh +
     check_op_benchmark_result.py — the regression gate over op kernels).
@@ -295,6 +318,90 @@ def _op_bench(only=None):
             paired_slope_ms(drun, 2, 194, pairs=8), 4)
         del dp, dkcs, dvcs
 
+    if want("decode_step_1b_megakernel", "decode_step_1b_paged_ref"):
+        # the decode megakernel under the gate (ISSUE 6): one full 1B
+        # int8-weight decode step over PAGED bf16 pools with the fused
+        # per-layer megakernel (kernels/decode_megakernel.py), next to
+        # the informational `decode_step_1b_paged_ref` row — the
+        # IDENTICAL paged program through the multi-kernel path — so the
+        # per-phase split (kernel time vs inter-kernel dispatch + HBM
+        # round-trips) is attributable: both rows record their
+        # kernels_per_step (pallas_call + dot_general launches per
+        # decode step) in OPBENCH's `info`. Target (ROADMAP): the fused
+        # row at <= 0.5x the decode_step_1b_int8 best.
+        from paddle_tpu.models import (LlamaConfig,
+                                       init_quant_serving_params)
+        from paddle_tpu.models.llama import (
+            _make_decode_step, _make_decode_step_megakernel,
+            make_paged_kv_helpers)
+        from paddle_tpu.kernels.decode_attention import (
+            paged_decode_attention)
+        from bench_util import paired_slope_ms
+
+        gcfg = LlamaConfig.llama_1b(dtype="bfloat16")
+        gp = init_quant_serving_params(gcfg, "weight_only_int8", seed=0)
+        np.asarray(jax.tree.leaves(gp)[-1])
+        MB, MBS, MW = 4, 64, 8              # 4 rows x 8 pages (512 ctx)
+        mnkv, mdh = gcfg.num_key_value_heads, gcfg.head_dim
+        m_pages = MB * MW + 1
+        mtables = jnp.asarray(
+            np.arange(MB * MW).reshape(MB, MW) + 1, jnp.int32)
+
+        def paged_pools():
+            return [jnp.zeros((m_pages, mnkv, MBS, mdh), jnp.bfloat16)
+                    for _ in range(gcfg.num_hidden_layers)]
+
+        def make_step(use_mega):
+            if use_mega:
+                return _make_decode_step_megakernel(gcfg, MB, mtables)
+            _, kv_write = make_paged_kv_helpers(MB, 0, mnkv, mdh, MBS,
+                                                mtables)
+
+            def kv_attend(q1, kc, vc, lens):
+                return paged_decode_attention(q1, kc, vc, mtables, lens)
+
+            return _make_decode_step(gcfg, MB, kv_write=kv_write,
+                                     kv_attend=kv_attend)
+
+        def make_loop(step):
+            def run(p, kcs, vcs, tok0, lens0, n):
+                def body(i, carry):
+                    tok, lens, kcs_, vcs_ = carry
+                    logits, kcs_, vcs_ = step(p, kcs_, vcs_,
+                                              tok[:, None], lens)
+                    return (jnp.argmax(logits, -1).astype(tok.dtype),
+                            lens + 1, kcs_, vcs_)
+
+                tok, lens, _, _ = jax.lax.fori_loop(
+                    0, n, body, (tok0, lens0, kcs, vcs))
+                return jnp.sum(tok) + jnp.sum(lens)
+
+            return jax.jit(run)
+
+        mtok = jnp.ones((MB,), jnp.int32)
+        mlens = jnp.full((MB,), 128, jnp.int32)
+        for name, use_mega in (("decode_step_1b_megakernel", True),
+                               ("decode_step_1b_paged_ref", False)):
+            if not want(name):
+                continue
+            step = make_step(use_mega)
+            loop = make_loop(step)
+            kcs, vcs = paged_pools(), paged_pools()
+
+            def mrun(n, loop=loop, kcs=kcs, vcs=vcs):
+                return float(loop(gp, kcs, vcs, mtok, mlens,
+                                  jnp.asarray(n, jnp.int32)))
+
+            mrun(2); mrun(194)  # warm (trip count traced: one compile)
+            ops[name] = round(paired_slope_ms(mrun, 2, 194, pairs=8), 4)
+            OP_INFO[name] = {
+                "kernels_per_step": _count_step_kernels(
+                    step, gp, paged_pools(), paged_pools(),
+                    mtok[:, None], mlens),
+                "pages_per_seq": MW,
+            }
+        del gp
+
     if want("serving_decode_chunk"):
         # the engine's decode hot loop under the gate (ISSUE 3): one
         # steps_per_sync=16 chunk for 8 slots over the PAGED pools —
@@ -367,7 +474,7 @@ def _op_bench(only=None):
 # — and prefix_prefill_ref is the masked-softmax fallback timed only as
 # the comparison line for the gated prefix_prefill kernel row.
 INFORMATIONAL_OPS = {"all_reduce_4mb", "eager_dispatch_add",
-                     "prefix_prefill_ref"}
+                     "prefix_prefill_ref", "decode_step_1b_paged_ref"}
 
 
 # regressions consciously accepted, with a dated reason — an entry here is

@@ -125,7 +125,8 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
                prefix_cache=False, double_buffer=False,
                max_prompt_len=PROMPT_BUCKET, warm_buckets=None,
                warm_prefix_widths=None, prefix_kernel=True,
-               prefill_batch=4, kv_cache_dtype=None, kv_pool_bytes=None):
+               prefill_batch=4, kv_cache_dtype=None, kv_pool_bytes=None,
+               megakernel=False):
     import paddle_tpu as paddle
 
     # the flag is read at program-BUILD time; keep it set for the whole
@@ -142,7 +143,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
             block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC,
             prefill_batch=prefill_batch, prefix_cache=prefix_cache,
             double_buffer=double_buffer, kv_cache_dtype=kv_cache_dtype,
-            kv_pool_bytes=kv_pool_bytes)
+            kv_pool_bytes=kv_pool_bytes, decode_megakernel=megakernel)
         # compile every (bucket, prefill-batch) program + the decode
         # chunk outside the clock
         eng.warm(warm_buckets or [max_prompt_len],
@@ -341,11 +342,24 @@ def main():
         warm_prefix_widths=[hit_width], prefill_batch=1,
         kv_cache_dtype="int8",
         kv_pool_bytes=rows[2]["kv_pool_bytes"] // 2))
+    # decode megakernel (ISSUE 6): the same trace with the per-layer
+    # decode step fused into one Pallas call per layer
+    # (FLAGS_decode_megakernel) — decode chunks dominate this trace, so
+    # the summary's tokens/s gain vs the +kernel row is the end-to-end
+    # fusion win, and token_match_rate guards that the fused path serves
+    # the same greedy tokens
+    rows.append(run_engine(
+        cfg, p, arrivals, prompts, targets,
+        policy="continuous+prefix+kernel+megakernel", prefix_cache=True,
+        prefix_kernel=True, max_prompt_len=mpl,
+        warm_buckets=[PROMPT_BUCKET, cold_bucket],
+        warm_prefix_widths=[hit_width], prefill_batch=1,
+        megakernel=True))
     toks = [row.pop("_tokens", None) for row in rows]
     for row in rows:
         row["trace"] = "deep_prefix"
         print(json.dumps(row), flush=True)
-    cold, jnp_row, kern, int8kv = rows
+    cold, jnp_row, kern, int8kv, mega = rows
     print(json.dumps({
         "trace": "deep_prefix", "summary": True,
         "prefix_hit_rate": kern["prefix_hit_rate"],
@@ -367,6 +381,12 @@ def main():
         "int8kv_n_cacheable_pages": int8kv["n_cacheable_pages"],
         "bf16_n_cacheable_pages": kern["n_cacheable_pages"],
         "int8kv_token_match_rate": _token_match_rate(toks[2], toks[3]),
+        # decode megakernel vs the multi-kernel decode step, same trace:
+        # end-to-end throughput gain + greedy-token agreement
+        "megakernel_useful_tok_s_gain": round(
+            mega["useful_tok_s"] / max(kern["useful_tok_s"], 1e-9), 3),
+        "megakernel_token_match_rate": _token_match_rate(toks[2],
+                                                         toks[4]),
     }), flush=True)
 
 

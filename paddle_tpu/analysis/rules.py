@@ -9,6 +9,10 @@ Rule catalog (see analysis/README.md for the long-form docs):
                               bf16 bytes on the bandwidth-bound decode
                               path), or an int8 pool consumed without
                               its absmax scale operands
+  TPU105 fusion-miss          a scan/while body lowering to more
+                              distinct small-output Pallas/dot launches
+                              than the fusion budget (dispatch-bound
+                              decode steps; FLAGS_decode_megakernel)
   TPU201 recompile-risk       weak-typed python scalars baked into the
                               graph as literals (every new value retraces)
   TPU202 const-bloat          large arrays captured as compile-time
@@ -268,6 +272,85 @@ class KVCacheDtypeRule(Rule):
                      "scales via FLAGS_kv_cache_dtype=int8 "
                      "(PADDLE_TPU_KV_CACHE_DTYPE)",
                 severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# TPU105: fusion-miss — dispatch-bound loop bodies
+# ---------------------------------------------------------------------------
+
+@register_rule
+class FusionMissRule(Rule):
+    """A jitted hot loop (scan/while — the decode-step shape) whose body
+    lowers to many DISTINCT kernel launches (pallas_call / dot_general)
+    with only small intermediates between them is dispatch-bound, not
+    compute-bound: each launch pays fixed issue overhead and the tiny
+    [B, 1, H] tensors round-trip through HBM between launches (OPBENCH:
+    decode_attention 0.21 ms inside a 1.9 ms decode step). Distinctness
+    is by (primitive, operand/result shapes), so a 32-layer stack of
+    identical layers counts its per-layer shapes once — the number this
+    rule reports is the per-iteration fusion-boundary count, which the
+    decode megakernel (FLAGS_decode_megakernel) exists to collapse.
+
+    Config: `max_kernels` (default 6) — the distinct-call budget;
+    `small_bytes` (default 1 MiB) — calls whose every result is under
+    this are counted (bigger results mean the launch does real
+    bandwidth work and is not a fusion miss)."""
+
+    id = "TPU105"
+    name = "fusion-miss"
+    default_severity = Severity.WARNING
+    MAX_KERNELS = 6
+    SMALL_BYTES = 1 << 20
+    KERNEL_PRIMS = frozenset({"pallas_call", "dot_general"})
+
+    @staticmethod
+    def _loop_key(path: str) -> Optional[str]:
+        """The enclosing loop's path prefix ("main/.../scan[jaxpr]"),
+        None when the eqn is not inside a scan/while body."""
+        parts = path.split("/")
+        for i in range(len(parts) - 1, -1, -1):
+            if parts[i].startswith(("scan[", "while[")):
+                return "/".join(parts[:i + 1])
+        return None
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        max_kernels = self.config.get("max_kernels", self.MAX_KERNELS)
+        small = self.config.get("small_bytes", self.SMALL_BYTES)
+        # loop path -> {distinct signature -> first ctx}
+        loops: Dict[str, Dict[tuple, EqnCtx]] = {}
+        totals: Dict[str, int] = {}
+        for ctx in graph.eqns():
+            if not ctx.in_loop or ctx.primitive not in self.KERNEL_PRIMS:
+                continue
+            out_bytes = max(
+                (int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                 for v in ctx.eqn.outvars), default=0)
+            if out_bytes >= small:
+                continue
+            key = self._loop_key(ctx.path)
+            if key is None:
+                continue
+            sig = (ctx.primitive,
+                   tuple(tuple(v.aval.shape) for v in ctx.eqn.invars),
+                   tuple(tuple(v.aval.shape) for v in ctx.eqn.outvars))
+            loops.setdefault(key, {}).setdefault(sig, ctx)
+            totals[key] = totals.get(key, 0) + 1
+        for key, sigs in loops.items():
+            n = len(sigs)
+            if n <= max_kernels:
+                continue
+            n_pallas = sum(1 for s in sigs if s[0] == "pallas_call")
+            first = next(iter(sigs.values()))
+            yield self.diag(
+                f"loop body lowers to {n} distinct small-output kernel "
+                f"launches ({n_pallas} pallas, {n - n_pallas} dot; "
+                f"{totals[key]} total sites) — more than the "
+                f"{max_kernels}-launch fusion budget: per-iteration "
+                "dispatch and HBM round-trips between tiny ops dominate",
+                where=first.path,
+                hint="fuse the step (serving decode: "
+                     "FLAGS_decode_megakernel serves the whole per-layer "
+                     "attention block as one Pallas call)")
 
 
 # ---------------------------------------------------------------------------

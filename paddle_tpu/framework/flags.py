@@ -110,6 +110,17 @@ define_flag("kv_cache_dtype", "bf16",
             "(also: PADDLE_TPU_KV_CACHE_DTYPE)",
             env_aliases=("PADDLE_TPU_KV_CACHE_DTYPE",))
 
+define_flag("decode_megakernel", False,
+            "serve paged decode steps through the fused per-layer "
+            "megakernel (kernels/decode_megakernel.py: rms + QKV + "
+            "rotary + paged attention + in-kernel KV commit + o-proj "
+            "in ONE Pallas call per layer); off (default) = the "
+            "multi-kernel oracle path. Read when a paged program / "
+            "engine is BUILT, so flip it before constructing (or "
+            "warming) an engine "
+            "(also: PADDLE_TPU_DECODE_MEGAKERNEL)",
+            env_aliases=("PADDLE_TPU_DECODE_MEGAKERNEL",))
+
 # --- resilience (paddle_tpu.resilience) ---
 define_flag("tpu_chaos", "",
             "fault-injection spec, e.g. 'io_error:0.1,preempt_at:200,"

@@ -16,7 +16,10 @@ from .rms_norm import rms_norm as fused_rms_norm  # noqa: F401
 from .rope import apply_rotary_emb  # noqa: F401
 
 # importing the kernel modules populates KERNEL_CONSTRAINTS; decode,
-# prefix-prefill and int4 register theirs on import too
+# prefix-prefill, int4, megakernel, rope and swiglu register theirs on
+# import too
 from . import decode_attention as _decode_attention  # noqa: F401
 from . import int4_matmul as _int4_matmul  # noqa: F401
 from .prefix_prefill import prefix_prefill_attention  # noqa: F401
+from .decode_megakernel import decode_layer_megakernel  # noqa: F401
+from . import swiglu as _swiglu  # noqa: F401

@@ -1,0 +1,609 @@
+"""Decode megakernel: the whole per-token serving layer step as ONE
+Pallas TPU kernel.
+
+Why (OPBENCH): `decode_attention` costs 0.21 ms but `decode_step_1b_int8`
+costs 1.9 ms — the decode hot path is dominated by inter-kernel dispatch
+and the HBM round-trips between tiny per-token ops (a [B, 1, H] tensor
+bounces through HBM between every projection), not by attention math.
+MPK (mega-kernelizing tensor programs) and the XLA operator-fusion
+analysis in PAPERS.md both show this overhead class is recoverable by
+fusing the layer step; this kernel is that fusion for the paged serving
+decode path.
+
+Fusion boundary (one kernel per decoder layer — the attention block):
+
+    rms_norm -> QKV projection (dense or weight-only-int8) -> rotary
+    -> paged GQA attention over the bf16/int8 pools
+    -> paged-KV commit (the int8 quantize-on-scatter read-modify-write
+       of ONE page per token from the q8 helpers, as an in-kernel
+       epilogue with the same monotone per-(page, kv-head) scale update)
+    -> o-proj + residual add
+
+The MLP half of the layer stays with XLA: its three [1, H] x [H, F]
+matmuls are weight-read-bound and XLA schedules them well (measured for
+swiglu in BASELINE.md); the dispatch overhead this kernel recovers lives
+in the many tiny attention-block ops.
+
+Grid: (b, nkv, 2 + n_inner) with the last axis "arbitrary":
+
+  j == 0            rms_norm (computed once per row at kv head 0, kept
+                    in scratch), QKV projection for this kv head's query
+                    group, rotary (cos/sin tables precomputed per row
+                    outside — position-only math), q/k/v parked in VMEM
+                    scratch; online-softmax scratch re-inits.
+  1 <= j <= n_inner the paged attention phase: each step streams
+                    `pages_per_step` (kv head, page) tiles straight from
+                    the pools via the block table — the PR 4 follow-up
+                    multi-page inner step — with the `_paged_gqa_kernel`
+                    online-softmax recurrence, f32 accumulation, pad
+                    pages masked AND pinned out of the DMA stream.
+                    Positions are masked STRICTLY below `lens[b]`: the
+                    current token never round-trips through the pool.
+  j == n_inner + 1  the current token's k/v (still in scratch) joins the
+                    softmax, the context finalizes, o-proj accumulates
+                    into a per-row scratch across kv heads (residual add
+                    + store at the last kv head), and the commit
+                    epilogue writes the token's K/V page in place
+                    (`input_output_aliases`: every pool page NOT
+                    committed this step is untouched HBM).
+
+Commit correctness: a slot's commit page is always one of its private
+pages (the engine admits at least one suffix token past any cached
+prefix), so distinct live rows never write the same page; retired rows
+all aim at the engine's scratch page, whose content is never read
+(their lens is 0, masking every streamed position).
+
+Numerics: matches the multi-kernel path op-for-op (f32 statistics and
+accumulation, bf16 rounding at the same seams), but not bitwise —
+parity is asserted to tolerance in tests/test_decode_megakernel.py and
+token identity is asserted end-to-end through the engine.
+
+Wired behind FLAGS_decode_megakernel / PADDLE_TPU_DECODE_MEGAKERNEL
+(default OFF — the multi-kernel path remains the oracle), read at
+program-BUILD time like the prefix-prefill flag; see
+models/llama.py `resolve_decode_megakernel` and serving/README.md.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_compat import CompilerParams as _CompilerParams
+
+from .constraints import (KernelConstraint, LANE, VMEM_BUDGET_BYTES,
+                          missing_scale_finding, register_constraint)
+from .decode_attention import _on_tpu
+from .rope import rope_freqs
+
+_NEG_INF = -1e30
+
+# maximum pages the attention phase streams per inner grid step (the
+# multi-page inner step); the actual factor is the largest of
+# (PAGES_PER_STEP, ..., 1) dividing the table width that fits VMEM
+PAGES_PER_STEP = 4
+
+
+def _check_megakernel_shapes(shapes, dtypes):
+    """Checker for the megakernel pallas call. The rank-3 operand tail
+    is the streamed/committed pool tiles [pages*nkv, block, dh] — the
+    LAST rank-3 operand is always a pool commit ref (the dense-weight
+    layout puts the reshaped [nkv, group*dh, H] o-proj weight first, so
+    the head must not be read); the head-dim lane check and the
+    int8-pool-without-scales check are both shape-decidable here."""
+    out = []
+    arr = [s for s in shapes if len(s) == 3]
+    if not arr:
+        return out
+    d = arr[-1][-1]
+    if d % LANE:
+        out.append(("warning",
+                    f"head_dim {d} is not a multiple of the {LANE}-lane "
+                    "tile; every fused projection and streamed page tile "
+                    f"pads to {-(-d // LANE) * LANE} lanes"))
+    finding = missing_scale_finding(shapes, dtypes)
+    if finding is not None:
+        out.append(finding)
+    return out
+
+
+CONSTRAINT = register_constraint(KernelConstraint(
+    name="decode_megakernel",
+    kernel_fns=("_decode_megakernel_kernel",),
+    blocks={"pages_per_step": PAGES_PER_STEP},
+    note="fused per-layer decode step (rms + qkv + rope + paged "
+         "attention + commit + o-proj); streams whole (kv head, page) "
+         "tiles, so the table width should admit a pages_per_step "
+         "divisor and head_dim should be lane-aligned",
+    checker=_check_megakernel_shapes,
+    source="decode_megakernel.py",
+))
+
+
+def _unpack_weight(w, n_out, n_in):
+    """(array, scale_or_None, is_quant) for a decode weight: dense
+    [n_in, n_out], or the nn.quant weight-only pair (int8 [n_out, n_in],
+    per-channel scale [n_out]). Packed int4 (K//2 columns) returns
+    is_quant=None — the caller must fall back to the multi-kernel
+    path."""
+    if isinstance(w, tuple):
+        wq, sc = w
+        if wq.shape != (n_out, n_in):
+            return None, None, None  # packed int4 or foreign layout
+        return wq, sc.reshape(1, n_out).astype(jnp.float32), True
+    if w.shape != (n_in, n_out):
+        return None, None, None
+    return w, None, False
+
+
+def megakernel_supported(h, w_in, wq, wk, wv, wo, k_cache, v_cache,
+                         tables, *, k_scale=None, v_scale=None) -> str | None:
+    """None when `decode_layer_megakernel` can serve these operands, a
+    human-readable reason otherwise (the builders fall back to the
+    multi-kernel oracle path on any reason)."""
+    if h.ndim != 3 or h.shape[1] != 1:
+        return f"hidden states must be [b, 1, H], got {h.shape}"
+    b, _, H = h.shape
+    if k_cache.ndim != 4:
+        return f"paged pools required, got cache rank {k_cache.ndim}"
+    max_pages, nkv, bs, dh = k_cache.shape
+    if dh % 2:
+        return f"head_dim {dh} is odd (rotary needs paired halves)"
+    quant_kv = k_cache.dtype == jnp.int8
+    if quant_kv and (k_scale is None or v_scale is None):
+        return "int8 pools need k_scale/v_scale"
+    qs = []
+    for w, (no, ni) in ((wq, (None, H)), (wk, (nkv * dh, H)),
+                        (wv, (nkv * dh, H)), (wo, (H, None))):
+        if isinstance(w, tuple):
+            shp = w[0].shape
+        else:
+            shp = w.shape[::-1]
+        n_out = shp[0] if no is None else no
+        n_in = shp[1] if ni is None else ni
+        _, _, q = _unpack_weight(w, n_out, n_in)
+        if q is None:
+            return "unsupported weight layout (packed int4?)"
+        qs.append(q)
+    if len(set(qs)) != 1:
+        return "mixed dense/quantized projection weights"
+    nh = (wq[0].shape[0] if isinstance(wq, tuple) else wq.shape[1]) // dh
+    if nh % nkv:
+        return f"Hq {nh} not a multiple of Hkv {nkv}"
+    group = nh // nkv
+    # resident VMEM estimate: the four weight blocks (double-buffered
+    # across kv-head transitions) + page tiles + the [1, H] rows
+    itw = 1 if qs[0] else jnp.dtype(h.dtype).itemsize
+    kv_it = 1 if quant_kv else jnp.dtype(k_cache.dtype).itemsize
+    wbytes = H * group * dh * itw * 2 + H * dh * itw * 2  # wq+wo, wk+wv
+    pbytes = 2 * PAGES_PER_STEP * bs * dh * kv_it
+    if 2 * (wbytes + pbytes) > VMEM_BUDGET_BYTES:
+        return (f"weight blocks ({2 * (wbytes + pbytes)} bytes "
+                "double-buffered) exceed the VMEM budget")
+    return None
+
+
+def _fit_pages_per_step(w_tbl: int) -> int:
+    """Largest factor of the table width <= PAGES_PER_STEP — the
+    multi-page inner step streams this many pages per grid step."""
+    mp = min(PAGES_PER_STEP, w_tbl)
+    while w_tbl % mp:
+        mp -= 1
+    return mp
+
+
+def _make_kernel(*, H, nkv, group, dh, bs, n_inner, mp, scale, eps,
+                 quant_w, quant_kv):
+    """Build the fused layer-step kernel body. Refs are parsed
+    positionally from the static (quant_w, quant_kv, mp) layout the
+    wrapper constructs."""
+    dh2 = dh // 2
+    f32 = jnp.float32
+
+    def _decode_megakernel_kernel(*refs):
+        tbl_ref, len_ref = refs[0], refs[1]
+        h_ref, win_ref, cos_ref, sin_ref = refs[2:6]
+        i = 6
+        if quant_w:
+            (wq_ref, wqs_ref, wk_ref, wks_ref, wv_ref, wvs_ref,
+             wo_ref, wos_ref) = refs[i:i + 8]
+            i += 8
+        else:
+            wq_ref, wk_ref, wv_ref, wo_ref = refs[i:i + 4]
+            i += 4
+        kp_refs = refs[i:i + mp]; i += mp
+        vp_refs = refs[i:i + mp]; i += mp
+        ksc_refs = vsc_refs = ()
+        if quant_kv:
+            ksc_refs = refs[i:i + mp]; i += mp
+            vsc_refs = refs[i:i + mp]; i += mp
+        kcom_ref, vcom_ref = refs[i], refs[i + 1]; i += 2
+        kscom_ref = vscom_ref = None
+        if quant_kv:
+            kscom_ref, vscom_ref = refs[i], refs[i + 1]; i += 2
+        oh_ref, ok_ref, ov_ref = refs[i:i + 3]; i += 3
+        oks_ref = ovs_ref = None
+        if quant_kv:
+            oks_ref, ovs_ref = refs[i], refs[i + 1]; i += 2
+        (x_scr, q_scr, k_scr, v_scr, m_scr, l_scr, acc_scr,
+         out_scr) = refs[i:]
+
+        b = pl.program_id(0)
+        h_id = pl.program_id(1)
+        j = pl.program_id(2)
+        nj = pl.num_programs(2)
+        valid_until = len_ref[b]
+
+        @pl.when((j == 0) & (h_id == 0))
+        def _row_init():
+            # rms_norm once per row (f32 statistics, like _k_rms), and
+            # the o-proj accumulator this row's kv heads sum into
+            xr = h_ref[...].astype(f32)
+            var = jnp.mean(xr * xr, axis=-1, keepdims=True)
+            inv = jax.lax.rsqrt(var + eps)
+            x_scr[...] = (xr * inv
+                          * win_ref[...].astype(f32)).astype(x_scr.dtype)
+            out_scr[...] = jnp.zeros_like(out_scr)
+
+        @pl.when(j == 0)
+        def _qkv():
+            m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+            x = x_scr[...]
+            if quant_w:
+                xf = x.astype(f32)
+                qf = jax.lax.dot_general(
+                    xf, wq_ref[...].astype(f32), (((1,), (1,)), ((), ())),
+                    preferred_element_type=f32) * wqs_ref[...]
+                kf = jax.lax.dot_general(
+                    xf, wk_ref[...].astype(f32), (((1,), (1,)), ((), ())),
+                    preferred_element_type=f32) * wks_ref[...]
+                vf = jax.lax.dot_general(
+                    xf, wv_ref[...].astype(f32), (((1,), (1,)), ((), ())),
+                    preferred_element_type=f32) * wvs_ref[...]
+            else:
+                qf = jax.lax.dot_general(
+                    x, wq_ref[...], (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32)
+                kf = jax.lax.dot_general(
+                    x, wk_ref[...], (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32)
+                vf = jax.lax.dot_general(
+                    x, wv_ref[...], (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32)
+            cdt = x_scr.dtype
+            qv, kv_, vv = qf.astype(cdt), kf.astype(cdt), vf.astype(cdt)
+            # rotary: the [b, dh] cos/sin rows are position-only tables
+            # (values duplicated over the halves); application is the
+            # neox rotate-half, at the multi-kernel path's dtype
+            c = cos_ref[0:1, :dh2].astype(cdt)
+            s = sin_ref[0:1, :dh2].astype(cdt)
+            for g in range(group):
+                x1 = qv[:, g * dh:g * dh + dh2]
+                x2 = qv[:, g * dh + dh2:(g + 1) * dh]
+                q_scr[g:g + 1, :dh2] = x1 * c - x2 * s
+                q_scr[g:g + 1, dh2:] = x2 * c + x1 * s
+            k1, k2 = kv_[:, :dh2], kv_[:, dh2:]
+            k_scr[:, :dh2] = k1 * c - k2 * s
+            k_scr[:, dh2:] = k2 * c + k1 * s
+            v_scr[...] = vv
+
+        def _accum(s, v):
+            """One online-softmax step (the `_gqa_grid_body`
+            recurrence) over masked scores s [group, T], values
+            v [T, dh]."""
+            m_prev = m_scr[...]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev[:, :1], m_cur)
+            corr = jnp.exp(m_prev[:, :1] - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=f32)
+            acc_scr[...] = acc_scr[...] * corr + pv
+            m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+            l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+        # ---- attention phase: mp (kv head, page) tiles per inner step,
+        # positions masked STRICTLY below lens (the current token never
+        # round-trips through the pool — it joins from scratch below)
+        for m in range(mp):
+            col = (j - 1) * mp + m
+
+            @pl.when((j >= 1) & (j <= n_inner)
+                     & (col * bs < valid_until))
+            def _page(m=m, col=col):
+                q = q_scr[...].astype(f32)
+                k = kp_refs[m][0].astype(f32)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=f32) * scale
+                if quant_kv:
+                    s = s * ksc_refs[m][0, 0]
+                pos = col * bs + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(pos < valid_until, s, _NEG_INF)
+                v = vp_refs[m][0].astype(f32)
+                if quant_kv:
+                    v = v * vsc_refs[m][0, 0]
+                _accum(s, v)
+
+        # ---- final step: current token joins, context finalizes,
+        # o-proj accumulates, commit epilogue writes the page in place
+        @pl.when(j == nj - 1)
+        def _final():
+            q = q_scr[...].astype(f32)
+            kcur = k_scr[...].astype(f32)                # [1, dh]
+            s = jax.lax.dot_general(
+                q, kcur, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32) * scale      # [group, 1]
+            _accum(s, v_scr[...].astype(f32))
+            l = l_scr[:, :1]
+            ctx = (acc_scr[...]
+                   / jnp.where(l > 0.0, l, 1.0)).astype(x_scr.dtype)
+            contrib = jnp.zeros((1, H), f32)
+            for g in range(group):
+                cg = ctx[g:g + 1, :]
+                if quant_w:
+                    wslice = wo_ref[:, g * dh:(g + 1) * dh]   # [H, dh]
+                    contrib += jax.lax.dot_general(
+                        cg.astype(f32), wslice.astype(f32),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=f32)
+                else:
+                    wslice = wo_ref[0, g * dh:(g + 1) * dh, :]  # [dh, H]
+                    contrib += jax.lax.dot_general(
+                        cg, wslice, (((1,), (0,)), ((), ())),
+                        preferred_element_type=f32)
+            out_scr[...] += contrib
+
+            # commit epilogue: the q8 helpers' monotone-scale
+            # read-modify-write (slot 0 resets a recycled page's absmax
+            # chain), or the plain bf16 slot write — whole page stored,
+            # aliased in place
+            slot = valid_until % bs
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bs, dh), 0)
+            if quant_kv:
+                for tok_ref, com_ref, scom_ref, o_ref, os_ref in (
+                        (k_scr, kcom_ref, kscom_ref, ok_ref, oks_ref),
+                        (v_scr, vcom_ref, vscom_ref, ov_ref, ovs_ref)):
+                    tokf = tok_ref[...].astype(f32)          # [1, dh]
+                    amax = jnp.max(jnp.abs(tokf), axis=-1,
+                                   keepdims=True) / 127.0    # [1, 1]
+                    old = jnp.where(slot == 0, 0.0, scom_ref[0, 0])
+                    new = jnp.maximum(old, amax)
+                    safe = jnp.where(new > 0.0, new, 1.0)
+                    ratio = old / safe
+                    pg = jnp.round(com_ref[0].astype(f32) * ratio)
+                    qtok = jnp.round(tokf / safe)
+                    pg = jnp.where(rows == slot,
+                                   jnp.broadcast_to(qtok, (bs, dh)), pg)
+                    o_ref[0] = jnp.clip(pg, -127, 127).astype(jnp.int8)
+                    os_ref[...] = new
+            else:
+                ok_ref[0] = jnp.where(
+                    rows == slot,
+                    jnp.broadcast_to(k_scr[...], (bs, dh)),
+                    kcom_ref[0]).astype(ok_ref.dtype)
+                ov_ref[0] = jnp.where(
+                    rows == slot,
+                    jnp.broadcast_to(v_scr[...], (bs, dh)),
+                    vcom_ref[0]).astype(ov_ref.dtype)
+
+        @pl.when((j == nj - 1) & (h_id == nkv - 1))
+        def _residual():
+            proj = out_scr[...]
+            if quant_w:
+                proj = proj * wos_ref[...]
+            oh_ref[...] = (h_ref[...].astype(f32)
+                           + proj).astype(oh_ref.dtype)
+
+    return _decode_megakernel_kernel
+
+
+def decode_layer_megakernel(h, lens, tables, w_in, wq, wk, wv, wo,
+                            k_cache, v_cache, *, rope_base: float = 10000.0,
+                            eps: float = 1e-6, scale: float | None = None,
+                            k_scale=None, v_scale=None):
+    """One decoder layer's fused decode step.
+
+    h: [b, 1, H] residual stream; lens: [b] int32 cached token counts
+    (the current token's position); tables: [b, W] block table;
+    w_in: [H] rms weight; wq/wk/wv/wo: dense [K, N] arrays or
+    nn.quant weight-only pairs (int8 [N, K], scale [N]) — all four must
+    agree; k_cache/v_cache: [max_pages, nkv, block, dh] paged pools
+    (bf16/f32, or int8 with `k_scale`/`v_scale` [max_pages, nkv]).
+
+    Returns (h_out [b, 1, H], k_cache', v_cache') — or, for int8 pools,
+    (h_out, (k_cache', k_scale'), (v_cache', v_scale')) — with exactly
+    one page per (row, kv head) rewritten (the commit) and every other
+    page byte-identical (aliased in place).
+    """
+    reason = megakernel_supported(h, w_in, wq, wk, wv, wo, k_cache,
+                                  v_cache, tables, k_scale=k_scale,
+                                  v_scale=v_scale)
+    if reason is not None:
+        raise ValueError(f"decode megakernel unsupported here: {reason}")
+    b, _, H = h.shape
+    max_pages, nkv, bs, dh = k_cache.shape
+    w_tbl = tables.shape[1]
+    quant_kv = k_cache.dtype == jnp.int8
+    nh = (wq[0].shape[0] if isinstance(wq, tuple) else wq.shape[1]) // dh
+    group = nh // nkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    mp = _fit_pages_per_step(w_tbl)
+    n_inner = w_tbl // mp
+    nj = n_inner + 2
+    gdh = group * dh
+    cdt = h.dtype
+
+    h2d = h.reshape(b, H)
+    win2 = w_in.reshape(1, H)
+    # position-only rotary tables from the one shared rope_freqs,
+    # duplicated over the halves so the kernel block stays lane-aligned
+    # at dh (the kernel reads only [:dh/2])
+    cos_h, sin_h = rope_freqs(0, dh, rope_base,
+                              position_ids=lens)         # [b, dh/2] f32
+    cos_t = jnp.concatenate([cos_h, cos_h], axis=-1)
+    sin_t = jnp.concatenate([sin_h, sin_h], axis=-1)
+
+    wq_a, wq_s, quant_w = _unpack_weight(wq, nh * dh, H)
+    wk_a, wk_s, _ = _unpack_weight(wk, nkv * dh, H)
+    wv_a, wv_s, _ = _unpack_weight(wv, nkv * dh, H)
+    wo_a, wo_s, _ = _unpack_weight(wo, H, nh * dh)
+
+    # pools collapse (page, kv head) -> one row axis, like the paged GQA
+    # decode kernel: page selection is tbl[b, i]*nkv + h
+    kc2 = k_cache.reshape(max_pages * nkv, bs, dh)
+    vc2 = v_cache.reshape(max_pages * nkv, bs, dh)
+    if quant_kv:
+        ksc2 = k_scale.astype(jnp.float32).reshape(max_pages * nkv, 1)
+        vsc2 = v_scale.astype(jnp.float32).reshape(max_pages * nkv, 1)
+
+    def row_map(b_, h_, j_, tbl, lens_):
+        return (b_, 0)
+
+    def const_map(b_, h_, j_, tbl, lens_):
+        return (0, 0)
+
+    def stream_map_m(m):
+        def _map(b_, h_, j_, tbl, lens_):
+            # pin pad pages (and the non-attention steps) to the row's
+            # last live page so skipped tiles are never DMA'd
+            col = jnp.clip((j_ - 1) * mp + m, 0, w_tbl - 1)
+            last = jnp.maximum((lens_[b_] - 1) // bs, 0)
+            col = jnp.minimum(col, last)
+            return (tbl[b_, col] * nkv + h_, 0, 0)
+        return _map
+
+    def stream_scale_map_m(m):
+        def _map(b_, h_, j_, tbl, lens_):
+            col = jnp.clip((j_ - 1) * mp + m, 0, w_tbl - 1)
+            last = jnp.maximum((lens_[b_] - 1) // bs, 0)
+            col = jnp.minimum(col, last)
+            return (tbl[b_, col] * nkv + h_, 0)
+        return _map
+
+    def commit_map(b_, h_, j_, tbl, lens_):
+        # the page the current token lands in (clamped like the XLA
+        # gather for frozen rows whose lens sits at the budget edge)
+        i = jnp.minimum(lens_[b_] // bs, w_tbl - 1)
+        return (tbl[b_, i] * nkv + h_, 0, 0)
+
+    def commit_scale_map(b_, h_, j_, tbl, lens_):
+        i = jnp.minimum(lens_[b_] // bs, w_tbl - 1)
+        return (tbl[b_, i] * nkv + h_, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, H), row_map),          # h
+        pl.BlockSpec((1, H), const_map),        # w_in
+        pl.BlockSpec((1, dh), row_map),         # cos
+        pl.BlockSpec((1, dh), row_map),         # sin
+    ]
+    operands = [h2d, win2, cos_t, sin_t]
+    if quant_w:
+        in_specs += [
+            pl.BlockSpec((gdh, H), lambda b_, h_, j_, t, l: (h_, 0)),
+            pl.BlockSpec((1, gdh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((dh, H), lambda b_, h_, j_, t, l: (h_, 0)),
+            pl.BlockSpec((1, dh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((dh, H), lambda b_, h_, j_, t, l: (h_, 0)),
+            pl.BlockSpec((1, dh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((H, gdh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((1, H), const_map),
+        ]
+        operands += [wq_a, wq_s, wk_a, wk_s, wv_a, wv_s, wo_a, wo_s]
+    else:
+        wo3 = wo_a.reshape(nkv, gdh, H)
+        in_specs += [
+            pl.BlockSpec((H, gdh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((H, dh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((H, dh), lambda b_, h_, j_, t, l: (0, h_)),
+            pl.BlockSpec((1, gdh, H),
+                         lambda b_, h_, j_, t, l: (h_, 0, 0)),
+        ]
+        operands += [wq_a, wk_a, wv_a, wo3]
+    for m in range(mp):
+        in_specs.append(pl.BlockSpec((1, bs, dh), stream_map_m(m)))
+        operands.append(kc2)
+    for m in range(mp):
+        in_specs.append(pl.BlockSpec((1, bs, dh), stream_map_m(m)))
+        operands.append(vc2)
+    if quant_kv:
+        for m in range(mp):
+            in_specs.append(pl.BlockSpec((1, 1), stream_scale_map_m(m)))
+            operands.append(ksc2)
+        for m in range(mp):
+            in_specs.append(pl.BlockSpec((1, 1), stream_scale_map_m(m)))
+            operands.append(vsc2)
+    # commit refs (the aliased read-modify-write operands)
+    commit_base = 2 + len(operands)  # call-arg index incl. the 2 prefetch
+    in_specs += [pl.BlockSpec((1, bs, dh), commit_map),
+                 pl.BlockSpec((1, bs, dh), commit_map)]
+    operands += [kc2, vc2]
+    if quant_kv:
+        in_specs += [pl.BlockSpec((1, 1), commit_scale_map),
+                     pl.BlockSpec((1, 1), commit_scale_map)]
+        operands += [ksc2, vsc2]
+
+    out_specs = [
+        pl.BlockSpec((1, H), row_map),
+        pl.BlockSpec((1, bs, dh), commit_map),
+        pl.BlockSpec((1, bs, dh), commit_map),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, H), cdt),
+        jax.ShapeDtypeStruct(kc2.shape, kc2.dtype),
+        jax.ShapeDtypeStruct(vc2.shape, vc2.dtype),
+    ]
+    aliases = {commit_base: 1, commit_base + 1: 2}
+    if quant_kv:
+        out_specs += [pl.BlockSpec((1, 1), commit_scale_map),
+                      pl.BlockSpec((1, 1), commit_scale_map)]
+        out_shape += [jax.ShapeDtypeStruct(ksc2.shape, jnp.float32),
+                      jax.ShapeDtypeStruct(vsc2.shape, jnp.float32)]
+        aliases[commit_base + 2] = 3
+        aliases[commit_base + 3] = 4
+
+    kernel = _make_kernel(H=H, nkv=nkv, group=group, dh=dh, bs=bs,
+                          n_inner=n_inner, mp=mp, scale=scale, eps=eps,
+                          quant_w=quant_w, quant_kv=quant_kv)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nkv, nj),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((1, H), cdt),        # x (post-rms)
+                pltpu.VMEM((group, dh), cdt),   # q (rotary-applied)
+                pltpu.VMEM((1, dh), cdt),       # k current token
+                pltpu.VMEM((1, dh), cdt),       # v current token
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, dh), jnp.float32),
+                pltpu.VMEM((1, H), jnp.float32),  # o-proj accumulator
+            ],
+        ),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=not _on_tpu(),
+    )(tables.astype(jnp.int32), lens.astype(jnp.int32), *operands)
+
+    h_out = out[0].reshape(b, 1, H)
+    kc_new = out[1].reshape(max_pages, nkv, bs, dh)
+    vc_new = out[2].reshape(max_pages, nkv, bs, dh)
+    if quant_kv:
+        ksc_new = out[3].reshape(max_pages, nkv)
+        vsc_new = out[4].reshape(max_pages, nkv)
+        return h_out, (kc_new, ksc_new), (vc_new, vsc_new)
+    return h_out, kc_new, vc_new

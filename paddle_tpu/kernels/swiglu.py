@@ -27,8 +27,47 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_compat import CompilerParams as _CompilerParams
 
+from .constraints import (KernelConstraint, LANE, SUBLANE,
+                          register_constraint)
+
 
 _BLOCK = 512  # default tile edge; alignment and the pallas paths share it
+
+
+def _check_swiglu_shapes(shapes, dtypes):
+    """Checker for the fused swiglu pallas calls. Operands are x2d
+    [M, K] then wg/wu [K, F] (+ dout [M, F] in backward); the wrapper
+    already routes non-_BLOCK-divisible shapes to the XLA path, so what
+    remains shape-decidable here is hardware-tile alignment of the dims
+    the kernel actually tiles."""
+    out = []
+    arr = [s for s in shapes if len(s) == 2]
+    if len(arr) < 3:
+        return out
+    (m, k), (_, f) = arr[0], arr[1]
+    sub = SUBLANE.get(dtypes[0], 8) if dtypes else 8
+    if m % sub:
+        out.append(("warning",
+                    f"M={m} is not a multiple of the {sub}-row sublane "
+                    "tile; every x tile pads its rows"))
+    for name, v in (("K", k), ("F", f)):
+        if v % LANE:
+            out.append(("warning",
+                        f"{name}={v} is not a multiple of the {LANE}-"
+                        "lane tile; the MXU pads the contraction"))
+    return out
+
+
+CONSTRAINT = register_constraint(KernelConstraint(
+    name="swiglu",
+    kernel_fns=("_swiglu_fwd_kernel", "_swiglu_bwd_kernel"),
+    blocks={"block": _BLOCK},
+    note="fused gate/up matmul + silu-mul; opt-in (fused=True) — XLA's "
+         "dual-matmul schedule wins at the bench MLP shape, see "
+         "swiglu_matmul",
+    checker=_check_swiglu_shapes,
+    source="swiglu.py",
+))
 
 
 def _aligned(m: int, f: int, k: int) -> bool:
@@ -50,7 +89,8 @@ def _swiglu_ref(x, wg, wu):
 # ---------------------------------------------------------------------------
 # forward kernel: grid (M/bm, F/bf, K/bk), k innermost accumulation
 # ---------------------------------------------------------------------------
-def _fwd_kernel(x_ref, wg_ref, wu_ref, o_ref, acc_g, acc_u, *, n_k: int):
+def _swiglu_fwd_kernel(x_ref, wg_ref, wu_ref, o_ref, acc_g, acc_u, *,
+                       n_k: int):
     @pl.when(pl.program_id(2) == 0)
     def _init():
         acc_g[...] = jnp.zeros_like(acc_g)
@@ -79,7 +119,7 @@ def _fwd_pallas(x2d, wg, wu, *, bm: int = _BLOCK, bf: int = _BLOCK,
     n_k = k // bk
     grid = (m // bm, f // bf, n_k)
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, n_k=n_k),
+        functools.partial(_swiglu_fwd_kernel, n_k=n_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
@@ -99,8 +139,8 @@ def _fwd_pallas(x2d, wg, wu, *, bm: int = _BLOCK, bf: int = _BLOCK,
 # ---------------------------------------------------------------------------
 # backward kernel: recompute gate/up per tile, emit dh_g and dh_u
 # ---------------------------------------------------------------------------
-def _bwd_kernel(x_ref, wg_ref, wu_ref, g_ref, dg_ref, du_ref, acc_g, acc_u,
-                *, n_k: int):
+def _swiglu_bwd_kernel(x_ref, wg_ref, wu_ref, g_ref, dg_ref, du_ref,
+                       acc_g, acc_u, *, n_k: int):
     @pl.when(pl.program_id(2) == 0)
     def _init():
         acc_g[...] = jnp.zeros_like(acc_g)
@@ -139,7 +179,7 @@ def _bwd_pallas(x2d, wg, wu, dout, *, bm: int = _BLOCK, bf: int = _BLOCK,
     n_k = k // bk
     grid = (m // bm, f // bf, n_k)
     return pl.pallas_call(
-        functools.partial(_bwd_kernel, n_k=n_k),
+        functools.partial(_swiglu_bwd_kernel, n_k=n_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),

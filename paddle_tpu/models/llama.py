@@ -506,14 +506,16 @@ class LlamaForCausalLM(Layer):
                              "cache_layout='paged'")
         params = dict(self.raw_state())
         dec_params = self._decode_params(params, quant)
-        # the paged program bakes the pool dtype in at build time, so the
-        # flag joins the cache key (flipping it must not serve a stale
-        # bf16 — or int8 — compiled program)
+        # the paged program bakes the pool dtype AND the megakernel
+        # choice in at build time, so both flags join the cache key
+        # (flipping either must not serve a stale compiled program)
         kv_dtype = resolve_kv_cache_dtype() if cache_layout == "paged" \
             else None
+        megakernel = resolve_decode_megakernel() \
+            if cache_layout == "paged" else None
         sig = (b, sb, max_new_tokens, eos_token_id, do_sample, int(top_k),
                quant, prefill_with_quant, cache_layout, kv_block_size,
-               kv_dtype)
+               kv_dtype, megakernel)
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = {}
@@ -949,6 +951,117 @@ def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str] = None) -> str:
     return kv_cache_dtype
 
 
+def resolve_decode_megakernel(decode_megakernel: Optional[bool] = None) \
+        -> bool:
+    """Whether paged decode programs should fuse the per-layer step into
+    the decode megakernel (kernels/decode_megakernel.py), from the
+    argument or FLAGS_decode_megakernel / PADDLE_TPU_DECODE_MEGAKERNEL.
+    Read at program-BUILD time (like FLAGS_prefix_prefill_kernel and
+    FLAGS_kv_cache_dtype): flip it before constructing or warming an
+    engine. Default OFF — the multi-kernel path is the oracle."""
+    if decode_megakernel is None:
+        from ..framework.flags import flag as _flag
+
+        return bool(_flag("decode_megakernel"))
+    return bool(decode_megakernel)
+
+
+def _megakernel_reason(cfg, b, p, kcs, vcs, tables) -> Optional[str]:
+    """None when the megakernel can serve this decode step's operands
+    (layer-0 weights stand in for every layer — `_decode_params`
+    quantizes them uniformly), else the reason the builder must fall
+    back to the multi-kernel path. Pure shape logic, runnable under
+    trace."""
+    from ..kernels.decode_megakernel import megakernel_supported
+
+    kc0, vc0 = kcs[0], vcs[0]
+    ksc = vsc = None
+    if isinstance(kc0, tuple):
+        (kc0, ksc), (vc0, vsc) = kc0, vc0
+    H = cfg.hidden_size
+    pre = "llama.layers.0."
+    h_spec = jax.ShapeDtypeStruct(
+        (b, 1, H), p["llama.embed_tokens.weight"].dtype)
+    return megakernel_supported(
+        h_spec, p[pre + "input_layernorm.weight"],
+        p[pre + "self_attn.q_proj.weight"],
+        p[pre + "self_attn.k_proj.weight"],
+        p[pre + "self_attn.v_proj.weight"],
+        p[pre + "self_attn.o_proj.weight"],
+        kc0, vc0, tables, k_scale=ksc, v_scale=vsc)
+
+
+def _megakernel_or_fallback_step(cfg, b, tables, p, kcs, vcs, base):
+    """The fused decode step when the megakernel supports these
+    operands, else `base` (the multi-kernel oracle) with a warning
+    naming the reason — the ONE fallback seam both
+    `build_paged_generate` and the serving engine's decode-chunk
+    builder go through."""
+    reason = _megakernel_reason(cfg, b, p, kcs, vcs, tables)
+    if reason is not None:
+        import warnings
+
+        warnings.warn(
+            "decode_megakernel requested but unsupported here "
+            f"({reason}); serving the multi-kernel path", stacklevel=3)
+        return base
+    return _make_decode_step_megakernel(cfg, b, tables)
+
+
+def _make_decode_step_megakernel(cfg, b, tables):
+    """`_make_decode_step`'s paged twin with the whole attention block —
+    rms_norm, QKV projection, rotary, paged-KV commit (int8 epilogue
+    included) paged GQA attention, o-proj + residual — fused into ONE
+    Pallas call per layer (kernels/decode_megakernel.py). The MLP half
+    and the lm head keep the shared `_mm`/`_k_rms` path, so the same
+    decode-params dict serves both step implementations."""
+    from ..kernels.decode_megakernel import decode_layer_megakernel
+
+    n_layers = cfg.num_hidden_layers
+    eps = cfg.rms_norm_eps
+    head_logits = _make_head_logits(cfg)
+
+    def decode_step(p, kcs, vcs, tok, pos):
+        h = p["llama.embed_tokens.weight"][tok[:, 0]][:, None, :]
+        if getattr(pos, "ndim", 0) == 1:
+            lens = pos.astype(jnp.int32)
+        else:
+            lens = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+        new_kcs, new_vcs = [], []
+        for i in range(n_layers):
+            pre = f"llama.layers.{i}."
+            kc, vc = kcs[i], vcs[i]
+            if isinstance(kc, tuple):
+                (kcp, ksc), (vcp, vsc) = kc, vc
+                h, kc_new, vc_new = decode_layer_megakernel(
+                    h, lens, tables, p[pre + "input_layernorm.weight"],
+                    p[pre + "self_attn.q_proj.weight"],
+                    p[pre + "self_attn.k_proj.weight"],
+                    p[pre + "self_attn.v_proj.weight"],
+                    p[pre + "self_attn.o_proj.weight"], kcp, vcp,
+                    rope_base=cfg.rope_theta, eps=eps, k_scale=ksc,
+                    v_scale=vsc)
+            else:
+                h, kc_new, vc_new = decode_layer_megakernel(
+                    h, lens, tables, p[pre + "input_layernorm.weight"],
+                    p[pre + "self_attn.q_proj.weight"],
+                    p[pre + "self_attn.k_proj.weight"],
+                    p[pre + "self_attn.v_proj.weight"],
+                    p[pre + "self_attn.o_proj.weight"], kc, vc,
+                    rope_base=cfg.rope_theta, eps=eps)
+            new_kcs.append(kc_new)
+            new_vcs.append(vc_new)
+            x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
+            gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+            up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+            h = h + _mm(jax.nn.silu(gate) * up,
+                        p[pre + "mlp.down_proj.weight"])
+        h = _k_rms(h, p["llama.norm.weight"], eps)
+        return head_logits(h, p)[:, -1], new_kcs, new_vcs
+
+    return decode_step
+
+
 def quantize_kv_pages(kv):
     """Symmetric absmax int8 quantization of whole K/V pages.
 
@@ -1305,6 +1418,7 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     pages_per_seq = -(-total // block_size)
     n_pre = sb // block_size
     quant_kv = resolve_kv_cache_dtype() == "int8"
+    use_mega = resolve_decode_megakernel()
 
     head_logits = _make_head_logits(cfg)
     base_prefill = _make_prefill(cfg, b, sb)
@@ -1349,7 +1463,11 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     def make_decode_step(tables):
         """The shared per-layer decode body (_make_decode_step) with the
         KV store swapped for page/slot scatter + table-indirect attention;
-        `pos` is the per-sequence [b] length vector (ragged batch)."""
+        `pos` is the per-sequence [b] length vector (ragged batch). With
+        FLAGS_decode_megakernel (read when this factory ran — program-
+        BUILD time) the whole attention block fuses into one Pallas call
+        per layer; unsupported shapes fall back to this multi-kernel
+        oracle path with a warning."""
         _, kv_write = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
                                             tables)
         if quant_kv:
@@ -1359,8 +1477,16 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
         def kv_attend(q1, kc, vc, lens):
             return paged_attn(q1, kc, vc, tables, lens)
 
-        return _make_decode_step(cfg, b, kv_write=kv_write,
+        base = _make_decode_step(cfg, b, kv_write=kv_write,
                                  kv_attend=kv_attend)
+        if not use_mega:
+            return base
+
+        def step(p, kcs, vcs, tok, pos):
+            return _megakernel_or_fallback_step(
+                cfg, b, tables, p, kcs, vcs, base)(p, kcs, vcs, tok, pos)
+
+        return step
 
     def run(p_dec, ids, s0_vec, tables, key, temperature, top_p):
         dtype = p_dec["llama.embed_tokens.weight"].dtype

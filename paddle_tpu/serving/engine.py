@@ -74,9 +74,11 @@ import numpy as np
 
 from ..models.llama import (PagedKVManager, _make_decode_step,
                             _make_head_logits, _make_prefill,
-                            _make_prefill_with_prefix, _sample_next,
+                            _make_prefill_with_prefix,
+                            _megakernel_or_fallback_step, _sample_next,
                             hash_prefix_blocks, make_paged_kv_helpers,
                             make_paged_kv_q8_helpers,
+                            resolve_decode_megakernel,
                             resolve_kv_cache_dtype)
 from ..resilience import chaos
 
@@ -169,7 +171,8 @@ class ContinuousBatchingEngine:
                  top_p: float = 1.0, seed: int = 0, dtype=jnp.bfloat16,
                  prefix_cache: bool = True, double_buffer: bool = False,
                  kv_cache_dtype: Optional[str] = None,
-                 kv_pool_bytes: Optional[int] = None):
+                 kv_pool_bytes: Optional[int] = None,
+                 decode_megakernel: Optional[bool] = None):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
         paged-pool element type: int8 pools halve the HBM bytes every
@@ -205,6 +208,11 @@ class ContinuousBatchingEngine:
         # FLAGS_prefix_prefill_kernel); it also joins the program-cache
         # keys so the compile-point helpers can never mix dtypes
         self.kv_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
+        # fused per-layer decode step (FLAGS_decode_megakernel /
+        # `decode_megakernel=`), likewise read HERE at build time: the
+        # decode-chunk program is compiled once per engine, so the flag
+        # is part of this engine's identity (warm() covers it)
+        self.use_megakernel = resolve_decode_megakernel(decode_megakernel)
         # pool capacity: every slot simultaneously full-length at the
         # ENGINE budget, +1 scratch page. Per-request reservations are
         # never larger — _plan TRIMS a cached prefix until the hit
@@ -492,9 +500,12 @@ class ContinuousBatchingEngine:
         steps = self.steps
         do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
         quant = self.kv_dtype == "int8"
+        use_mega = self.use_megakernel
 
-        def run(p, kcs, vcs, toks, lens, budgets, tables, live, key,
-                temperature, top_p):
+        def make_step(tables, p, kcs, vcs):
+            """Per-layer decode body for one chunk: the megakernel
+            (FLAGS_decode_megakernel) when enabled and supported for
+            these operand shapes, else the multi-kernel oracle path."""
             if quant:
                 _, kv_write = make_paged_kv_q8_helpers(
                     b, 0, cfg.num_key_value_heads, cfg.head_dim, bs,
@@ -514,8 +525,16 @@ class ContinuousBatchingEngine:
                     return paged_decode_attention(q1, kc, vc, tables,
                                                   lens_)
 
-            decode_step = _make_decode_step(cfg, b, kv_write=kv_write,
-                                            kv_attend=kv_attend)
+            base = _make_decode_step(cfg, b, kv_write=kv_write,
+                                     kv_attend=kv_attend)
+            if not use_mega:
+                return base
+            return _megakernel_or_fallback_step(cfg, b, tables, p, kcs,
+                                                vcs, base)
+
+        def run(p, kcs, vcs, toks, lens, budgets, tables, live, key,
+                temperature, top_p):
+            decode_step = make_step(tables, p, kcs, vcs)
 
             def step(carry, _):
                 tok, lens_, kcs_, vcs_, done, key_ = carry

@@ -117,8 +117,10 @@ class TestKernelConstraints:
         assert not diags(r, "TPU102")
 
     def test_generic_kernel_name_needs_matching_source(self):
-        # swiglu also names its kernel `_fwd_kernel`; the source hint
-        # must keep it from inheriting flash_attention's checker
+        # a foreign module reusing the generic `_fwd_kernel` name (as
+        # swiglu did before joining the registry under unique names)
+        # must not inherit flash_attention's checker: the source hint
+        # gates the match
         from paddle_tpu.kernels.constraints import constraint_for_kernel_fn
 
         assert constraint_for_kernel_fn(
@@ -186,6 +188,155 @@ class TestPrefixPrefillConstraint:
         assert c.blocks["block_q"] == pp.BLOCK_Q
         assert c.blocks["block_s"] == pp.BLOCK_S
         assert "_prefix_prefill_kernel" in c.kernel_fns
+
+
+# ---------------------------------------------------------------------------
+# TPU105: fusion-miss (dispatch-bound loop bodies)
+# ---------------------------------------------------------------------------
+
+class TestFusionMiss:
+    """TPU105: a scan body lowering to more distinct small-output
+    pallas/dot launches than the fusion budget is dispatch-bound (the
+    decode-step shape the megakernel collapses)."""
+
+    @staticmethod
+    def _scan_body_graph(n_dots, size=8):
+        # n_dots dots of DISTINCT shapes, each with a tiny output,
+        # inside a scan — a synthetic dispatch-bound decode step
+        ws = [jnp.ones((size + i, size + i), jnp.float32)
+              for i in range(n_dots)]
+
+        def f(x):
+            def body(c, _):
+                out = 0.0
+                for i, w in enumerate(ws):
+                    v = jnp.ones((1, size + i), jnp.float32) * c
+                    out = out + jnp.sum(v @ w)
+                return out, out
+
+            c, _ = jax.lax.scan(body, x, None, length=4)
+            return c
+
+        return analysis.analyze(f, jnp.asarray(1.0, jnp.float32),
+                                rules=["TPU105"])
+
+    def test_many_distinct_small_launches_flagged(self):
+        found = diags(self._scan_body_graph(9), "TPU105")
+        assert found and found[0].severity == Severity.WARNING
+        assert "distinct small-output kernel launches" in found[0].message
+        assert "decode_megakernel" in (found[0].hint or "")
+
+    def test_within_budget_clean(self):
+        assert not diags(self._scan_body_graph(3), "TPU105")
+
+    def test_repeated_layers_count_once(self):
+        """A 32-layer stack of IDENTICAL shapes is one distinct launch
+        per op, not 32 — depth must not fire the rule."""
+        w = jnp.ones((8, 8), jnp.float32)
+
+        def f(x):
+            def body(c, _):
+                out = c
+                for _ in range(32):   # same shapes every "layer"
+                    out = jnp.sum(jnp.ones((1, 8), jnp.float32) * out @ w)
+                return out, out
+
+            c, _ = jax.lax.scan(body, x, None, length=4)
+            return c
+
+        r = analysis.analyze(f, jnp.asarray(1.0, jnp.float32),
+                             rules=["TPU105"])
+        assert not diags(r, "TPU105")
+
+    def test_big_outputs_not_counted(self):
+        """Launches whose results are large do real bandwidth work —
+        they are not fusion misses."""
+        ws = [jnp.ones((512, 600 + 8 * i), jnp.float32)
+              for i in range(9)]
+
+        def f(x):
+            def body(c, _):
+                out = 0.0
+                for w in ws:  # each output ~1.2 MiB
+                    out = out + jnp.sum(
+                        (jnp.ones((512, 512), jnp.float32) * c) @ w)
+                return out, out
+
+            c, _ = jax.lax.scan(body, x, None, length=2)
+            return c
+
+        r = analysis.analyze(f, jnp.asarray(1.0, jnp.float32),
+                             rules=["TPU105"])
+        assert not diags(r, "TPU105")
+
+    def test_outside_loop_not_flagged(self):
+        ws = [jnp.ones((8 + i, 8 + i), jnp.float32) for i in range(9)]
+
+        def f(x):
+            out = 0.0
+            for i, w in enumerate(ws):
+                out = out + jnp.sum(jnp.ones((1, 8 + i),
+                                             jnp.float32) * x @ w)
+            return out
+
+        r = analysis.analyze(f, jnp.asarray(1.0, jnp.float32),
+                             rules=["TPU105"])
+        assert not diags(r, "TPU105")
+
+    def test_decode_step_shape_fires_and_megakernel_shrinks(self):
+        """The real thing: a tiny multi-kernel paged decode step inside
+        a scan trips TPU105; the megakernel step at the same shape
+        stays under the budget."""
+        import dataclasses
+
+        from paddle_tpu.kernels.decode_attention import (
+            paged_decode_attention)
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.llama import (
+            _make_decode_step, _make_decode_step_megakernel,
+            make_paged_kv_helpers)
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  num_key_value_heads=2)
+        paddle.seed(3)
+        params = dict(LlamaForCausalLM(cfg).raw_state())
+        b, bs, W = 2, 8, 2
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        tables = jnp.asarray(np.arange(b * W).reshape(b, W) + 1,
+                             jnp.int32)
+        pools = lambda: [jnp.zeros((b * W + 1, nkv, bs, dh),
+                                   jnp.float32)
+                         for _ in range(cfg.num_hidden_layers)]
+        _, kv_write = make_paged_kv_helpers(b, 0, nkv, dh, bs, tables)
+        base = _make_decode_step(
+            cfg, b, kv_write=kv_write,
+            kv_attend=lambda q1, kc, vc, lens: paged_decode_attention(
+                q1, kc, vc, tables, lens))
+        mega = _make_decode_step_megakernel(cfg, b, tables)
+
+        def chunk(step):
+            def run(tok, lens, kcs, vcs):
+                def body(carry, _):
+                    tok, lens, kcs, vcs = carry
+                    logits, kcs, vcs = step(params, kcs, vcs,
+                                            tok[:, None], lens)
+                    return (jnp.argmax(logits, -1).astype(tok.dtype),
+                            lens + 1, kcs, vcs), ()
+
+                carry, _ = jax.lax.scan(
+                    body, (tok, lens, kcs, vcs), None, length=2)
+                return carry[0]
+
+            return run
+
+        tok = jnp.ones((b,), jnp.int32)
+        lens = jnp.full((b,), 3, jnp.int32)
+        r_base = analysis.analyze(chunk(base), tok, lens, pools(),
+                                  pools(), rules=["TPU105"])
+        r_mega = analysis.analyze(chunk(mega), tok, lens, pools(),
+                                  pools(), rules=["TPU105"])
+        assert diags(r_base, "TPU105")
+        assert not diags(r_mega, "TPU105")
 
 
 # ---------------------------------------------------------------------------
