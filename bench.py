@@ -402,19 +402,19 @@ def _op_bench(only=None):
             }
         del gp
 
-    if want("serving_decode_chunk"):
-        # the engine's decode hot loop under the gate (ISSUE 3): one
-        # steps_per_sync=16 chunk for 8 slots over the PAGED pools —
-        # the program ContinuousBatchingEngine re-dispatches for every
-        # scheduling sync, so a regression in the paged decode kernel,
-        # the scan, or the per-chunk dispatch glue shows up in the
-        # bench trajectory. Chunks are timed by chaining N donated
-        # invocations and syncing once (the slope cancels the fixed
-        # tunnel RTT, same as every other row).
+    def _serving_chunk_harness(serving_mp=1):
+        """The 1B engine decode-chunk timing rig shared by the
+        serving_decode_chunk and decode_step_1b_mp rows: an 8-slot
+        steps_per_sync=16 engine whose chunks are timed by chaining N
+        donated invocations and syncing once (the slope cancels the
+        fixed tunnel RTT). budget == lens freezes every row at a
+        representative mid-generation context (full per-step compute
+        incl. paged attention over 96 cached tokens, writes aimed at
+        the scratch page, constant cost per chunk — slope-stable).
+        Returns (engine, run) with `run` compiled once."""
         from paddle_tpu.models import (LlamaConfig,
                                        init_quant_serving_params)
         from paddle_tpu.serving import ContinuousBatchingEngine
-        from bench_util import paired_slope_ms
 
         scfg = LlamaConfig.llama_1b(dtype="bfloat16")
         sp = init_quant_serving_params(scfg, "weight_only_int8", seed=0)
@@ -422,19 +422,20 @@ def _op_bench(only=None):
         eng = ContinuousBatchingEngine(
             scfg, sp, slots=8, prompt_bucket=128, max_prompt_len=128,
             max_new_tokens=64, block_size=64, steps_per_sync=16,
-            prefill_batch=1, prefix_cache=False)
+            prefill_batch=1, prefix_cache=False, serving_mp=serving_mp,
+            # pinned: the decode_step_1b_mp gather-bytes formula below
+            # describes the multi-kernel path's bf16 o-proj all-gather;
+            # the megakernel TP path's collective is an f32 psum at
+            # full hidden width (its own row when the default flips)
+            decode_megakernel=False)
         stables = jnp.full((eng.slots, eng.table_width), eng.scratch_page,
                            jnp.int32)
         slive = jnp.ones((eng.slots,), bool)
-        # budget == lens freezes every row at a representative mid-
-        # generation context (full per-step compute incl. paged
-        # attention over 96 cached tokens, writes aimed at the scratch
-        # page, constant cost per chunk — slope-stable)
         slens = jnp.full((eng.slots,), 96, jnp.int32)
         sone = jnp.asarray(1.0, jnp.float32)
         skey = jax.random.PRNGKey(0)
 
-        def srun(n):
+        def run(n):
             toks, lens = jnp.zeros((eng.slots,), jnp.int32), slens
             for _ in range(int(n)):
                 out, lens, _, eng.kcs, eng.vcs = eng._decode(
@@ -443,10 +444,48 @@ def _op_bench(only=None):
                 toks = out[:, -1]
             return float(jnp.sum(lens))
 
-        srun(1)  # compile once
+        run(1)  # compile once
+        return eng, run
+
+    if want("serving_decode_chunk"):
+        # the engine's decode hot loop under the gate (ISSUE 3): one
+        # steps_per_sync=16 chunk for 8 slots over the PAGED pools —
+        # the program ContinuousBatchingEngine re-dispatches for every
+        # scheduling sync, so a regression in the paged decode kernel,
+        # the scan, or the per-chunk dispatch glue shows up in the
+        # bench trajectory.
+        from bench_util import paired_slope_ms
+
+        eng, srun = _serving_chunk_harness()
         ops["serving_decode_chunk"] = round(
             paired_slope_ms(srun, 1, 13, pairs=6), 4)
-        del sp, eng
+        del eng, srun
+
+    if want("decode_step_1b_mp") and len(jax.devices()) >= 2:
+        # tensor-parallel serving decode (ISSUE 7): the SAME chunk rig,
+        # kv-head-sharded across an mp=2 mesh (FLAGS_serving_mp) — the
+        # per-layer o-proj activation all-gather is the one cross-chip
+        # collective, and bytes_all_gathered_per_token in OPBENCH's
+        # `info` records its per-chip wire cost per decoded token (the
+        # number the EQuARX-style quantized all-gather follow-up will
+        # halve; TPU401's collective-size lint watches the same seam).
+        # Skipped (row absent, nothing gates) on single-device runs.
+        from bench_util import paired_slope_ms
+
+        teng, trun = _serving_chunk_harness(serving_mp=2)
+        ops["decode_step_1b_mp"] = round(
+            paired_slope_ms(trun, 1, 13, pairs=6), 4)
+        # per decoded token per chip: every layer all-gathers the
+        # [b, 1, nh_local*dh] bf16 o-proj activations — each chip
+        # RECEIVES (mp-1)/mp of the full head axis
+        mp_, tcfg = teng.mp, teng.cfg
+        OP_INFO["decode_step_1b_mp"] = {
+            "mp": mp_,
+            "bytes_all_gathered_per_token": int(
+                tcfg.num_hidden_layers * tcfg.num_attention_heads
+                * tcfg.head_dim * 2 * (mp_ - 1) // mp_),
+        }
+        del teng, trun
 
     # eager dispatch overhead: one tiny op, eager, host-timed — tracks the
     # per-op cost of the eager tape + device round-trip over rounds

@@ -32,6 +32,17 @@ Traces:
   on half the HBM) and int8kv_token_match_rate guards accuracy
   (>= 0.99 is the acceptance bar).
 
+- sharded (ISSUE 7): the shared_prefix traffic served by the
+  TENSOR-PARALLEL engine (FLAGS_serving_mp) at mp=1/2/4 plus a
+  disaggregated prefill/decode mp=2 run — kv-head-sharded paged pools,
+  replicated block tables, one o-proj activation all-gather per layer.
+  Rows report useful_tok_s_per_chip (the honest TP number) and the
+  summary reports token_match_vs_mp1 (acceptance bar: 1.0 —
+  the sharded programs are token-identical by construction),
+  aggregate_cacheable_pages (equal across mp at the same per-chip
+  budget ratio) and kv_pool_bytes_per_chip_ratio (~1/mp). Rows whose
+  mp exceeds the visible device count are skipped with a note.
+
 Every engine row also reports pool capacity at trace end
 (kv_cache_dtype, kv_pool_bytes via PagedKVManager.kv_pool_bytes(),
 n_cacheable_pages, n_available/n_cached, prefix_evictions) so
@@ -126,7 +137,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
                max_prompt_len=PROMPT_BUCKET, warm_buckets=None,
                warm_prefix_widths=None, prefix_kernel=True,
                prefill_batch=4, kv_cache_dtype=None, kv_pool_bytes=None,
-               megakernel=False):
+               megakernel=False, serving_mp=1, disaggregated=False):
     import paddle_tpu as paddle
 
     # the flag is read at program-BUILD time; keep it set for the whole
@@ -143,7 +154,8 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
             block_size=BLOCK, steps_per_sync=STEPS_PER_SYNC,
             prefill_batch=prefill_batch, prefix_cache=prefix_cache,
             double_buffer=double_buffer, kv_cache_dtype=kv_cache_dtype,
-            kv_pool_bytes=kv_pool_bytes, decode_megakernel=megakernel)
+            kv_pool_bytes=kv_pool_bytes, decode_megakernel=megakernel,
+            serving_mp=serving_mp, disaggregated=disaggregated)
         # compile every (bucket, prefill-batch) program + the decode
         # chunk outside the clock
         eng.warm(warm_buckets or [max_prompt_len],
@@ -187,11 +199,19 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
         # pool capacity at trace end: capacity-driven hit-rate changes
         # (page budget, pool dtype) are attributable from the row itself
         "kv_cache_dtype": eng.kv_dtype,
+        # kv_pool_bytes is PER-CHIP under serving_mp (what an HBM
+        # budget constrains); page counts are aggregate — page ids are
+        # global, every chip maps the same table
         "kv_pool_bytes": eng.mgr.kv_pool_bytes(),
         "n_cacheable_pages": eng.n_cacheable_pages,
         "n_available": eng.mgr.n_available,
         "n_cached": eng.mgr.n_cached,
         "prefix_evictions": eng.mgr.prefix_evictions,
+        # tensor-parallel serving (ISSUE 7): per-chip throughput is the
+        # honest TP number — mp chips serving X tok/s is X/mp per chip
+        "mp": serving_mp,
+        "useful_tok_s_per_chip": round(useful / wall / serving_mp, 1),
+        "prefill_handoffs": eng.prefill_handoffs,
         # stripped before printing; the deep_prefix summary computes the
         # int8-vs-bf16 token match rate from it
         "_tokens": {r.req_id: list(r.tokens) for r in eng.finished},
@@ -388,6 +408,58 @@ def main():
         "megakernel_token_match_rate": _token_match_rate(toks[2],
                                                          toks[4]),
     }), flush=True)
+
+    # sharded trace (ISSUE 7): the shared_prefix traffic across a
+    # kv-head-sharded mp mesh (FLAGS_serving_mp) — mp=1 is the
+    # single-chip baseline, mp=2/4 shard the paged pools by kv head
+    # (per-chip pool bytes drop to 1/mp at the SAME aggregate page
+    # capacity), and the mp=2+disagg row splits prefill and decode
+    # workers over the same sharded pools. Per-chip tokens/s is the
+    # honest TP number (the all-gather + shard_map overhead show up
+    # there); token_match vs the mp=1 row guards the sharded programs'
+    # token identity end-to-end. Rows needing more devices than are
+    # visible are skipped with a note, not faked.
+    n_dev = len(jax.devices())
+    arrivals, prompts, targets = make_trace(n, seed, rate_req_s=20.0,
+                                            variance="shared_prefix")
+    mpl, buckets = 2 * PROMPT_BUCKET, [PROMPT_BUCKET, 2 * PROMPT_BUCKET]
+    sharded = [("sharded mp=1", 1, False), ("sharded mp=2", 2, False),
+               ("sharded mp=4", 4, False),
+               ("sharded mp=2+disagg", 2, True)]
+    rows, toks = [], []
+    for pol, mp, disagg in sharded:
+        if n_dev < mp:
+            print(json.dumps({"trace": "sharded", "policy": pol,
+                              "skipped": f"needs {mp} devices, "
+                                         f"have {n_dev}"}), flush=True)
+            continue
+        row = run_engine(cfg, p, arrivals, prompts, targets,
+                         policy=pol, prefix_cache=True,
+                         max_prompt_len=mpl, warm_buckets=buckets,
+                         serving_mp=mp, disaggregated=disagg)
+        toks.append(row.pop("_tokens", None))
+        row["trace"] = "sharded"
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    if len(rows) > 1:
+        base = rows[0]
+        print(json.dumps({
+            "trace": "sharded", "summary": True,
+            # token identity vs single-chip is the acceptance bar (1.0)
+            "token_match_vs_mp1": {
+                r["policy"]: _token_match_rate(toks[0], t)
+                for r, t in zip(rows[1:], toks[1:])},
+            "tok_s_per_chip": {r["policy"]: r["useful_tok_s_per_chip"]
+                               for r in rows},
+            # aggregate page capacity is equal across rows; per-chip
+            # bytes shrink 1/mp — the HBM headroom sharding buys
+            "aggregate_cacheable_pages": {
+                r["policy"]: r["n_cacheable_pages"] for r in rows},
+            "kv_pool_bytes_per_chip_ratio": {
+                r["policy"]: round(r["kv_pool_bytes"]
+                                   / max(base["kv_pool_bytes"], 1), 3)
+                for r in rows[1:]},
+        }), flush=True)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ def analyze(fn: Callable, *args,
             rules: Optional[Iterable] = None,
             severity_overrides: Optional[Dict[str, Severity]] = None,
             mesh_axes: Optional[Sequence[str]] = None,
+            rule_config: Optional[Dict] = None,
             name: Optional[str] = None,
             **kwargs) -> Report:
     """Lint `fn` called with `args`/`kwargs` (arrays, Tensors, or
@@ -62,10 +63,23 @@ def analyze(fn: Callable, *args,
     every registered rule. `severity_overrides` ({rule_id: Severity, or
     None to disable}) applies whether rules are explicit or defaulted.
     `mesh_axes` feeds the collective rule the axes it should treat as
-    valid. Returns a `Report`; apply a policy with
-    `report.raise_or_warn()`.
+    valid; `rule_config` passes extra per-rule knobs (e.g.
+    `{"max_collective_bytes": 1 << 16}` tightens TPU401's unquantized-
+    collective size threshold for serving decode programs). Returns a
+    `Report`; apply a policy with `report.raise_or_warn()`.
     """
     overrides = severity_overrides or {}
+    cfg = rule_config or {}
+    # rule_config is for RULE knobs only — 'mesh_axes' would collide
+    # with the explicit kwarg below (TypeError deep in rule
+    # construction) and 'severity' would silently blanket every rule,
+    # bypassing severity_overrides
+    reserved = {"mesh_axes", "severity"} & set(cfg)
+    if reserved:
+        raise ValueError(
+            f"rule_config keys {sorted(reserved)} are reserved: pass "
+            "mesh_axes= directly and use severity_overrides= for "
+            "per-rule severities")
     resolved = None
     if rules is not None:
         resolved = []
@@ -76,9 +90,9 @@ def analyze(fn: Callable, *args,
                 if r not in RULES:
                     raise KeyError(
                         f"unknown rule {r!r}; registered: {sorted(RULES)}")
-                rule = RULES[r](mesh_axes=mesh_axes)
+                rule = RULES[r](mesh_axes=mesh_axes, **cfg)
             elif isinstance(r, type) and issubclass(r, Rule):
-                rule = r(mesh_axes=mesh_axes)
+                rule = r(mesh_axes=mesh_axes, **cfg)
             else:
                 raise TypeError(f"cannot interpret rule {r!r}")
             if rule.id in overrides:
@@ -87,7 +101,7 @@ def analyze(fn: Callable, *args,
                 rule.severity = overrides[rule.id]
             resolved.append(rule)
     pipe = Pipeline(rules=resolved, severity_overrides=severity_overrides,
-                    mesh_axes=mesh_axes)
+                    mesh_axes=mesh_axes, **cfg)
     return pipe.analyze(fn, *args, name=name, **kwargs)
 
 

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, Iterator, List, Optional, Type
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..kernels.constraints import (
@@ -572,7 +573,7 @@ class DtypePromotionRule(Rule):
 
 @register_rule
 class CollectiveRule(Rule):
-    """Three checks over collective equations (psum/all_gather/
+    """Four checks over collective equations (psum/all_gather/
     all_to_all/ppermute/reduce_scatter):
 
     - dead: the collective's result is never consumed — it still pays
@@ -580,7 +581,21 @@ class CollectiveRule(Rule):
     - duplicate: two identical collectives over the same operand+axes
       (fold into one);
     - unknown axis: the axis name is not in the mesh axes the caller
-      declared via `mesh_axes=` (skipped when not declared).
+      declared via `mesh_axes=` (skipped when not declared);
+    - unquantized large payload: a collective moving more than
+      `max_collective_bytes` (config; default 1 MiB, 0 disables) of
+      floating-point data per equation. EQuARX (PAPERS.md) shows
+      block-quantized int8 collectives inside XLA recover most of that
+      wire time at negligible numerics cost — an absmax-int8 payload +
+      f32 scale sidecar is the exact scheme the int8 paged KV pools
+      already use. First customer: the tensor-parallel serving decode
+      path's per-layer o-proj activation all-gather (FLAGS_serving_mp)
+      — small at decode (b x 1 x H), but the same rule watches prefill
+      all-gathers and dp gradient psums, where payloads are MBs.
+      int8/int32 payloads (already-quantized or index traffic) never
+      fire. Note scans AMPLIFY the cost: a flagged collective inside a
+      scan body pays per iteration — those report at WARNING even when
+      a top-level one would be INFO.
     """
 
     id = "TPU401"
@@ -593,8 +608,35 @@ class CollectiveRule(Rule):
         "ppermute", "reduce_scatter", "pgather",
     })
 
+    # over this many bytes of float payload, a collective is worth
+    # quantizing (EQuARX); override with max_collective_bytes=
+    DEFAULT_MAX_COLLECTIVE_BYTES = 1 << 20
+
+    def _payload_bytes(self, ctx) -> int:
+        """Float bytes one execution of this collective moves (sum of
+        floating-point operand sizes; int payloads don't count — they
+        are either already quantized or index traffic)."""
+        total = 0
+        for v in ctx.eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            dt = np.dtype(aval.dtype)
+            # jnp.issubdtype, NOT np.issubdtype: bfloat16 is an
+            # ml_dtypes extension type (numpy kind 'V') that
+            # np.issubdtype does not class as floating — and bf16
+            # activations/gradients are exactly the payloads this
+            # check exists for
+            if not jnp.issubdtype(dt, jnp.floating):
+                continue
+            total += int(np.prod(aval.shape, dtype=np.int64)) \
+                * dt.itemsize
+        return total
+
     def check(self, graph: Graph) -> Iterator[Diagnostic]:
         mesh_axes = self.config.get("mesh_axes")
+        max_bytes = self.config.get(
+            "max_collective_bytes", self.DEFAULT_MAX_COLLECTIVE_BYTES)
         seen: Dict[tuple, EqnCtx] = {}
         for ctx in graph.eqns():
             if ctx.primitive not in self.COLLECTIVES:
@@ -604,6 +646,26 @@ class CollectiveRule(Rule):
             if not isinstance(axes, (tuple, list)):
                 axes = (axes,)
             axes = tuple(a for a in axes if isinstance(a, str))
+            # unquantized large payload (EQuARX candidate)
+            if max_bytes:
+                payload = self._payload_bytes(ctx)
+                if payload > max_bytes:
+                    # loop bodies AMPLIFY the cost (the collective pays
+                    # per iteration) — those escalate to the rule's
+                    # severity; a one-shot top-level collective is an
+                    # INFO-grade EQuARX candidate
+                    yield self.diag(
+                        f"{ctx.primitive} over {axes} moves {payload} "
+                        f"bytes of float payload (> {max_bytes}) "
+                        + ("inside a loop body — per iteration"
+                           if ctx.in_loop else "per call"),
+                        where=ctx.path,
+                        severity=None if ctx.in_loop else Severity.INFO,
+                        hint="quantize the payload (absmax int8 + f32 "
+                             "scale sidecar, EQuARX-style — the int8 "
+                             "KV pools' exact scheme) or shrink it; "
+                             "raise max_collective_bytes= if this "
+                             "size is intended")
             # unknown axis
             if mesh_axes is not None:
                 for a in axes:

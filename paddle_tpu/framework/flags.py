@@ -121,6 +121,20 @@ define_flag("decode_megakernel", False,
             "(also: PADDLE_TPU_DECODE_MEGAKERNEL)",
             env_aliases=("PADDLE_TPU_DECODE_MEGAKERNEL",))
 
+define_flag("serving_mp", 1,
+            "tensor-parallel degree of the PAGED serving stack: the "
+            "engine's K/V pools (and their int8 scale sidecars) shard "
+            "by kv head across an `mp` mesh of this many devices, the "
+            "decode / prefill / prefix-prefill programs run under "
+            "shard_map with each shard streaming only its local kv "
+            "heads, and the sole per-layer cross-chip traffic is the "
+            "all-gather of the per-shard o-proj activations. 1 "
+            "(default) = today's single-chip path, byte-identical. "
+            "Read when a paged program / engine is BUILT (it joins "
+            "every program key), so flip it before constructing (or "
+            "warming) an engine (also: PADDLE_TPU_SERVING_MP)",
+            env_aliases=("PADDLE_TPU_SERVING_MP",))
+
 # --- resilience (paddle_tpu.resilience) ---
 define_flag("tpu_chaos", "",
             "fault-injection spec, e.g. 'io_error:0.1,preempt_at:200,"

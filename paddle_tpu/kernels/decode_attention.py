@@ -560,6 +560,13 @@ def paged_decode_attention(q: jax.Array, key_cache: jax.Array,
     [max_pages, Hkv] — each grid step then streams the int8 tile plus
     its scale and rescales inside the f32 accumulation; the dequantized
     bf16 pool never materializes.
+
+    Head counts (and therefore the GQA group) derive from the OPERAND
+    shapes, never a model config: under tensor-parallel serving
+    (FLAGS_serving_mp) this call sees the shard-LOCAL q heads and pool
+    kv heads inside shard_map, so both the kv-head-sharded grid and
+    the replicated-KV MQA fallback (full Hkv, local Hq) lower to the
+    correct group without any head-offset plumbing.
     """
     b, h, d = q.shape
     hkv = key_cache.shape[1]

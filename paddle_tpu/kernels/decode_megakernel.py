@@ -197,10 +197,13 @@ def _fit_pages_per_step(w_tbl: int) -> int:
 
 
 def _make_kernel(*, H, nkv, group, dh, bs, n_inner, mp, scale, eps,
-                 quant_w, quant_kv):
+                 quant_w, quant_kv, residual=True):
     """Build the fused layer-step kernel body. Refs are parsed
     positionally from the static (quant_w, quant_kv, mp) layout the
-    wrapper constructs."""
+    wrapper constructs. With `residual=False` the final store emits the
+    f32 o-proj PARTIAL sum only (no h add) — the tensor-parallel
+    serving path psums the per-shard partials outside the kernel and
+    adds the residual once, after the collective."""
     dh2 = dh // 2
     f32 = jnp.float32
 
@@ -400,8 +403,12 @@ def _make_kernel(*, H, nkv, group, dh, bs, n_inner, mp, scale, eps,
             proj = out_scr[...]
             if quant_w:
                 proj = proj * wos_ref[...]
-            oh_ref[...] = (h_ref[...].astype(f32)
-                           + proj).astype(oh_ref.dtype)
+            if residual:
+                oh_ref[...] = (h_ref[...].astype(f32)
+                               + proj).astype(oh_ref.dtype)
+            else:
+                # partial-sum output: the caller owns residual + psum
+                oh_ref[...] = proj.astype(oh_ref.dtype)
 
     return _decode_megakernel_kernel
 
@@ -409,7 +416,8 @@ def _make_kernel(*, H, nkv, group, dh, bs, n_inner, mp, scale, eps,
 def decode_layer_megakernel(h, lens, tables, w_in, wq, wk, wv, wo,
                             k_cache, v_cache, *, rope_base: float = 10000.0,
                             eps: float = 1e-6, scale: float | None = None,
-                            k_scale=None, v_scale=None):
+                            k_scale=None, v_scale=None,
+                            residual: bool = True):
     """One decoder layer's fused decode step.
 
     h: [b, 1, H] residual stream; lens: [b] int32 cached token counts
@@ -419,10 +427,19 @@ def decode_layer_megakernel(h, lens, tables, w_in, wq, wk, wv, wo,
     agree; k_cache/v_cache: [max_pages, nkv, block, dh] paged pools
     (bf16/f32, or int8 with `k_scale`/`v_scale` [max_pages, nkv]).
 
+    Head counts derive from the OPERANDS (nkv from the pool shape, nh
+    from wq, group = nh // nkv) — under tensor-parallel serving these
+    are the LOCAL shard's counts, so the grid is correct for any
+    head sharding the caller arranged (ISSUE 7 satellite: never the
+    full-model config's nq // nkv).
+
     Returns (h_out [b, 1, H], k_cache', v_cache') — or, for int8 pools,
     (h_out, (k_cache', k_scale'), (v_cache', v_scale')) — with exactly
     one page per (row, kv head) rewritten (the commit) and every other
-    page byte-identical (aliased in place).
+    page byte-identical (aliased in place). With ``residual=False``
+    h_out is instead the f32 o-proj PARTIAL sum (no residual add) —
+    the TP serving path psums partials across shards and adds the
+    residual after the collective.
     """
     reason = megakernel_supported(h, w_in, wq, wk, wv, wo, k_cache,
                                   v_cache, tables, k_scale=k_scale,
@@ -558,7 +575,7 @@ def decode_layer_megakernel(h, lens, tables, w_in, wq, wk, wv, wo,
         pl.BlockSpec((1, bs, dh), commit_map),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((b, H), cdt),
+        jax.ShapeDtypeStruct((b, H), cdt if residual else jnp.float32),
         jax.ShapeDtypeStruct(kc2.shape, kc2.dtype),
         jax.ShapeDtypeStruct(vc2.shape, vc2.dtype),
     ]
@@ -573,7 +590,8 @@ def decode_layer_megakernel(h, lens, tables, w_in, wq, wk, wv, wo,
 
     kernel = _make_kernel(H=H, nkv=nkv, group=group, dh=dh, bs=bs,
                           n_inner=n_inner, mp=mp, scale=scale, eps=eps,
-                          quant_w=quant_w, quant_kv=quant_kv)
+                          quant_w=quant_w, quant_kv=quant_kv,
+                          residual=residual)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -19,6 +19,7 @@ TPU-first design:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from collections import OrderedDict
 from typing import Optional, Tuple
@@ -167,10 +168,10 @@ def _constrain(x, mesh, *dims):
 # batch dim is data-parallel over both dp and the ZeRO axis; seq dim is
 # context-parallel over sep (reference: 5-D topo [data,pipe,sharding,sep,model],
 # fleet/base/topology.py:188)
-from ..parallel.mesh import BATCH_AXES  # noqa: E402  (single topology source)
+from ..parallel.mesh import (BATCH_AXES,  # noqa: E402 (single topology source)
+                             MP_AXIS)
 
 SEQ_AXIS = "sep"
-MP_AXIS = "mp"
 
 
 # ---------------------------------------------------------------------------
@@ -513,9 +514,11 @@ class LlamaForCausalLM(Layer):
             else None
         megakernel = resolve_decode_megakernel() \
             if cache_layout == "paged" else None
+        serving_mp = resolve_serving_mp() if cache_layout == "paged" \
+            else None
         sig = (b, sb, max_new_tokens, eos_token_id, do_sample, int(top_k),
                quant, prefill_with_quant, cache_layout, kv_block_size,
-               kv_dtype, megakernel)
+               kv_dtype, megakernel, serving_mp)
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = {}
@@ -523,7 +526,8 @@ class LlamaForCausalLM(Layer):
             if cache_layout == "paged":
                 fn = build_paged_generate(cfg, b, sb, max_new_tokens,
                                           kv_block_size, eos_token_id,
-                                          do_sample, int(top_k))
+                                          do_sample, int(top_k),
+                                          serving_mp=serving_mp)
             elif prefill_with_quant:
                 fn = build_quant_generate(cfg, b, sb, max_new_tokens,
                                           max_seq, eos_token_id, do_sample,
@@ -707,16 +711,27 @@ def _make_head_logits(cfg):
     return head_logits
 
 
-def _make_prefill(cfg, b, sb):
+def _make_prefill(cfg, b, sb, tp=None):
     """Shared per-layer prefill over the `_decode_params` layout (dense
     OR quantized projections, via _mm): embed -> L x (rms/attn/mlp) ->
     final rms. Returns (h_final, [(k_i, v_i)]) with rotary-applied K/V
     [b, sb, nkv, dh] per layer — the caller owns the cache layout
-    (contiguous slices or page scatter)."""
+    (contiguous slices or page scatter).
+
+    With `tp` (ServingTP, inside a shard_map body) the q/k/v weights
+    arrive column-sharded so each shard computes only its local heads;
+    the flash attention runs shard-local and the per-shard outputs
+    all-gather along the head axis before the (replicated) o-proj —
+    the one cross-chip collective per layer. The returned K/V carry
+    the LOCAL kv heads (callers scatter into the local pool shard)."""
     from ..kernels.flash_attention import flash_attention as _flash
 
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
+    # head counts the projections reshape at: the LOCAL shard's under
+    # tp, the full model's otherwise (never the config's alone)
+    nh_l = tp.nh_local if tp is not None else nh
+    nkv_l = tp.nkv_local if tp is not None else nkv
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
 
@@ -728,15 +743,17 @@ def _make_prefill(cfg, b, sb):
             pre = f"llama.layers.{i}."
             x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
             q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
-                b, sb, nh, dh)
+                b, sb, nh_l, dh)
             k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
-                b, sb, nkv, dh)
+                b, sb, nkv_l, dh)
             v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
-                b, sb, nkv, dh)
+                b, sb, nkv_l, dh)
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
             kvs.append((k, v))
-            attn = _flash(q, k, v, causal=True)          # [b, sb, nh, dh]
+            attn = _flash(q, k, v, causal=True)        # [b, sb, nh_l, dh]
+            if tp is not None:
+                attn = tp.gather_heads(attn)           # [b, sb, nh, dh]
             h = h + _mm(attn.reshape(b, sb, nh * dh),
                         p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
@@ -750,7 +767,7 @@ def _make_prefill(cfg, b, sb):
     return prefill
 
 
-def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
+def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size, tp=None):
     """Suffix prefill over a cached block-aligned prefix: compute hidden
     states for the `sb` UNCACHED suffix tokens only, attending over the
     prefix K/V gathered from the paged pools (already rotary-encoded at
@@ -792,9 +809,18 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
     int8 pools (FLAGS_kv_cache_dtype): pass kcs/vcs entries as
     (int8 pool, f32 scale [max_pages, nkv]) tuples — both the kernel
     and the fallback dequantize against the scales (the fallback in
-    f32 at the gather, the kernel inside its accumulation)."""
+    f32 at the gather, the kernel inside its accumulation).
+
+    With `tp` (ServingTP, inside a shard_map body): q/k/v weights and
+    the pools arrive shard-local, the mixed prefix+suffix attention
+    (kernel or fallback — both derive head counts from their OPERAND
+    shapes) streams only the local kv heads' pages, and the per-shard
+    outputs all-gather along the head axis before the replicated
+    o-proj — same single collective per layer as the decode step."""
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
+    nh_l = tp.nh_local if tp is not None else nh
+    nkv_l = tp.nkv_local if tp is not None else nkv
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
     scale = 1.0 / math.sqrt(dh)
@@ -812,11 +838,11 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
             pre = f"llama.layers.{i}."
             x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
             q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
-                b, sb, nh, dh)
+                b, sb, nh_l, dh)
             k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
-                b, sb, nkv, dh)
+                b, sb, nkv_l, dh)
             v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
-                b, sb, nkv, dh)
+                b, sb, nkv_l, dh)
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
             kvs.append((k, v))
@@ -840,6 +866,8 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size):
                     q, k, v, kc_i, vc_i, prefix_tables, prefix_lens,
                     scale=scale, k_scale=ksc_i,
                     v_scale=vsc_i).astype(h.dtype)
+            if tp is not None:
+                attn = tp.gather_heads(attn)
             h = h + _mm(attn.reshape(b, sb, nh * dh),
                         p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
@@ -966,12 +994,190 @@ def resolve_decode_megakernel(decode_megakernel: Optional[bool] = None) \
     return bool(decode_megakernel)
 
 
-def _megakernel_reason(cfg, b, p, kcs, vcs, tables) -> Optional[str]:
+SERVING_MP_FALLBACK_MSG = (
+    "kv heads not divisible by serving_mp; falling back to "
+    "replicated-KV head-sharded-Q (each shard streams the FULL kv "
+    "pools — no per-chip KV memory win, query compute still shards)")
+
+
+def resolve_serving_mp(serving_mp: Optional[int] = None) -> int:
+    """Tensor-parallel degree of the paged serving stack, from the
+    argument or FLAGS_serving_mp / PADDLE_TPU_SERVING_MP. Read at
+    program-BUILD time (like FLAGS_kv_cache_dtype): flip it before
+    constructing or warming an engine. 1 (default) = the single-chip
+    path, byte-identical to a build without the flag."""
+    if serving_mp is None:
+        from ..framework.flags import flag as _flag
+
+        serving_mp = int(_flag("serving_mp"))
+    serving_mp = int(serving_mp)
+    if serving_mp < 1:
+        raise ValueError(f"serving_mp must be >= 1, got {serving_mp}")
+    return serving_mp
+
+
+class ServingTP:
+    """Head-sharding geometry of a tensor-parallel serving program.
+
+    The sharding layout (ROADMAP: "pools+scales sharded; decode
+    all-gathers only the o-proj activations"):
+
+    - q/k/v projections COLUMN-shard by head over `mp`: shard i owns
+      contiguous q heads [i*nh_local, (i+1)*nh_local) and kv heads
+      [i*nkv_local, (i+1)*nkv_local) — the same contiguous blocks a
+      `NamedSharding(P(..., 'mp'))` device_put produces, so GQA group
+      membership is preserved per shard (group = nh/nkv is invariant).
+    - the paged K/V pools (and their int8 scale sidecars) shard on the
+      kv-head axis; block tables, lengths and budgets stay replicated,
+      so page ids mean the same thing on every chip and "KV transfer"
+      between workers is table bookkeeping, not data movement.
+    - attention runs entirely shard-local (each shard streams only its
+      local kv heads); the per-shard attention outputs — the o-proj
+      ACTIVATIONS — are all-gathered along the head axis, and the
+      o-proj itself plus everything outside the attention block (embed,
+      norms, MLP, lm head, sampling) is computed replicated. That makes
+      the all-gather the ONE cross-chip collective per layer, and every
+      per-element computation identical to the single-chip program
+      (token identity, not just closeness).
+
+    MQA fallback (`kv heads % mp != 0`, e.g. nkv=1): kv heads cannot
+    shard, so k/v projections and the pools stay REPLICATED while q
+    heads still shard — each shard streams the full pools against its
+    query group (`group_local = nh_local // nkv`), commits identical
+    K/V on every chip, and the o-proj all-gather is unchanged. A
+    build-time warning names the fallback (the per-chip KV-memory win
+    is gone; the grid is still correct — satellite of ISSUE 7: group
+    math derives from LOCAL head counts, never the full-model config).
+    """
+
+    def __init__(self, cfg, mp: int, axis: str = MP_AXIS):
+        nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        if nh % mp:
+            raise ValueError(
+                f"serving_mp={mp} does not divide num_attention_heads "
+                f"{nh}; query heads must shard evenly")
+        self.mp = int(mp)
+        self.axis = axis
+        self.nh_local = nh // mp
+        self.kv_sharded = nkv % mp == 0
+        self.nkv_local = nkv // mp if self.kv_sharded else nkv
+        if self.kv_sharded and self.nh_local % self.nkv_local:
+            raise ValueError(
+                f"serving_mp={mp} breaks the GQA grouping: {nh} q heads "
+                f"/ {nkv} kv heads shard to {self.nh_local}/"
+                f"{self.nkv_local} per chip")
+        if not self.kv_sharded:
+            if self.nh_local % nkv:
+                raise ValueError(
+                    f"serving_mp={mp} with {nkv} kv heads leaves "
+                    f"{self.nh_local} q heads per chip — not a whole "
+                    "number of kv groups; no valid replicated-KV grid")
+            import warnings
+
+            warnings.warn(
+                f"serving_mp={mp} with {nkv} kv heads: "
+                + SERVING_MP_FALLBACK_MSG, stacklevel=3)
+
+    def gather_heads(self, ctx):
+        """All-gather the per-shard attention outputs along the head
+        axis — THE one cross-chip collective per layer (the o-proj
+        activations; shard i's block lands at head offset i*nh_local,
+        matching the column-sharded q projection). EQuARX (PAPERS.md)
+        is the follow-up for quantizing this payload; TPU401's
+        collective-size lint watches it meanwhile."""
+        return jax.lax.all_gather(ctx, self.axis, axis=ctx.ndim - 2,
+                                  tiled=True)
+
+
+def make_serving_tp(cfg, serving_mp: Optional[int] = None) \
+        -> Optional[ServingTP]:
+    """ServingTP geometry for the resolved mp degree, or None at mp=1
+    (the single-chip path takes no TP plumbing at all)."""
+    mp = resolve_serving_mp(serving_mp)
+    return ServingTP(cfg, mp) if mp > 1 else None
+
+
+def _tp_weight_spec(name: str, w, tp: ServingTP):
+    """PartitionSpec(s) for one serving weight under ServingTP: q (and,
+    when kv shards, k/v) projections shard on their OUTPUT-head axis —
+    dense [in, out] on axis 1; nn.quant pairs (int8/int4-packed
+    [out, in_packed], per-channel scale [out]) on axis 0 of both — and
+    EVERYTHING else (o-proj included: it consumes the all-gathered
+    activations) replicates. Mirrors `shard_serving_params`; both feed
+    shard_map in_specs."""
+    from jax.sharding import PartitionSpec as _P
+
+    sharded = name.endswith("q_proj.weight") or (
+        tp.kv_sharded and (name.endswith("k_proj.weight")
+                           or name.endswith("v_proj.weight")))
+    if isinstance(w, tuple):
+        if sharded:
+            return (_P(tp.axis, None), _P(tp.axis))
+        return (_P(), _P())
+    if sharded:
+        return _P(None, tp.axis)
+    return _P(*([None] * getattr(w, "ndim", 0)))
+
+
+def serving_param_specs(params: dict, tp: ServingTP) -> dict:
+    """{name: PartitionSpec | (spec, spec)} mirroring a `_decode_params`
+    dict under ServingTP — the in_specs tree every sharded serving
+    program passes to shard_map."""
+    return {name: _tp_weight_spec(name, w, tp)
+            for name, w in params.items()}
+
+
+def shard_serving_params(params: dict, mesh, tp: ServingTP) -> dict:
+    """Lay a `_decode_params` dict out on the serving mesh per
+    `serving_param_specs` (one device_put per weight; sharded q/k/v
+    columns, everything else replicated across the mp devices)."""
+    specs = serving_param_specs(params, tp)
+    out = {}
+    for name, w in params.items():
+        sp = specs[name]
+        if isinstance(w, tuple):
+            out[name] = tuple(
+                jax.device_put(a, NamedSharding(mesh, s))
+                for a, s in zip(w, sp))
+        else:
+            out[name] = jax.device_put(w, NamedSharding(mesh, sp))
+    return out
+
+
+def _tp_slice_o_proj(w, tp: ServingTP, spec_only: bool = False):
+    """The LOCAL contraction slice of a (replicated) o-proj weight for
+    the fused megakernel path: the megakernel computes o-proj in-kernel,
+    so each shard multiplies its local attention heads against its own
+    contraction rows and the partial sums psum outside. Dense weights
+    [nh*dh, H] slice rows; nn.quant pairs (int8 [H, nh*dh], scale [H])
+    slice contraction COLUMNS with the per-output scale replicated.
+    `spec_only` returns ShapeDtypeStructs (megakernel_supported runs
+    shape-only, outside any traced axis context)."""
+    idx = None if spec_only else jax.lax.axis_index(tp.axis)
+    if isinstance(w, tuple):
+        wq, sc = w
+        k_local = wq.shape[1] // tp.mp
+        if spec_only:
+            return (jax.ShapeDtypeStruct((wq.shape[0], k_local),
+                                         wq.dtype), sc)
+        return (jax.lax.dynamic_slice_in_dim(wq, idx * k_local, k_local,
+                                             axis=1), sc)
+    k_local = w.shape[0] // tp.mp
+    if spec_only:
+        return jax.ShapeDtypeStruct((k_local, w.shape[1]), w.dtype)
+    return jax.lax.dynamic_slice_in_dim(w, idx * k_local, k_local, axis=0)
+
+
+def _megakernel_reason(cfg, b, p, kcs, vcs, tables, tp=None) \
+        -> Optional[str]:
     """None when the megakernel can serve this decode step's operands
     (layer-0 weights stand in for every layer — `_decode_params`
     quantizes them uniformly), else the reason the builder must fall
     back to the multi-kernel path. Pure shape logic, runnable under
-    trace."""
+    trace. Under ServingTP the check sees the SHARD-LOCAL operands (the
+    q/k/v weights and pools arrive pre-sharded inside shard_map; the
+    o-proj check uses the local contraction slice's shape), so head
+    counts derive from the local shard, never the full model config."""
     from ..kernels.decode_megakernel import megakernel_supported
 
     kc0, vc0 = kcs[0], vcs[0]
@@ -982,22 +1188,25 @@ def _megakernel_reason(cfg, b, p, kcs, vcs, tables) -> Optional[str]:
     pre = "llama.layers.0."
     h_spec = jax.ShapeDtypeStruct(
         (b, 1, H), p["llama.embed_tokens.weight"].dtype)
+    wo = p[pre + "self_attn.o_proj.weight"]
+    if tp is not None:
+        wo = _tp_slice_o_proj(wo, tp, spec_only=True)
     return megakernel_supported(
         h_spec, p[pre + "input_layernorm.weight"],
         p[pre + "self_attn.q_proj.weight"],
         p[pre + "self_attn.k_proj.weight"],
         p[pre + "self_attn.v_proj.weight"],
-        p[pre + "self_attn.o_proj.weight"],
-        kc0, vc0, tables, k_scale=ksc, v_scale=vsc)
+        wo, kc0, vc0, tables, k_scale=ksc, v_scale=vsc)
 
 
-def _megakernel_or_fallback_step(cfg, b, tables, p, kcs, vcs, base):
+def _megakernel_or_fallback_step(cfg, b, tables, p, kcs, vcs, base,
+                                 tp=None):
     """The fused decode step when the megakernel supports these
     operands, else `base` (the multi-kernel oracle) with a warning
     naming the reason — the ONE fallback seam both
     `build_paged_generate` and the serving engine's decode-chunk
-    builder go through."""
-    reason = _megakernel_reason(cfg, b, p, kcs, vcs, tables)
+    builder go through (single-chip AND ServingTP-sharded)."""
+    reason = _megakernel_reason(cfg, b, p, kcs, vcs, tables, tp=tp)
     if reason is not None:
         import warnings
 
@@ -1005,16 +1214,24 @@ def _megakernel_or_fallback_step(cfg, b, tables, p, kcs, vcs, base):
             "decode_megakernel requested but unsupported here "
             f"({reason}); serving the multi-kernel path", stacklevel=3)
         return base
-    return _make_decode_step_megakernel(cfg, b, tables)
+    return _make_decode_step_megakernel(cfg, b, tables, tp=tp)
 
 
-def _make_decode_step_megakernel(cfg, b, tables):
+def _make_decode_step_megakernel(cfg, b, tables, tp=None):
     """`_make_decode_step`'s paged twin with the whole attention block —
     rms_norm, QKV projection, rotary, paged-KV commit (int8 epilogue
     included) paged GQA attention, o-proj + residual — fused into ONE
     Pallas call per layer (kernels/decode_megakernel.py). The MLP half
     and the lm head keep the shared `_mm`/`_k_rms` path, so the same
-    decode-params dict serves both step implementations."""
+    decode-params dict serves both step implementations.
+
+    Under ServingTP each shard runs the SAME fused kernel over its
+    local heads/pools with its local o-proj contraction slice and
+    `residual=False` — the kernel emits the f32 o-proj PARTIAL sum,
+    which is psum'd over the mp axis before the residual add (still
+    the ONE cross-chip collective per layer, but f32 at full hidden
+    width: ~2x the multi-kernel path's bf16 activation gather in
+    bytes — the quantized-collective follow-up applies doubly here)."""
     from ..kernels.decode_megakernel import decode_layer_megakernel
 
     n_layers = cfg.num_hidden_layers
@@ -1031,24 +1248,34 @@ def _make_decode_step_megakernel(cfg, b, tables):
         for i in range(n_layers):
             pre = f"llama.layers.{i}."
             kc, vc = kcs[i], vcs[i]
+            wo = p[pre + "self_attn.o_proj.weight"]
+            if tp is not None:
+                wo = _tp_slice_o_proj(wo, tp)
+            mk = functools.partial(
+                decode_layer_megakernel, rope_base=cfg.rope_theta,
+                eps=eps, residual=tp is None)
             if isinstance(kc, tuple):
                 (kcp, ksc), (vcp, vsc) = kc, vc
-                h, kc_new, vc_new = decode_layer_megakernel(
+                h_out, kc_new, vc_new = mk(
                     h, lens, tables, p[pre + "input_layernorm.weight"],
                     p[pre + "self_attn.q_proj.weight"],
                     p[pre + "self_attn.k_proj.weight"],
                     p[pre + "self_attn.v_proj.weight"],
-                    p[pre + "self_attn.o_proj.weight"], kcp, vcp,
-                    rope_base=cfg.rope_theta, eps=eps, k_scale=ksc,
-                    v_scale=vsc)
+                    wo, kcp, vcp, k_scale=ksc, v_scale=vsc)
             else:
-                h, kc_new, vc_new = decode_layer_megakernel(
+                h_out, kc_new, vc_new = mk(
                     h, lens, tables, p[pre + "input_layernorm.weight"],
                     p[pre + "self_attn.q_proj.weight"],
                     p[pre + "self_attn.k_proj.weight"],
                     p[pre + "self_attn.v_proj.weight"],
-                    p[pre + "self_attn.o_proj.weight"], kc, vc,
-                    rope_base=cfg.rope_theta, eps=eps)
+                    wo, kc, vc)
+            if tp is None:
+                h = h_out
+            else:
+                # h_out is the f32 o-proj PARTIAL (no residual): psum
+                # over the shards' contraction slices, then residual
+                h = (h.astype(jnp.float32)
+                     + jax.lax.psum(h_out, tp.axis)).astype(h.dtype)
             new_kcs.append(kc_new)
             new_vcs.append(vc_new)
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
@@ -1178,10 +1405,23 @@ class PagedKVManager:
 
     @staticmethod
     def page_bytes(block_size: int, *, n_layers: int, num_kv_heads: int,
-                   head_dim: int, kv_cache_dtype: str = "bf16") -> int:
-        """Device bytes ONE page costs across all layers: K + V pools
-        (2 x nkv x block x dh x itemsize per layer) plus, for int8, the
-        per-(page, kv-head) f32 absmax scale rows (2 x nkv x 4)."""
+                   head_dim: int, kv_cache_dtype: str = "bf16",
+                   mp: int = 1) -> int:
+        """PER-CHIP device bytes ONE page costs across all layers: K + V
+        pools (2 x nkv x block x dh x itemsize per layer) plus, for
+        int8, the per-(page, kv-head) f32 absmax scale rows
+        (2 x nkv x 4). Under kv-head sharding (`mp` — ServingTP with
+        nkv % mp == 0) each chip holds only nkv/mp heads of every page,
+        so a page costs 1/mp of the replicated bytes per chip; page ids
+        and page COUNTS stay global (every chip maps the same ids)."""
+        mp = int(mp)
+        if mp > 1:
+            if num_kv_heads % mp:
+                raise ValueError(
+                    f"per-shard geometry needs kv heads {num_kv_heads} "
+                    f"divisible by mp {mp} (the MQA fallback replicates "
+                    "the pools — pass mp=1)")
+            num_kv_heads //= mp
         itemsize = 1 if kv_cache_dtype == "int8" else 2
         per_layer = 2 * num_kv_heads * block_size * head_dim * itemsize
         if kv_cache_dtype == "int8":
@@ -1191,35 +1431,50 @@ class PagedKVManager:
     @classmethod
     def pages_for_bytes(cls, budget_bytes: int, block_size: int, *,
                         n_layers: int, num_kv_heads: int, head_dim: int,
-                        kv_cache_dtype: str = "bf16") -> int:
-        """Pages a device byte budget holds — the capacity side of the
-        int8 win: at the same budget an int8 pool holds ~2x the pages
-        (so ~2x the cacheable prefix blocks before LRU eviction)."""
+                        kv_cache_dtype: str = "bf16", mp: int = 1) -> int:
+        """Pages a PER-CHIP device byte budget holds — the capacity side
+        of the int8 win (at the same budget an int8 pool holds ~2x the
+        pages) AND of kv-head sharding: at mp shards a per-chip budget
+        buys ~mp x the AGGREGATE cacheable pages, because each chip
+        stores only its 1/mp slice of every page."""
         per_page = cls.page_bytes(block_size, n_layers=n_layers,
                                   num_kv_heads=num_kv_heads,
                                   head_dim=head_dim,
-                                  kv_cache_dtype=kv_cache_dtype)
+                                  kv_cache_dtype=kv_cache_dtype, mp=mp)
         return max(0, int(budget_bytes) // per_page)
 
     def set_pool_geometry(self, *, n_layers: int, num_kv_heads: int,
-                          head_dim: int, kv_cache_dtype: str = "bf16"):
+                          head_dim: int, kv_cache_dtype: str = "bf16",
+                          mp: int = 1):
         """Record the pool geometry this manager's page ids index into,
         enabling `kv_pool_bytes()` (benches attribute capacity-driven
-        hit-rate changes with it)."""
+        hit-rate changes with it). `mp` is the kv-head shard count (1
+        when the pools are replicated — including the MQA fallback), so
+        byte accounting reports PER-CHIP cost while page capacity math
+        stays aggregate."""
         resolve_kv_cache_dtype(kv_cache_dtype)
+        if mp > 1 and num_kv_heads % mp:
+            raise ValueError(
+                f"kv heads {num_kv_heads} not divisible by mp {mp}; "
+                "replicated pools record mp=1")
         self._geometry = dict(n_layers=int(n_layers),
                               num_kv_heads=int(num_kv_heads),
                               head_dim=int(head_dim),
-                              kv_cache_dtype=kv_cache_dtype)
+                              kv_cache_dtype=kv_cache_dtype,
+                              mp=int(mp))
 
-    def kv_pool_bytes(self) -> int:
-        """Total device bytes of the K/V pools (+ int8 scale arrays)
-        this manager allocates pages of. Requires `set_pool_geometry`."""
+    def kv_pool_bytes(self, aggregate: bool = False) -> int:
+        """Device bytes of the K/V pools (+ int8 scale arrays) this
+        manager allocates pages of — PER CHIP by default (the number an
+        HBM budget constrains); `aggregate=True` multiplies the kv-head
+        shard count back in (the whole-fleet footprint). Requires
+        `set_pool_geometry`."""
         if self._geometry is None:
             raise RuntimeError(
                 "kv_pool_bytes() needs set_pool_geometry(...) first")
-        return self.max_pages * self.page_bytes(self.block_size,
-                                                **self._geometry)
+        per_chip = self.max_pages * self.page_bytes(self.block_size,
+                                                    **self._geometry)
+        return per_chip * self._geometry["mp"] if aggregate else per_chip
 
     @property
     def n_free(self) -> int:
@@ -1377,7 +1632,8 @@ class PagedKVManager:
 
 
 def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
-                         eos_token_id=None, do_sample=False, top_k=0):
+                         eos_token_id=None, do_sample=False, top_k=0,
+                         serving_mp=None):
     """Generation over a PAGED KV cache with block tables — the vLLM-class
     serving core (reference: block_multihead_attention.py:25 + the paged
     decode kernels in paddle/phi/kernels/fusion/gpu/block_attn.h).
@@ -1405,6 +1661,14 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     page scatter, decode commits re-quantize per token, and the Pallas
     kernels dequantize in-kernel. Returns
     run(dec_params, ids, s0_vec, tables, key, temperature, top_p).
+
+    With FLAGS_serving_mp > 1 (or `serving_mp=`, likewise read at
+    BUILD time) the whole program runs under shard_map on the serving
+    mesh: pools (created inside the body) hold only the shard's local
+    kv heads, q/k/v weights arrive column-sharded per
+    `serving_param_specs`, and the per-layer o-proj activation
+    all-gather is the one cross-chip collective. Tokens out are
+    replicated — byte-identical to the single-chip program.
     """
     from ..kernels.decode_attention import paged_decode_attention
 
@@ -1419,14 +1683,18 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
     n_pre = sb // block_size
     quant_kv = resolve_kv_cache_dtype() == "int8"
     use_mega = resolve_decode_megakernel()
+    tp = make_serving_tp(cfg, serving_mp)
+    # the kv-head count of the pools the BODY sees (local under tp;
+    # full when replicated — including the MQA fallback)
+    nkv_eff = tp.nkv_local if tp is not None else nkv
 
     head_logits = _make_head_logits(cfg)
-    base_prefill = _make_prefill(cfg, b, sb)
+    base_prefill = _make_prefill(cfg, b, sb, tp=tp)
 
     def prefill(p, ids, tables, pools):
-        to_pages, _ = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
-                                            tables)
-        to_pages_q8, _ = make_paged_kv_q8_helpers(b, n_pre, nkv, dh,
+        to_pages, _ = make_paged_kv_helpers(b, n_pre, nkv_eff, dh,
+                                            block_size, tables)
+        to_pages_q8, _ = make_paged_kv_q8_helpers(b, n_pre, nkv_eff, dh,
                                                   block_size, tables)
         h, kvs = base_prefill(p, ids)
         for i, (k, v) in enumerate(kvs):
@@ -1468,23 +1736,24 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
         BUILD time) the whole attention block fuses into one Pallas call
         per layer; unsupported shapes fall back to this multi-kernel
         oracle path with a warning."""
-        _, kv_write = make_paged_kv_helpers(b, n_pre, nkv, dh, block_size,
-                                            tables)
+        _, kv_write = make_paged_kv_helpers(b, n_pre, nkv_eff, dh,
+                                            block_size, tables)
         if quant_kv:
-            _, kv_write = make_paged_kv_q8_helpers(b, n_pre, nkv, dh,
+            _, kv_write = make_paged_kv_q8_helpers(b, n_pre, nkv_eff, dh,
                                                    block_size, tables)
 
         def kv_attend(q1, kc, vc, lens):
             return paged_attn(q1, kc, vc, tables, lens)
 
         base = _make_decode_step(cfg, b, kv_write=kv_write,
-                                 kv_attend=kv_attend)
+                                 kv_attend=kv_attend, tp=tp)
         if not use_mega:
             return base
 
         def step(p, kcs, vcs, tok, pos):
             return _megakernel_or_fallback_step(
-                cfg, b, tables, p, kcs, vcs, base)(p, kcs, vcs, tok, pos)
+                cfg, b, tables, p, kcs, vcs, base,
+                tp=tp)(p, kcs, vcs, tok, pos)
 
         return step
 
@@ -1493,13 +1762,15 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
         max_pages = b * pages_per_seq
         if quant_kv:
             def pool():
-                return (jnp.zeros((max_pages, nkv, block_size, dh),
+                return (jnp.zeros((max_pages, nkv_eff, block_size, dh),
                                   jnp.int8),
-                        jnp.zeros((max_pages, nkv), jnp.float32))
+                        jnp.zeros((max_pages, nkv_eff), jnp.float32))
             pools = [(pool(), pool()) for _ in range(n_layers)]
         else:
-            pools = [(jnp.zeros((max_pages, nkv, block_size, dh), dtype),
-                      jnp.zeros((max_pages, nkv, block_size, dh), dtype))
+            pools = [(jnp.zeros((max_pages, nkv_eff, block_size, dh),
+                                dtype),
+                      jnp.zeros((max_pages, nkv_eff, block_size, dh),
+                                dtype))
                      for _ in range(n_layers)]
         h, pools = prefill(p_dec, ids, tables, pools)
         # each row's own last-position logits (ragged batch)
@@ -1512,7 +1783,25 @@ def build_paged_generate(cfg, b, sb, max_new, block_size: int = 64,
                             temperature, top_p, ids.dtype, max_new,
                             eos_token_id, do_sample, top_k, b)
 
-    return run
+    if tp is None:
+        return run
+
+    from ..parallel.mesh import serving_mesh
+    from ..parallel.shard_map_compat import shard_map
+
+    mesh = serving_mesh(tp.mp)
+
+    def run_sharded(p_dec, ids, s0_vec, tables, key, temperature, top_p):
+        # in_specs are derived from the params structure at trace time
+        # (quant pairs vs dense); pools never cross the boundary — they
+        # are born local inside the body
+        specs = serving_param_specs(p_dec, tp)
+        fn = shard_map(run, mesh=mesh,
+                       in_specs=(specs, P(), P(), P(), P(), P(), P()),
+                       out_specs=P(), check_vma=False)
+        return fn(p_dec, ids, s0_vec, tables, key, temperature, top_p)
+
+    return run_sharded
 
 
 def init_quant_serving_params(cfg, quant, seed: int = 0,
@@ -1597,7 +1886,8 @@ def _decode_tail(decode_step, p_dec, kcs, vcs, last_logits,
     return jnp.concatenate(pieces, axis=1).astype(ids_dtype)
 
 
-def _make_decode_step(cfg, b, max_seq=None, kv_write=None, kv_attend=None):
+def _make_decode_step(cfg, b, max_seq=None, kv_write=None, kv_attend=None,
+                      tp=None):
     """Single-token decode step — the per-layer transformer math shared
     by EVERY generation program (fp, quant-only, paged); only the KV
     store differs, injected via two callbacks:
@@ -1608,10 +1898,21 @@ def _make_decode_step(cfg, b, max_seq=None, kv_write=None, kv_attend=None):
 
     Defaults (both None, requires max_seq): contiguous [B, Hkv, max_seq,
     D] caches with the grouped masked softmax — the
-    masked_multihead_attention math."""
+    masked_multihead_attention math.
+
+    With `tp` (ServingTP, inside a shard_map body) the projections
+    compute only the local shard's heads, kv_write/kv_attend operate on
+    the local pool shard, and the per-shard context all-gathers along
+    the head axis before the replicated o-proj — the ONE cross-chip
+    collective per decode step per layer (the o-proj activations)."""
     nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
-    group = nh // nkv
+    nh_l = tp.nh_local if tp is not None else nh
+    nkv_l = tp.nkv_local if tp is not None else nkv
+    # GQA group from the LOCAL shard's head counts, never the full
+    # model config (nh//nkv) — under the replicated-KV MQA fallback the
+    # local group is nh_l // nkv, not nh // nkv (ISSUE 7 satellite)
+    group = nh_l // nkv_l
     n_layers = cfg.num_hidden_layers
     eps = cfg.rms_norm_eps
     head_logits = _make_head_logits(cfg)
@@ -1652,17 +1953,19 @@ def _make_decode_step(cfg, b, max_seq=None, kv_write=None, kv_attend=None):
             pre = f"llama.layers.{i}."
             x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
             q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
-                b, 1, nh, dh)
+                b, 1, nh_l, dh)
             k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
-                b, 1, nkv, dh)
+                b, 1, nkv_l, dh)
             v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
-                b, 1, nkv, dh)
+                b, 1, nkv_l, dh)
             q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
                                     base=cfg.rope_theta)
             kc, vc = kv_write(kcs[i], vcs[i], k, v, pos)
             new_kcs.append(kc)
             new_vcs.append(vc)
-            ctx = kv_attend(q[:, 0], kc, vc, pos)
+            ctx = kv_attend(q[:, 0], kc, vc, pos)       # [b, nh_l, dh]
+            if tp is not None:
+                ctx = tp.gather_heads(ctx)              # [b, nh, dh]
             h = h + _mm(ctx.reshape(b, 1, nh * dh),
                         p[pre + "self_attn.o_proj.weight"])
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)

@@ -24,6 +24,8 @@ HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
 # the axes a data batch shards over (dp + the ZeRO axis); the single source
 # for model activation specs and the Ulysses shard_map specs
 BATCH_AXES = ("dp", "sharding")
+# the tensor-parallel axis (model weights / kv heads)
+MP_AXIS = "mp"
 
 
 def divisible_prefix(mesh, dim: int, names) -> tuple:
@@ -67,6 +69,25 @@ def build_mesh(
             f"got {len(devices)}")
     dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, axis_names)
+
+
+def serving_mesh(mp: int, devices: Optional[Sequence] = None) \
+        -> Optional[Mesh]:
+    """Single-axis `mp` mesh over the first `mp` local devices — the
+    tensor-parallel serving topology (FLAGS_serving_mp). Kept separate
+    from the global hybrid training mesh: the serving engine owns its
+    own mesh so a co-resident trainer's dp/pp axes never leak into the
+    paged programs' shard_map specs. Returns None at mp == 1 (the
+    single-chip path takes no mesh at all)."""
+    mp = int(mp)
+    if mp <= 1:
+        return None
+    if devices is None:
+        devices = jax.devices()
+    if mp > len(devices):
+        raise ValueError(
+            f"serving_mp={mp} needs {mp} devices, found {len(devices)}")
+    return build_mesh({MP_AXIS: mp}, devices=list(devices)[:mp])
 
 
 def set_global_mesh(mesh: Mesh) -> None:

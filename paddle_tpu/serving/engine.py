@@ -59,6 +59,33 @@ unchanged; `kv_pool_bytes=` sizes the pool by bytes instead.
 Weights go through the `_decode_params` layout (`_mm`), so dense AND
 weight-only int8/int4 serving compose with the engine unchanged (and
 with the int8 KV cache: weight quant and KV quant are independent).
+
+**Tensor-parallel serving** (FLAGS_serving_mp, default 1): the paged
+pools and their int8 scale sidecars shard by KV HEAD across an `mp`
+mesh; block tables, budgets, lengths and every other scheduling input
+replicate, so page ids mean the same thing on every chip and all host
+bookkeeping above is untouched. Each device program runs under
+shard_map — prefill, prefix prefill and the decode chunk all stream
+only their shard's kv heads — and the sole cross-chip traffic is the
+per-layer all-gather of the o-proj activations (the per-shard
+attention outputs; the o-proj itself and the whole MLP/lm-head tail
+compute replicated, which keeps every per-element computation
+identical to the single-chip program: mp=2/4 is TOKEN-IDENTICAL, not
+just close). Per-chip KV bytes drop to 1/mp at the same aggregate
+page capacity — the lever that lets batch x context outgrow one
+chip's HBM. MQA models (nkv=1) fall back to replicated pools with
+sharded query heads, warned at build time.
+
+**Prefill/decode disaggregation** (`disaggregated=True`): admission
+(the prefill worker) and decode chunking (the decode worker) decouple
+— prefill runs up to `slots` requests ahead into a handoff queue
+without waiting for a free decode slot, and the decode worker maps
+handed-off requests into slots as they free. Because the pools are
+shared (and sharded), the "KV transfer" between workers is nothing
+but block-table bookkeeping, and the refcounted prefix cache is a
+cross-worker resource: a prefill-worker insert serves later decode-
+worker admissions, and retire paths on either side only ever release
+references.
 """
 from __future__ import annotations
 
@@ -77,9 +104,10 @@ from ..models.llama import (PagedKVManager, _make_decode_step,
                             _make_prefill_with_prefix,
                             _megakernel_or_fallback_step, _sample_next,
                             hash_prefix_blocks, make_paged_kv_helpers,
-                            make_paged_kv_q8_helpers,
+                            make_paged_kv_q8_helpers, make_serving_tp,
                             resolve_decode_megakernel,
-                            resolve_kv_cache_dtype)
+                            resolve_kv_cache_dtype, resolve_serving_mp,
+                            serving_param_specs, shard_serving_params)
 from ..resilience import chaos
 
 
@@ -172,7 +200,9 @@ class ContinuousBatchingEngine:
                  prefix_cache: bool = True, double_buffer: bool = False,
                  kv_cache_dtype: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
-                 decode_megakernel: Optional[bool] = None):
+                 decode_megakernel: Optional[bool] = None,
+                 serving_mp: Optional[int] = None,
+                 disaggregated: bool = False):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
         paged-pool element type: int8 pools halve the HBM bytes every
@@ -181,7 +211,30 @@ class ContinuousBatchingEngine:
         dequantized in-kernel). `kv_pool_bytes` sizes the pool by a
         DEVICE BYTE budget instead of `max_pages` — at the same budget
         an int8 pool holds ~2x the pages, i.e. ~2x `n_cacheable_pages`
-        before LRU eviction."""
+        before LRU eviction; under kv-head sharding the budget is
+        PER-CHIP, so mp shards hold ~mp x the aggregate pages.
+
+        `serving_mp` (default from FLAGS_serving_mp /
+        PADDLE_TPU_SERVING_MP, resolved HERE at build time like the
+        kv-dtype and megakernel flags — it joins every program key and
+        `warm()` covers it) shards the engine across an `mp` mesh: the
+        paged K/V pools and their int8 scale sidecars shard by kv head,
+        block tables / budgets / slot state replicate, and every device
+        program runs under shard_map with ONE cross-chip collective per
+        layer (the o-proj activation all-gather). mp=1 is byte-
+        identical to a build without the flag. Models whose kv heads
+        don't divide mp (MQA) fall back to replicated-KV
+        head-sharded-Q with a build-time warning.
+
+        `disaggregated` splits scheduling into a PREFILL worker and a
+        DECODE worker with paged-KV handoff: admission prefills up to
+        `slots` requests ahead without waiting for a free decode slot
+        (their pages — sharded under mp — are already resident), and
+        the decode worker maps handed-off requests into slots via the
+        replicated block table; the refcounted prefix cache is shared
+        by both workers. Token output is identical to the unified
+        scheduler; what changes is that prefill admission no longer
+        queues behind decode slot occupancy."""
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a whole number of "
@@ -213,6 +266,26 @@ class ContinuousBatchingEngine:
         # decode-chunk program is compiled once per engine, so the flag
         # is part of this engine's identity (warm() covers it)
         self.use_megakernel = resolve_decode_megakernel(decode_megakernel)
+        # tensor-parallel degree (FLAGS_serving_mp), resolved at build
+        # time like the flags above; mp=1 builds exactly the single-chip
+        # programs (no mesh, no shard_map — byte-identical)
+        self.mp = resolve_serving_mp(serving_mp)
+        self._tp = make_serving_tp(cfg, self.mp)
+        self.mp_mesh = None
+        if self._tp is not None:
+            from ..parallel.mesh import serving_mesh
+
+            self.mp_mesh = serving_mesh(self.mp)
+        # kv-head shard count of the POOLS: mp when they shard, 1 when
+        # replicated (single-chip or the MQA fallback) — the geometry
+        # byte accounting and budget sizing run on
+        self.kv_shards = self.mp if (self._tp is not None
+                                     and self._tp.kv_sharded) else 1
+        # prefill/decode disaggregation: prefilled-but-unslotted
+        # requests wait here with their pages already committed
+        self.disaggregated = bool(disaggregated)
+        self._handoff: list[ServeRequest] = []
+        self.prefill_handoffs = 0   # requests that crossed the handoff
         # pool capacity: every slot simultaneously full-length at the
         # ENGINE budget, +1 scratch page. Per-request reservations are
         # never larger — _plan TRIMS a cached prefix until the hit
@@ -232,10 +305,14 @@ class ContinuousBatchingEngine:
             if max_pages is not None:
                 raise ValueError(
                     "pass max_pages OR kv_pool_bytes, not both")
+            # PER-CHIP budget: under kv-head sharding each chip holds
+            # only nkv/mp heads of every page, so the same per-chip
+            # bytes buy ~mp x the aggregate cacheable pages
             max_pages = PagedKVManager.pages_for_bytes(
                 kv_pool_bytes, block_size,
                 n_layers=cfg.num_hidden_layers, num_kv_heads=nkv,
-                head_dim=dh, kv_cache_dtype=self.kv_dtype)
+                head_dim=dh, kv_cache_dtype=self.kv_dtype,
+                mp=self.kv_shards)
             if max_pages < cap + 2:
                 raise ValueError(
                     f"kv_pool_bytes {kv_pool_bytes} holds only "
@@ -247,7 +324,8 @@ class ContinuousBatchingEngine:
         self.mgr = PagedKVManager(max_pages, block_size)
         self.mgr.set_pool_geometry(n_layers=cfg.num_hidden_layers,
                                    num_kv_heads=nkv, head_dim=dh,
-                                   kv_cache_dtype=self.kv_dtype)
+                                   kv_cache_dtype=self.kv_dtype,
+                                   mp=self.kv_shards)
         self.scratch_page = self.mgr.alloc_pages(1)[0]  # retired rows' sink
         if self.kv_dtype == "int8":
             # (int8 pool, per-(page, kv head) f32 absmax scale) pairs —
@@ -257,13 +335,35 @@ class ContinuousBatchingEngine:
                 return (jnp.zeros((max_pages, nkv, block_size, dh),
                                   jnp.int8),
                         jnp.zeros((max_pages, nkv), jnp.float32))
-            self.kcs = [_pool() for _ in range(cfg.num_hidden_layers)]
-            self.vcs = [_pool() for _ in range(cfg.num_hidden_layers)]
         else:
-            self.kcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
-                        for _ in range(cfg.num_hidden_layers)]
-            self.vcs = [jnp.zeros((max_pages, nkv, block_size, dh), dtype)
-                        for _ in range(cfg.num_hidden_layers)]
+            def _pool():
+                return jnp.zeros((max_pages, nkv, block_size, dh), dtype)
+        if self._tp is not None:
+            # pools are BORN on the serving mesh (kv-head sharded, or
+            # replicated under the MQA fallback): max_pages was sized
+            # from a PER-CHIP byte budget, so materializing a full pool
+            # on one chip and resharding would transiently hold mp x
+            # that budget — the exact overflow kv-head sharding exists
+            # to avoid. jit with out_shardings allocates each shard on
+            # its own device; one compile covers all layers (k and v
+            # entries share shape/dtype/spec).
+            from jax.sharding import NamedSharding
+
+            sp = self._pool_entry_spec()
+            # sp is a (pool, scale) spec PAIR on int8, a single spec on
+            # bf16 (PartitionSpec subclasses tuple, so key on the dtype)
+            out = tuple(NamedSharding(self.mp_mesh, s) for s in sp) \
+                if self.kv_dtype == "int8" \
+                else NamedSharding(self.mp_mesh, sp)
+            _pool = jax.jit(_pool, out_shardings=out)
+        self.kcs = [_pool() for _ in range(cfg.num_hidden_layers)]
+        self.vcs = [_pool() for _ in range(cfg.num_hidden_layers)]
+        if self._tp is not None:
+            # params per `serving_param_specs` (q/k/v columns sharded,
+            # the rest — o-proj included — replicated). Logical shapes
+            # are unchanged: the shard_map bodies see the local slices
+            self.p = shard_serving_params(self.p, self.mp_mesh, self._tp)
+            self._param_specs = serving_param_specs(self.p, self._tp)
         self._slots = [_Slot() for _ in range(slots)]
         self._tables = np.full((slots, cap), self.scratch_page, np.int32)
         self._tokens = np.zeros((slots,), np.int32)
@@ -273,8 +373,9 @@ class ContinuousBatchingEngine:
         self.finished: list[ServeRequest] = []
         self._next_id = 0
         self._prefill_cache = {}
-        self._decode = jax.jit(self._build_decode_chunk(),
-                               donate_argnums=(1, 2))
+        self._decode = jax.jit(
+            self._shard_program(self._build_decode_chunk(), 8, 3),
+            donate_argnums=(1, 2))
         self.device_steps = 0    # decode-chunk dispatches (for metrics)
         self.prefill_calls = 0   # batched-admission device calls
         self.hung_retired = 0    # slots retired by the watchdog
@@ -314,6 +415,53 @@ class ContinuousBatchingEngine:
         # constructed yet when __init__ sizes the pool from this)
         return -(-(sb + max_new) // self.block_size)
 
+    # ---- tensor-parallel plumbing (FLAGS_serving_mp) --------------------
+
+    @property
+    def _nkv_eff(self) -> int:
+        """kv-head count of the pools a program BODY sees: the local
+        shard's under kv-head sharding, the full model's otherwise
+        (single chip, or the replicated-KV MQA fallback)."""
+        return self._tp.nkv_local if self._tp is not None \
+            else self.cfg.num_key_value_heads
+
+    def _pool_entry_spec(self):
+        """PartitionSpec(s) of one per-layer K or V pool entry on the
+        serving mesh: [max_pages, nkv, block, dh] sharded on the
+        kv-head axis (scale sidecars [max_pages, nkv] likewise), or
+        fully replicated under the MQA fallback."""
+        from jax.sharding import PartitionSpec as P
+
+        shard = self._tp is not None and self._tp.kv_sharded
+        # NOTE: trailing-None-free form — jit normalizes output specs
+        # (P(None, 'mp', None, None) comes back as P(None, 'mp')) and
+        # treats the two spellings as DIFFERENT shardings; matching the
+        # normalized form keeps warm()'s compile serving the steady
+        # state instead of donating into a one-entry-stale cache
+        pool = P(None, self._tp.axis) if shard else P()
+        sc = P(None, self._tp.axis) if shard else P()
+        return (pool, sc) if self.kv_dtype == "int8" else pool
+
+    def _shard_program(self, fn, n_repl: int, n_out_repl: int):
+        """Wrap an engine device program (signature: p, kcs, vcs,
+        *replicated) in shard_map over the serving mesh. Params follow
+        `serving_param_specs`, pools follow `_pool_entry_spec`, every
+        other input — and the leading `n_out_repl` outputs (tokens,
+        lengths, done flags) — replicates; the trailing outputs are the
+        threaded (donated) pools. Identity at mp=1: the single-chip
+        engine never touches shard_map."""
+        if self._tp is None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.shard_map_compat import shard_map
+
+        pools = [self._pool_entry_spec()] * self.cfg.num_hidden_layers
+        in_specs = (self._param_specs, pools, pools) + (P(),) * n_repl
+        out_specs = (P(),) * n_out_repl + (pools, pools)
+        return shard_map(fn, mesh=self.mp_mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
     @property
     def n_active(self) -> int:
         return sum(1 for s in self._slots if s.req is not None)
@@ -331,7 +479,8 @@ class ContinuousBatchingEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting) or self.n_active > 0
+        return bool(self.waiting) or bool(self._handoff) \
+            or self.n_active > 0
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -409,9 +558,8 @@ class ContinuousBatchingEngine:
         page."""
         cfg = self.cfg
         bs = self.block_size
-        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
         n_pre = sb // bs
-        base = _make_prefill(cfg, bsz, sb)
+        base = _make_prefill(cfg, bsz, sb, tp=self._tp)
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
         scatter = self._page_scatter(bsz, n_pre)
@@ -432,9 +580,11 @@ class ContinuousBatchingEngine:
         """The prefill K/V page scatter shared by the cold and
         cached-prefix prefill programs — THE quantize-on-scatter seam:
         the int8 path computes each page's absmax in f32 and stores the
-        int8 page + its scale row in the same update."""
+        int8 page + its scale row in the same update. Under serving_mp
+        the helpers are built at the LOCAL kv-head count — the scatter
+        runs inside the shard_map body on the local pool shard."""
         cfg = self.cfg
-        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        nkv, dh = self._nkv_eff, cfg.head_dim
         bs = self.block_size
         to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
         if self.kv_dtype != "int8":
@@ -469,7 +619,8 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         bs = self.block_size
         n_pre = sb // bs
-        base = _make_prefill_with_prefix(cfg, bsz, sb, w_pre, bs)
+        base = _make_prefill_with_prefix(cfg, bsz, sb, w_pre, bs,
+                                         tp=self._tp)
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
         scatter = self._page_scatter(bsz, n_pre)
@@ -501,15 +652,18 @@ class ContinuousBatchingEngine:
         do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
         quant = self.kv_dtype == "int8"
         use_mega = self.use_megakernel
+        nkv_eff = self._nkv_eff
+        tp = self._tp
 
         def make_step(tables, p, kcs, vcs):
             """Per-layer decode body for one chunk: the megakernel
             (FLAGS_decode_megakernel) when enabled and supported for
-            these operand shapes, else the multi-kernel oracle path."""
+            these operand shapes, else the multi-kernel oracle path.
+            Under serving_mp this runs inside the shard_map body — the
+            kv helpers and the attention see the LOCAL kv heads."""
             if quant:
                 _, kv_write = make_paged_kv_q8_helpers(
-                    b, 0, cfg.num_key_value_heads, cfg.head_dim, bs,
-                    tables)
+                    b, 0, nkv_eff, cfg.head_dim, bs, tables)
 
                 def kv_attend(q1, kct, vct, lens_):
                     (kc, ksc), (vc, vsc) = kct, vct
@@ -518,19 +672,18 @@ class ContinuousBatchingEngine:
                                                   v_scale=vsc)
             else:
                 _, kv_write = make_paged_kv_helpers(
-                    b, 0, cfg.num_key_value_heads, cfg.head_dim, bs,
-                    tables)
+                    b, 0, nkv_eff, cfg.head_dim, bs, tables)
 
                 def kv_attend(q1, kc, vc, lens_):
                     return paged_decode_attention(q1, kc, vc, tables,
                                                   lens_)
 
             base = _make_decode_step(cfg, b, kv_write=kv_write,
-                                     kv_attend=kv_attend)
+                                     kv_attend=kv_attend, tp=tp)
             if not use_mega:
                 return base
             return _megakernel_or_fallback_step(cfg, b, tables, p, kcs,
-                                                vcs, base)
+                                                vcs, base, tp=tp)
 
         def run(p, kcs, vcs, toks, lens, budgets, tables, live, key,
                 temperature, top_p):
@@ -570,17 +723,19 @@ class ContinuousBatchingEngine:
         dtype rides every key: an engine only ever builds programs at
         its own kv_cache_dtype, and the key makes that self-evident in
         compile_stats()."""
-        key = ("cold", sb, bsz, self.kv_dtype)
+        key = ("cold", sb, bsz, self.kv_dtype, self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
-                self._build_prefill(sb, bsz), donate_argnums=(1, 2))
+                self._shard_program(self._build_prefill(sb, bsz), 6, 1),
+                donate_argnums=(1, 2))
         return self._prefill_cache[key]
 
     def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
-        key = ("prefix", sb, bsz, w_pre, self.kv_dtype)
+        key = ("prefix", sb, bsz, w_pre, self.kv_dtype, self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
-                self._build_prefix_prefill(sb, bsz, w_pre),
+                self._shard_program(
+                    self._build_prefix_prefill(sb, bsz, w_pre), 8, 1),
                 donate_argnums=(1, 2))
         return self._prefill_cache[key]
 
@@ -742,17 +897,27 @@ class ContinuousBatchingEngine:
         bs = self.block_size
         while self.waiting:
             self._check_owner(token)
-            free_slots = [i for i, s in enumerate(self._slots)
-                          if s.req is None]
-            if not free_slots:
-                return
+            if self.disaggregated:
+                # prefill WORKER: bounded by handoff headroom (at most
+                # `slots` prefilled requests parked at the handoff) and
+                # by pages — never by decode slot occupancy; that
+                # decoupling is the disaggregation
+                room = self.slots - len(self._handoff)
+                if room <= 0:
+                    return
+                limit = min(room, self.prefill_batch)
+            else:
+                free_slots = [i for i, s in enumerate(self._slots)
+                              if s.req is None]
+                if not free_slots:
+                    return
+                limit = min(len(free_slots), self.prefill_batch)
             head = self._plan(self.waiting[0])
             key = (head.sb_suf, head.n_cached > 0)
             batch, plans = [], []
             # available = free + evictable; acquiring a refcount-0
             # cached page also consumes availability (n_lru)
             avail = self.mgr.n_available
-            limit = min(len(free_slots), self.prefill_batch)
             for req in self.waiting:
                 if len(batch) >= limit:
                     break
@@ -795,7 +960,9 @@ class ContinuousBatchingEngine:
                 for row, (req, plan) in enumerate(zip(batch, plans)):
                     cached = acquired[row]
                     priv = self.mgr.alloc_pages(plan.need)
-                    req.slot, req.bucket = free_slots[row], sb_suf
+                    req.bucket = sb_suf
+                    if not self.disaggregated:
+                        req.slot = free_slots[row]
                     req.pages = cached + priv
                     req.n_prefix = len(cached)
                     req.cached_tokens = len(cached) * bs
@@ -837,21 +1004,9 @@ class ContinuousBatchingEngine:
                 del self.waiting[:len(batch)]
                 now = time.perf_counter()
                 for row, (req, plan) in enumerate(zip(batch, plans)):
-                    slot_id = req.slot
-                    slot = self._slots[slot_id]
                     first = int(firsts[row])
                     req.tokens.append(first)
                     req.prefill_time = now
-                    slot.req = req
-                    slot.length = len(req.prompt)
-                    slot.emitted = 1
-                    slot.done = self.eos is not None and first == self.eos
-                    padded = req.pages + [req.pages[-1]] * \
-                        (self.table_width - len(req.pages))
-                    self._tables[slot_id] = padded
-                    self._tokens[slot_id] = first
-                    self._budgets[slot_id] = len(req.prompt) + req.max_new
-                    self._override[slot_id] = True
                     self.prompt_tokens += len(req.prompt)
                     self.prefix_hit_tokens += req.cached_tokens
                     if self.prefix_cache:
@@ -866,8 +1021,66 @@ class ContinuousBatchingEngine:
                                 req.pages[req.n_prefix:full],
                                 start_block=req.n_prefix,
                                 hashes=req.block_hashes)
-                    if slot.done or req.max_new == 1:
-                        self._retire(slot_id)
+                    if self.disaggregated:
+                        # prefill -> decode HANDOFF: the "KV transfer"
+                        # is nothing — the pages (sharded under mp) are
+                        # already resident; the decode worker maps them
+                        # through the replicated block table at install
+                        self.prefill_handoffs += 1
+                        if (self.eos is not None and first == self.eos) \
+                                or req.max_new == 1:
+                            self._finish_prefilled(req)
+                        else:
+                            self._handoff.append(req)
+                    else:
+                        self._bind_slot(req.slot, req)
+
+    def _bind_slot(self, slot_id: int, req: ServeRequest):
+        """Install a prefilled request into a decode slot: map its
+        already-resident pages into the replicated block table and seed
+        the chunk inputs from its first sampled token. Shared by
+        unified admission and the disaggregated decode worker
+        (`_install_handoffs`) — the install is pure host bookkeeping
+        either way."""
+        first = req.tokens[0]
+        slot = self._slots[slot_id]
+        req.slot = slot_id
+        slot.req = req
+        slot.length = len(req.prompt)
+        slot.emitted = 1
+        slot.done = self.eos is not None and first == self.eos
+        padded = req.pages + [req.pages[-1]] * \
+            (self.table_width - len(req.pages))
+        self._tables[slot_id] = padded
+        self._tokens[slot_id] = first
+        self._budgets[slot_id] = len(req.prompt) + req.max_new
+        self._override[slot_id] = True
+        if slot.done or req.max_new == 1:
+            self._retire(slot_id)
+
+    def _finish_prefilled(self, req: ServeRequest):
+        """A request fully served by its prefill (EOS first token, or
+        max_new == 1) retires at the handoff without ever taking a
+        decode slot; its pages release through the refcounted free."""
+        req.finish_time = time.perf_counter()
+        self.finished.append(req)
+        self.mgr.free(req.pages)
+        req.pages = None
+
+    def _install_handoffs(self, token: Optional[int] = None):
+        """Decode-worker half of the disaggregated split: map handed-
+        off requests (FIFO) into free decode slots. No device work —
+        prefill committed the pages, so installing is writing the
+        replicated block table row and chunk seeds."""
+        if not self._handoff:
+            return
+        with self._commit_lock:
+            self._check_owner(token)
+            for slot_id, slot in enumerate(self._slots):
+                if not self._handoff:
+                    break
+                if slot.req is None:
+                    self._bind_slot(slot_id, self._handoff.pop(0))
 
     def _retire(self, slot_id: int, failed: bool = False,
                 error: Optional[str] = None):
@@ -988,6 +1201,8 @@ class ContinuousBatchingEngine:
         if wd is not None:
             wd.phase = "admit"
         self._admit(token)
+        if self.disaggregated:
+            self._install_handoffs(token)
         rec = self._dispatch_chunk(token, chain=False)
         if rec is None:
             return 0
@@ -1006,6 +1221,8 @@ class ContinuousBatchingEngine:
         if wd is not None:
             wd.phase = "admit"
         self._admit(token)
+        if self.disaggregated:
+            self._install_handoffs(token)
         rec = self._dispatch_chunk(token, chain=True)
         with self._commit_lock:
             self._check_owner(token)

@@ -539,6 +539,138 @@ class TestCollectives:
                              rules=["TPU401"], mesh_axes=("dp",))
         assert not diags(r, "TPU401")
 
+    # -- unquantized large-collective payloads (EQuARX candidates) ------
+
+    def test_large_unquantized_collective_flagged(self):
+        """A float psum over > max_collective_bytes fires with the
+        quantize hint; the same payload under the threshold is clean."""
+        mesh = self._mesh()
+
+        def f(x):
+            return jax.lax.psum(x * 1.0, "dp")
+
+        # per-SHARD payload is what the traced jaxpr sees: (1, 64, 128)
+        # f32 = 32 KiB on each of the 8 dp shards
+        big = jnp.ones((8, 64, 128), jnp.float32)
+        r = analysis.analyze(
+            self._smap(f, mesh), big, rules=["TPU401"],
+            mesh_axes=("dp",),
+            rule_config={"max_collective_bytes": 1 << 14})
+        found = [d for d in diags(r, "TPU401")
+                 if "float payload" in d.message]
+        assert found and "EQuARX" in (found[0].hint or "")
+        # default threshold (1 MiB) does not fire at this size
+        r2 = analysis.analyze(self._smap(f, mesh), big,
+                              rules=["TPU401"], mesh_axes=("dp",))
+        assert not [d for d in diags(r2, "TPU401")
+                    if "float payload" in d.message]
+
+    def test_bf16_payload_counts_as_float(self):
+        """bfloat16 is an ml_dtypes extension type numpy does NOT class
+        as floating — but bf16 activations/gradients are exactly the
+        payloads this check exists for (the serving o-proj all-gather
+        is bf16). Regression: the size check must fire on bf16."""
+        mesh = self._mesh()
+
+        def f(x):
+            return jax.lax.psum(x * jnp.bfloat16(1.0), "dp")
+
+        big = jnp.ones((8, 64, 128), jnp.bfloat16)   # 16 KiB/shard
+        r = analysis.analyze(
+            self._smap(f, mesh), big, rules=["TPU401"],
+            mesh_axes=("dp",),
+            rule_config={"max_collective_bytes": 1 << 13})
+        found = [d for d in diags(r, "TPU401")
+                 if "float payload" in d.message]
+        assert found, "bf16 payload must count as float bytes"
+        # a one-shot top-level collective is an INFO-grade candidate;
+        # loop bodies (per-iteration cost) escalate to WARNING — the
+        # serving-decode test below asserts the escalated side
+        assert found[0].severity is Severity.INFO
+
+    def test_int8_collective_payload_never_fires(self):
+        """Already-quantized payloads are the lint's GOAL state: an int8
+        all-gather of any size passes (its f32 scale sidecar is tiny)."""
+        mesh = self._mesh()
+
+        def f(q, sc):
+            g = jax.lax.all_gather(q, "dp", axis=0, tiled=True)
+            s = jax.lax.all_gather(sc, "dp", axis=0, tiled=True)
+            return g.astype(jnp.float32) * s[:, None]
+
+        r = analysis.analyze(
+            self._smap2(f, mesh),
+            jnp.ones((8, 4096), jnp.int8), jnp.ones((8,), jnp.float32),
+            rules=["TPU401"], mesh_axes=("dp",),
+            rule_config={"max_collective_bytes": 1 << 10})
+        assert not [d for d in diags(r, "TPU401")
+                    if "float payload" in d.message]
+
+    def test_zero_threshold_disables_size_check(self):
+        mesh = self._mesh()
+
+        def f(x):
+            return jax.lax.psum(x * 1.0, "dp")
+
+        r = analysis.analyze(
+            self._smap(f, mesh), jnp.ones((8, 1024, 128), jnp.float32),
+            rules=["TPU401"], mesh_axes=("dp",),
+            rule_config={"max_collective_bytes": 0})
+        assert not [d for d in diags(r, "TPU401")
+                    if "float payload" in d.message]
+
+    def test_serving_decode_all_gather_is_first_customer(self):
+        """The tensor-parallel serving decode step's per-layer o-proj
+        activation all-gather (ISSUE 7) is visible to the size lint: at
+        a tightened threshold the collective inside the decode scan
+        fires WITH the loop-amplification note — the EQuARX follow-up's
+        target. At the default 1 MiB threshold the tiny-model decode
+        program stays clean (a [b, 1, H] bf16 gather is small)."""
+        import dataclasses as _dc
+
+        from jax.sharding import Mesh
+
+        from paddle_tpu.models import LlamaConfig
+        from paddle_tpu.models.llama import build_paged_generate
+
+        cfg = _dc.replace(LlamaConfig.tiny(), num_key_value_heads=2)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+        del mesh  # build_paged_generate makes its own serving mesh
+        fn = build_paged_generate(cfg, 2, 8, 4, 8, serving_mp=2)
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        p = dict(model.raw_state())
+        tables = jnp.zeros((2, 2), jnp.int32)
+        args = (p, jnp.ones((2, 8), jnp.int32),
+                jnp.full((2,), 8, jnp.int32), tables,
+                jax.random.PRNGKey(0), jnp.float32(1.0), jnp.float32(1.0))
+        r = analysis.analyze(fn, *args, rules=["TPU401"],
+                             mesh_axes=("mp",),
+                             rule_config={"max_collective_bytes": 1})
+        loud = [d for d in diags(r, "TPU401")
+                if "float payload" in d.message]
+        assert loud, "the o-proj all-gather must be visible to TPU401"
+        assert any("loop body" in d.message for d in loud)
+        # per-iteration cost escalates: in-loop findings carry the
+        # rule's WARNING severity, not the top-level INFO grade
+        assert all(d.severity is Severity.WARNING for d in loud
+                   if "loop body" in d.message)
+        r2 = analysis.analyze(fn, *args, rules=["TPU401"],
+                              mesh_axes=("mp",))
+        assert not [d for d in diags(r2, "TPU401")
+                    if "float payload" in d.message]
+
+    def _smap2(self, fn, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.parallel.shard_map_compat import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                         out_specs=P("dp"), check_vma=False)
+
 
 # ---------------------------------------------------------------------------
 # TPU501: host sync

@@ -255,6 +255,7 @@ class TestStrategy:
                 np.asarray(p_full[k]), np.asarray(p_gm[k]), rtol=2e-5,
                 atol=2e-6, err_msg=f"gradient-merge diverged on {k}")
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_recompute_pass_changes_compiled_memory(self):
         """Toggling the recompute pass must change the compiled program:
         peak temp memory drops (the backward recomputes instead of saving)."""

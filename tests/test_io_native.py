@@ -62,6 +62,9 @@ class TestShmRing:
 
 
 class TestMultiprocessDataLoader:
+    @pytest.mark.slow  # tier-1 budget: test_multiple_epochs below
+    # keeps the multiprocess loader in tier-1 (same worker plumbing,
+    # epoch reshuffle on top); run explicitly with -m slow
     def test_ordering_and_values(self):
         dl = DataLoader(RangeDS(), batch_size=8, num_workers=3,
                         shuffle=False)

@@ -244,6 +244,9 @@ class TestEngineInt8(unittest.TestCase):
         eng.run(max_iters=300)
         return eng, {r.req_id: r.tokens for r in eng.finished}
 
+    @pytest.mark.slow  # tier-1 budget: int8 engine traffic stays
+    # covered by the parity suites above + the bench traces carry the
+    # >=99% match bar; run explicitly with -m slow
     def test_token_match_rate_vs_bf16_over_shared_prefix(self):
         """The engine-level accuracy guard: int8 greedy tokens over
         shared-prefix traffic agree with the bf16 engine on the vast
@@ -278,6 +281,9 @@ class TestEngineInt8(unittest.TestCase):
         # full drain: every page back except scratch
         self.assertEqual(e8.mgr.n_available, e8.mgr.max_pages - 1)
 
+    @pytest.mark.slow  # tier-1 budget: the mixed-traffic and mp=2
+    # zero-recompile guards (test_serving_engine / test_serving_mp)
+    # keep the warm()-covers-every-key contract in tier-1
     def test_zero_recompiles_after_warm_int8(self):
         """The int8 path keeps the steady-state compile guarantee:
         after warm() covering the traffic's buckets, serving mixed

@@ -132,6 +132,7 @@ class TestLlama:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # over tier-1 budget; run explicitly with -m slow
     def test_parallel_matches_serial(self):
         cfg = LlamaConfig.tiny()
         crit = LlamaPretrainingCriterion(cfg)
@@ -277,6 +278,9 @@ class TestLlama:
                                    top_k=1, temperature=5.0, seed=s)
             assert int(t.numpy()[0, -1]) == greedy_tok
 
+    @pytest.mark.slow  # tier-1 budget: int8-weight serving stays
+    # covered by test_quant_serving_params_and_program and
+    # test_quant_only_prefill_generation_matches
     def test_jit_generate_int8_weight_only_decode(self):
         """quant='weight_only_int8' decode (round-2 VERDICT item 3): the
         int8 per-channel path must track the fp greedy path."""

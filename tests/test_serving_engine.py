@@ -6,6 +6,7 @@ import dataclasses
 import unittest
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -457,6 +458,9 @@ class TestCompileGuard(unittest.TestCase):
         self.assertGreater(eng.prefix_hit_tokens, 0)  # hits exercised
         self.assertEqual(eng.compile_stats(), before)
 
+    @pytest.mark.slow  # tier-1 budget: the mixed-traffic guard above
+    # and the mp=2 guard (test_serving_mp) keep zero-recompile-after-
+    # warm in tier-1; this adds the width-rung sweep
     def test_zero_recompiles_kernel_path_across_prefix_widths(self):
         """The prefix-prefill KERNEL path (FLAGS_prefix_prefill_kernel,
         default on) under the same guard, with hits at DIFFERENT prefix
