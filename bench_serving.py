@@ -1,10 +1,14 @@
 """Serving benchmark: quantized Llama decode on one chip.
 
-Usage: python bench_serving.py CONFIG [CONFIG...]
+Usage: python bench_serving.py CONFIG [CONFIG...] [--trace out.json]
   CONFIG: any key of CONFIGS ({7b,13b,1b}_{int8,int4}, llama3_8b_int8)
   plus `_paged` / `_paged_ragged` variants; each large config runs in
   its own process invocation (a 7B int8 + int4 pair would not co-reside
   in 16 GB HBM).
+  --trace out.json (ISSUE 8): record every timed generate call as an
+  observability span (per-config tracks) and export the chrome-trace/
+  Perfetto JSON; each result row then embeds a `metrics` snapshot
+  (generate-call latency histogram percentiles).
 
 Measures ms/decode-step by paired slope (bench_util.paired_slope_ms):
 the program runs at max_new=2 and max_new=130, the step cost is the
@@ -57,11 +61,47 @@ PAGED_CONFIGS.update({f"{k}_paged_ragged": v for k, v in CONFIGS.items()})
 # round-3→4 "1.11 → 1.33 ms drift" flagged in VERDICT.
 MN_LO, MN_HI = 2, 130
 
+# armed by --trace (observability, ISSUE 8): spans per timed generate
+# call + a per-config latency histogram embedded in each result row
+_TRACER = None
+_METRICS = None
+
 
 def _paired_slope_ms(run, pairs: int = 8):
     from bench_util import paired_slope_ms
 
     return paired_slope_ms(run, MN_LO, MN_HI, pairs)
+
+
+def _timed_run(run, name: str):
+    """Wrap the blocking generate call with a span + histogram sample
+    when --trace armed the sinks; byte-identical callable otherwise."""
+    if _TRACER is None and _METRICS is None:
+        return run
+
+    def wrapped(mn):
+        t0 = time.perf_counter()
+        out = run(mn)
+        t1 = time.perf_counter()
+        if _TRACER is not None:
+            _TRACER.complete(f"generate:{name}", int(t0 * 1e9),
+                             int(t1 * 1e9), max_new=int(mn))
+        if _METRICS is not None:
+            _METRICS.histogram(f"generate_call_s:{name}").observe(t1 - t0)
+        return out
+
+    return wrapped
+
+
+def _row_metrics(name: str):
+    """Percentile snapshot for one config's result row (None when
+    --trace is off)."""
+    if _METRICS is None:
+        return None
+    from bench_util import hist_percentiles_ms
+
+    ms = hist_percentiles_ms(_METRICS.histogram(f"generate_call_s:{name}"))
+    return None if ms is None else {"generate_call_ms": ms}
 
 
 def quant_weight_gb(cfg, quant):
@@ -100,8 +140,8 @@ def run_config(name: str, b: int = 4, sb: int = 128):
     for max_new in (MN_LO, MN_HI):
         fns[max_new] = jax.jit(build_quant_generate(cfg, b, sb, max_new))
         np.asarray(fns[max_new](p, ids, s0, key, one, one))  # compile
-    ms_step = _paired_slope_ms(
-        lambda mn: np.asarray(fns[mn](p, ids, s0, key, one, one)))
+    ms_step = _paired_slope_ms(_timed_run(
+        lambda mn: np.asarray(fns[mn](p, ids, s0, key, one, one)), name))
     tok_s = b / (ms_step / 1e3)
     gb, read_gb = quant_weight_gb(cfg, quant)
     bound_ms = read_gb * 2**30 / 819e9 * 1e3  # v5e ~819 GB/s HBM
@@ -113,6 +153,9 @@ def run_config(name: str, b: int = 4, sb: int = 128):
         "bound_fraction": round(bound_ms / ms_step, 3),
         "init_s": round(t_init, 1), "batch": b,
     }
+    m = _row_metrics(name)
+    if m is not None:
+        result["metrics"] = m
     print(json.dumps(result), flush=True)
     return result
 
@@ -146,9 +189,9 @@ def run_paged_config(name: str, b: int = 4, sb: int = 128,
             build_paged_generate(cfg, b, sb, max_new, block_size))
         np.asarray(fns[max_new](p, ids, s0_vec, tbls[max_new], key,
                                 one, one))
-    ms_step = _paired_slope_ms(
+    ms_step = _paired_slope_ms(_timed_run(
         lambda mn: np.asarray(fns[mn](p, ids, s0_vec, tbls[mn], key,
-                                      one, one)))
+                                      one, one)), name))
     gb, read_gb = quant_weight_gb(cfg, quant)
     bound_ms = read_gb * 2**30 / 819e9 * 1e3
     result = {
@@ -160,14 +203,32 @@ def run_paged_config(name: str, b: int = 4, sb: int = 128,
         "init_s": round(t_init, 1), "batch": b,
         "kv_block_size": block_size,
     }
+    m = _row_metrics(name)
+    if m is not None:
+        result["metrics"] = m
     print(json.dumps(result), flush=True)
     return result
 
 
 if __name__ == "__main__":
-    names = sys.argv[1:] or ["1b_int8"]
+    args = sys.argv[1:]
+    from bench_util import pop_trace_arg
+
+    trace_path = pop_trace_arg(
+        args, "usage: bench_serving.py CONFIG [CONFIG...] "
+              "[--trace out.json]")
+    if trace_path:
+        from paddle_tpu.observability import MetricsRegistry, Tracer
+
+        _TRACER = Tracer(capacity=1 << 18)
+        _METRICS = MetricsRegistry()
+    names = args or ["1b_int8"]
     for nm in names:
         if nm in PAGED_CONFIGS:
             run_paged_config(nm)
         else:
             run_config(nm)
+    if _TRACER is not None:
+        _TRACER.export(trace_path,
+                       metadata={"bench": "bench_serving",
+                                 "configs": names})

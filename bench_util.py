@@ -31,3 +31,28 @@ def paired_slope_ms(run, lo, hi, pairs: int = 8):
     mid = len(slopes) // 2
     return slopes[mid] if len(slopes) % 2 else \
         (slopes[mid - 1] + slopes[mid]) / 2
+
+
+def pop_trace_arg(argv, usage: str):
+    """Extract `--trace PATH` from an argv list in place; returns the
+    path or None. Shared by bench_continuous/bench_serving (ISSUE 8)
+    so the missing-path usage error stays in one place."""
+    import sys
+
+    if "--trace" not in argv:
+        return None
+    i = argv.index("--trace")
+    if i + 1 >= len(argv):
+        sys.exit(usage + "  (--trace needs a path)")
+    path = argv[i + 1]
+    del argv[i:i + 2]
+    return path
+
+
+def hist_percentiles_ms(hist, qs=(50, 90, 99)):
+    """An observability Histogram's percentiles in rounded ms for a
+    bench JSON row; None when the histogram is empty."""
+    if not hist.count:
+        return None
+    return {k: (None if v is None else round(v * 1e3, 2))
+            for k, v in hist.percentiles(qs).items()}

@@ -49,6 +49,7 @@ from . import vision  # noqa: F401
 from . import metric  # noqa: F401
 from . import hapi  # noqa: F401
 from . import distribution  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401

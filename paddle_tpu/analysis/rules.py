@@ -25,6 +25,12 @@ Rule catalog (see analysis/README.md for the long-form docs):
   TPU601 ckpt-in-jit          checkpoint saves / block_until_ready
                               smuggled into a jitted region via a host
                               callback (the save serializes the device)
+  TPU602 trace-in-jit         trace/metrics emitters (span, instant,
+                              record_event, perfetto export) compiled
+                              into a jitted program via a host callback
+                              (a host round-trip per execution; the
+                              observability recorder raises the same
+                              way at trace time)
 
 Custom rules: subclass `Rule`, decorate with `@register_rule`, and pass
 the id in `rules=` (or nothing — registered rules run by default).
@@ -791,6 +797,63 @@ class CheckpointInJitRule(Rule):
                 hint="checkpoint at step boundaries on the host; use "
                      "resilience.CheckpointManager.save(blocking=False) "
                      "so the step never waits on storage")
+
+
+# ---------------------------------------------------------------------------
+# TPU602: trace/metrics emitters inside a jitted region
+# ---------------------------------------------------------------------------
+
+@register_rule
+class TraceEmitterInJitRule(Rule):
+    """Observability emitters (`observability.trace` spans/instants,
+    `record_event`, metric observes, chrome-trace exporters) wrapped
+    into a jitted program through a host callback. Same failure shape
+    as TPU601 but a different budget: a trace emit is microseconds, so
+    it hides in profiles — yet inside a compiled program it is a host
+    round-trip serialized into EVERY execution (and inside a scan,
+    every iteration), precisely the per-step stall the bounded
+    host-side recorder exists to avoid. Tracing belongs on the host
+    BETWEEN dispatches.
+
+    Detection is the TPU601 callback-identity mechanism
+    (`_callback_identity`: the callback's bare ``__name__``). The
+    dynamic half of the guard lives in `observability.trace`, which
+    raises `TraceUnderJitError` when a span/instant is emitted at
+    trace time — this rule catches the emitters that reach the jaxpr
+    as explicit `pure_callback`/`io_callback` wrappers instead."""
+
+    id = "TPU602"
+    name = "trace-in-jit"
+    default_severity = Severity.ERROR
+
+    CALLBACKS = HostSyncRule.CALLBACKS
+    import re as _re
+    # bare-__name__ matching, (?:\b|_) around the short tokens so
+    # snake_case emitters (emit_span, trace_step, record_instant)
+    # match; 'log_metrics'-style benign logging stays TPU501's
+    # business — only names that identify a TRACE/SPAN emitter fire
+    PATTERN = _re.compile(
+        r"(?:\b|_)spans?(?:\b|_)|(?:\b|_)traces?(?:\b|_)"
+        r"|(?:\b|_)instant(?:\b|_)|(?:\b|_)tracer(?:\b|_)"
+        r"|record_event|emit_event|perfetto|observability"
+        r"|chrome_trac", _re.IGNORECASE)
+
+    def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        for ctx in graph.eqns():
+            if ctx.primitive not in self.CALLBACKS:
+                continue
+            ident, match_target = _callback_identity(ctx.eqn)
+            if not self.PATTERN.search(match_target):
+                continue
+            yield self.diag(
+                f"host callback `{ident}` looks like a trace/metrics "
+                "emitter compiled into the jitted program: a host "
+                "round-trip serializes the device every execution"
+                + (" EVERY loop iteration" if ctx.in_loop else ""),
+                where=ctx.path,
+                hint="emit spans on the host between dispatches; the "
+                     "observability recorder raises TraceUnderJitError "
+                     "at trace time for exactly this reason")
 
 
 def _callback_identity(eqn) -> tuple:

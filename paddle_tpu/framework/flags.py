@@ -135,6 +135,23 @@ define_flag("serving_mp", 1,
             "warming) an engine (also: PADDLE_TPU_SERVING_MP)",
             env_aliases=("PADDLE_TPU_SERVING_MP",))
 
+# --- observability (paddle_tpu.observability) ---
+define_flag("trace", "",
+            "host span tracing: a non-empty value arms the global "
+            "observability tracer and is the chrome-trace/Perfetto "
+            "JSON export path (written at exit, or via "
+            "observability.trace.export_global()). Empty (default) = "
+            "off with a no-allocation fast path "
+            "(also: PADDLE_TPU_TRACE)",
+            env_aliases=("PADDLE_TPU_TRACE",))
+define_flag("metrics", False,
+            "arm the global observability metrics registry (TTFT / "
+            "TPOT / queue-wait / chunk-time histograms, resilience "
+            "event log; snapshot()/emit_jsonl()/prometheus_text()). "
+            "Off (default) = a single is-None check per site "
+            "(also: PADDLE_TPU_METRICS)",
+            env_aliases=("PADDLE_TPU_METRICS",))
+
 # --- resilience (paddle_tpu.resilience) ---
 define_flag("tpu_chaos", "",
             "fault-injection spec, e.g. 'io_error:0.1,preempt_at:200,"

@@ -7,6 +7,7 @@ keeps the reference's convenience trainer surface.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -14,6 +15,8 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..metric import Metric
+from ..observability import metrics as obs_metrics
+from ..observability import trace as obs_trace
 from .callbacks import Callback, CallbackList, ProgBarLogger
 
 __all__ = ["Model", "summary"]
@@ -138,6 +141,14 @@ class Model:
             shuffle=False or a seeded sampler).
           checkpoint_freq: save every N steps (async, off the step
             path); None saves at epoch boundaries only.
+
+        Observability (ISSUE 8): with FLAGS_trace / FLAGS_metrics
+        armed, every step records `fit.data_fetch` (loader wait),
+        `fit.step` (train_batch dispatch, bridged to
+        jax.profiler.StepTraceAnnotation so host steps align with a
+        live device trace) and `fit.checkpoint_save` spans plus the
+        matching `fit_*_s` histograms. Off (default): the loop is
+        byte-identical to the uninstrumented one.
         """
         loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
@@ -193,13 +204,35 @@ class Model:
                 logs = {}
                 hit_num_iters = False
                 step = -1
-                for step, batch in enumerate(loader):
+                tr = obs_trace.get_tracer()
+                mt = obs_metrics.get_metrics()
+                batches = loader if tr is None and mt is None \
+                    else self._timed_batches(loader, tr, mt)
+                for step, batch in enumerate(batches):
                     if epoch == start_epoch and step < skip_steps:
                         continue  # replayed batches of a resumed epoch
                     cbks.on_train_batch_begin(step)
                     ins, labs = self._split_batch(batch)
                     update = (step + 1) % accumulate_grad_batches == 0
-                    res = self.train_batch(ins, labs, update=update)
+                    if tr is None and mt is None:
+                        res = self.train_batch(ins, labs, update=update)
+                    else:
+                        t0 = time.perf_counter()
+                        if tr is not None:
+                            # StepTraceAnnotation bridging: host steps
+                            # align with a live XPlane device trace
+                            with tr.step_span("fit.step", it_count):
+                                res = self.train_batch(ins, labs,
+                                                       update=update)
+                        else:
+                            res = self.train_batch(ins, labs,
+                                                   update=update)
+                        if mt is not None:
+                            mt.histogram(
+                                "fit_step_s",
+                                "train step dispatch+sync").observe(
+                                    time.perf_counter() - t0)
+                            mt.counter("fit_steps").inc()
                     logs = self._pack_logs(res)
                     cbks.on_train_batch_end(step, logs)
                     it_count += 1
@@ -216,6 +249,10 @@ class Model:
                             blocking=True)
                         self.preempted = True
                         self.stop_training = True
+                        from ..observability import record_event
+
+                        record_event("preemption.emergency_checkpoint",
+                                     step=it_count, epoch=epoch)
                         break
                     if ckpt_mgr is not None and checkpoint_freq \
                             and it_count % checkpoint_freq == 0:
@@ -253,6 +290,28 @@ class Model:
 
                     _preemption.uninstall()
 
+    @staticmethod
+    def _timed_batches(loader, tr, mt):
+        """Loader wrapped with `fit.data_fetch` spans / histogram —
+        only on the instrumented path (fit falls back to the raw
+        loader when observability is off)."""
+        it = iter(loader)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            t1 = time.perf_counter()
+            if tr is not None:
+                tr.complete("fit.data_fetch", int(t0 * 1e9),
+                            int(t1 * 1e9))
+            if mt is not None:
+                mt.histogram("fit_data_fetch_s",
+                             "host wait on the data loader").observe(
+                                 t1 - t0)
+            yield batch
+
     def _save_checkpoint(self, mgr, epoch, step_in_epoch, global_step,
                          blocking):
         """Model + optimizer + loop position as one atomic generation.
@@ -261,9 +320,21 @@ class Model:
         state = {"model": self.network.state_dict()}
         if self._optimizer is not None:
             state["optimizer"] = self._optimizer.state_dict()
+        tr = obs_trace.get_tracer()
+        mt = obs_metrics.get_metrics()
+        t0 = time.perf_counter()
         mgr.save(state, step=global_step,
                  meta={"epoch": epoch, "step_in_epoch": step_in_epoch,
                        "global_step": global_step}, blocking=blocking)
+        if tr is not None:
+            tr.complete("fit.checkpoint_save", int(t0 * 1e9),
+                        time.perf_counter_ns(), step=global_step,
+                        blocking=blocking)
+        if mt is not None:
+            mt.histogram("fit_checkpoint_save_s",
+                         "checkpoint snapshot+enqueue (or full write "
+                         "when blocking)").observe(
+                             time.perf_counter() - t0)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -19,7 +19,6 @@ the analytic model in tests/test_pipeline.py.
 """
 from __future__ import annotations
 
-import json
 from typing import Dict, List, Optional
 
 __all__ = ["pipeline_timeline", "render_timeline", "timeline_stats",
@@ -185,6 +184,10 @@ def save_chrome_trace(tl: Dict, path: str, tick_us: float = 1000.0,
     meta += [{"name": "thread_name", "ph": "M", "pid": 0, "tid": r,
               "args": {"name": f"pp rank {r}"}}
              for r in range(len(tl["ranks"]))]
-    with open(path, "w") as f:
-        json.dump({"traceEvents": meta + events,
-                   "metadata": {"stats": stats or timeline_stats(tl)}}, f)
+    # one JSON-format implementation for every chrome-trace artifact
+    # (ISSUE 8): emission goes through observability.trace; this
+    # module keeps only the schedule->events assembly
+    from ..observability.trace import write_chrome_trace
+
+    write_chrome_trace(meta + events, path,
+                       metadata={"stats": stats or timeline_stats(tl)})

@@ -231,13 +231,15 @@ class Profiler:
     # ------------------------------------------------------------------
     def export(self, path: str, format: str = "json"):
         """Export host spans as chrome://tracing JSON (reference:
-        profiler.py export / chrome_tracing export at :215)."""
+        profiler.py export / chrome_tracing export at :215). Emission
+        goes through the ONE shared writer
+        (`observability.trace.write_chrome_trace`, ISSUE 8) — same
+        output path and schema as before."""
+        from ..observability.trace import write_chrome_trace
+
         with _events_lock:
             events = list(_events)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events,
-                       "displayTimeUnit": "ms"}, f)
-        return path
+        return write_chrome_trace(events, path, display_time_unit="ms")
 
     def _device_op_stats(self):
         """Parse the captured device trace (the XPlane chrome export jax

@@ -86,9 +86,16 @@ class ChaosMonkey:
                     "known: io_error, corrupt, preempt_at, hang")
             self.faults.append(f)
 
-    def _count(self, fault: _Fault):
+    def _count(self, fault: _Fault, seam: str = ""):
         fault.fired += 1
         self.counters[fault.kind] = self.counters.get(fault.kind, 0) + 1
+        # chaos firings surface as structured observability events so a
+        # chaos-soaked serve/fit can attribute every absorbed fault from
+        # one metrics snapshot (ISSUE 8); .counters stays authoritative
+        from ..observability import record_event
+
+        record_event("chaos." + fault.kind,
+                     seam=seam or fault.seam or None)
 
     def _match(self, kind: str, seam: str) -> Optional[_Fault]:
         for f in self.faults:
@@ -106,7 +113,7 @@ class ChaosMonkey:
         with self._lock:
             hit = self._rng.random() < f.prob
             if hit:
-                self._count(f)
+                self._count(f, seam)
         if hit:
             raise ChaosError(f"chaos: injected IOError at seam {seam!r} "
                              f"(p={f.prob}, seed={self.seed})")
@@ -119,7 +126,7 @@ class ChaosMonkey:
         with self._lock:
             if self._rng.random() >= f.prob:
                 return data
-            self._count(f)
+            self._count(f, seam)
             pos = self._rng.randrange(len(data))
         out = bytearray(data)
         out[pos] ^= 0xFF
@@ -131,7 +138,7 @@ class ChaosMonkey:
         for f in self.faults:
             if f.kind == "preempt_at" and not f.fired and step >= f.step \
                     and (not f.seam or f.seam in loop):
-                self._count(f)
+                self._count(f, loop)
                 from . import preemption
 
                 preemption.self_preempt()
@@ -146,7 +153,7 @@ class ChaosMonkey:
                 with self._lock:
                     if f.fired:
                         continue
-                    self._count(f)
+                    self._count(f, seam)
                 time.sleep(f.seconds)
                 raise ChaosHang(
                     f"chaos: hang at seam {seam!r} elapsed "

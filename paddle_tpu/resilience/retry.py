@@ -110,11 +110,19 @@ class RetryPolicy:
                     self.stats.last_error = f"{type(e).__name__}: {e}"
                     if attempt >= self.max_attempts:
                         self.stats.giveups += 1
+                        self._obs_event("retry.giveup", attempt=attempt,
+                                        error=self.stats.last_error)
                         raise
                     delay = next(delays)
                     self.stats.retries += 1
                     self.stats.delays.append(delay)
                     del self.stats.delays[:-RetryStats.MAX_DELAYS]
+                # RetryStats stays the per-policy source of truth; the
+                # observability event log is where ALL resilience
+                # telemetry converges (ISSUE 8)
+                self._obs_event("retry.backoff", attempt=attempt,
+                                delay_s=round(delay, 4),
+                                error=f"{type(e).__name__}: {e}")
                 if self._on_retry is not None:
                     self._on_retry(attempt, e, delay)
                 self._sleep(delay)
@@ -122,6 +130,12 @@ class RetryPolicy:
                 with self._lock:
                     self.stats.successes += 1
                 return out
+
+    @staticmethod
+    def _obs_event(name: str, **fields):
+        from ..observability import record_event
+
+        record_event(name, **fields)
 
     def wrap(self, fn: Callable) -> Callable:
         @functools.wraps(fn)

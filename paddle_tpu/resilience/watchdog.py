@@ -104,8 +104,14 @@ class Watchdog:
         worker.join(self.timeout_s)
         if worker.is_alive():
             self.timeouts += 1
+            elapsed = time.monotonic() - t0
+            from ..observability import record_event
+
+            record_event("watchdog.timeout", watchdog=self.name,
+                         phase=self.phase, timeout_s=self.timeout_s,
+                         elapsed_s=round(elapsed, 4))
             raise StepTimeout(self.name, self.phase, self.timeout_s,
-                              time.monotonic() - t0)
+                              elapsed)
         if error:
             raise error[0]
         return result[0]

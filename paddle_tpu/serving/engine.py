@@ -108,6 +108,8 @@ from ..models.llama import (PagedKVManager, _make_decode_step,
                             resolve_decode_megakernel,
                             resolve_kv_cache_dtype, resolve_serving_mp,
                             serving_param_specs, shard_serving_params)
+from ..observability import metrics as obs_metrics
+from ..observability import trace as obs_trace
 from ..resilience import chaos
 
 
@@ -202,7 +204,8 @@ class ContinuousBatchingEngine:
                  kv_pool_bytes: Optional[int] = None,
                  decode_megakernel: Optional[bool] = None,
                  serving_mp: Optional[int] = None,
-                 disaggregated: bool = False):
+                 disaggregated: bool = False,
+                 tracer=None, metrics=None):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
         paged-pool element type: int8 pools halve the HBM bytes every
@@ -234,7 +237,20 @@ class ContinuousBatchingEngine:
         replicated block table; the refcounted prefix cache is shared
         by both workers. Token output is identical to the unified
         scheduler; what changes is that prefill admission no longer
-        queues behind decode slot occupancy."""
+        queues behind decode slot occupancy.
+
+        `tracer` / `metrics` (observability, ISSUE 8): an
+        `observability.Tracer` records the full request lifecycle
+        (enqueue -> admit -> prefill dispatch/commit -> handoff ->
+        per-chunk decode -> retire, plus eviction / watchdog /
+        double-buffer-stall events) as Perfetto-exportable spans; a
+        `MetricsRegistry` accumulates the TTFT / TPOT / queue-wait /
+        chunk-time / sync-wait histograms and the structured event
+        log. Default (None): the flag-armed globals (FLAGS_trace /
+        FLAGS_metrics), i.e. off unless the operator opted in — every
+        instrumented site is then one `is None` check. Pass False to
+        force OFF even when the global flags are armed (an untraced
+        baseline must stay untraced)."""
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a whole number of "
@@ -394,6 +410,18 @@ class ContinuousBatchingEngine:
         self._chain_tok = None
         self._chain_lens = None
         self._override = np.ones((slots,), bool)
+        # observability (ISSUE 8): None defers to the flag-armed
+        # globals, False forces OFF regardless of flags (how an
+        # untraced bench baseline stays untraced next to an armed
+        # PADDLE_TPU_TRACE), an instance wins outright. The hot paths
+        # hold the attribute and branch once per event, so the
+        # disabled overhead is unmeasurable (bench_continuous --trace
+        # asserts < 2% tokens/s)
+        self._tracer = obs_trace.get_tracer() if tracer is None \
+            else (tracer or None)
+        self._metrics = obs_metrics.get_metrics() if metrics is None \
+            else (metrics or None)
+        self._evictions_seen = 0  # mgr.prefix_evictions already reported
         # makes ownership-check + device dispatch + host-state commit
         # atomic against the timeout path's epoch-bump + victim-retire
         # (a step completing exactly at the deadline must either fully
@@ -499,6 +527,43 @@ class ContinuousBatchingEngine:
                 self._jit_cache_size(fn)
         return stats
 
+    def metrics(self) -> dict:
+        """Every engine counter in ONE dict (ISSUE 8 satellite) —
+        callers stop poking `eng.sync_wait_s`-style attributes:
+        scheduling counters, prefix-cache effectiveness, sync-wait
+        telemetry, compile stats, and pool occupancy (byte budget via
+        `PagedKVManager.kv_pool_bytes()`). Pure host bookkeeping —
+        safe to call mid-serve from another thread."""
+        mgr = self.mgr
+        in_use = mgr.max_pages - mgr.n_available
+        return {
+            "requests_finished": len(self.finished),
+            "requests_waiting": len(self.waiting),
+            "requests_active": self.n_active,
+            "prefill_calls": self.prefill_calls,
+            "device_steps": self.device_steps,
+            "prefill_handoffs": self.prefill_handoffs,
+            "hung_retired": self.hung_retired,
+            # prefix cache
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "prefix_inserts": self.prefix_inserts,
+            "prefix_evictions": mgr.prefix_evictions,
+            # sync-wait telemetry (what double buffering hides)
+            "sync_wait_s": self.sync_wait_s,
+            "blocked_syncs": self.blocked_syncs,
+            # pool occupancy: pages not reclaimable right now / bytes
+            "kv_cache_dtype": self.kv_dtype,
+            "kv_pool_bytes": mgr.kv_pool_bytes(),
+            "n_cacheable_pages": self.n_cacheable_pages,
+            "n_available": mgr.n_available,
+            "n_cached": mgr.n_cached,
+            "pages_in_use": in_use,
+            "pool_occupancy": in_use / max(mgr.max_pages, 1),
+            "compile_stats": self.compile_stats(),
+        }
+
     @staticmethod
     def _jit_cache_size(fn) -> int:
         try:
@@ -545,6 +610,12 @@ class ContinuousBatchingEngine:
             req.block_hashes = hash_prefix_blocks(prompt, self.block_size)
         self._next_id += 1
         self.waiting.append(req)
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("req.enqueue", req_id=req.req_id,
+                       prompt_len=len(prompt), max_new=req.max_new)
+        if mt is not None:
+            mt.counter("requests_enqueued").inc()
         return req
 
     # ---- device programs ------------------------------------------------
@@ -947,6 +1018,8 @@ class ContinuousBatchingEngine:
                 max(plan.n_cached for plan in plans)) if has_prefix else 1
             ptbl = np.full((bsz, w_call), self.scratch_page, np.int32)
             plens = np.zeros((bsz,), np.int32)
+            tr, mt = self._tracer, self._metrics
+            t_disp0 = time.perf_counter()
             with self._commit_lock:
                 self._check_owner(token)
                 # pin every row's cached prefix BEFORE any alloc —
@@ -992,6 +1065,20 @@ class ContinuousBatchingEngine:
             # blocking readback OUTSIDE the lock: a hung device wait
             # must never hold the lock the timeout path needs
             firsts = np.asarray(firsts_dev)
+            if tr is not None:
+                # dispatch + readback as ONE span: the prefill program's
+                # host-visible cost for this admission batch
+                tr.complete("prefill.dispatch",
+                            int(t_disp0 * 1e9),
+                            time.perf_counter_ns(),
+                            bucket=sb_suf, batch=len(batch),
+                            cached_prefix=has_prefix,
+                            req_ids=[r.req_id for r in batch])
+            if mt is not None:
+                mt.histogram(
+                    "prefill_chunk_s",
+                    "prefill dispatch + first-token readback").observe(
+                        time.perf_counter() - t_disp0)
             # abandoned mid-prefill: commit NOTHING. The batch is still
             # in `waiting` (popped only below), so the live loop
             # re-admits it with fresh pages; this thread's page
@@ -1009,6 +1096,24 @@ class ContinuousBatchingEngine:
                     req.prefill_time = now
                     self.prompt_tokens += len(req.prompt)
                     self.prefix_hit_tokens += req.cached_tokens
+                    if tr is not None:
+                        tr.instant("req.admit", req_id=req.req_id,
+                                   cached_tokens=req.cached_tokens,
+                                   suffix_bucket=sb_suf)
+                    if mt is not None:
+                        # TTFT = arrival -> first token committed;
+                        # queue wait = arrival -> prefill dispatch
+                        mt.histogram(
+                            "ttft_s", "arrival to first token").observe(
+                                now - req.arrival_time)
+                        mt.histogram(
+                            "queue_wait_s",
+                            "arrival to prefill dispatch").observe(
+                                max(t_disp0 - req.arrival_time, 0.0))
+                        mt.counter("requests_admitted").inc()
+                        mt.counter("prompt_tokens").inc(len(req.prompt))
+                        mt.counter("prefix_hit_tokens").inc(
+                            req.cached_tokens)
                     if self.prefix_cache:
                         # register every freshly computed FULL prompt
                         # block (its K/V is prefix-deterministic; decode
@@ -1027,6 +1132,10 @@ class ContinuousBatchingEngine:
                         # already resident; the decode worker maps them
                         # through the replicated block table at install
                         self.prefill_handoffs += 1
+                        if tr is not None:
+                            tr.instant("req.handoff", req_id=req.req_id)
+                        if mt is not None:
+                            mt.counter("prefill_handoffs").inc()
                         if (self.eos is not None and first == self.eos) \
                                 or req.max_new == 1:
                             self._finish_prefilled(req)
@@ -1034,6 +1143,16 @@ class ContinuousBatchingEngine:
                             self._handoff.append(req)
                     else:
                         self._bind_slot(req.slot, req)
+            # LRU prefix evictions since last report (alloc_pages evicts
+            # under pool pressure; surfacing the delta here keeps the
+            # manager observability-free)
+            ev_delta = self.mgr.prefix_evictions - self._evictions_seen
+            if ev_delta:
+                self._evictions_seen = self.mgr.prefix_evictions
+                if tr is not None:
+                    tr.instant("prefix.evict", n=ev_delta)
+                if mt is not None:
+                    mt.counter("prefix_evictions").inc(ev_delta)
 
     def _bind_slot(self, slot_id: int, req: ServeRequest):
         """Install a prefilled request into a decode slot: map its
@@ -1064,6 +1183,14 @@ class ContinuousBatchingEngine:
         decode slot; its pages release through the refcounted free."""
         req.finish_time = time.perf_counter()
         self.finished.append(req)
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            # same lifecycle terminator as _retire — span-coverage
+            # checks must see every request retire, slotless or not
+            tr.instant("req.retire", req_id=req.req_id, slot=None,
+                       tokens=len(req.tokens), failed=False)
+        if mt is not None:
+            mt.counter("requests_finished").inc()
         self.mgr.free(req.pages)
         req.pages = None
 
@@ -1090,6 +1217,20 @@ class ContinuousBatchingEngine:
         req.failed = failed
         req.error = error
         self.finished.append(req)
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("req.retire", req_id=req.req_id, slot=slot_id,
+                       tokens=len(req.tokens), failed=failed)
+        if mt is not None:
+            mt.counter("requests_failed" if failed
+                       else "requests_finished").inc()
+            if not failed and req.prefill_time is not None \
+                    and len(req.tokens) > 1:
+                # time per OUTPUT token, first (prefill) token excluded
+                mt.histogram(
+                    "tpot_s", "decode seconds per output token").observe(
+                        (req.finish_time - req.prefill_time)
+                        / (len(req.tokens) - 1))
         # refcount-aware: private pages recycle now; shared prefix pages
         # only once NO live slot maps them (then LRU, evict on pressure)
         self.mgr.free(req.pages)
@@ -1119,6 +1260,8 @@ class ContinuousBatchingEngine:
         # abort at the owner check without ever dispatching against the
         # donated KV pools from a dead thread
         chaos.maybe_hang("decode")
+        tr, mt = self._tracer, self._metrics
+        t_disp0 = time.perf_counter()
         with self._commit_lock:
             self._check_owner(token)
             self._key, k = jax.random.split(self._key)
@@ -1150,8 +1293,22 @@ class ContinuousBatchingEngine:
                 self._chain_tok = None
                 self._chain_lens = None
                 self._override[:] = True
+            if tr is not None:
+                tr.complete("decode.dispatch", int(t_disp0 * 1e9),
+                            time.perf_counter_ns(),
+                            chunk=self.device_steps,
+                            live=int(live.sum()))
+            if mt is not None:
+                mt.gauge("live_slots", "slots decoding").set(
+                    int(live.sum()))
+                mt.gauge("kv_pages_available",
+                         "free + evictable pool pages").set(
+                             self.mgr.n_available)
+            # dispatch wall time rides the record: _commit_chunk turns
+            # (dispatch start -> readback done) into decode_chunk_s
             return {"out": out, "lens": new_lens, "done": done,
-                    "reqs": [s.req for s in self._slots]}
+                    "reqs": [s.req for s in self._slots],
+                    "t_disp0": t_disp0}
 
     def _commit_chunk(self, rec, token: Optional[int] = None) -> int:
         """Block on a dispatched chunk's host-visible outputs and commit
@@ -1161,11 +1318,29 @@ class ContinuousBatchingEngine:
         skipped — their device work was speculative waste, their writes
         are confined to pages that are overwritten before any new owner
         reads them. Returns live tokens produced."""
+        tr, mt = self._tracer, self._metrics
         t0 = time.perf_counter()
         out = np.asarray(rec["out"])          # the blocking host sync
         new_lens = np.asarray(rec["lens"])
         done = np.asarray(rec["done"])
-        wait = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        wait = t1 - t0
+        stalled = wait > self.stall_threshold_s
+        if tr is not None:
+            # the sync wait was timed anyway — record it retroactively
+            # (a `stalled` span is the double-buffer stall the pipeline
+            # exists to hide; Perfetto query: name='decode.sync_wait'
+            # AND args.stalled)
+            tr.complete("decode.sync_wait", int(t0 * 1e9), int(t1 * 1e9),
+                        stalled=stalled)
+        if mt is not None:
+            mt.histogram("sync_wait_s",
+                         "host blocked on decode readback").observe(wait)
+            mt.histogram("decode_chunk_s",
+                         "decode-chunk dispatch to readback").observe(
+                             t1 - rec.get("t_disp0", t0))
+            if stalled:
+                mt.counter("blocked_syncs").inc()
         with self._commit_lock:
             self._check_owner(token)  # abandoned mid-wait: discard
             self.sync_wait_s += wait
@@ -1188,6 +1363,8 @@ class ContinuousBatchingEngine:
                 self._tokens[slot_id] = toks[-1] if toks else 0
                 if slot.done or slot.emitted >= req.max_new:
                     self._retire(slot_id)
+            if mt is not None:
+                mt.counter("output_tokens").inc(produced)
             return produced
 
     def step(self) -> int:
@@ -1313,5 +1490,15 @@ class ContinuousBatchingEngine:
             return False
         victim = live[0]
         self.hung_retired += 1
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("watchdog.retire_hung_slot", slot=victim,
+                       phase=getattr(exc, "phase", None),
+                       elapsed_s=getattr(exc, "elapsed_s", None))
+        if mt is not None:
+            mt.counter("hung_slots_retired").inc()
+            mt.event("watchdog.retire_hung_slot", slot=victim,
+                     phase=getattr(exc, "phase", None),
+                     timeout_s=getattr(exc, "timeout_s", None))
         self._retire(victim, failed=True, error=str(exc))
         return True

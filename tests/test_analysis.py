@@ -779,6 +779,86 @@ class TestCheckpointInJit:
 
 
 # ---------------------------------------------------------------------------
+# TPU602: trace/metrics emitters smuggled into a jitted region
+# ---------------------------------------------------------------------------
+
+class TestTraceEmitterInJit:
+    def test_span_emitter_callback_is_error(self):
+        def emit_span(x):  # stand-in for a host-side trace emit
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                emit_span, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU602"])
+        found = diags(r, "TPU602")
+        assert found and found[0].severity == Severity.ERROR
+        assert "emit_span" in found[0].message
+
+    def test_record_event_callback_is_error(self):
+        def record_event(x):
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                record_event, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU602"])
+        assert diags(r, "TPU602")
+
+    def test_snake_case_trace_name_flagged(self):
+        def trace_step(x):  # (?:\b|_) so snake_case matches
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                trace_step, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU602"])
+        assert diags(r, "TPU602")
+
+    def test_innocent_callback_not_flagged(self):
+        def fetch_tokens(x):  # a host fetch: TPU501's business, not 602's
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                fetch_tokens, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU602"])
+        assert not diags(r, "TPU602")
+
+    def test_log_metrics_stays_501_business(self):
+        # TPU601's negative case must stay negative for 602 too: plain
+        # host logging is flagged generically by TPU501, not as a
+        # trace-emitter error
+        def log_metrics(x):
+            return np.asarray(x)
+
+        def f(x):
+            return jax.pure_callback(
+                log_metrics, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        r = analysis.analyze(f, jnp.ones((4,)), rules=["TPU602"])
+        assert not diags(r, "TPU602")
+
+    def test_live_span_under_trace_raises_at_trace_time(self):
+        # the dynamic half of the guard: the recorder itself refuses to
+        # emit while jax is tracing (message points at TPU602)
+        from paddle_tpu.observability import Tracer, TraceUnderJitError
+
+        tr = Tracer()
+
+        def f(x):
+            with tr.span("inside.jit"):
+                return x + 1
+
+        with pytest.raises(TraceUnderJitError, match="TPU602"):
+            jax.jit(f)(jnp.ones((4,)))
+
+
+# ---------------------------------------------------------------------------
 # pipeline plumbing: severity policy, custom rules, jit integration
 # ---------------------------------------------------------------------------
 
