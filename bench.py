@@ -493,6 +493,13 @@ def _op_bench(only=None):
             "overhead_pct": round(
                 100.0 * (traced - untraced) / max(untraced, 1e-9), 2),
         }
+        # static memory auditor (ISSUE 10): predicted per-chip peak of
+        # the timed chunk program, recorded so the next TPU run can
+        # compare the estimate against device_memory_stats actuals
+        OP_INFO["serving_decode_chunk"] = {
+            "predicted_peak_hbm_bytes": eng.audit_memory(
+                programs=("decode",))["fleet_peak_hbm_bytes"],
+        }
         del eng, smake
 
     if want("decode_step_1b_mp") and len(jax.devices()) >= 2:
@@ -519,6 +526,10 @@ def _op_bench(only=None):
             "bytes_all_gathered_per_token": int(
                 tcfg.num_hidden_layers * tcfg.num_attention_heads
                 * tcfg.head_dim * 2 * (mp_ - 1) // mp_),
+            # per-chip under kv-head sharding — pairs with the mp=1
+            # row's estimate to confirm the 1/mp pool scaling on device
+            "predicted_peak_hbm_bytes": teng.audit_memory(
+                programs=("decode",))["fleet_peak_hbm_bytes"],
         }
         del teng, trun
 

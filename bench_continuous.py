@@ -214,6 +214,12 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
     useful = sum(len(r.tokens) for r in eng.finished)
     slot_steps = eng.device_steps * STEPS_PER_SYNC * SLOTS
     em = eng.metrics()  # the ONE engine-counter dict (ISSUE 8)
+    # static auditor (ISSUE 10): predicted per-chip peak of the decode
+    # chunk — the steady-state resident bound for this policy's pools
+    # (host-side trace, off the clock); compare against
+    # device_memory_stats on the next TPU run
+    predicted_peak = eng.audit_memory(
+        programs=("decode",))["fleet_peak_hbm_bytes"]
     return {
         "policy": policy, "wall_s": round(wall, 2),
         "useful_tokens": useful,
@@ -235,6 +241,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
         # budget constrains); page counts are aggregate — page ids are
         # global, every chip maps the same table
         "kv_pool_bytes": em["kv_pool_bytes"],
+        "predicted_peak_hbm_bytes": predicted_peak,
         "n_cacheable_pages": em["n_cacheable_pages"],
         "n_available": em["n_available"],
         "n_cached": em["n_cached"],

@@ -31,9 +31,16 @@ from .pipeline import Pipeline, analyze, lint  # noqa: F401
 from .rules import (  # noqa: F401
     RULES, Rule, default_rules, register_rule,
 )
+# imported for its side effect too: registers TPU701/702/703 into RULES
+from . import memory  # noqa: F401,E402
+from .memory import (  # noqa: F401
+    MemoryReport, audit_graph, audit_memory, trace_auto,
+    trace_for_memory,
+)
 
 __all__ = [
-    "Diagnostic", "Graph", "LintError", "Pipeline", "Report", "RULES",
-    "Rule", "Severity", "analyze", "default_rules", "lint",
-    "register_rule", "trace_graph",
+    "Diagnostic", "Graph", "LintError", "MemoryReport", "Pipeline",
+    "Report", "RULES", "Rule", "Severity", "analyze", "audit_graph",
+    "audit_memory", "default_rules", "lint", "memory", "register_rule",
+    "trace_for_memory", "trace_graph",
 ]

@@ -1,8 +1,10 @@
-"""CLI: lint a model factory from the command line.
+"""CLI: lint (and memory-audit) a model factory from the command line.
 
     python -m paddle_tpu.analysis                       # bundled llama demo
     python -m paddle_tpu.analysis mypkg.models:factory  # your factory
     python -m paddle_tpu.analysis mypkg.models:Net --shape 1,128:int32
+    python -m paddle_tpu.analysis --memory --format json   # CI schema
+    python -m paddle_tpu.analysis --rule-config TPU401.max_collective_bytes=65536
 
 A factory is any zero-arg callable in an importable module. It may
 return:
@@ -11,13 +13,30 @@ return:
   - a bare callable / `Layer`: example inputs then come from ``--shape``
     (repeatable, ``dims:dtype``).
 
-Exit status is 1 when any diagnostic reaches ``--fail-on`` (default:
-error), so it slots straight into CI.
+``--memory`` additionally runs the static memory auditor
+(`analysis/memory.py`): the target is traced donation-aware (a jitted
+factory's `donate_argnums` are recovered from the pjit equation), the
+TPU701/702/703 rules see real donation info, and the output gains the
+peak-HBM estimate + per-buffer breakdown. With no target, ``--memory``
+audits the bundled tiny-llama PAGED DECODE program (the serving
+engine's donated decode chunk) instead of the plain forward — the
+program whose donation/pool accounting the auditor exists for.
+
+``--rule-config KEY=VALUE`` (repeatable) passes rule knobs: bare keys
+reach every rule (``max_collective_bytes=65536``), ``TPUxxx.``-prefixed
+keys reach one rule (``TPU702.hbm_budget_bytes=2147483648``). Values
+parse as int, float, true/false, or string.
+
+``--format json`` prints one machine-readable object
+(`Report.to_json()` schema, plus a ``memory`` key under ``--memory``)
+so CI can gate on exit status AND diff the findings. Exit status is 1
+when any diagnostic reaches ``--fail-on`` (default: error).
 """
 from __future__ import annotations
 
 import argparse
 import importlib
+import json
 import sys
 
 
@@ -30,8 +49,31 @@ def _parse_shape(spec: str):
     return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype or "float32"))
 
 
+def _parse_rule_config(pairs):
+    """KEY=VALUE strings -> a rule_config dict with typed values."""
+    out = {}
+    for pair in pairs:
+        key, sep, raw = pair.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                f"--rule-config expects KEY=VALUE, got {pair!r}")
+        val: object = raw
+        low = raw.lower()
+        if low in ("true", "false"):
+            val = low == "true"
+        else:
+            for cast in (int, float):
+                try:
+                    val = cast(raw)
+                    break
+                except ValueError:
+                    continue
+        out[key] = val
+    return out
+
+
 def _llama_demo():
-    """Default target: the bundled tiny-llama forward pass."""
+    """Default lint target: the bundled tiny-llama forward pass."""
     import jax
     import jax.numpy as jnp
 
@@ -42,8 +84,26 @@ def _llama_demo():
     return model, (ids,), {}
 
 
-def _resolve_target(spec, shapes):
+def _decode_demo():
+    """Default --memory target: the tiny-llama PAGED DECODE program —
+    the serving engine's jitted decode chunk with its donated KV pools,
+    exactly what the donation/peak-HBM audit exists to check."""
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    eng = ContinuousBatchingEngine(
+        cfg, dict(model.raw_state()), slots=2, prompt_bucket=16,
+        max_prompt_len=32, max_new_tokens=8, block_size=16,
+        steps_per_sync=4)
+    return eng._decode, eng._decode_example_args(), {}
+
+
+def _resolve_target(spec, shapes, memory_mode=False):
     if spec is None:
+        if memory_mode:
+            return _decode_demo() + ("models.llama tiny paged decode",)
         return _llama_demo() + ("models.llama tiny forward",)
     mod_name, _, attr = spec.partition(":")
     if not attr:
@@ -67,10 +127,12 @@ def _resolve_target(spec, shapes):
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="jaxpr-level TPU lint for paddle_tpu programs")
+        description="jaxpr-level TPU lint + static memory audit for "
+                    "paddle_tpu programs")
     parser.add_argument(
         "target", nargs="?", default=None,
-        help="module.path:factory (default: bundled tiny-llama demo)")
+        help="module.path:factory (default: bundled tiny-llama demo; "
+             "with --memory: the tiny-llama paged decode program)")
     parser.add_argument(
         "--shape", action="append", default=[], metavar="DIMS[:DTYPE]",
         help="example input when the factory returns a bare callable, "
@@ -82,26 +144,63 @@ def main(argv=None) -> int:
         "--mesh-axes", default=None,
         help="comma-separated mesh axis names collectives may use")
     parser.add_argument(
+        "--rule-config", action="append", default=[], metavar="KEY=VALUE",
+        help="rule knob, repeatable: bare keys reach every rule "
+             "(max_collective_bytes=65536), TPUxxx.-prefixed keys reach "
+             "one rule (TPU702.hbm_budget_bytes=2147483648)")
+    parser.add_argument(
+        "--memory", action="store_true",
+        help="also run the static memory auditor: donation-aware trace "
+             "(TPU701 sees real donate_argnums), peak-HBM estimate + "
+             "buffer breakdown in the output")
+    parser.add_argument(
+        "--format", default="text", choices=["text", "json"],
+        help="output format; json prints one stable machine-readable "
+             "object (Report.to_json schema + a 'memory' key under "
+             "--memory)")
+    parser.add_argument(
         "--fail-on", default="error",
         choices=["info", "warning", "error", "never"],
         help="exit 1 when a diagnostic reaches this severity")
     parser.add_argument(
         "--min-severity", default="info",
         choices=["info", "warning", "error"],
-        help="hide diagnostics below this severity")
+        help="hide diagnostics below this severity (text output)")
     args = parser.parse_args(argv)
 
     from . import Severity, analyze
 
     fn, call_args, call_kwargs, label = _resolve_target(
-        args.target, args.shape)
+        args.target, args.shape, memory_mode=args.memory)
     rules = args.rules.split(",") if args.rules else None
     mesh_axes = args.mesh_axes.split(",") if args.mesh_axes else None
+    rule_config = _parse_rule_config(args.rule_config) or None
 
-    report = analyze(fn, *call_args, rules=rules, mesh_axes=mesh_axes,
-                     name=label, **call_kwargs)
-    print(report.format(
-        min_severity=Severity[args.min_severity.upper()]))
+    mem_report = None
+    if args.memory:
+        # trace_auto, not trace_for_memory: a factory may return a
+        # framework Layer, which only the lint tracer can thread
+        from .memory import audit_graph, trace_auto
+
+        graph = trace_auto(fn, *call_args, name=label, **call_kwargs)
+        report = analyze(None, graph=graph, rules=rules,
+                         mesh_axes=mesh_axes, rule_config=rule_config)
+        mem_report = audit_graph(graph)
+    else:
+        report = analyze(fn, *call_args, rules=rules, mesh_axes=mesh_axes,
+                         rule_config=rule_config, name=label,
+                         **call_kwargs)
+
+    if args.format == "json":
+        out = report.to_dict()
+        if mem_report is not None:
+            out["memory"] = mem_report.to_dict()
+        print(json.dumps(out, sort_keys=True, indent=2))
+    else:
+        print(report.format(
+            min_severity=Severity[args.min_severity.upper()]))
+        if mem_report is not None:
+            print(mem_report.format())
     if args.fail_on != "never" and \
             report.at_least(Severity[args.fail_on.upper()]):
         return 1

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import json
 from typing import Dict, Iterable, List, Optional
 
 
@@ -106,6 +107,31 @@ class Report:
         lines += [d.format() for d in self.diagnostics
                   if d.severity >= min_severity]
         return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        """Stable, machine-readable form of the report: diagnostics in
+        emission order, severities as lowercase strings, summary counts
+        alongside. The schema `--format json` and the bench drivers
+        consume — add keys, never rename them."""
+        return {
+            "target": self.target,
+            "counts": {
+                "error": len(self.errors),
+                "warning": len(self.warnings),
+                "info": len(self.diagnostics) - len(self.errors)
+                - len(self.warnings),
+            },
+            "diagnostics": [
+                {"rule": d.rule, "severity": str(d.severity),
+                 "message": d.message, "where": d.where, "hint": d.hint}
+                for d in self.diagnostics
+            ],
+        }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        """`to_dict` serialized with sorted keys — byte-stable for the
+        same findings, so CI can diff two runs."""
+        return json.dumps(self.to_dict(), sort_keys=True, indent=indent)
 
     def raise_or_warn(self, fail_on: Severity = Severity.ERROR,
                       warn_on: Severity = Severity.WARNING):

@@ -65,12 +65,18 @@ class Graph:
 
     def __init__(self, closed_jaxpr, name: str = "main",
                  example_args: Optional[tuple] = None,
-                 scalar_args: Optional[List[Tuple[Any, str]]] = None):
+                 scalar_args: Optional[List[Tuple[Any, str]]] = None,
+                 donated_invars: Optional[Tuple[bool, ...]] = None):
         self.closed_jaxpr = closed_jaxpr
         self.jaxpr = closed_jaxpr.jaxpr
         self.consts = list(closed_jaxpr.consts)
         self.name = name
         self.example_args = example_args
+        # per-invar jit donation mask, known only on the memory-audit
+        # trace path (`memory.trace_for_memory`); None means "jit
+        # options unknown" — the donation-miss rule (TPU701) then stays
+        # quiet rather than guessing
+        self.donated_invars = donated_invars
         # python-scalar call arguments as (value, label) pairs — a list,
         # not a dict: 2 and 2.0 hash equal and must stay distinct. The
         # recompile-risk rule hunts for these values among the captured

@@ -17,7 +17,7 @@ from typing import Callable, Dict, Iterable, Optional, Sequence
 
 from .diagnostics import Diagnostic, LintError, Report, Severity
 from .graph import Graph, trace_graph
-from .rules import Rule, RULES, default_rules
+from .rules import Rule, RULES, default_rules, rule_config_for
 
 
 class Pipeline:
@@ -49,12 +49,13 @@ class Pipeline:
         return self.run(trace_graph(fn, *args, name=name, **kwargs))
 
 
-def analyze(fn: Callable, *args,
+def analyze(fn: Optional[Callable], *args,
             rules: Optional[Iterable] = None,
             severity_overrides: Optional[Dict[str, Severity]] = None,
             mesh_axes: Optional[Sequence[str]] = None,
             rule_config: Optional[Dict] = None,
             name: Optional[str] = None,
+            graph: Optional[Graph] = None,
             **kwargs) -> Report:
     """Lint `fn` called with `args`/`kwargs` (arrays, Tensors, or
     ShapeDtypeStruct placeholders — nothing executes on device).
@@ -63,10 +64,15 @@ def analyze(fn: Callable, *args,
     every registered rule. `severity_overrides` ({rule_id: Severity, or
     None to disable}) applies whether rules are explicit or defaulted.
     `mesh_axes` feeds the collective rule the axes it should treat as
-    valid; `rule_config` passes extra per-rule knobs (e.g.
-    `{"max_collective_bytes": 1 << 16}` tightens TPU401's unquantized-
-    collective size threshold for serving decode programs). Returns a
-    `Report`; apply a policy with `report.raise_or_warn()`.
+    valid; `rule_config` passes extra per-rule knobs — either bare keys
+    handed to every rule (`{"max_collective_bytes": 1 << 16}`) or
+    `TPUxxx.key` keys routed to one rule only
+    (`{"TPU702.hbm_budget_bytes": 2 << 30}`). `graph` runs the rules
+    over an already-traced `Graph` instead of tracing `fn` (the CLI's
+    `--memory` path traces once via `memory.trace_for_memory`, which
+    preserves donation info, and shares the graph between the lint and
+    the liveness pass). Returns a `Report`; apply a policy with
+    `report.raise_or_warn()`.
     """
     overrides = severity_overrides or {}
     cfg = rule_config or {}
@@ -80,6 +86,12 @@ def analyze(fn: Callable, *args,
             f"rule_config keys {sorted(reserved)} are reserved: pass "
             "mesh_axes= directly and use severity_overrides= for "
             "per-rule severities")
+    unknown = sorted({k.split(".", 1)[0] for k in cfg if "." in k}
+                     - set(RULES))
+    if unknown:
+        raise ValueError(
+            f"rule_config prefixes {unknown} name no registered rule; "
+            f"registered: {sorted(RULES)}")
     resolved = None
     if rules is not None:
         resolved = []
@@ -90,9 +102,11 @@ def analyze(fn: Callable, *args,
                 if r not in RULES:
                     raise KeyError(
                         f"unknown rule {r!r}; registered: {sorted(RULES)}")
-                rule = RULES[r](mesh_axes=mesh_axes, **cfg)
+                rule = RULES[r](mesh_axes=mesh_axes,
+                                **rule_config_for(r, cfg))
             elif isinstance(r, type) and issubclass(r, Rule):
-                rule = r(mesh_axes=mesh_axes, **cfg)
+                rule = r(mesh_axes=mesh_axes,
+                         **rule_config_for(r.id, cfg))
             else:
                 raise TypeError(f"cannot interpret rule {r!r}")
             if rule.id in overrides:
@@ -102,6 +116,8 @@ def analyze(fn: Callable, *args,
             resolved.append(rule)
     pipe = Pipeline(rules=resolved, severity_overrides=severity_overrides,
                     mesh_axes=mesh_axes, **cfg)
+    if graph is not None:
+        return pipe.run(graph)
     return pipe.analyze(fn, *args, name=name, **kwargs)
 
 

@@ -877,15 +877,33 @@ def _callback_identity(eqn) -> tuple:
     return rep, rep
 
 
+def rule_config_for(rule_id: str, config: Dict) -> Dict:
+    """Split a mixed rule_config into THIS rule's knobs: unprefixed
+    keys go to every rule (legacy behaviour — rules read only the keys
+    they know), and `TPUxxx.key` keys route to rule TPUxxx alone, so
+    `{'TPU401.max_collective_bytes': 65536,
+    'TPU702.hbm_budget_bytes': 2 << 30}` tunes two rules from one dict
+    (the CLI's repeatable `--rule-config KEY=VALUE` builds exactly
+    this)."""
+    out = {k: v for k, v in config.items() if "." not in k}
+    prefix = rule_id + "."
+    for k, v in config.items():
+        if k.startswith(prefix):
+            out[k[len(prefix):]] = v
+    return out
+
+
 def default_rules(severity_overrides: Optional[Dict[str, Severity]] = None,
                   **config) -> List[Rule]:
     """Instantiate every registered rule, applying per-rule severity
     overrides ({'TPU501': Severity.ERROR} or {'TPU202': None} to
-    disable)."""
+    disable) and routing `TPUxxx.`-prefixed config keys to their
+    rule."""
     overrides = severity_overrides or {}
     out = []
     for rule_id, cls in sorted(RULES.items()):
         if rule_id in overrides and overrides[rule_id] is None:
             continue
-        out.append(cls(severity=overrides.get(rule_id), **config))
+        out.append(cls(severity=overrides.get(rule_id),
+                       **rule_config_for(rule_id, config)))
     return out

@@ -88,6 +88,14 @@ define_flag("tpu_lint_fail_on", "error",
             "error|warning|info|never "
             "(also: PADDLE_TPU_LINT_FAIL_ON)",
             env_aliases=("PADDLE_TPU_LINT_FAIL_ON",))
+define_flag("audit_memory", False,
+            "run the static memory auditor (analysis/memory.py: jaxpr "
+            "liveness peak-HBM estimate + donation analysis) at the "
+            "audit hooks — ContinuousBatchingEngine.warm() over every "
+            "cached program and Model.fit over the forward pass. "
+            "PADDLE_TPU_LINT=1 implies it (the hooks compose with the "
+            "lint switch) (also: PADDLE_TPU_AUDIT_MEMORY)",
+            env_aliases=("PADDLE_TPU_AUDIT_MEMORY",))
 
 # --- serving kernels ---
 define_flag("prefix_prefill_kernel", True,

@@ -47,6 +47,9 @@ class Model:
         self._metrics: List[Metric] = []
         self.stop_training = False
         self.preempted = False
+        # static memory audit of the forward pass (ISSUE 10): dict via
+        # fit(audit_memory=True) / PADDLE_TPU_AUDIT_MEMORY, else None
+        self.memory_audit = None
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -127,7 +130,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
-            resume=False, checkpoint_freq=None):
+            resume=False, checkpoint_freq=None, audit_memory=None):
         """reference: hapi/model.py fit (:1807).
 
         Resilience extensions (paddle_tpu.resilience):
@@ -149,7 +152,20 @@ class Model:
         live device trace) and `fit.checkpoint_save` spans plus the
         matching `fit_*_s` histograms. Off (default): the loop is
         byte-identical to the uninstrumented one.
+
+        Static memory audit (ISSUE 10): `audit_memory=True` (default:
+        FLAGS_audit_memory / PADDLE_TPU_AUDIT_MEMORY, implied by
+        PADDLE_TPU_LINT=1) traces the network forward at the first
+        batch's shapes through `analysis/memory.py` — a jaxpr-liveness
+        peak-HBM estimate over params + activations, no device work —
+        stores the report on `self.memory_audit`, and emits a
+        `memory.audit` observability event. One-shot per fit call.
         """
+        if audit_memory is not False:  # False skips the analysis import
+            from ..analysis.memory import resolve_audit_memory
+
+            audit_memory = resolve_audit_memory(audit_memory)
+        audit_pending = bool(audit_memory)
         loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
@@ -213,6 +229,9 @@ class Model:
                         continue  # replayed batches of a resumed epoch
                     cbks.on_train_batch_begin(step)
                     ins, labs = self._split_batch(batch)
+                    if audit_pending:
+                        audit_pending = False
+                        self._audit_memory(ins)
                     update = (step + 1) % accumulate_grad_batches == 0
                     if tr is None and mt is None:
                         res = self.train_batch(ins, labs, update=update)
@@ -311,6 +330,28 @@ class Model:
                              "host wait on the data loader").observe(
                                  t1 - t0)
             yield batch
+
+    def _audit_memory(self, ins):
+        """One-shot static memory audit of the forward pass at the
+        first batch's shapes (fit(audit_memory=True)): host-side
+        tracing only. An audit failure must never take down training —
+        it degrades to a warning."""
+        try:
+            from ..analysis import memory as _mem
+            from ..observability import record_event
+
+            arrays = [np.asarray(i.numpy() if isinstance(i, Tensor)
+                                 else i) for i in _to_list(ins)]
+            rep = _mem.audit_memory(self.network, *arrays,
+                                    name="fit.forward")
+            self.memory_audit = rep.to_dict()
+            record_event("memory.audit", target="fit.forward",
+                         peak_hbm_bytes=rep.peak_bytes, mp=rep.mp)
+        except Exception as e:  # pragma: no cover - defensive
+            import warnings
+
+            warnings.warn(f"fit(audit_memory=True) failed: "
+                          f"{type(e).__name__}: {e}")
 
     def _save_checkpoint(self, mgr, epoch, step_in_epoch, global_step,
                          blocking):

@@ -337,6 +337,11 @@ class ContinuousBatchingEngine:
                     "(one full request + scratch + one cacheable page)")
         if max_pages is None:
             max_pages = slots * cap + 1
+        # the operator's explicit PER-CHIP pool byte budget (None when
+        # sized by max_pages) — audit_memory() derives its default
+        # TPU702 HBM budget from it
+        self._kv_pool_budget = kv_pool_bytes
+        self._memory_audit = None   # fleet report from the last audit
         self.mgr = PagedKVManager(max_pages, block_size)
         self.mgr.set_pool_geometry(n_layers=cfg.num_hidden_layers,
                                    num_kv_heads=nkv, head_dim=dh,
@@ -562,6 +567,10 @@ class ContinuousBatchingEngine:
             "pages_in_use": in_use,
             "pool_occupancy": in_use / max(mgr.max_pages, 1),
             "compile_stats": self.compile_stats(),
+            # static memory audit (ISSUE 10): the fleet report from the
+            # last audit_memory() / warm(audit_memory=True) run — None
+            # until one ran
+            "memory_audit": self._memory_audit,
         }
 
     @staticmethod
@@ -839,7 +848,7 @@ class ContinuousBatchingEngine:
             bsz *= 2
         return bsz
 
-    def warm(self, buckets=None, prefix_widths=None):
+    def warm(self, buckets=None, prefix_widths=None, audit_memory=None):
         """Compile (and cache) every program the engine can need for the
         given prompt buckets — each power-of-two prefill batch (cold AND
         cached-prefix variants) plus the decode chunk — by running them
@@ -850,7 +859,16 @@ class ContinuousBatchingEngine:
         buckets (a hit's suffix is shorter than its prompt).
         `prefix_widths` narrows the cached-prefix variants to specific
         `_prefix_width_ladder` rungs (benches that know their hit depth
-        skip the full ladder); default warms every rung."""
+        skip the full ladder); default warms every rung.
+
+        `audit_memory` (ISSUE 10): after warming, run the static memory
+        auditor (`analysis/memory.py`) over EVERY program in the cache
+        and keep the fleet report (per-program per-chip peak-HBM
+        estimate, donation coverage, TPU701/702/703 diagnostics) on
+        `metrics()['memory_audit']`, also emitted through the
+        observability event log. Default (None) follows
+        FLAGS_audit_memory / PADDLE_TPU_AUDIT_MEMORY — and composes
+        with PADDLE_TPU_LINT=1, which implies it."""
         buckets = [self.max_prompt_len] if buckets is None else buckets
         if prefix_widths is None:
             prefix_widths = self._prefix_width_ladder()
@@ -913,6 +931,161 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.top_p, jnp.float32))
         _, _, _, self.kcs, self.vcs = out
         np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
+        from ..analysis.memory import resolve_audit_memory
+
+        if resolve_audit_memory(audit_memory):
+            self.audit_memory()
+
+    # ---- static memory audit (ISSUE 10) ---------------------------------
+
+    def _decode_example_args(self):
+        b = self.slots
+        return (self.p, self.kcs, self.vcs,
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, self.table_width), jnp.int32),
+                jnp.zeros((b,), bool), jax.random.PRNGKey(0),
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
+
+    def _prefill_example_args(self, key):
+        """Warm()-shaped example args for a `_prefill_cache` entry —
+        tracing only, nothing executes, so zeros aimed nowhere are
+        fine."""
+        kind, sb, bsz = key[0], key[1], key[2]
+        n_pre = sb // self.block_size
+        head = (self.p, self.kcs, self.vcs,
+                jnp.zeros((bsz, sb), jnp.int32),
+                jnp.ones((bsz,), jnp.int32),
+                jnp.zeros((bsz, n_pre), jnp.int32))
+        tail = (jax.random.PRNGKey(0),
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
+        if kind == "prefix":
+            w = key[3]
+            return head + (jnp.zeros((bsz, w), jnp.int32),
+                           jnp.zeros((bsz,), jnp.int32)) + tail
+        return head + tail
+
+    def _program_inventory(self):
+        """(name, jitted_fn, example_args) for every program this
+        engine can dispatch: the decode chunk plus every compiled
+        prefill variant — the enumeration the fleet audit (and any
+        future whole-cache tooling) walks."""
+        progs = [("decode", self._decode, self._decode_example_args())]
+        for key, fn in sorted(self._prefill_cache.items(),
+                              key=lambda kv: str(kv[0])):
+            name = "prefill:" + ":".join(str(k) for k in key)
+            progs.append((name, fn, self._prefill_example_args(key)))
+        return progs
+
+    def audit_memory(self, hbm_budget_bytes=None, programs=None) -> dict:
+        """Static memory audit (ISSUE 10): run the jaxpr liveness pass
+        (`analysis/memory.py`) over every program in the cache and
+        return ONE fleet report — per-program per-chip peak-HBM
+        estimates, donation coverage, and the TPU701/702/703
+        diagnostics. Programs share the pools and params and execute
+        serially, so the fleet-resident bound is the MAX per-program
+        peak, not the sum.
+
+        `hbm_budget_bytes` arms TPU702; default (None) derives a
+        budget from the engine's explicit `kv_pool_bytes=` sizing when
+        one was given — pool budget + per-chip param bytes + 25%
+        activation headroom — and leaves TPU702 off otherwise.
+        `programs` filters by inventory name ("decode",
+        "prefill:cold:..."); unknown names raise, and a filtered run
+        returns a `partial` report WITHOUT touching the fleet sinks.
+        Full audits land on `metrics()['memory_audit']` and are
+        emitted through the observability event log. Host-side tracing
+        only: nothing executes on device."""
+        from ..analysis import memory as _mem
+        from ..analysis.pipeline import analyze as _analyze
+
+        if hbm_budget_bytes is None and self._kv_pool_budget is not None:
+            # pool budget + per-chip params + activation/workspace
+            # headroom: 25% relative, floored at 1 MiB — prefill
+            # activations scale with batch x bucket x hidden, not with
+            # the pool budget, so a pure percentage under-provisions
+            # small pools
+            base = self._kv_pool_budget \
+                + _mem.pytree_local_bytes(self.p)
+            hbm_budget_bytes = base + max(base // 4, 1 << 20)
+        rule_config = {}
+        if hbm_budget_bytes:
+            rule_config["TPU702.hbm_budget_bytes"] = int(hbm_budget_bytes)
+        inventory = self._program_inventory()
+        if programs is not None:
+            want = set(programs)
+            inventory = [it for it in inventory if it[0] in want]
+            missing = want - {it[0] for it in inventory}
+            if missing:
+                # a typo'd filter must not yield a vacuously clean
+                # report a CI gate would wave through
+                raise ValueError(
+                    f"programs {sorted(missing)} not in the inventory "
+                    f"{[it[0] for it in self._program_inventory()]}")
+        min_miss = _mem.DonationMissRule.MIN_BYTES
+        out, diags = {}, 0
+        for name, fn, args in inventory:
+            g = _mem.trace_for_memory(fn, *args, name=name)
+            rep = _mem.audit_graph(g)
+            lint = _analyze(None, graph=g,
+                            rules=["TPU701", "TPU702", "TPU703"],
+                            rule_config=rule_config)
+            misses = [m for m in rep.donation["misses"]
+                      if m["bytes"] >= min_miss]
+            donated = rep.donation["donated_bytes"]
+            missed = sum(m["bytes"] for m in misses)
+            diags += len(lint)
+            out[name] = {
+                "peak_hbm_bytes": rep.peak_bytes,
+                "n_eqns": rep.n_eqns,
+                "mp": rep.mp,
+                "donated_bytes": donated,
+                "missed_bytes": missed,
+                "donation_misses": len(misses),
+                "donation_coverage": donated / (donated + missed)
+                if donated + missed else 1.0,
+                "diagnostics": lint.to_dict()["diagnostics"],
+            }
+        fleet_peak = max((p["peak_hbm_bytes"] for p in out.values()),
+                         default=0)
+        report = {
+            "programs": out,
+            "programs_audited": len(out),
+            "fleet_peak_hbm_bytes": fleet_peak,
+            "per_chip": True,
+            "mp": self.mp,
+            "kv_pool_bytes": self.mgr.kv_pool_bytes(),
+            "hbm_budget_bytes": hbm_budget_bytes,
+            "donation_clean": all(p["donation_misses"] == 0
+                                  for p in out.values()),
+            "n_diagnostics": diags,
+            "partial": programs is not None,
+        }
+        if report["partial"]:
+            # a programs=-narrowed run (the bench drivers' decode-only
+            # audits) must not overwrite the FLEET report monitoring
+            # reads off metrics()['memory_audit'] — a prefill donation
+            # regression would hide behind a decode-only clean bill
+            return report
+        self._memory_audit = report
+        # instance sinks, like every other engine site (they default to
+        # the flag-armed globals when the engine was built with None)
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("memory.audit", fleet_peak_hbm_bytes=fleet_peak,
+                       programs=len(out), mp=self.mp,
+                       donation_clean=report["donation_clean"])
+        if mt is not None:
+            mt.event("memory.audit", fleet_peak_hbm_bytes=fleet_peak,
+                     programs=len(out), mp=self.mp,
+                     donation_clean=report["donation_clean"],
+                     n_diagnostics=diags)
+            mt.gauge("predicted_peak_hbm_bytes",
+                     "static auditor per-chip peak over cached "
+                     "programs").set(fleet_peak)
+        return report
 
     def _check_owner(self, token: Optional[int]):
         """A watchdog-abandoned step thread must stop mutating shared
