@@ -518,18 +518,32 @@ def _op_bench(only=None):
         ops["decode_step_1b_mp"] = round(
             paired_slope_ms(trun, 1, 13, pairs=6), 4)
         # per decoded token per chip: every layer all-gathers the
-        # [b, 1, nh_local*dh] bf16 o-proj activations — each chip
-        # RECEIVES (mp-1)/mp of the full head axis
+        # [b, 1, nh_local*dh] o-proj activations — each chip RECEIVES
+        # (mp-1)/mp of the full head axis. Itemsize 4: the comms
+        # auditor (ISSUE 11) exposed that the decode step gathers the
+        # attention output at its f32 accumulation dtype (the bf16
+        # downcast happens at the o-proj, after the gather) — the
+        # earlier *2 formula under-reported the wire bytes 2x, and the
+        # f32 payload is TPU803's first quantization customer
         mp_, tcfg = teng.mp, teng.cfg
+        # ONE decode trace serves both static auditors
+        tgraphs = teng._traced_inventory(programs=("decode",))
         OP_INFO["decode_step_1b_mp"] = {
             "mp": mp_,
             "bytes_all_gathered_per_token": int(
                 tcfg.num_hidden_layers * tcfg.num_attention_heads
-                * tcfg.head_dim * 2 * (mp_ - 1) // mp_),
+                * tcfg.head_dim * 4 * (mp_ - 1) // mp_),
+            # static comms auditor (ISSUE 11): jaxpr-derived wire bytes
+            # per decoded token per chip — next to the hand formula
+            # above so the next TPU run lands an estimate/actual ratio
+            "predicted_bytes_on_wire_per_token": int(
+                teng.audit_comms(programs=("decode",), graphs=tgraphs)
+                ["predicted_bytes_on_wire_per_token"]),
             # per-chip under kv-head sharding — pairs with the mp=1
             # row's estimate to confirm the 1/mp pool scaling on device
             "predicted_peak_hbm_bytes": teng.audit_memory(
-                programs=("decode",))["fleet_peak_hbm_bytes"],
+                programs=("decode",),
+                graphs=tgraphs)["fleet_peak_hbm_bytes"],
         }
         del teng, trun
 

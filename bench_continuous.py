@@ -218,8 +218,17 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
     # chunk — the steady-state resident bound for this policy's pools
     # (host-side trace, off the clock); compare against
     # device_memory_stats on the next TPU run
+    graphs = eng._traced_inventory(programs=("decode",))
     predicted_peak = eng.audit_memory(
-        programs=("decode",))["fleet_peak_hbm_bytes"]
+        programs=("decode",), graphs=graphs)["fleet_peak_hbm_bytes"]
+    # wire-side twin (ISSUE 11): predicted per-chip bytes on wire per
+    # decoded token for this policy's decode chunk — 0 at mp=1, the
+    # per-layer o-proj all-gather at mp>1; the `sharded` rows pair it
+    # with the measured bytes_all_gathered_per_token OPBENCH counter
+    # (ONE decode trace serves both auditors)
+    predicted_wire = eng.audit_comms(
+        programs=("decode",),
+        graphs=graphs)["predicted_bytes_on_wire_per_token"]
     return {
         "policy": policy, "wall_s": round(wall, 2),
         "useful_tokens": useful,
@@ -242,6 +251,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
         # global, every chip maps the same table
         "kv_pool_bytes": em["kv_pool_bytes"],
         "predicted_peak_hbm_bytes": predicted_peak,
+        "predicted_bytes_on_wire_per_token": round(predicted_wire, 1),
         "n_cacheable_pages": em["n_cacheable_pages"],
         "n_available": em["n_available"],
         "n_cached": em["n_cached"],

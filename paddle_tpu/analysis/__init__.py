@@ -37,10 +37,14 @@ from .memory import (  # noqa: F401
     MemoryReport, audit_graph, audit_memory, trace_auto,
     trace_for_memory,
 )
+# likewise registers TPU801/802/803 (static communication auditor)
+from . import comms  # noqa: F401,E402
+from .comms import CommsReport, audit_comms  # noqa: F401
 
 __all__ = [
-    "Diagnostic", "Graph", "LintError", "MemoryReport", "Pipeline",
-    "Report", "RULES", "Rule", "Severity", "analyze", "audit_graph",
-    "audit_memory", "default_rules", "lint", "memory", "register_rule",
+    "CommsReport", "Diagnostic", "Graph", "LintError", "MemoryReport",
+    "Pipeline", "Report", "RULES", "Rule", "Severity", "analyze",
+    "audit_comms", "audit_graph", "audit_memory", "comms",
+    "default_rules", "lint", "memory", "register_rule",
     "trace_for_memory", "trace_graph",
 ]

@@ -4,7 +4,9 @@
     python -m paddle_tpu.analysis mypkg.models:factory  # your factory
     python -m paddle_tpu.analysis mypkg.models:Net --shape 1,128:int32
     python -m paddle_tpu.analysis --memory --format json   # CI schema
+    python -m paddle_tpu.analysis --comms --format json    # wire-side twin
     python -m paddle_tpu.analysis --rule-config TPU401.max_collective_bytes=65536
+    python -m paddle_tpu.analysis --comms --rule-config TPU801.max_step_wire_bytes=1048576
 
 A factory is any zero-arg callable in an importable module. It may
 return:
@@ -22,15 +24,25 @@ audits the bundled tiny-llama PAGED DECODE program (the serving
 engine's donated decode chunk) instead of the plain forward — the
 program whose donation/pool accounting the auditor exists for.
 
+``--comms`` runs the static COMMUNICATION auditor (`analysis/comms.py`):
+bytes-on-wire per chip with the ring cost model, loop amplification,
+and the TPU801/802/803 rules riding the same trace. With no target it
+audits the bundled tiny-llama SHARDED decode program at mp=2 — the
+one-all-gather-per-layer program the wire accounting exists for; on a
+single-device host it notes the downgrade and audits the mp=1 decode
+program instead (zero collectives, still valid output + exit 0).
+
 ``--rule-config KEY=VALUE`` (repeatable) passes rule knobs: bare keys
 reach every rule (``max_collective_bytes=65536``), ``TPUxxx.``-prefixed
-keys reach one rule (``TPU702.hbm_budget_bytes=2147483648``). Values
+keys reach one rule (``TPU702.hbm_budget_bytes=2147483648``,
+``TPU801.max_step_wire_bytes=...``, ``TPU803.min_bytes=...``). Values
 parse as int, float, true/false, or string.
 
 ``--format json`` prints one machine-readable object
-(`Report.to_json()` schema, plus a ``memory`` key under ``--memory``)
-so CI can gate on exit status AND diff the findings. Exit status is 1
-when any diagnostic reaches ``--fail-on`` (default: error).
+(`Report.to_json()` schema, plus a ``memory`` key under ``--memory``
+and a ``comms`` key under ``--comms``) so CI can gate on exit status
+AND diff the findings. Exit status is 1 when any diagnostic reaches
+``--fail-on`` (default: error) — the scriptable gate.
 """
 from __future__ import annotations
 
@@ -100,8 +112,38 @@ def _decode_demo():
     return eng._decode, eng._decode_example_args(), {}
 
 
-def _resolve_target(spec, shapes, memory_mode=False):
+def _sharded_decode_demo():
+    """Default --comms target: the tiny-llama paged decode program
+    SHARDED at mp=2 — one o-proj activation all-gather per layer inside
+    the decode scan, the program the bytes-on-wire accounting exists
+    for. Single-device hosts cannot build an mp=2 mesh: note the
+    downgrade and audit the mp=1 program (zero collectives) so the
+    schema + exit-status gate stay scriptable everywhere."""
+    import jax
+
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..serving import ContinuousBatchingEngine
+
+    mp = 2 if len(jax.devices()) >= 2 else 1
+    if mp == 1:
+        print("note: single-device host — auditing the mp=1 decode "
+              "program (zero collectives); run with >= 2 devices "
+              "(e.g. XLA_FLAGS=--xla_force_host_platform_device_count"
+              "=2) for the sharded mp=2 demo", file=sys.stderr)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    eng = ContinuousBatchingEngine(
+        cfg, dict(model.raw_state()), slots=2, prompt_bucket=16,
+        max_prompt_len=32, max_new_tokens=8, block_size=16,
+        steps_per_sync=4, serving_mp=mp)
+    return (eng._decode, eng._decode_example_args(), {},
+            f"models.llama tiny sharded decode (mp={mp})")
+
+
+def _resolve_target(spec, shapes, memory_mode=False, comms_mode=False):
     if spec is None:
+        if comms_mode:
+            return _sharded_decode_demo()
         if memory_mode:
             return _decode_demo() + ("models.llama tiny paged decode",)
         return _llama_demo() + ("models.llama tiny forward",)
@@ -154,10 +196,17 @@ def main(argv=None) -> int:
              "(TPU701 sees real donate_argnums), peak-HBM estimate + "
              "buffer breakdown in the output")
     parser.add_argument(
+        "--comms", action="store_true",
+        help="also run the static communication auditor: per-chip "
+             "bytes-on-wire (ring cost model, loop amplification) + "
+             "per-axis/per-kind splits in the output; with no target, "
+             "audits the mp=2 tiny-llama sharded decode demo "
+             "(single-device hosts note the downgrade and audit mp=1)")
+    parser.add_argument(
         "--format", default="text", choices=["text", "json"],
         help="output format; json prints one stable machine-readable "
              "object (Report.to_json schema + a 'memory' key under "
-             "--memory)")
+             "--memory, a 'comms' key under --comms)")
     parser.add_argument(
         "--fail-on", default="error",
         choices=["info", "warning", "error", "never"],
@@ -171,21 +220,28 @@ def main(argv=None) -> int:
     from . import Severity, analyze
 
     fn, call_args, call_kwargs, label = _resolve_target(
-        args.target, args.shape, memory_mode=args.memory)
+        args.target, args.shape, memory_mode=args.memory,
+        comms_mode=args.comms)
     rules = args.rules.split(",") if args.rules else None
     mesh_axes = args.mesh_axes.split(",") if args.mesh_axes else None
     rule_config = _parse_rule_config(args.rule_config) or None
 
-    mem_report = None
-    if args.memory:
+    mem_report = comms_report = None
+    if args.memory or args.comms:
         # trace_auto, not trace_for_memory: a factory may return a
-        # framework Layer, which only the lint tracer can thread
+        # framework Layer, which only the lint tracer can thread. ONE
+        # trace serves the lint rules AND both auditors.
         from .memory import audit_graph, trace_auto
 
         graph = trace_auto(fn, *call_args, name=label, **call_kwargs)
         report = analyze(None, graph=graph, rules=rules,
                          mesh_axes=mesh_axes, rule_config=rule_config)
-        mem_report = audit_graph(graph)
+        if args.memory:
+            mem_report = audit_graph(graph)
+        if args.comms:
+            from .comms import audit_graph as comms_audit_graph
+
+            comms_report = comms_audit_graph(graph)
     else:
         report = analyze(fn, *call_args, rules=rules, mesh_axes=mesh_axes,
                          rule_config=rule_config, name=label,
@@ -195,12 +251,16 @@ def main(argv=None) -> int:
         out = report.to_dict()
         if mem_report is not None:
             out["memory"] = mem_report.to_dict()
+        if comms_report is not None:
+            out["comms"] = comms_report.to_dict()
         print(json.dumps(out, sort_keys=True, indent=2))
     else:
         print(report.format(
             min_severity=Severity[args.min_severity.upper()]))
         if mem_report is not None:
             print(mem_report.format())
+        if comms_report is not None:
+            print(comms_report.format())
     if args.fail_on != "never" and \
             report.at_least(Severity[args.fail_on.upper()]):
         return 1

@@ -44,7 +44,6 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, Iterator, List, Optional, Type
 
-import jax.numpy as jnp
 import numpy as np
 
 from ..kernels.constraints import (
@@ -589,7 +588,7 @@ class CollectiveRule(Rule):
     - unknown axis: the axis name is not in the mesh axes the caller
       declared via `mesh_axes=` (skipped when not declared);
     - unquantized large payload: a collective moving more than
-      `max_collective_bytes` (config; default 1 MiB, 0 disables) of
+      `max_collective_bytes` (config; default 0 = off — see below) of
       floating-point data per equation. EQuARX (PAPERS.md) shows
       block-quantized int8 collectives inside XLA recover most of that
       wire time at negligible numerics cost — an absmax-int8 payload +
@@ -599,79 +598,69 @@ class CollectiveRule(Rule):
       — small at decode (b x 1 x H), but the same rule watches prefill
       all-gathers and dp gradient psums, where payloads are MBs.
       int8/int32 payloads (already-quantized or index traffic) never
-      fire. Note scans AMPLIFY the cost: a flagged collective inside a
-      scan body pays per iteration — those report at WARNING even when
-      a top-level one would be INFO.
+      fire. Note scans AMPLIFY the cost: the size check compares the
+      AMPLIFIED payload (bytes x scan trip count, via the shared
+      `analysis/comms.py` inventory), and in-loop findings report at
+      WARNING even when a top-level one would be INFO. OFF unless
+      `max_collective_bytes=` is set explicitly: in the default
+      pipeline TPU803 (quantizable-collective, default 1 MiB) owns
+      the size check — two rules reporting the same site with the
+      same hint at the same threshold would double every finding.
+
+    The collective primitive list and the float-payload byte math live
+    in `analysis/comms.py` (the bytes-on-wire pass) — ONE inventory
+    serves this rule and TPU801/802/803.
     """
 
     id = "TPU401"
     name = "collectives"
     default_severity = Severity.WARNING
 
-    # pbroadcast is shard_map replication bookkeeping, not a comm op
-    COLLECTIVES = frozenset({
-        "psum", "psum2", "pmax", "pmin", "all_gather", "all_to_all",
-        "ppermute", "reduce_scatter", "pgather",
-    })
-
-    # over this many bytes of float payload, a collective is worth
-    # quantizing (EQuARX); override with max_collective_bytes=
-    DEFAULT_MAX_COLLECTIVE_BYTES = 1 << 20
-
-    def _payload_bytes(self, ctx) -> int:
-        """Float bytes one execution of this collective moves (sum of
-        floating-point operand sizes; int payloads don't count — they
-        are either already quantized or index traffic)."""
-        total = 0
-        for v in ctx.eqn.invars:
-            aval = getattr(v, "aval", None)
-            if aval is None or not hasattr(aval, "shape"):
-                continue
-            dt = np.dtype(aval.dtype)
-            # jnp.issubdtype, NOT np.issubdtype: bfloat16 is an
-            # ml_dtypes extension type (numpy kind 'V') that
-            # np.issubdtype does not class as floating — and bf16
-            # activations/gradients are exactly the payloads this
-            # check exists for
-            if not jnp.issubdtype(dt, jnp.floating):
-                continue
-            total += int(np.prod(aval.shape, dtype=np.int64)) \
-                * dt.itemsize
-        return total
-
     def check(self, graph: Graph) -> Iterator[Diagnostic]:
+        # lazy import: comms.py subclasses Rule, so it imports this
+        # module at load time — the shared inventory resolves at check
+        from . import comms as _comms
+
         mesh_axes = self.config.get("mesh_axes")
-        max_bytes = self.config.get(
-            "max_collective_bytes", self.DEFAULT_MAX_COLLECTIVE_BYTES)
+        # no default: TPU803 carries the default-threshold size check
+        # (same inventory, same hint) — this knob re-arms the legacy
+        # TPU401 channel at an explicit threshold
+        max_bytes = self.config.get("max_collective_bytes", 0)
+        # unquantized large payloads (EQuARX candidates) ride the
+        # bytes-on-wire inventory so scan amplification is counted —
+        # a per-layer collective inside a decode chunk pays per
+        # iteration, and comparing one occurrence under-reported it
+        if max_bytes:
+            for ev in _comms.audit_graph(graph).collectives:
+                payload = ev.float_payload_bytes
+                amplified = ev.total_float_payload_bytes
+                if not payload or amplified <= max_bytes:
+                    continue
+                if ev.in_loop:
+                    amp = (f" x {ev.count} iterations = {amplified} "
+                           f"bytes" if ev.count > 1 else "")
+                    desc = (f"per iteration inside a loop body"
+                            f"{amp} (> {max_bytes})")
+                else:
+                    desc = f"(> {max_bytes}) per call"
+                # loop bodies AMPLIFY the cost — those escalate to the
+                # rule's severity; a one-shot top-level collective is
+                # an INFO-grade EQuARX candidate
+                yield self.diag(
+                    f"{ev.kind} over {ev.axes} moves {payload} "
+                    f"bytes of float payload " + desc,
+                    where=ev.path,
+                    severity=None if ev.in_loop else Severity.INFO,
+                    hint="quantize the payload (absmax int8 + f32 "
+                         "scale sidecar, EQuARX-style — the int8 "
+                         "KV pools' exact scheme) or shrink it; "
+                         "raise max_collective_bytes= if this "
+                         "size is intended")
         seen: Dict[tuple, EqnCtx] = {}
         for ctx in graph.eqns():
-            if ctx.primitive not in self.COLLECTIVES:
+            if ctx.primitive not in _comms.COLLECTIVE_PRIMS:
                 continue
-            axes = ctx.params.get("axes",
-                                  ctx.params.get("axis_name", ()))
-            if not isinstance(axes, (tuple, list)):
-                axes = (axes,)
-            axes = tuple(a for a in axes if isinstance(a, str))
-            # unquantized large payload (EQuARX candidate)
-            if max_bytes:
-                payload = self._payload_bytes(ctx)
-                if payload > max_bytes:
-                    # loop bodies AMPLIFY the cost (the collective pays
-                    # per iteration) — those escalate to the rule's
-                    # severity; a one-shot top-level collective is an
-                    # INFO-grade EQuARX candidate
-                    yield self.diag(
-                        f"{ctx.primitive} over {axes} moves {payload} "
-                        f"bytes of float payload (> {max_bytes}) "
-                        + ("inside a loop body — per iteration"
-                           if ctx.in_loop else "per call"),
-                        where=ctx.path,
-                        severity=None if ctx.in_loop else Severity.INFO,
-                        hint="quantize the payload (absmax int8 + f32 "
-                             "scale sidecar, EQuARX-style — the int8 "
-                             "KV pools' exact scheme) or shrink it; "
-                             "raise max_collective_bytes= if this "
-                             "size is intended")
+            axes = _comms.collective_axes(ctx.eqn)
             # unknown axis
             if mesh_axes is not None:
                 for a in axes:

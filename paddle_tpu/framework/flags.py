@@ -96,6 +96,15 @@ define_flag("audit_memory", False,
             "PADDLE_TPU_LINT=1 implies it (the hooks compose with the "
             "lint switch) (also: PADDLE_TPU_AUDIT_MEMORY)",
             env_aliases=("PADDLE_TPU_AUDIT_MEMORY",))
+define_flag("audit_comms", False,
+            "run the static communication auditor (analysis/comms.py: "
+            "jaxpr bytes-on-wire pass + per-chip collective cost "
+            "model) at the audit hooks — "
+            "ContinuousBatchingEngine.warm() over every cached program "
+            "and Model.fit over the training step. PADDLE_TPU_LINT=1 "
+            "implies it (the hooks compose with the lint switch) "
+            "(also: PADDLE_TPU_AUDIT_COMMS)",
+            env_aliases=("PADDLE_TPU_AUDIT_COMMS",))
 
 # --- serving kernels ---
 define_flag("prefix_prefill_kernel", True,

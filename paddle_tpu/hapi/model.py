@@ -50,6 +50,9 @@ class Model:
         # static memory audit of the forward pass (ISSUE 10): dict via
         # fit(audit_memory=True) / PADDLE_TPU_AUDIT_MEMORY, else None
         self.memory_audit = None
+        # static communication audit of the training step (ISSUE 11):
+        # dict via fit(audit_comms=True) / PADDLE_TPU_AUDIT_COMMS
+        self.comms_audit = None
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -130,7 +133,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
-            resume=False, checkpoint_freq=None, audit_memory=None):
+            resume=False, checkpoint_freq=None, audit_memory=None,
+            audit_comms=None):
         """reference: hapi/model.py fit (:1807).
 
         Resilience extensions (paddle_tpu.resilience):
@@ -160,12 +164,29 @@ class Model:
         peak-HBM estimate over params + activations, no device work —
         stores the report on `self.memory_audit`, and emits a
         `memory.audit` observability event. One-shot per fit call.
+
+        Static communication audit (ISSUE 11): `audit_comms=True`
+        (default: FLAGS_audit_comms / PADDLE_TPU_AUDIT_COMMS, implied
+        by PADDLE_TPU_LINT=1) traces the TRAINING STEP — loss +
+        backward at the first batch's shapes — through
+        `analysis/comms.py`. When the global mesh carries a `dp` axis
+        (size > 1) the gradient sync is made explicit (batch sharded
+        over dp, grads psum'd — the all-reduce GSPMD inserts at
+        compile time, surfaced so the static wire pass can count it);
+        the bytes-on-wire report + TPU801/802/803 diagnostics land on
+        `self.comms_audit` with a `comms.audit` observability event.
+        One-shot per fit call; failures degrade to a warning.
         """
         if audit_memory is not False:  # False skips the analysis import
             from ..analysis.memory import resolve_audit_memory
 
             audit_memory = resolve_audit_memory(audit_memory)
         audit_pending = bool(audit_memory)
+        if audit_comms is not False:
+            from ..analysis.comms import resolve_audit_comms
+
+            audit_comms = resolve_audit_comms(audit_comms)
+        comms_pending = bool(audit_comms)
         loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
@@ -232,6 +253,9 @@ class Model:
                     if audit_pending:
                         audit_pending = False
                         self._audit_memory(ins)
+                    if comms_pending:
+                        comms_pending = False
+                        self._audit_comms(ins, labs)
                     update = (step + 1) % accumulate_grad_batches == 0
                     if tr is None and mt is None:
                         res = self.train_batch(ins, labs, update=update)
@@ -351,6 +375,116 @@ class Model:
             import warnings
 
             warnings.warn(f"fit(audit_memory=True) failed: "
+                          f"{type(e).__name__}: {e}")
+
+    def _audit_comms(self, ins, labs):
+        """One-shot static communication audit of the training step at
+        the first batch's shapes (fit(audit_comms=True)): traces loss +
+        backward, host-side only. Data parallelism here is batch
+        sharding over the global mesh's `dp` axis, and the gradient
+        all-reduce is inserted by GSPMD at COMPILE time — invisible to
+        a traced jaxpr — so the audit builds the dp step explicitly
+        (shard_map over dp, `lax.psum` over the grads: the canonical
+        dp gradient sync) and counts exactly the wire bytes the
+        compiled step pays. An audit failure must never take down
+        training — it degrades to a warning."""
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from ..analysis import comms as _comms
+            from ..analysis import memory as _mem
+            from ..analysis.pipeline import analyze as _analyze
+            from ..core import tape as _tape
+            from ..core.tensor import unwrap
+            from ..observability import record_event
+            from ..parallel import mesh as mesh_mod
+
+            ins_arr = [np.asarray(i.numpy() if isinstance(i, Tensor)
+                                  else i) for i in _to_list(ins)]
+            lab_arr = [np.asarray(l.numpy() if isinstance(l, Tensor)
+                                  else l) for l in _to_list(labs)]
+            n_in = len(ins_arr)
+            state = dict(self.network.raw_state())
+            # only inexact leaves are differentiable; int/bool buffers
+            # ride the closure (their grads would be float0 anyway)
+            params = {k: v for k, v in state.items()
+                      if jnp.issubdtype(jnp.asarray(v).dtype,
+                                        jnp.inexact)}
+            rest = {k: v for k, v in state.items() if k not in params}
+            has_loss = self._loss is not None and bool(lab_arr)
+
+            def loss_fn(p, *batch):
+                with _tape.no_grad():
+                    out = self.network.func_call(
+                        {**rest, **p},
+                        *(Tensor(b) for b in batch[:n_in]))
+                    if has_loss:
+                        loss = unwrap(self._compute_loss(
+                            out, [Tensor(l) for l in batch[n_in:]]))
+                    else:
+                        loss = sum(jnp.sum(unwrap(o).astype(jnp.float32))
+                                   for o in _to_list(out))
+                return jnp.asarray(loss).astype(jnp.float32)
+
+            def step(p, *batch):
+                return jax.value_and_grad(loss_fn)(p, *batch)
+
+            target, name = step, "fit.step"
+            batch = tuple(ins_arr + lab_arr)
+            mesh = mesh_mod.get_global_mesh()
+            dp = int(mesh.shape["dp"]) if mesh is not None \
+                and "dp" in getattr(mesh, "axis_names", ()) else 1
+            dp_shardable = batch and all(
+                b.ndim >= 1 and b.shape[0] % dp == 0 for b in batch)
+            if dp > 1 and not dp_shardable:
+                # the fallback audits the single-chip step — zero
+                # collectives — while the REAL compiled step pays the
+                # dp gradient all-reduce; a silent clean report here
+                # would hide exactly the bytes the audit exists for
+                import warnings
+
+                warnings.warn(
+                    f"fit(audit_comms=True): global mesh has dp={dp} "
+                    "but a batch leaf is 0-d or its leading dim does "
+                    "not divide by dp — auditing the single-chip step; "
+                    "the dp gradient psum is NOT counted")
+            if dp > 1 and dp_shardable:
+                from jax.sharding import Mesh, PartitionSpec as P
+
+                from ..parallel.shard_map_compat import shard_map
+
+                dp_mesh = Mesh(np.asarray(jax.devices()[:dp]), ("dp",))
+                p_specs = jax.tree.map(lambda _: P(), params)
+
+                def dp_step(p, *b):
+                    loss, grads = jax.value_and_grad(loss_fn)(p, *b)
+                    # THE dp gradient sync: one fused all-reduce over
+                    # every grad leaf — explicit so the wire pass (and
+                    # TPU803) can see what GSPMD emits
+                    grads = jax.lax.psum(grads, "dp")
+                    return jax.lax.psum(loss, "dp") / dp, grads
+
+                target = shard_map(
+                    dp_step, mesh=dp_mesh,
+                    in_specs=(p_specs,) + (P("dp"),) * len(batch),
+                    out_specs=(P(), p_specs), check_vma=False)
+                name = f"fit.step[dp={dp}]"
+            g = _mem.trace_auto(target, params, *batch, name=name)
+            rep = _comms.audit_graph(g)
+            lint = _analyze(None, graph=g,
+                            rules=["TPU801", "TPU802", "TPU803"])
+            self.comms_audit = {
+                **rep.to_dict(),
+                "diagnostics": lint.to_dict()["diagnostics"],
+            }
+            record_event("comms.audit", target=name,
+                         bytes_on_wire=rep.total_wire_bytes,
+                         n_collectives=rep.n_collectives, mp=rep.mp)
+        except Exception as e:  # pragma: no cover - defensive
+            import warnings
+
+            warnings.warn(f"fit(audit_comms=True) failed: "
                           f"{type(e).__name__}: {e}")
 
     def _save_checkpoint(self, mgr, epoch, step_in_epoch, global_step,

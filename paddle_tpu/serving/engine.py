@@ -342,6 +342,7 @@ class ContinuousBatchingEngine:
         # TPU702 HBM budget from it
         self._kv_pool_budget = kv_pool_bytes
         self._memory_audit = None   # fleet report from the last audit
+        self._comms_audit = None    # wire-side twin (ISSUE 11)
         self.mgr = PagedKVManager(max_pages, block_size)
         self.mgr.set_pool_geometry(n_layers=cfg.num_hidden_layers,
                                    num_kv_heads=nkv, head_dim=dh,
@@ -571,6 +572,10 @@ class ContinuousBatchingEngine:
             # last audit_memory() / warm(audit_memory=True) run — None
             # until one ran
             "memory_audit": self._memory_audit,
+            # static communication audit (ISSUE 11): bytes-on-wire
+            # fleet report from the last audit_comms() /
+            # warm(audit_comms=True) run — None until one ran
+            "comms_audit": self._comms_audit,
         }
 
     @staticmethod
@@ -848,7 +853,8 @@ class ContinuousBatchingEngine:
             bsz *= 2
         return bsz
 
-    def warm(self, buckets=None, prefix_widths=None, audit_memory=None):
+    def warm(self, buckets=None, prefix_widths=None, audit_memory=None,
+             audit_comms=None):
         """Compile (and cache) every program the engine can need for the
         given prompt buckets — each power-of-two prefill batch (cold AND
         cached-prefix variants) plus the decode chunk — by running them
@@ -868,7 +874,16 @@ class ContinuousBatchingEngine:
         `metrics()['memory_audit']`, also emitted through the
         observability event log. Default (None) follows
         FLAGS_audit_memory / PADDLE_TPU_AUDIT_MEMORY — and composes
-        with PADDLE_TPU_LINT=1, which implies it."""
+        with PADDLE_TPU_LINT=1, which implies it.
+
+        `audit_comms` (ISSUE 11): likewise runs the static
+        COMMUNICATION auditor (`analysis/comms.py`) over the cache —
+        per-program bytes-on-wire with the per-chip collective cost
+        model, TPU801/802/803 diagnostics, and the
+        `predicted_bytes_on_wire_per_token` gauge — onto
+        `metrics()['comms_audit']`. Default (None) follows
+        FLAGS_audit_comms / PADDLE_TPU_AUDIT_COMMS, also implied by
+        PADDLE_TPU_LINT=1."""
         buckets = [self.max_prompt_len] if buckets is None else buckets
         if prefix_widths is None:
             prefix_widths = self._prefix_width_ladder()
@@ -931,10 +946,21 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.top_p, jnp.float32))
         _, _, _, self.kcs, self.vcs = out
         np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
+        from ..analysis.comms import resolve_audit_comms
         from ..analysis.memory import resolve_audit_memory
 
-        if resolve_audit_memory(audit_memory):
-            self.audit_memory()
+        do_mem = resolve_audit_memory(audit_memory)
+        do_comms = resolve_audit_comms(audit_comms)
+        # one jaxpr trace per program serves BOTH auditors (their
+        # passes memoize on the Graph) — under PADDLE_TPU_LINT=1,
+        # which implies both, the warm path must not trace the whole
+        # fleet twice
+        shared = self._traced_inventory() if do_mem and do_comms \
+            else None
+        if do_mem:
+            self.audit_memory(graphs=shared)
+        if do_comms:
+            self.audit_comms(graphs=shared)
 
     # ---- static memory audit (ISSUE 10) ---------------------------------
 
@@ -979,7 +1005,31 @@ class ContinuousBatchingEngine:
             progs.append((name, fn, self._prefill_example_args(key)))
         return progs
 
-    def audit_memory(self, hbm_budget_bytes=None, programs=None) -> dict:
+    def _traced_inventory(self, programs=None):
+        """(name, Graph) pairs for every (optionally filtered) cached
+        program — ONE donation-aware jaxpr trace per program. Both
+        static auditors run over these graphs (their passes memoize on
+        the Graph), so a caller wanting memory AND comms reports —
+        warm() under PADDLE_TPU_LINT=1, the bench drivers — traces
+        each program once, not once per audit. Unknown filter names
+        raise: a typo'd filter must not yield a vacuously clean report
+        a CI gate would wave through."""
+        from ..analysis import memory as _mem
+
+        inventory = self._program_inventory()
+        if programs is not None:
+            want = set(programs)
+            inventory = [it for it in inventory if it[0] in want]
+            missing = want - {it[0] for it in inventory}
+            if missing:
+                raise ValueError(
+                    f"programs {sorted(missing)} not in the inventory "
+                    f"{[it[0] for it in self._program_inventory()]}")
+        return [(name, _mem.trace_for_memory(fn, *args, name=name))
+                for name, fn, args in inventory]
+
+    def audit_memory(self, hbm_budget_bytes=None, programs=None,
+                     graphs=None) -> dict:
         """Static memory audit (ISSUE 10): run the jaxpr liveness pass
         (`analysis/memory.py`) over every program in the cache and
         return ONE fleet report — per-program per-chip peak-HBM
@@ -997,7 +1047,10 @@ class ContinuousBatchingEngine:
         returns a `partial` report WITHOUT touching the fleet sinks.
         Full audits land on `metrics()['memory_audit']` and are
         emitted through the observability event log. Host-side tracing
-        only: nothing executes on device."""
+        only: nothing executes on device. `graphs` (pre-traced
+        (name, Graph) pairs from `_traced_inventory`) shares one
+        trace with `audit_comms` — pass the same `programs` filter
+        you traced with."""
         from ..analysis import memory as _mem
         from ..analysis.pipeline import analyze as _analyze
 
@@ -1013,21 +1066,11 @@ class ContinuousBatchingEngine:
         rule_config = {}
         if hbm_budget_bytes:
             rule_config["TPU702.hbm_budget_bytes"] = int(hbm_budget_bytes)
-        inventory = self._program_inventory()
-        if programs is not None:
-            want = set(programs)
-            inventory = [it for it in inventory if it[0] in want]
-            missing = want - {it[0] for it in inventory}
-            if missing:
-                # a typo'd filter must not yield a vacuously clean
-                # report a CI gate would wave through
-                raise ValueError(
-                    f"programs {sorted(missing)} not in the inventory "
-                    f"{[it[0] for it in self._program_inventory()]}")
+        if graphs is None:
+            graphs = self._traced_inventory(programs)
         min_miss = _mem.DonationMissRule.MIN_BYTES
         out, diags = {}, 0
-        for name, fn, args in inventory:
-            g = _mem.trace_for_memory(fn, *args, name=name)
+        for name, g in graphs:
             rep = _mem.audit_graph(g)
             lint = _analyze(None, graph=g,
                             rules=["TPU701", "TPU702", "TPU703"],
@@ -1085,6 +1128,91 @@ class ContinuousBatchingEngine:
             mt.gauge("predicted_peak_hbm_bytes",
                      "static auditor per-chip peak over cached "
                      "programs").set(fleet_peak)
+        return report
+
+    def audit_comms(self, programs=None, rule_config=None,
+                    graphs=None) -> dict:
+        """Static communication audit (ISSUE 11): run the jaxpr
+        bytes-on-wire pass (`analysis/comms.py`) over every program in
+        the cache and return ONE fleet report — per-program per-chip
+        wire bytes (ring cost model, loop amplification folded in),
+        per-axis/per-kind splits, and the TPU801/802/803 diagnostics.
+        The headline gauge is `predicted_bytes_on_wire_per_token`: the
+        decode chunk's amplified wire bytes divided by the tokens one
+        chunk produces (steps_per_sync x slots) — the number that
+        pairs with the measured `bytes_all_gathered_per_token` bench
+        counter, and the one an EQuARX-style quantized collective
+        must beat. At mp=1 every program audits to zero collectives.
+
+        `programs` filters by inventory name like `audit_memory`;
+        filtered runs return a `partial` report without touching the
+        fleet sinks. `rule_config` passes TPU80x knobs through
+        (`{"TPU803.min_bytes": ...}`). `graphs` (pre-traced
+        (name, Graph) pairs from `_traced_inventory`) shares one
+        trace with `audit_memory`. Host-side tracing only."""
+        from ..analysis import comms as _comms
+        from ..analysis.pipeline import analyze as _analyze
+
+        if graphs is None:
+            graphs = self._traced_inventory(programs)
+        out, diags = {}, 0
+        for name, g in graphs:
+            rep = _comms.audit_graph(g)
+            lint = _analyze(None, graph=g,
+                            rules=["TPU801", "TPU802", "TPU803"],
+                            rule_config=rule_config)
+            diags += len(lint)
+            out[name] = {
+                "bytes_on_wire": rep.total_wire_bytes,
+                "n_collective_sites": rep.n_collective_sites,
+                "n_collectives": rep.n_collectives,
+                "n_implicit_reshards": len(rep.reshards),
+                "mp": rep.mp,
+                "per_axis": rep.per_axis(),
+                "per_kind": rep.per_kind(),
+                "top_talkers": [e.to_dict()
+                                for e in rep.top_talkers(4)],
+                "diagnostics": lint.to_dict()["diagnostics"],
+            }
+        # per decoded token per chip: one decode chunk produces
+        # steps_per_sync tokens for each of the `slots` rows
+        per_token = None
+        if "decode" in out:
+            per_token = out["decode"]["bytes_on_wire"] \
+                / max(self.steps * self.slots, 1)
+        report = {
+            "programs": out,
+            "programs_audited": len(out),
+            "per_chip": True,
+            "mp": self.mp,
+            "total_bytes_on_wire": sum(p["bytes_on_wire"]
+                                       for p in out.values()),
+            "predicted_bytes_on_wire_per_token": per_token,
+            "comms_clean": diags == 0,
+            "n_diagnostics": diags,
+            "partial": programs is not None,
+        }
+        if report["partial"]:
+            # same contract as audit_memory: a narrowed run must not
+            # overwrite the FLEET report monitoring reads
+            return report
+        self._comms_audit = report
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("comms.audit",
+                       total_bytes_on_wire=report["total_bytes_on_wire"],
+                       programs=len(out), mp=self.mp,
+                       comms_clean=report["comms_clean"])
+        if mt is not None:
+            mt.event("comms.audit",
+                     total_bytes_on_wire=report["total_bytes_on_wire"],
+                     programs=len(out), mp=self.mp,
+                     comms_clean=report["comms_clean"],
+                     n_diagnostics=diags)
+            if per_token is not None:
+                mt.gauge("predicted_bytes_on_wire_per_token",
+                         "static auditor per-chip wire bytes per "
+                         "decoded token (decode chunk)").set(per_token)
         return report
 
     def _check_owner(self, token: Optional[int]):
