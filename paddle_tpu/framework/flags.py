@@ -190,3 +190,11 @@ define_flag("step_timeout_s", 0.0,
             "default wall-clock watchdog deadline per serving-engine "
             "step; 0 disables (also: PADDLE_TPU_STEP_TIMEOUT_S)",
             env_aliases=("PADDLE_TPU_STEP_TIMEOUT_S",))
+define_flag("barrier_timeout_s", 60.0,
+            "default deadline of a gang coordination barrier "
+            "(resilience/coordination.py): how long a host waits for "
+            "its peers at a checkpoint stage/commit or generation "
+            "agreement before raising a structured BarrierTimeout "
+            "naming the missing ranks (also: "
+            "PADDLE_TPU_BARRIER_TIMEOUT_S)",
+            env_aliases=("PADDLE_TPU_BARRIER_TIMEOUT_S",))

@@ -53,6 +53,10 @@ class Model:
         # static communication audit of the training step (ISSUE 11):
         # dict via fit(audit_comms=True) / PADDLE_TPU_AUDIT_COMMS
         self.comms_audit = None
+        # generation fit(resume=True) restored from (gang mode: the
+        # AGREED generation — every rank reports the same number), or
+        # None when the run started fresh (ISSUE 12)
+        self.restored_generation = None
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -134,7 +138,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
             resume=False, checkpoint_freq=None, audit_memory=None,
-            audit_comms=None):
+            audit_comms=None, coordinator=None):
         """reference: hapi/model.py fit (:1807).
 
         Resilience extensions (paddle_tpu.resilience):
@@ -148,6 +152,22 @@ class Model:
             shuffle=False or a seeded sampler).
           checkpoint_freq: save every N steps (async, off the step
             path); None saves at epoch boundaries only.
+          coordinator: a `resilience.Coordinator` puts checkpointing in
+            GANG mode (ISSUE 12): every save is the two-phase
+            coordinated commit (all hosts stage + barrier, rank 0
+            writes the group manifest, barrier, visible) and
+            resume=True routes through generation AGREEMENT — each
+            host publishes its newest digest-verified generation and
+            all adopt the group min, recorded on
+            `self.restored_generation`. A peer that dies mid-protocol
+            surfaces as a structured `BarrierTimeout` naming the
+            missing rank (the gang supervisor's relaunch signal), and
+            the solo emergency checkpoint on preemption is replaced by
+            a best-effort gang save that is ABANDONED on barrier
+            timeout: a single host cannot commit a group generation,
+            the periodic coordinated checkpoints are the recovery
+            point. Subprocess workers build one with
+            `resilience.coordination.from_env()`.
 
         Observability (ISSUE 8): with FLAGS_trace / FLAGS_metrics
         armed, every step records `fit.data_fetch` (loader wait),
@@ -200,6 +220,7 @@ class Model:
                          "verbose": verbose, "metrics": self._metric_names()})
         self.stop_training = False
         self.preempted = False
+        self.restored_generation = None
         from ..resilience import chaos as _chaos
 
         ckpt_mgr = guard = None
@@ -210,10 +231,14 @@ class Model:
                 from ..resilience.checkpoint import (
                     CheckpointManager, CheckpointNotFoundError)
 
-                ckpt_mgr = CheckpointManager(checkpoint_dir, max_to_keep=3)
+                ckpt_mgr = CheckpointManager(checkpoint_dir, max_to_keep=3,
+                                             coordinator=coordinator)
                 guard = _preemption.install()
                 if resume:
                     try:
+                        # gang mode: routed through generation
+                        # agreement — min over every host's newest
+                        # digest-verified group generation
                         ck = ckpt_mgr.restore()
                     except CheckpointNotFoundError:
                         # an EMPTY dir is a legitimate fresh run;
@@ -228,6 +253,7 @@ class Model:
                                 and "optimizer" in ck.value:
                             self._optimizer.set_state_dict(
                                 ck.value["optimizer"])
+                        self.restored_generation = ck.generation
                         start_epoch = int(ck.meta.get("epoch", 0))
                         skip_steps = int(ck.meta.get("step_in_epoch", 0))
                         it_count = int(ck.meta.get("global_step", 0))
@@ -286,10 +312,31 @@ class Model:
                         self.stop_training = True
                     if guard is not None and guard.requested:
                         # emergency checkpoint: blocking, then a clean
-                        # stop — the grace window is for THIS write
-                        self._save_checkpoint(
-                            ckpt_mgr, epoch, step + 1, it_count,
-                            blocking=True)
+                        # stop — the grace window is for THIS write. In
+                        # gang mode the save is the coordinated two-
+                        # phase commit and BEST-EFFORT: a peer that was
+                        # preempted harder than us (never reaches the
+                        # stage barrier) must not wedge our shutdown —
+                        # abandon on BarrierTimeout, the periodic gang
+                        # generations are the recovery point
+                        try:
+                            self._save_checkpoint(
+                                ckpt_mgr, epoch, step + 1, it_count,
+                                blocking=True)
+                        except Exception as e:
+                            from ..resilience.coordination import (
+                                BarrierTimeout)
+
+                            if coordinator is None \
+                                    or not isinstance(e, BarrierTimeout):
+                                raise
+                            import warnings
+
+                            warnings.warn(
+                                f"emergency gang checkpoint abandoned "
+                                f"({e}); the newest committed group "
+                                "generation is the recovery point",
+                                RuntimeWarning)
                         self.preempted = True
                         self.stop_training = True
                         from ..observability import record_event

@@ -15,13 +15,14 @@ mocked-etcd unit strategy (test_fleet_elastic_manager.py).
 """
 from __future__ import annotations
 
-import json
-import os
-import tempfile
 import threading
-import time
-from typing import Callable, Dict, List, Optional
-from urllib.parse import quote, unquote
+from typing import Callable, List, Optional
+
+# hoisted to resilience/store.py (ISSUE 12) — the gang coordination
+# layer shares the exact same store implementations; re-exported here
+# so existing `from paddle_tpu.parallel.elastic import DictStore`
+# imports keep working
+from ..resilience.store import DictStore, FileStore  # noqa: F401
 
 __all__ = ["ElasticManager", "ElasticStatus", "DictStore", "FileStore"]
 
@@ -32,102 +33,6 @@ class ElasticStatus:
     HOLD = "hold"
     RESTART = "restart"
     EXIT = "exit"
-
-
-class DictStore:
-    """In-process KV store with TTL semantics (etcd stand-in)."""
-
-    def __init__(self):
-        self._kv: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
-
-    def put(self, key: str, value: str, ttl: Optional[float] = None):
-        with self._lock:
-            exp = time.time() + ttl if ttl else None
-            self._kv[key] = (value, exp)
-
-    def get(self, key: str):
-        with self._lock:
-            v = self._kv.get(key)
-            if v is None:
-                return None
-            if v[1] is not None and v[1] < time.time():
-                del self._kv[key]
-                return None
-            return v[0]
-
-    def delete(self, key: str):
-        with self._lock:
-            self._kv.pop(key, None)
-
-    def prefix(self, pre: str) -> Dict[str, str]:
-        with self._lock:
-            now = time.time()
-            out = {}
-            for k, (v, exp) in list(self._kv.items()):
-                if exp is not None and exp < now:
-                    del self._kv[k]
-                elif k.startswith(pre):
-                    out[k] = v
-            return out
-
-
-class FileStore:
-    """File-backed KV store with TTL, shared ACROSS PROCESSES through a
-    directory (the etcd stand-in the launcher's elastic path uses;
-    reference: ElasticManager's etcd registry, manager.py:124). One file
-    per key (name URL-quoted), values written atomically via
-    tempfile+rename so concurrent readers never see partial writes."""
-
-    def __init__(self, root: str):
-        self.root = root
-        os.makedirs(root, exist_ok=True)
-
-    def _path(self, key: str) -> str:
-        return os.path.join(self.root, quote(key, safe="") + ".json")
-
-    def put(self, key: str, value: str, ttl: Optional[float] = None):
-        payload = {"v": value, "exp": time.time() + ttl if ttl else None}
-        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self._path(key))
-
-    def _read(self, path: str):
-        try:
-            with open(path) as f:
-                payload = json.load(f)
-        except (OSError, ValueError):
-            return None
-        if payload["exp"] is not None and payload["exp"] < time.time():
-            # do NOT unlink: between our read and an unlink the owner may
-            # have atomically renewed the file, and we would delete the
-            # fresh heartbeat (spurious membership flap). Expired files
-            # are simply skipped; the owner's delete() cleans up.
-            return None
-        return payload["v"]
-
-    def get(self, key: str):
-        return self._read(self._path(key))
-
-    def delete(self, key: str):
-        try:
-            os.unlink(self._path(key))
-        except OSError:
-            pass
-
-    def prefix(self, pre: str) -> Dict[str, str]:
-        out = {}
-        for fn in os.listdir(self.root):
-            if not fn.endswith(".json"):
-                continue
-            key = unquote(fn[:-len(".json")])
-            if not key.startswith(pre):
-                continue
-            v = self._read(os.path.join(self.root, fn))
-            if v is not None:
-                out[key] = v
-        return out
 
 
 class ElasticManager:

@@ -11,4 +11,5 @@ local processes to emulate multi-host on CPU), exports the
 then supervises: failure detection + restart with re-rendezvous is the
 elastic path (manager.py ElasticManager analog).
 """
+from .gang import GangResult, GangSupervisor  # noqa: F401
 from .main import launch  # noqa: F401

@@ -4,24 +4,32 @@ hung steps.
 The production counterpart of "runs fast": the ROADMAP north star is a
 fleet serving heavy traffic, and on real TPU fleets that means
 preemptible slices, transient blob-store faults, and occasionally a
-wedged collective. Four pillars, each independently usable and all
+wedged collective. Six pillars, each independently usable and all
 chaos-testable on CPU (see `resilience/README.md` for the failure
 matrix):
 
-- `checkpoint` — atomic, digest-verified, generation-counted pytree
+- `checkpoint`    — atomic, digest-verified, generation-counted pytree
   checkpoints with async save and corrupt-generation fallback;
-- `chaos`     — deterministic seed-driven fault injection armed via
-  ``PADDLE_TPU_CHAOS`` (io_error / corrupt / preempt_at / hang);
-- `preemption`— SIGTERM/SIGINT -> step-boundary flag -> emergency
+- `coordination`  — multi-host gangs (ISSUE 12): store-backed barriers
+  with structured `BarrierTimeout`s, two-phase group commit
+  (`CheckpointManager(dir, coordinator=...)`), restore-generation
+  agreement (min over digest-verified hosts), coordinated GC;
+- `store`         — the pluggable `DictStore`/`FileStore` KV stores the
+  barriers AND `parallel/elastic.py` rendezvous through;
+- `chaos`         — deterministic seed-driven fault injection armed via
+  ``PADDLE_TPU_CHAOS`` (io_error / corrupt / preempt_at /
+  preempt_host:K@N / hang);
+- `preemption`    — SIGTERM/SIGINT -> step-boundary flag -> emergency
   checkpoint + clean exit;
-- `watchdog`  — wall-clock deadlines around step callables, raising a
-  structured `StepTimeout` with the last-known phase;
-- `retry`     — jittered-exponential-backoff `RetryPolicy` shared by
-  the I/O seams (streaming checkpoint reader, DataLoader).
+- `watchdog`      — wall-clock deadlines around step callables, raising
+  a structured `StepTimeout` with the last-known phase;
+- `retry`         — jittered-exponential-backoff `RetryPolicy` shared
+  by the I/O seams (streaming checkpoint reader, DataLoader).
 
-Integration points: `hapi.Model.fit(checkpoint_dir=..., resume=True)`,
-`serving.ContinuousBatchingEngine.run(watchdog_timeout=...)`,
-`models.checkpoint` shard reads, and the
+Integration points: `hapi.Model.fit(checkpoint_dir=..., resume=True,
+coordinator=...)`, `parallel.launch.GangSupervisor` (subprocess gang
+relaunch), `serving.ContinuousBatchingEngine.run(watchdog_timeout=...,
+requeue_hung=...)`, `models.checkpoint` shard reads, and the
 `incubate.checkpoint.auto_checkpoint` Paddle-parity shim.
 """
 from . import chaos  # noqa: F401
@@ -31,14 +39,20 @@ from .checkpoint import (  # noqa: F401
     save_checkpoint,
 )
 from .chaos import ChaosError, ChaosHang, ChaosMonkey  # noqa: F401
+from .coordination import (  # noqa: F401
+    Barrier, BarrierTimeout, Coordinator, GangCheckpointManager,
+)
 from .preemption import EXIT_PREEMPTED, PreemptionGuard  # noqa: F401
 from .retry import RetryPolicy, RetryStats, retry  # noqa: F401
+from .store import DictStore, FileStore  # noqa: F401
 from .watchdog import StepTimeout, Watchdog  # noqa: F401
 
 __all__ = [
-    "Checkpoint", "CheckpointCorruptError", "CheckpointError",
-    "CheckpointManager", "CheckpointNotFoundError", "ChaosError",
-    "ChaosHang", "ChaosMonkey", "EXIT_PREEMPTED", "PreemptionGuard",
-    "RetryPolicy", "RetryStats", "StepTimeout", "Watchdog", "chaos",
-    "restore_checkpoint", "retry", "save_checkpoint",
+    "Barrier", "BarrierTimeout", "Checkpoint", "CheckpointCorruptError",
+    "CheckpointError", "CheckpointManager", "CheckpointNotFoundError",
+    "ChaosError", "ChaosHang", "ChaosMonkey", "Coordinator",
+    "DictStore", "EXIT_PREEMPTED", "FileStore", "GangCheckpointManager",
+    "PreemptionGuard", "RetryPolicy", "RetryStats", "StepTimeout",
+    "Watchdog", "chaos", "restore_checkpoint", "retry",
+    "save_checkpoint",
 ]

@@ -18,6 +18,16 @@ Fault grammar (comma-separated ``kind:arg[:arg2]``):
                         (optionally only at seams containing SEAM)
     corrupt:P[:SEAM]    flip a byte of written payloads with prob. P
     preempt_at:N        deliver a real SIGTERM at loop step N (once)
+    preempt_host:K@N    HARD-kill gang rank K (SIGKILL, no grace, no
+                        emergency checkpoint — a host preemption) when
+                        it executes loop step N exactly; the rank comes
+                        from PADDLE_GANG_RANK, so the same spec can be
+                        armed fleet-wide and fires on one host. Step
+                        equality (not >=) means a gang relaunched from
+                        an earlier generation replays step N at most
+                        once per process — pair with a supervisor that
+                        strips the spec on restart attempts for a
+                        one-shot preemption
     hang:SEAM[:SECS]    stall SEAM for SECS (default 60) once, then
                         raise ChaosHang so the abandoned worker thread
                         unwinds without side effects
@@ -49,12 +59,25 @@ class ChaosHang(RuntimeError):
 
 @dataclass
 class _Fault:
-    kind: str            # io_error | corrupt | preempt_at | hang
+    kind: str            # io_error | corrupt | preempt_at | preempt_host | hang
     prob: float = 0.0    # io_error / corrupt
-    step: int = -1       # preempt_at
+    step: int = -1       # preempt_at / preempt_host
     seam: str = ""       # seam filter (io_error/corrupt) or target (hang)
     seconds: float = 60.0  # hang duration
+    rank: int = -1       # preempt_host victim (gang rank)
     fired: int = 0
+
+
+def gang_rank() -> Optional[int]:
+    """This process's gang rank (PADDLE_GANG_RANK, exported by the gang
+    supervisor), or None outside a gang."""
+    raw = os.environ.get("PADDLE_GANG_RANK")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 class ChaosMonkey:
@@ -76,6 +99,14 @@ class ChaosMonkey:
                            seam=bits[2] if len(bits) > 2 else "")
             elif kind == "preempt_at":
                 f = _Fault(kind, step=int(bits[1]))
+            elif kind == "preempt_host":
+                rank_s, sep, step_s = (bits[1] if len(bits) > 1
+                                       else "").partition("@")
+                if not sep:
+                    raise ValueError(
+                        f"preempt_host needs K@N (kill rank K at step "
+                        f"N), got {part!r} in spec {spec!r}")
+                f = _Fault(kind, rank=int(rank_s), step=int(step_s))
             elif kind == "hang":
                 f = _Fault(kind, seam=bits[1] if len(bits) > 1 else "",
                            seconds=float(bits[2]) if len(bits) > 2
@@ -134,7 +165,8 @@ class ChaosMonkey:
 
     def on_step(self, loop: str, step: int):
         """Called once per loop step; delivers SIGTERM at `preempt_at`'s
-        step (once per fault)."""
+        step (once per fault), SIGKILL at `preempt_host:K@N` when THIS
+        process is gang rank K executing step N."""
         for f in self.faults:
             if f.kind == "preempt_at" and not f.fired and step >= f.step \
                     and (not f.seam or f.seam in loop):
@@ -142,6 +174,17 @@ class ChaosMonkey:
                 from . import preemption
 
                 preemption.self_preempt()
+            elif f.kind == "preempt_host" and not f.fired \
+                    and step == f.step and gang_rank() == f.rank:
+                self._count(f, loop)
+                # a HOST preemption: no grace window, no signal handler,
+                # no emergency checkpoint — the process is simply gone.
+                # Recovery is the gang protocol: peers trip BarrierTimeout
+                # at the next coordinated checkpoint, the supervisor
+                # relaunches, and everyone agrees on a restore generation.
+                import signal
+
+                os.kill(os.getpid(), signal.SIGKILL)
 
     def maybe_hang(self, seam: str):
         """Stall once at `seam`, then raise ChaosHang (the stalled

@@ -199,8 +199,22 @@ class CheckpointManager:
         ck.value, ck.step, ck.generation
     """
 
+    def __new__(cls, *args, **kwargs):
+        # gang mode (ISSUE 12): `CheckpointManager(dir, coordinator=c)`
+        # builds the multi-host manager — per-host shard dirs, two-phase
+        # barrier commit, restore through generation agreement. The
+        # coordinator-less path below is byte-identical to the
+        # single-writer manager it always was.
+        if cls is CheckpointManager \
+                and kwargs.get("coordinator") is not None:
+            from .coordination import GangCheckpointManager
+
+            return object.__new__(GangCheckpointManager)
+        return object.__new__(cls)
+
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = None,
-                 digest: str = "crc32"):
+                 digest: str = "crc32", coordinator=None):
+        assert coordinator is None  # handled by __new__ dispatch
         self.directory = str(directory)
         self.max_to_keep = max_to_keep
         self.digest = digest
@@ -257,9 +271,9 @@ class CheckpointManager:
         self.wait()  # one in-flight async save; surfaces prior errors
         tensors: List[np.ndarray] = []
         skeleton = _flatten(value, "", tensors)
-        gen = self._next_generation()
+        gen = self._issue_generation()
         if blocking:
-            self._write_generation(gen, skeleton, tensors, step, meta)
+            self._commit_generation(gen, skeleton, tensors, step, meta)
             return gen
         # the SNAPSHOT: np.asarray aliases leaves that were already
         # host ndarrays, so without this copy a train step mutating
@@ -269,7 +283,8 @@ class CheckpointManager:
 
         def writer():
             try:
-                self._write_generation(gen, skeleton, tensors, step, meta)
+                self._commit_generation(gen, skeleton, tensors, step,
+                                        meta)
             except BaseException as e:
                 self._async_error = e
 
@@ -278,6 +293,16 @@ class CheckpointManager:
         self._pending = t
         t.start()
         return gen
+
+    # the two seams gang mode overrides (coordination.py): generation
+    # numbering from the shared group history, and commit promoted to
+    # the two-phase barrier protocol — the save() scaffolding above
+    # (wait/flatten/snapshot/async writer) exists exactly once
+    def _issue_generation(self) -> int:
+        return self._next_generation()
+
+    def _commit_generation(self, gen, skeleton, tensors, step, meta):
+        self._write_generation(gen, skeleton, tensors, step, meta)
 
     def wait(self):
         """Barrier for an in-flight async save; re-raises its error."""
@@ -421,6 +446,16 @@ class CheckpointManager:
         raise CheckpointNotFoundError(
             f"every generation under {self.directory!r} failed "
             f"verification: {errors}")
+
+    def verify_generation(self, generation: int) -> bool:
+        """True when `generation` exists and every shard passes digest +
+        shape verification. Reads the shards (restore-path cost) — meant
+        for restore-time agreement across hosts, not for hot loops."""
+        try:
+            self._load_generation(generation, True)
+            return True
+        except CheckpointError:
+            return False
 
     def _load_generation(self, gen: int, verify: bool) -> Checkpoint:
         path = self._gen_path(gen)

@@ -126,6 +126,8 @@ class ServeRequest:
     finish_time: Optional[float] = None
     failed: bool = False                   # retired by the watchdog
     error: Optional[str] = None
+    requeued: bool = False                 # already got its one retry
+                                           # (run(requeue_hung=True))
     # host-side scheduling state (None until admitted)
     slot: Optional[int] = None
     pages: Optional[list] = None
@@ -401,6 +403,8 @@ class ContinuousBatchingEngine:
         self.device_steps = 0    # decode-chunk dispatches (for metrics)
         self.prefill_calls = 0   # batched-admission device calls
         self.hung_retired = 0    # slots retired by the watchdog
+        self.hung_requeued = 0   # hung slots requeued (requeue_hung=)
+        self._requeue_hung = False  # armed per run()
         self.prefix_hit_tokens = 0   # prompt tokens served from cache
         self.prompt_tokens = 0       # prompt tokens admitted in total
         self.prefix_inserts = 0      # blocks registered into the cache
@@ -550,6 +554,7 @@ class ContinuousBatchingEngine:
             "device_steps": self.device_steps,
             "prefill_handoffs": self.prefill_handoffs,
             "hung_retired": self.hung_retired,
+            "hung_requeued": self.hung_requeued,
             # prefix cache
             "prefix_hit_rate": self.prefix_hit_rate,
             "prefix_hit_tokens": self.prefix_hit_tokens,
@@ -1713,7 +1718,8 @@ class ContinuousBatchingEngine:
 
     def run(self, max_iters: int = 100000,
             watchdog_timeout: Optional[float] = None,
-            double_buffer: Optional[bool] = None):
+            double_buffer: Optional[bool] = None,
+            requeue_hung: bool = False):
         """Drain the queues. `watchdog_timeout` (seconds; default from
         FLAGS_step_timeout_s / PADDLE_TPU_STEP_TIMEOUT_S, 0 = off)
         bounds every scheduling step with a wall-clock deadline: a hung
@@ -1729,11 +1735,21 @@ class ContinuousBatchingEngine:
         `warm()` before arming a tight deadline: a first-admit compile
         inside a watchdogged step would eat the whole budget (and an
         abandoned step mid-compile keeps running on its worker
-        thread)."""
+        thread).
+
+        `requeue_hung` (ISSUE 12 satellite — the shed/requeue building
+        block of an SLO-aware front-end): instead of retiring the
+        victim as `failed`, give it ONE retry — the request re-enters
+        `waiting` (head of queue: it is the oldest row) with its slot
+        freed and pages RELEASED through the refcount-aware pool, never
+        recycled in place; generation restarts from the prompt on
+        re-admission. The second timeout of the same request retires
+        it failed as before. Counted by `metrics()['hung_requeued']`."""
         if watchdog_timeout is None:
             from ..framework.flags import flag
 
             watchdog_timeout = float(flag("step_timeout_s"))
+        self._requeue_hung = bool(requeue_hung)
         db = self.double_buffer if double_buffer is None else double_buffer
         step_fn = self._pipeline_step if db else self.step
         wd = None
@@ -1785,13 +1801,21 @@ class ContinuousBatchingEngine:
         """Degrade gracefully after a StepTimeout: fail the victim slot
         (lowest-id live slot — deterministic, and FIFO admission makes
         it the longest-running row), recycle its pages, keep the rest.
-        Returns False when no slot is live (nothing to blame)."""
+        With `requeue_hung` armed, a first-time victim is REQUEUED
+        instead (see `run`). Returns False when no slot is live
+        (nothing to blame). Always called under `_commit_lock` AFTER
+        the epoch bump, so the abandoned step thread can never commit
+        tokens into (or dispatch against the pages of) the request we
+        reset here."""
         live = [i for i, s in enumerate(self._slots) if s.req is not None]
         if not live:
             return False
         victim = live[0]
-        self.hung_retired += 1
         tr, mt = self._tracer, self._metrics
+        if self._requeue_hung and not self._slots[victim].req.requeued:
+            self._requeue_slot(victim)
+            return True
+        self.hung_retired += 1
         if tr is not None:
             tr.instant("watchdog.retire_hung_slot", slot=victim,
                        phase=getattr(exc, "phase", None),
@@ -1803,3 +1827,39 @@ class ContinuousBatchingEngine:
                      timeout_s=getattr(exc, "timeout_s", None))
         self._retire(victim, failed=True, error=str(exc))
         return True
+
+    def _requeue_slot(self, slot_id: int):
+        """Put a hung slot's request back at the head of `waiting` for
+        exactly one retry: release its pages through the refcount-aware
+        pool (a shared prefix page a live peer maps stays pinned — the
+        pages are never recycled in place), reset the request to its
+        pre-admission state (tokens regenerate from the prompt; the
+        memoized block hashes survive), and free the slot row."""
+        slot = self._slots[slot_id]
+        req = slot.req
+        req.requeued = True
+        self.hung_requeued += 1
+        self.mgr.free(req.pages)
+        req.pages = None
+        req.slot = None
+        req.bucket = None
+        req.tokens = []
+        req.prefill_time = None
+        req.n_prefix = 0
+        req.cached_tokens = 0
+        slot.req, slot.length, slot.emitted, slot.done = None, 0, 0, False
+        # the row must stop pointing at released pages before they are
+        # handed to another request
+        self._tables[slot_id] = self.scratch_page
+        self._tokens[slot_id] = 0
+        self._budgets[slot_id] = 0
+        self._override[slot_id] = True
+        self.waiting.insert(0, req)
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("watchdog.requeue_hung_slot", slot=slot_id,
+                       req_id=req.req_id)
+        if mt is not None:
+            mt.counter("hung_slots_requeued").inc()
+            mt.event("watchdog.requeue_hung_slot", slot=slot_id,
+                     req_id=req.req_id)

@@ -1,0 +1,753 @@
+"""Multi-host gang coordination suite (ISSUE 12).
+
+Store-backed barriers raise structured `BarrierTimeout`s naming the
+missing ranks instead of hanging; the gang checkpoint manager commits
+through the two-phase protocol (per-host shards + rank-0 group
+manifest), restores through generation AGREEMENT (min over each host's
+newest digest-verified generation), and its coordinated GC never
+deletes the agreed restore floor. The acceptance test runs a REAL
+subprocess gang under ``PADDLE_TPU_CHAOS=preempt_host:K@N``: the
+supervisor relaunches the killed gang, every rank restores the same
+agreed generation, and the post-resume loss trajectory equals the
+uninterrupted run's.
+"""
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import unittest
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.resilience import (Barrier, BarrierTimeout,
+                                   CheckpointManager,
+                                   CheckpointNotFoundError, Coordinator,
+                                   DictStore, GangCheckpointManager,
+                                   chaos)
+from paddle_tpu.resilience import coordination
+
+
+def _run_ranks(fn, world, store, **coord_kw):
+    """Run fn(rank, coordinator) on one thread per rank; re-raise the
+    first failure. Returns {rank: fn result}."""
+    results, errors = {}, []
+
+    def runner(rank):
+        try:
+            results[rank] = fn(rank, Coordinator(store, rank, world,
+                                                 **coord_kw))
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((rank, e))
+
+    ts = [threading.Thread(target=runner, args=(r,), daemon=True)
+          for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+class TestStoreHoist(unittest.TestCase):
+    def test_elastic_reexports_shared_stores(self):
+        """coordination must not fork a third store implementation:
+        elastic's stores ARE resilience.store's."""
+        from paddle_tpu.parallel import elastic
+        from paddle_tpu.resilience import store
+
+        self.assertIs(elastic.DictStore, store.DictStore)
+        self.assertIs(elastic.FileStore, store.FileStore)
+        self.assertIn("DictStore", elastic.__all__)
+        # and the coordination layer rides the same classes
+        self.assertIs(coordination.DictStore, store.DictStore)
+        self.assertIs(coordination.FileStore, store.FileStore)
+
+    def test_elastic_manager_still_works_on_hoisted_store(self):
+        from paddle_tpu.parallel.elastic import ElasticManager
+
+        m = ElasticManager(store=DictStore(), host="h0")
+        m.register()
+        self.assertEqual(m.members(), ["h0"])
+        m.exit()
+
+
+class TestBarrier(unittest.TestCase):
+    def test_all_arrive_returns_values(self):
+        store = DictStore()
+        b = Barrier(store, 3, name="/t/b1", timeout=10)
+        out = _run_ranks(
+            lambda r, c: b.wait(r, value=f"v{r}"), 3, store)
+        for r in range(3):
+            self.assertEqual(out[r], {0: "v0", 1: "v1", 2: "v2"})
+
+    def test_timeout_names_missing_ranks(self):
+        store = DictStore()
+        b = Barrier(store, 3, name="/t/b2", timeout=0.3)
+        with self.assertRaises(BarrierTimeout) as cm:
+            # ranks 0 arrives; 1 and 2 never do
+            b.wait(0)
+        e = cm.exception
+        self.assertEqual(e.missing, [1, 2])
+        self.assertEqual(e.arrived, [0])
+        self.assertEqual(e.world_size, 3)
+        self.assertIn("missing rank(s) [1, 2]", str(e))
+        self.assertIn("/t/b2", str(e))
+        # never-seen ranks report last_seen None
+        self.assertEqual(e.last_seen, {1: None, 2: None})
+
+    def test_timeout_reports_last_seen_heartbeat(self):
+        store = DictStore()
+        # rank 1 registered (rendezvoused) but never reaches the barrier
+        Coordinator(store, 1, 2, timeout=0.3, job_id="ls")
+        c0 = Coordinator(store, 0, 2, timeout=0.3, job_id="ls")
+        with self.assertRaises(BarrierTimeout) as cm:
+            c0.barrier("x")
+        ago = cm.exception.last_seen[1]
+        self.assertIsNotNone(ago)
+        self.assertLess(ago, 30.0)
+        self.assertIn("s ago", str(cm.exception))
+
+
+class TestCoordinator(unittest.TestCase):
+    def test_attempt_namespacing_isolates_barriers(self):
+        """A dead incarnation's arrivals must not satisfy the relaunched
+        gang's barrier: attempt 0's rank-1 arrival is invisible to
+        attempt 1."""
+        store = DictStore()
+        c1_old = Coordinator(store, 1, 2, timeout=0.2, attempt=0)
+        with self.assertRaises(BarrierTimeout):
+            c1_old.barrier("ckpt")  # rank 0 of attempt 0 never comes
+        c0_new = Coordinator(store, 0, 2, timeout=0.2, attempt=1)
+        with self.assertRaises(BarrierTimeout) as cm:
+            c0_new.barrier("ckpt")
+        # rank 1's attempt-0 arrival did NOT leak into attempt 1
+        self.assertEqual(cm.exception.missing, [1])
+
+    def test_barrier_name_reuse_is_distinct_rendezvous(self):
+        store = DictStore()
+
+        def fn(rank, coord):
+            a = coord.barrier("same", value=f"a{rank}")
+            b = coord.barrier("same", value=f"b{rank}")
+            return a, b
+
+        out = _run_ranks(fn, 2, store, timeout=10)
+        self.assertEqual(out[0][0], {0: "a0", 1: "a1"})
+        self.assertEqual(out[0][1], {0: "b0", 1: "b1"})
+
+    def test_peers_and_wait_accounting(self):
+        store = DictStore()
+        c0 = Coordinator(store, 0, 2, timeout=5)
+        c1 = Coordinator(store, 1, 2, timeout=5)
+        self.assertEqual(sorted(c0.peers()), [0, 1])
+        self.assertEqual(c1.peers()[0]["pid"], os.getpid())
+        out = _run_ranks(lambda r, c: (c.barrier("b"), c.n_barriers,
+                                       c.barrier_wait_s),
+                         2, DictStore(), timeout=5)
+        self.assertEqual(out[0][1], 1)
+        self.assertGreaterEqual(out[0][2], 0.0)
+
+    def test_rank_validation(self):
+        with self.assertRaises(ValueError):
+            Coordinator(DictStore(), 2, 2)
+        with self.assertRaises(ValueError):
+            Barrier(DictStore(), 0)
+
+    def test_from_env(self):
+        env = {"PADDLE_GANG_RANK": "1", "PADDLE_GANG_WORLD_SIZE": "3",
+               "PADDLE_GANG_ATTEMPT": "2", "PADDLE_GANG_JOB": "j7"}
+        with mock.patch.dict(os.environ, env):
+            c = coordination.from_env(store=DictStore())
+            self.assertEqual((c.rank, c.world_size, c.attempt, c.job_id),
+                             (1, 3, 2, "j7"))
+        with mock.patch.dict(os.environ):
+            os.environ.pop("PADDLE_GANG_RANK", None)
+            self.assertIsNone(coordination.from_env())
+        with mock.patch.dict(os.environ, {"PADDLE_GANG_RANK": "0"}):
+            os.environ.pop("PADDLE_GANG_STORE", None)
+            with self.assertRaisesRegex(ValueError, "PADDLE_GANG_STORE"):
+                coordination.from_env()
+
+
+class TestChaosPreemptHost(unittest.TestCase):
+    def tearDown(self):
+        chaos.uninstall()
+
+    def test_parse(self):
+        m = chaos.ChaosMonkey("preempt_host:2@14")
+        f = m.faults[0]
+        self.assertEqual((f.kind, f.rank, f.step),
+                         ("preempt_host", 2, 14))
+        with self.assertRaisesRegex(ValueError, "K@N"):
+            chaos.ChaosMonkey("preempt_host:3")
+
+    def test_fires_only_on_matching_rank_and_exact_step(self):
+        m = chaos.ChaosMonkey("preempt_host:1@6")
+        with mock.patch("paddle_tpu.resilience.chaos.os.kill") as kill:
+            # not in a gang: never fires
+            with mock.patch.dict(os.environ):
+                os.environ.pop("PADDLE_GANG_RANK", None)
+                for s in range(1, 10):
+                    m.on_step("fit", s)
+            kill.assert_not_called()
+            # wrong rank: never fires
+            with mock.patch.dict(os.environ, {"PADDLE_GANG_RANK": "0"}):
+                for s in range(1, 10):
+                    m.on_step("fit", s)
+            kill.assert_not_called()
+            # matching rank: fires at EXACTLY step 6 (a relaunched gang
+            # resuming PAST step 6 is not re-killed), once
+            with mock.patch.dict(os.environ, {"PADDLE_GANG_RANK": "1"}):
+                m.on_step("fit", 5)
+                kill.assert_not_called()
+                m.on_step("fit", 6)
+                kill.assert_called_once()
+                import signal as _signal
+
+                self.assertEqual(kill.call_args[0],
+                                 (os.getpid(), _signal.SIGKILL))
+                m.on_step("fit", 7)
+            kill.assert_called_once()
+        self.assertEqual(m.counters["preempt_host"], 1)
+
+    def test_resumed_run_past_step_not_rekilled(self):
+        m = chaos.ChaosMonkey("preempt_host:1@6")
+        with mock.patch("paddle_tpu.resilience.chaos.os.kill") as kill:
+            with mock.patch.dict(os.environ, {"PADDLE_GANG_RANK": "1"}):
+                for s in range(7, 20):
+                    m.on_step("fit", s)
+            kill.assert_not_called()
+
+
+class TestGangCheckpoint(unittest.TestCase):
+    def setUp(self):
+        self.dir = tempfile.mkdtemp()
+
+    def tearDown(self):
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def _save(self, store, world, payload_of_rank, step=1, job="j",
+              max_to_keep=None, attempt=0):
+        def fn(rank, coord):
+            mgr = CheckpointManager(self.dir, max_to_keep=max_to_keep,
+                                    coordinator=coord)
+            return mgr.save(payload_of_rank(rank), step=step)
+
+        return _run_ranks(fn, world, store, timeout=15, job_id=job,
+                          attempt=attempt)
+
+    def test_dispatch_and_single_host_unchanged(self):
+        """CheckpointManager(dir, coordinator=c) builds the gang
+        manager; WITHOUT one it is byte-for-byte today's single-writer
+        manager — same class, same flat gen-* layout, no store, no
+        barriers."""
+        c = Coordinator(DictStore(), 0, 1, timeout=5)
+        mgr = CheckpointManager(self.dir, coordinator=c)
+        self.assertIsInstance(mgr, GangCheckpointManager)
+        self.assertIsInstance(mgr, CheckpointManager)
+        plain_dir = os.path.join(self.dir, "plain")
+        plain = CheckpointManager(plain_dir)
+        self.assertIs(type(plain), CheckpointManager)
+        plain.save({"w": np.arange(4.0)}, step=3)
+        self.assertEqual(sorted(os.listdir(plain_dir)), ["gen-00000001"])
+        ck = plain.restore()
+        self.assertEqual(ck.step, 3)
+        np.testing.assert_array_equal(ck.value["w"], np.arange(4.0))
+
+    def test_gang_roundtrip_layout_and_per_host_shards(self):
+        store = DictStore()
+        gens = self._save(store, 2,
+                          lambda r: {"w": np.full((4,), r, np.float32)},
+                          step=7)
+        self.assertEqual(gens, {0: 1, 1: 1})
+        self.assertEqual(sorted(os.listdir(self.dir)),
+                         ["group", "host-00000", "host-00001"])
+        manifest = json.load(open(os.path.join(
+            self.dir, "group", "gen-00000001.json")))
+        self.assertEqual(manifest["world_size"], 2)
+        self.assertEqual(manifest["hosts"],
+                         ["host-00000", "host-00001"])
+
+        def restore(rank, coord):
+            mgr = CheckpointManager(self.dir, coordinator=coord)
+            ck = mgr.restore()
+            return ck.generation, float(ck.value["w"][0]), ck.step
+
+        out = _run_ranks(restore, 2, store, timeout=15, job_id="j2")
+        self.assertEqual(out[0], (1, 0.0, 7))
+        self.assertEqual(out[1], (1, 1.0, 7))
+
+    def test_uncommitted_stage_is_invisible(self):
+        """A staged per-host generation with no group manifest (the
+        crash-before-commit window) must not be restorable."""
+        store = DictStore()
+        self._save(store, 2, lambda r: {"w": np.zeros(2, np.float32)})
+        # fake a torn second save: host dirs staged gen 2, no manifest
+        for host in ("host-00000", "host-00001"):
+            src = os.path.join(self.dir, host, "gen-00000001")
+            shutil.copytree(src, os.path.join(self.dir, host,
+                                              "gen-00000002"))
+
+        def restore(rank, coord):
+            mgr = CheckpointManager(self.dir, coordinator=coord)
+            self.assertEqual(mgr.generations(), [1])
+            self.assertEqual(mgr.local_generations(), [1, 2])
+            return mgr.restore().generation
+
+        out = _run_ranks(restore, 2, store, timeout=15, job_id="j2")
+        self.assertEqual(out, {0: 1, 1: 1})
+
+    def test_agreement_adopts_min_and_skips_corrupt(self):
+        """Host 1's newest generation is digest-corrupt -> it publishes
+        gen 1, host 0 publishes gen 2, the gang adopts min = 1 on BOTH
+        hosts (coordinated rollback, not divergence)."""
+        store = DictStore()
+        self._save(store, 2, lambda r: {"w": np.full(8, r + 1.0,
+                                                     np.float32)})
+        self._save(store, 2, lambda r: {"w": np.full(8, r + 10.0,
+                                                     np.float32)},
+                   job="j2")
+        shard = glob.glob(os.path.join(self.dir, "host-00001",
+                                       "gen-00000002", "shard-*.bin"))[0]
+        with open(shard, "r+b") as f:
+            f.write(b"\xff\xee\xdd")  # the corrupt:P chaos byte-flip
+
+        def restore(rank, coord):
+            mgr = CheckpointManager(self.dir, coordinator=coord)
+            ck = mgr.restore()
+            return ck.generation, float(ck.value["w"][0])
+
+        out = _run_ranks(restore, 2, store, timeout=15, job_id="j3")
+        self.assertEqual(out[0], (1, 1.0))   # rolled BACK past its
+        self.assertEqual(out[1], (1, 2.0))   # own valid gen 2
+
+    def test_agreement_raises_when_a_host_has_no_verified_copy(self):
+        store = DictStore()
+        self._save(store, 2, lambda r: {"w": np.full(8, 1.0,
+                                                     np.float32)})
+        for shard in glob.glob(os.path.join(self.dir, "host-00001",
+                                            "gen-*", "shard-*.bin")):
+            with open(shard, "r+b") as f:
+                f.write(b"\x00garbage\x00")
+
+        def restore(rank, coord):
+            mgr = CheckpointManager(self.dir, coordinator=coord)
+            with self.assertRaisesRegex(CheckpointNotFoundError,
+                                        r"rank\(s\) \[1\]"):
+                mgr.restore()
+            return True
+
+        out = _run_ranks(restore, 2, store, timeout=15, job_id="j4")
+        self.assertEqual(out, {0: True, 1: True})
+
+    def test_fresh_gang_restore_raises_not_found(self):
+        def restore(rank, coord):
+            mgr = CheckpointManager(self.dir, coordinator=coord)
+            self.assertEqual(mgr.generations(), [])
+            with self.assertRaises(CheckpointNotFoundError):
+                mgr.restore()
+            return True
+
+        _run_ranks(restore, 2, DictStore(), timeout=15)
+
+    def test_coordinated_gc_keeps_agreed_floor(self):
+        """max_to_keep=1, gens 1..2 with host 1's gen 2 corrupt: the
+        gang agrees on floor 1; a later save (gen 3) GCs gen 2 but MUST
+        keep gen 1 — a peer may still fall back to it."""
+        store = DictStore()
+        # setup saves keep everything (GC only arms on the manager that
+        # does the post-agreement save below)
+        self._save(store, 2, lambda r: {"w": np.full(8, 1.0,
+                                                     np.float32)})
+        self._save(store, 2, lambda r: {"w": np.full(8, 2.0,
+                                                     np.float32)},
+                   job="j2")
+        shard = glob.glob(os.path.join(self.dir, "host-00001",
+                                       "gen-00000002", "shard-*.bin"))[0]
+        with open(shard, "r+b") as f:
+            f.write(b"\xff\xee\xdd")
+
+        def agree_then_save(rank, coord):
+            mgr = CheckpointManager(self.dir, max_to_keep=1,
+                                    coordinator=coord)
+            ck = mgr.restore()          # agreement -> floor gen 1
+            self.assertEqual(ck.generation, 1)
+            mgr.save({"w": np.full(8, 3.0, np.float32)})  # gen 3 + GC
+            return sorted(mgr.local_generations())
+
+        out = _run_ranks(agree_then_save, 2, store, timeout=15,
+                         job_id="j5")
+        # window is {3}; the agreed floor 1 survives on EVERY host, 2
+        # is GC'd (group manifests checked after the join — only rank 0
+        # unlinks them, so a peer's listing is eventually consistent)
+        self.assertEqual(out[0], [1, 3])
+        self.assertEqual(out[1], [1, 3])
+        group = sorted(os.listdir(os.path.join(self.dir, "group")))
+        self.assertEqual(group, ["gen-00000001.json",
+                                 "gen-00000003.json"])
+
+    def test_gc_without_agreement_keeps_window_only(self):
+        store = DictStore()
+        for i, job in enumerate(("a", "b", "c")):
+            self._save(store, 2,
+                       lambda r, v=float(i): {"w": np.full(8, v,
+                                                           np.float32)},
+                       job=job, max_to_keep=2)
+        mgr = CheckpointManager(
+            self.dir, max_to_keep=2,
+            coordinator=Coordinator(store, 0, 2, timeout=5, job_id="z"))
+        self.assertEqual(mgr.generations(), [2, 3])
+
+    def test_straggler_at_barrier_raises_not_hangs(self):
+        """A gang save with a peer that never arrives trips
+        BarrierTimeout naming the missing rank — the acceptance
+        criterion's 'worker that never returns' case."""
+        c0 = Coordinator(DictStore(), 0, 2, timeout=0.4, job_id="s")
+        mgr = CheckpointManager(self.dir, coordinator=c0)
+        with self.assertRaises(BarrierTimeout) as cm:
+            mgr.save({"w": np.zeros(4, np.float32)})
+        self.assertEqual(cm.exception.missing, [1])
+        # staged locally but never committed group-wide
+        self.assertEqual(mgr.local_generations(), [1])
+        self.assertEqual(mgr.generations(), [])
+
+    def test_async_gang_save_surfaces_timeout_at_wait(self):
+        c0 = Coordinator(DictStore(), 0, 2, timeout=0.4, job_id="s2")
+        mgr = CheckpointManager(self.dir, coordinator=c0)
+        mgr.save({"w": np.zeros(4, np.float32)}, blocking=False)
+        with self.assertRaises(BarrierTimeout):
+            mgr.wait()
+
+    def test_world_size_one_gang_layout(self):
+        """A 1-host gang exercises the same layout with degenerate
+        barriers (instant) — the bridge between solo and fleet."""
+        c = Coordinator(DictStore(), 0, 1, timeout=5)
+        mgr = CheckpointManager(self.dir, coordinator=c)
+        g = mgr.save({"w": np.arange(3.0)}, step=9)
+        self.assertEqual(g, 1)
+        ck = mgr.restore()
+        self.assertEqual((ck.generation, ck.step), (1, 9))
+        self.assertEqual(sorted(os.listdir(self.dir)),
+                         ["group", "host-00000"])
+
+
+class TestGangTelemetry(unittest.TestCase):
+    """Coordination telemetry lands in the ONE observability event log
+    (PR 8 pattern): barrier.wait / barrier.timeout /
+    ckpt.agree_generation / ckpt.gang_commit / gang.worker_restart."""
+
+    def setUp(self):
+        self.reg = obs_metrics.enable()
+        self.dir = tempfile.mkdtemp()
+
+    def tearDown(self):
+        obs_metrics.disable()
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def test_gang_checkpoint_events(self):
+        store = DictStore()
+
+        def fn(rank, coord):
+            mgr = CheckpointManager(self.dir, coordinator=coord)
+            mgr.save({"w": np.zeros(4, np.float32)}, step=1)
+            mgr.restore()
+            return True
+
+        _run_ranks(fn, 2, store, timeout=15)
+        names = {e["event"] for e in self.reg.events()}
+        self.assertIn("barrier.wait", names)
+        self.assertIn("ckpt.gang_commit", names)
+        self.assertIn("ckpt.agree_generation", names)
+        agree = self.reg.events("ckpt.agree_generation")[0]
+        self.assertEqual(agree["generation"], 1)
+
+    def test_barrier_timeout_event(self):
+        c0 = Coordinator(DictStore(), 0, 2, timeout=0.2, job_id="t")
+        with self.assertRaises(BarrierTimeout):
+            c0.barrier("x")
+        evs = self.reg.events("barrier.timeout")
+        self.assertEqual(len(evs), 1)
+        self.assertIn("[1]", evs[0]["missing"])
+
+    def test_supervisor_restart_event(self):
+        """gang.worker_restart is emitted from the supervisor process
+        when it relaunches a failed gang (exercised with a trivially
+        failing one-rank command)."""
+        from paddle_tpu.parallel.launch import GangSupervisor
+
+        sup = GangSupervisor(
+            [sys.executable, "-c", "import sys; sys.exit(5)"], 1,
+            store_dir=os.path.join(self.dir, "store"), max_restarts=1,
+            terminate_grace_s=0.2)
+        res = sup.run(timeout=60)
+        self.assertFalse(res.success)
+        self.assertEqual(res.attempts, 2)
+        evs = self.reg.events("gang.worker_restart")
+        self.assertEqual(len(evs), 1)
+        self.assertEqual(evs[0]["prev_exit"], 5)
+        self.assertEqual(evs[0]["rank"], 0)
+
+
+class TestModelFitGang(unittest.TestCase):
+    """In-process (thread-gang) fit wiring: periodic saves go through
+    the two-phase protocol and resume agrees on one generation."""
+
+    def setUp(self):
+        self.dir = tempfile.mkdtemp()
+
+    def tearDown(self):
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    @staticmethod
+    def _fit(rank, coord, ckpt_dir, resume):
+        paddle.seed(5 + rank)
+        rng = np.random.default_rng(rank)
+        batches = [(rng.normal(size=(4, 4)).astype(np.float32),
+                    np.zeros((4, 1), np.float32)) for _ in range(6)]
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt.Adam(learning_rate=0.01,
+                                         parameters=net.parameters()),
+                      loss=lambda p, l: nn.MSELoss()(p, l))
+        model.fit(batches, epochs=1, verbose=0, checkpoint_dir=ckpt_dir,
+                  resume=resume, checkpoint_freq=3, coordinator=coord)
+        return model.restored_generation
+
+    def test_fit_saves_gang_generations_and_resume_agrees(self):
+        store = DictStore()
+        out0 = _run_ranks(
+            lambda r, c: self._fit(r, c, self.dir, True), 2, store,
+            timeout=30, attempt=0)
+        self.assertEqual(out0, {0: None, 1: None})  # fresh start
+        group = sorted(os.listdir(os.path.join(self.dir, "group")))
+        self.assertEqual(group, ["gen-00000001.json",
+                                 "gen-00000002.json"])  # steps 3, 6
+        out1 = _run_ranks(
+            lambda r, c: self._fit(r, c, self.dir, True), 2, store,
+            timeout=30, attempt=1)
+        # every rank restored the SAME agreed generation
+        self.assertEqual(out1, {0: 2, 1: 2})
+
+
+# ---------------------------------------------------------------------------
+# acceptance: subprocess gang kill-and-resume
+# ---------------------------------------------------------------------------
+
+_GANG_TRAIN_SCRIPT = r"""
+import json, os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.resilience import coordination
+
+ckpt_dir, out_dir, n_batches, epochs = (sys.argv[1], sys.argv[2],
+                                        int(sys.argv[3]),
+                                        int(sys.argv[4]))
+coord = coordination.from_env()
+rank = coord.rank
+paddle.seed(5 + rank)
+np.random.seed(5 + rank)
+rng = np.random.default_rng(rank)
+w = rng.normal(size=(4, 1)).astype(np.float32)
+batches = []
+for _ in range(n_batches):
+    x = rng.normal(size=(4, 4)).astype(np.float32)
+    batches.append((x, x @ w
+                    + 0.01 * rng.normal(size=(4, 1)).astype(np.float32)))
+
+net = nn.Linear(4, 1)
+model = paddle.Model(net)
+model.prepare(optimizer=opt.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+              loss=lambda p, l: nn.MSELoss()(p, l))
+
+trail = open(os.path.join(out_dir,
+                          f"rank{rank}-a{coord.attempt}.jsonl"), "w")
+
+
+class Tape(paddle.hapi.Callback):
+    epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        Tape.epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        gs = Tape.epoch * int(sys.argv[3]) + step + 1
+        # flushed PER STEP so a SIGKILLed worker leaves its partial
+        # trajectory for the test to merge
+        trail.write(json.dumps({"step": gs,
+                                "loss": float(logs["loss"][0])}) + "\n")
+        trail.flush()
+
+
+model.fit(batches, epochs=epochs, verbose=0, callbacks=[Tape()],
+          checkpoint_dir=ckpt_dir, resume=True, checkpoint_freq=1,
+          coordinator=coord)
+with open(os.path.join(out_dir,
+                       f"rank{rank}-a{coord.attempt}-done.json"),
+          "w") as f:
+    json.dump({"restored": model.restored_generation,
+               "preempted": bool(model.preempted)}, f)
+"""
+
+
+class _GangE2EBase(unittest.TestCase):
+    n_batches = 8
+    epochs = 2
+
+    def setUp(self):
+        self.dir = tempfile.mkdtemp()
+        self.script = os.path.join(self.dir, "train.py")
+        with open(self.script, "w") as f:
+            f.write(_GANG_TRAIN_SCRIPT)
+
+    def tearDown(self):
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def _oracle(self, rank):
+        """The uninterrupted per-rank trajectory, computed in-process
+        (the script replicates these seeds exactly)."""
+        paddle.seed(5 + rank)
+        np.random.seed(5 + rank)
+        rng = np.random.default_rng(rank)
+        w = rng.normal(size=(4, 1)).astype(np.float32)
+        batches = []
+        for _ in range(self.n_batches):
+            x = rng.normal(size=(4, 4)).astype(np.float32)
+            batches.append(
+                (x, x @ w
+                 + 0.01 * rng.normal(size=(4, 1)).astype(np.float32)))
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt.Adam(learning_rate=0.01,
+                                         parameters=net.parameters()),
+                      loss=lambda p, l: nn.MSELoss()(p, l))
+        losses = []
+
+        class Tape(paddle.hapi.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                losses.append(float(logs["loss"][0]))
+
+        model.fit(batches, epochs=self.epochs, verbose=0,
+                  callbacks=[Tape()])
+        return losses
+
+    def _run_gang(self, world, chaos_spec):
+        from paddle_tpu.parallel.launch import GangSupervisor
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        ck = os.path.join(self.dir, "ck")
+        out = os.path.join(self.dir, "out")
+        store = os.path.join(self.dir, "store")
+        for p in (ck, out, store):
+            os.makedirs(p, exist_ok=True)
+
+        def env(rank, attempt):
+            e = {"JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": repo + os.pathsep
+                 + os.environ.get("PYTHONPATH", ""),
+                 "PADDLE_TPU_BARRIER_TIMEOUT_S": "20",
+                 # the preemption is a ONE-SHOT external event: armed
+                 # on attempt 0 only, or the relaunched rank would be
+                 # re-killed replaying the same step
+                 "PADDLE_TPU_CHAOS": chaos_spec if attempt == 0
+                 else ""}
+            return e
+
+        sup = GangSupervisor(
+            [sys.executable, self.script, ck, out,
+             str(self.n_batches), str(self.epochs)],
+            world, store_dir=store, max_restarts=2, env=env,
+            terminate_grace_s=1.5)
+        res = sup.run(timeout=360)
+        if not res.success:
+            logs = sorted(glob.glob(os.path.join(store, "logs", "*")))
+            tail = open(logs[-1]).read()[-3000:] if logs else ""
+            self.fail(f"gang failed: {res.as_dict()}\n{tail}")
+        return res, out, ck
+
+    def _merged_trail(self, out, rank):
+        """{step: loss} merged across attempts; any step two attempts
+        both recorded MUST agree (deterministic replay from the agreed
+        generation)."""
+        merged = {}
+        for fn in sorted(glob.glob(
+                os.path.join(out, f"rank{rank}-a*.jsonl"))):
+            for line in open(fn):
+                rec = json.loads(line)
+                if rec["step"] in merged:
+                    self.assertAlmostEqual(
+                        merged[rec["step"]], rec["loss"], places=5,
+                        msg=f"rank {rank} step {rec['step']} diverged "
+                            f"between attempts")
+                merged[rec["step"]] = rec["loss"]
+        return merged
+
+    def _check(self, world, killed_rank, chaos_spec):
+        res, out, ck = self._run_gang(world, chaos_spec)
+        self.assertEqual(res.attempts, 2)  # exactly one gang relaunch
+        # the killed rank died by SIGKILL (host preemption), attempt 0
+        self.assertIn((killed_rank, 0, -9), res.restarts)
+        n_steps = self.n_batches * self.epochs
+        restored = set()
+        for rank in range(world):
+            oracle = self._oracle(rank)
+            merged = self._merged_trail(out, rank)
+            self.assertEqual(sorted(merged), list(range(1, n_steps + 1)),
+                             f"rank {rank} trajectory has holes")
+            np.testing.assert_allclose(
+                [merged[s] for s in range(1, n_steps + 1)], oracle,
+                rtol=1e-5,
+                err_msg=f"rank {rank} post-resume trajectory diverged "
+                        "from the uninterrupted run")
+            done = json.load(open(os.path.join(
+                out, f"rank{rank}-a1-done.json")))
+            self.assertIsNotNone(done["restored"])
+            restored.add(done["restored"])
+        # ALL ranks restored the SAME agreed generation
+        self.assertEqual(len(restored), 1, restored)
+        floor = restored.pop()
+        # ... and coordinated GC (max_to_keep=3 in fit) kept the agreed
+        # floor even after n_steps more per-step generations
+        group = sorted(os.listdir(os.path.join(ck, "group")))
+        self.assertIn(f"gen-{floor:08d}.json", group)
+        self.assertLessEqual(len(group), 4)  # window(3) + floor
+
+
+class TestGangKillResumeEndToEnd(_GangE2EBase):
+    """ACCEPTANCE (ISSUE 12): N=2 subprocess gang under
+    PADDLE_TPU_CHAOS=preempt_host:1@6 — the supervisor relaunches the
+    dead gang, all ranks restore the same agreed generation, and the
+    merged loss trajectory equals the uninterrupted run's."""
+
+    def test_kill_and_resume_converges_on_agreed_generation(self):
+        self._check(2, killed_rank=1, chaos_spec="preempt_host:1@6")
+
+
+@pytest.mark.slow
+class TestGangKillResumeN4(_GangE2EBase):
+    """The N=4 variant (kill a middle rank) — same invariants, more
+    hosts at the barriers."""
+
+    epochs = 1
+
+    def test_kill_and_resume_n4(self):
+        self._check(4, killed_rank=2, chaos_spec="preempt_host:2@5")
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -725,6 +725,75 @@ class TestEngineWatchdog(unittest.TestCase):
         # pages all recycled: victim's pages were freed, pool drains
         self.assertEqual(eng.mgr.n_free, eng.mgr.max_pages - 1)
 
+    def test_hung_slot_requeued_once_then_completes(self):
+        """run(requeue_hung=True): the watchdog victim re-enters
+        `waiting` (not `failed`) and finishes with the exact tokens of
+        an undisturbed run — the shed/requeue building block of the
+        SLO-aware front-end (ISSUE 12 satellite)."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import ContinuousBatchingEngine
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  num_key_value_heads=2)
+        paddle.seed(21)
+        params = dict(LlamaForCausalLM(cfg).raw_state())
+
+        def engine(slots=2):
+            return ContinuousBatchingEngine(
+                cfg, params, slots=slots, prompt_bucket=8,
+                max_prompt_len=16, max_new_tokens=4, block_size=8,
+                steps_per_sync=2)
+
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, cfg.vocab_size, (5,)).tolist()
+                   for _ in range(3)]
+        ref = engine()
+        oracle = {tuple(p): ref.add_request(p) for p in prompts}
+        ref.run()
+
+        eng = engine()
+        reqs = [eng.add_request(p) for p in prompts]
+        eng.warm(buckets=[8])
+        chaos.install("hang:decode:20")
+        eng.run(watchdog_timeout=2.0, requeue_hung=True)
+        self.assertEqual(len(eng.finished), 3)
+        self.assertFalse(any(r.failed for r in eng.finished))
+        self.assertEqual(eng.hung_requeued, 1)
+        self.assertEqual(eng.hung_retired, 0)
+        self.assertEqual(eng.metrics()["hung_requeued"], 1)
+        for p, r in zip(prompts, reqs):
+            # generation restarted from the prompt on re-admission:
+            # token-identical to the undisturbed engine
+            self.assertEqual(r.tokens, oracle[tuple(p)].tokens)
+        # pages were RELEASED through the pool, not leaked or recycled
+        # in place: the drained pool is whole again
+        self.assertEqual(eng.mgr.n_available, eng.mgr.max_pages - 1)
+
+    def test_hung_slot_requeued_exactly_once_then_fails(self):
+        """The SECOND timeout of the same request retires it failed —
+        requeue_hung is one retry, not an infinite loop."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import ContinuousBatchingEngine
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  num_key_value_heads=2)
+        paddle.seed(21)
+        params = dict(LlamaForCausalLM(cfg).raw_state())
+        eng = ContinuousBatchingEngine(
+            cfg, params, slots=1, prompt_bucket=8, max_prompt_len=16,
+            max_new_tokens=4, block_size=8, steps_per_sync=2)
+        rng = np.random.default_rng(3)
+        req = eng.add_request(rng.integers(1, cfg.vocab_size,
+                                           (5,)).tolist())
+        eng.warm(buckets=[8])
+        chaos.install("hang:decode:20,hang:decode:20")  # two hangs
+        eng.run(watchdog_timeout=2.0, requeue_hung=True)
+        self.assertTrue(req.failed)
+        self.assertTrue(req.requeued)
+        self.assertEqual(eng.hung_requeued, 1)
+        self.assertEqual(eng.hung_retired, 1)
+        self.assertEqual(eng.mgr.n_available, eng.mgr.max_pages - 1)
+
     def test_hang_retire_never_frees_shared_prefix_page(self):
         """Chaos hang:decode + watchdog retire of the slot that OWNS a
         cached prefix block must not recycle the page — a surviving
