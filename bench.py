@@ -31,22 +31,13 @@ def _count_step_kernels(step_fn, *args):
     equations in its jaxpr, sub-jaxprs included (the number TPU105
     budgets and the decode megakernel exists to collapse). Recorded in
     OPBENCH `info` so the megakernel row's win is attributable to fewer
-    launches, not a faster attention kernel."""
-    def walk(jaxpr):
-        n = 0
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name in ("pallas_call", "dot_general"):
-                n += 1
-                continue  # kernel bodies are not separate launches
-            for v in eqn.params.values():
-                vals = v if isinstance(v, (tuple, list)) else (v,)
-                for item in vals:
-                    sub = getattr(item, "jaxpr", item)
-                    if hasattr(sub, "eqns"):
-                        n += walk(sub)
-        return n
+    launches, not a faster attention kernel. THE walker lives in
+    `analysis/roofline.py` (ISSUE 13) — one inventory shared by this
+    counter, TPU105's fusion budget, and the roofline launch-overhead
+    term."""
+    from paddle_tpu.analysis.roofline import count_step_kernels
 
-    return walk(jax.make_jaxpr(step_fn)(*args).jaxpr)
+    return count_step_kernels(step_fn, *args)
 
 
 def _op_bench(only=None):
@@ -493,12 +484,20 @@ def _op_bench(only=None):
             "overhead_pct": round(
                 100.0 * (traced - untraced) / max(untraced, 1e-9), 2),
         }
-        # static memory auditor (ISSUE 10): predicted per-chip peak of
-        # the timed chunk program, recorded so the next TPU run can
-        # compare the estimate against device_memory_stats actuals
+        # static auditors (ISSUES 10 + 13): predicted per-chip peak AND
+        # predicted roofline latency/MFU of the timed chunk program,
+        # recorded NEXT TO the measured slope so the next TPU run lands
+        # estimate/actual ratios (one shared trace serves both)
+        sgraphs = eng._traced_inventory(programs=("decode",))
+        sroof = eng.audit_roofline(programs=("decode",),
+                                   graphs=sgraphs)["programs"]["decode"]
         OP_INFO["serving_decode_chunk"] = {
             "predicted_peak_hbm_bytes": eng.audit_memory(
-                programs=("decode",))["fleet_peak_hbm_bytes"],
+                programs=("decode",),
+                graphs=sgraphs)["fleet_peak_hbm_bytes"],
+            "predicted_step_ms": round(sroof["predicted_step_ms"], 4),
+            "predicted_mfu": sroof["predicted_mfu"],
+            "predicted_bound": sroof["bound"],
         }
         del eng, smake
 
@@ -526,8 +525,10 @@ def _op_bench(only=None):
         # earlier *2 formula under-reported the wire bytes 2x, and the
         # f32 payload is TPU803's first quantization customer
         mp_, tcfg = teng.mp, teng.cfg
-        # ONE decode trace serves both static auditors
+        # ONE decode trace serves all three static auditors
         tgraphs = teng._traced_inventory(programs=("decode",))
+        troof = teng.audit_roofline(programs=("decode",),
+                                    graphs=tgraphs)["programs"]["decode"]
         OP_INFO["decode_step_1b_mp"] = {
             "mp": mp_,
             "bytes_all_gathered_per_token": int(
@@ -544,6 +545,11 @@ def _op_bench(only=None):
             "predicted_peak_hbm_bytes": teng.audit_memory(
                 programs=("decode",),
                 graphs=tgraphs)["fleet_peak_hbm_bytes"],
+            # static roofline (ISSUE 13): predicted chunk latency next
+            # to the measured slope — estimate/actual on the next run
+            "predicted_step_ms": round(troof["predicted_step_ms"], 4),
+            "predicted_mfu": troof["predicted_mfu"],
+            "predicted_bound": troof["bound"],
         }
         del teng, trun
 
@@ -747,9 +753,14 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params
     achieved = tok_per_s * flops_per_token
-    # per-chip peak: v5e 197 TFLOPs bf16, v6e 918; detect via device kind
+    # per-chip peak: v5e 197 TFLOPs bf16, v6e 918; detect via device
+    # kind — ONE spec table (analysis/device_specs.py) serves this, the
+    # static roofline pass, and the other benches (ISSUE 13 hoist;
+    # values unchanged)
+    from paddle_tpu.analysis.device_specs import spec_for_device_kind
+
     kind = jax.devices()[0].device_kind.lower()
-    peak = 918e12 if "v6" in kind else 197e12
+    peak = spec_for_device_kind(kind).peak_for("bfloat16")
     mfu = achieved / (peak * n_dev) if on_tpu else 0.0
 
     regressions = []

@@ -107,7 +107,12 @@ def run_config(name: str, overrides: dict, batch=8, seq=2048, iters=8):
         return
     tok_s = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    mfu = tok_s * 6 * n_params / 197e12
+    # peak from THE spec table (analysis/device_specs.py; ISSUE 13
+    # hoist — value unchanged: v5e bf16 197e12)
+    from paddle_tpu.analysis.device_specs import DEVICE_SPECS
+
+    mfu = tok_s * 6 * n_params / DEVICE_SPECS["tpu-v5e"].peak_for(
+        "bfloat16")
     print(json.dumps({"config": name, "tok_s": round(tok_s, 1),
                       "mfu": round(mfu, 4),
                       "loss": round(float(loss), 3)}), flush=True)

@@ -33,9 +33,13 @@ from paddle_tpu.models.llama import _make_decode_step
 from paddle_tpu.nn.quant import weight_quantize
 from paddle_tpu.core.tensor import Tensor, unwrap
 
+from paddle_tpu.analysis.device_specs import DEVICE_SPECS
+
 CONFIGS = {"7b_int8": "llama2_7b", "1b_int8": "llama_1b"}
 B = 4
-HBM_GBS = 819e9
+# ONE spec table (analysis/device_specs.py) owns the hardware numbers
+# (ISSUE 13 hoist; value unchanged: v5e 819e9)
+HBM_GBS = DEVICE_SPECS["tpu-v5e"].hbm_gbs
 
 
 def build_decode_loop(cfg, b, max_seq=None, kv_attend=None,

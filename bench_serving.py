@@ -33,9 +33,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.analysis.device_specs import DEVICE_SPECS
 from paddle_tpu.models import (LlamaConfig, PagedKVManager,
                                build_paged_generate, build_quant_generate,
                                init_quant_serving_params)
+
+# ONE spec table (analysis/device_specs.py) owns the hardware numbers
+# (ISSUE 13 hoist; value unchanged: v5e ~819 GB/s HBM)
+HBM_GBS = DEVICE_SPECS["tpu-v5e"].hbm_gbs
 
 CONFIGS = {
     "7b_int8": ("llama2_7b", "weight_only_int8"),
@@ -144,7 +149,7 @@ def run_config(name: str, b: int = 4, sb: int = 128):
         lambda mn: np.asarray(fns[mn](p, ids, s0, key, one, one)), name))
     tok_s = b / (ms_step / 1e3)
     gb, read_gb = quant_weight_gb(cfg, quant)
-    bound_ms = read_gb * 2**30 / 819e9 * 1e3  # v5e ~819 GB/s HBM
+    bound_ms = read_gb * 2**30 / HBM_GBS * 1e3
     result = {
         "config": name, "ms_per_decode_step": round(ms_step, 3),
         "decode_tok_s": round(tok_s, 1),
@@ -193,7 +198,7 @@ def run_paged_config(name: str, b: int = 4, sb: int = 128,
         lambda mn: np.asarray(fns[mn](p, ids, s0_vec, tbls[mn], key,
                                       one, one)), name))
     gb, read_gb = quant_weight_gb(cfg, quant)
-    bound_ms = read_gb * 2**30 / 819e9 * 1e3
+    bound_ms = read_gb * 2**30 / HBM_GBS * 1e3
     result = {
         "config": name, "ms_per_decode_step": round(ms_step, 3),
         "decode_tok_s": round(b / (ms_step / 1e3), 1),

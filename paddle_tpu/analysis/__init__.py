@@ -40,11 +40,22 @@ from .memory import (  # noqa: F401
 # likewise registers TPU801/802/803 (static communication auditor)
 from . import comms  # noqa: F401,E402
 from .comms import CommsReport, audit_comms  # noqa: F401
+# likewise registers TPU901/902/903 (static roofline auditor) and
+# carries the shared kernel-launch walker; device_specs is THE hardware
+# constant table the benches and the pass both read
+from . import device_specs, roofline  # noqa: F401,E402
+from .device_specs import DeviceSpec, get_spec  # noqa: F401
+from .roofline import (  # noqa: F401
+    RooflineReport, audit_roofline, count_kernel_launches,
+    count_step_kernels,
+)
 
 __all__ = [
-    "CommsReport", "Diagnostic", "Graph", "LintError", "MemoryReport",
-    "Pipeline", "Report", "RULES", "Rule", "Severity", "analyze",
-    "audit_comms", "audit_graph", "audit_memory", "comms",
-    "default_rules", "lint", "memory", "register_rule",
-    "trace_for_memory", "trace_graph",
+    "CommsReport", "DeviceSpec", "Diagnostic", "Graph", "LintError",
+    "MemoryReport", "Pipeline", "Report", "RooflineReport", "RULES",
+    "Rule", "Severity", "analyze", "audit_comms", "audit_graph",
+    "audit_memory", "audit_roofline", "comms", "count_kernel_launches",
+    "count_step_kernels", "default_rules", "device_specs", "get_spec",
+    "lint", "memory", "register_rule", "roofline", "trace_for_memory",
+    "trace_graph",
 ]

@@ -5,6 +5,8 @@
     python -m paddle_tpu.analysis mypkg.models:Net --shape 1,128:int32
     python -m paddle_tpu.analysis --memory --format json   # CI schema
     python -m paddle_tpu.analysis --comms --format json    # wire-side twin
+    python -m paddle_tpu.analysis --roofline --format json  # compute-time leg
+    python -m paddle_tpu.analysis --roofline --device tpu-v5p
     python -m paddle_tpu.analysis --rule-config TPU401.max_collective_bytes=65536
     python -m paddle_tpu.analysis --comms --rule-config TPU801.max_step_wire_bytes=1048576
 
@@ -31,6 +33,14 @@ audits the bundled tiny-llama SHARDED decode program at mp=2 — the
 one-all-gather-per-layer program the wire accounting exists for; on a
 single-device host it notes the downgrade and audits the mp=1 decode
 program instead (zero collectives, still valid output + exit 0).
+
+``--roofline`` runs the static ROOFLINE auditor (`analysis/roofline.py`):
+per-eqn FLOPs/HBM-bytes against the ``--device`` spec row
+(`analysis/device_specs.py`; default: detect a live TPU, else the v5e
+baseline), predicted step latency + MFU + bound class, and the
+TPU901/902/903 rules riding the same trace. With no target it audits
+the bundled tiny-llama PAGED DECODE program (same demo as ``--memory``
+— the bandwidth-bound program the roofline exists to classify).
 
 ``--rule-config KEY=VALUE`` (repeatable) passes rule knobs: bare keys
 reach every rule (``max_collective_bytes=65536``), ``TPUxxx.``-prefixed
@@ -140,11 +150,12 @@ def _sharded_decode_demo():
             f"models.llama tiny sharded decode (mp={mp})")
 
 
-def _resolve_target(spec, shapes, memory_mode=False, comms_mode=False):
+def _resolve_target(spec, shapes, memory_mode=False, comms_mode=False,
+                    roofline_mode=False):
     if spec is None:
         if comms_mode:
             return _sharded_decode_demo()
-        if memory_mode:
+        if memory_mode or roofline_mode:
             return _decode_demo() + ("models.llama tiny paged decode",)
         return _llama_demo() + ("models.llama tiny forward",)
     mod_name, _, attr = spec.partition(":")
@@ -203,10 +214,23 @@ def main(argv=None) -> int:
              "audits the mp=2 tiny-llama sharded decode demo "
              "(single-device hosts note the downgrade and audit mp=1)")
     parser.add_argument(
+        "--roofline", action="store_true",
+        help="also run the static roofline auditor: per-eqn FLOPs/HBM "
+             "bytes against the --device spec row, predicted step "
+             "latency + MFU + bound class in the output; with no "
+             "target, audits the tiny-llama paged decode demo")
+    from .device_specs import DEVICE_SPECS
+
+    parser.add_argument(
+        "--device", default=None, choices=sorted(DEVICE_SPECS),
+        help="device-spec row for --roofline (analysis/device_specs."
+             "py; default: detect a live TPU, else tpu-v5e)")
+    parser.add_argument(
         "--format", default="text", choices=["text", "json"],
         help="output format; json prints one stable machine-readable "
              "object (Report.to_json schema + a 'memory' key under "
-             "--memory, a 'comms' key under --comms)")
+             "--memory, a 'comms' key under --comms, a 'roofline' key "
+             "under --roofline)")
     parser.add_argument(
         "--fail-on", default="error",
         choices=["info", "warning", "error", "never"],
@@ -221,16 +245,23 @@ def main(argv=None) -> int:
 
     fn, call_args, call_kwargs, label = _resolve_target(
         args.target, args.shape, memory_mode=args.memory,
-        comms_mode=args.comms)
+        comms_mode=args.comms, roofline_mode=args.roofline)
     rules = args.rules.split(",") if args.rules else None
     mesh_axes = args.mesh_axes.split(",") if args.mesh_axes else None
     rule_config = _parse_rule_config(args.rule_config) or None
+    if args.device:
+        # the TPU90x rules run in EVERY mode (registered defaults), so
+        # an explicit --device must price them against the requested
+        # row even without --roofline
+        rule_config = dict(rule_config or {})
+        for rid in ("TPU901", "TPU902", "TPU903"):
+            rule_config.setdefault(f"{rid}.device", args.device)
 
-    mem_report = comms_report = None
-    if args.memory or args.comms:
+    mem_report = comms_report = roofline_report = None
+    if args.memory or args.comms or args.roofline:
         # trace_auto, not trace_for_memory: a factory may return a
         # framework Layer, which only the lint tracer can thread. ONE
-        # trace serves the lint rules AND both auditors.
+        # trace serves the lint rules AND every auditor.
         from .memory import audit_graph, trace_auto
 
         graph = trace_auto(fn, *call_args, name=label, **call_kwargs)
@@ -242,6 +273,11 @@ def main(argv=None) -> int:
             from .comms import audit_graph as comms_audit_graph
 
             comms_report = comms_audit_graph(graph)
+        if args.roofline:
+            from .roofline import audit_graph as roofline_audit_graph
+
+            roofline_report = roofline_audit_graph(graph,
+                                                   device=args.device)
     else:
         report = analyze(fn, *call_args, rules=rules, mesh_axes=mesh_axes,
                          rule_config=rule_config, name=label,
@@ -253,6 +289,8 @@ def main(argv=None) -> int:
             out["memory"] = mem_report.to_dict()
         if comms_report is not None:
             out["comms"] = comms_report.to_dict()
+        if roofline_report is not None:
+            out["roofline"] = roofline_report.to_dict()
         print(json.dumps(out, sort_keys=True, indent=2))
     else:
         print(report.format(
@@ -261,6 +299,8 @@ def main(argv=None) -> int:
             print(mem_report.format())
         if comms_report is not None:
             print(comms_report.format())
+        if roofline_report is not None:
+            print(roofline_report.format())
     if args.fail_on != "never" and \
             report.at_least(Severity[args.fail_on.upper()]):
         return 1

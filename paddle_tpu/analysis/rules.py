@@ -307,7 +307,17 @@ class FusionMissRule(Rule):
     default_severity = Severity.WARNING
     MAX_KERNELS = 6
     SMALL_BYTES = 1 << 20
-    KERNEL_PRIMS = frozenset({"pallas_call", "dot_general"})
+
+    @property
+    def KERNEL_PRIMS(self):
+        # THE kernel-launch inventory lives in roofline.py
+        # (KERNEL_LAUNCH_PRIMS) — one walker/prim-set shared by this
+        # rule, the OPBENCH kernels_per_step counter, and the roofline
+        # launch-overhead term. Lazy: roofline.py subclasses Rule, so
+        # it imports this module at load time.
+        from .roofline import KERNEL_LAUNCH_PRIMS
+
+        return KERNEL_LAUNCH_PRIMS
 
     @staticmethod
     def _loop_key(path: str) -> Optional[str]:

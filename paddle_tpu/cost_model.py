@@ -7,7 +7,11 @@ TPU-native form: per-op latency comes from timing jitted single-op
 programs on the live backend (XLA cost modelling subsumes the reference's
 per-kernel table); the measured table feeds parallel.auto_tuner /
 parallel.cost_model the way static_op_benchmark.json feeds the
-reference's planner.
+reference's planner. `static_estimate` is the measured table's static
+twin (ISSUE 13): the same callable priced by the jaxpr roofline pass
+(`analysis/roofline.py`) WITHOUT executing — predicted ms, bound class,
+and MFU sit in the same table as `profile_measure`'s wall-clock rows,
+so the reference API exposes estimate next to actual.
 """
 from __future__ import annotations
 
@@ -53,6 +57,32 @@ class CostModel:
             out = jfn(*args)
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+    def static_estimate(self, fn, *args, device=None, name=None):
+        """Price `fn(*args)` STATICALLY via the roofline pass
+        (analysis/roofline.py) — nothing executes on device. Returns
+        {"time": predicted ms, "bound", "mfu", "flops", "hbm_bytes",
+        "kernel_launches", "device"} and records the predicted ms in
+        the internal table under ``static:<name>`` so it sits next to
+        the `profile_measure` wall-clock rows (estimate beside actual,
+        the ISSUE 13 contract). `device` picks an
+        `analysis.device_specs` row (default: detect live TPU, else
+        the v5e baseline)."""
+        from .analysis import roofline
+
+        rep = roofline.audit_roofline(fn, *args, device=device,
+                                      name=name)
+        key = name or getattr(fn, "__name__", None) or type(fn).__name__
+        self._table[f"static:{key}"] = rep.predicted_step_ms
+        return {
+            "time": rep.predicted_step_ms,
+            "bound": rep.bound,
+            "mfu": rep.predicted_mfu,
+            "flops": rep.total_flops,
+            "hbm_bytes": rep.total_hbm_bytes,
+            "kernel_launches": rep.kernel_launches,
+            "device": rep.spec.name,
+        }
 
     def static_cost_data(self, path=None):
         """Load (or return) the measured op-latency table (reference:

@@ -105,6 +105,15 @@ define_flag("audit_comms", False,
             "implies it (the hooks compose with the lint switch) "
             "(also: PADDLE_TPU_AUDIT_COMMS)",
             env_aliases=("PADDLE_TPU_AUDIT_COMMS",))
+define_flag("audit_roofline", False,
+            "run the static roofline auditor (analysis/roofline.py: "
+            "jaxpr FLOPs/bytes pass against the device-spec table -> "
+            "predicted step latency, bound class, MFU) at the audit "
+            "hooks — ContinuousBatchingEngine.warm() over every cached "
+            "program and Model.fit over the training step. "
+            "PADDLE_TPU_LINT=1 implies it (the hooks compose with the "
+            "lint switch) (also: PADDLE_TPU_AUDIT_ROOFLINE)",
+            env_aliases=("PADDLE_TPU_AUDIT_ROOFLINE",))
 
 # --- serving kernels ---
 define_flag("prefix_prefill_kernel", True,

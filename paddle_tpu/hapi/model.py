@@ -53,6 +53,9 @@ class Model:
         # static communication audit of the training step (ISSUE 11):
         # dict via fit(audit_comms=True) / PADDLE_TPU_AUDIT_COMMS
         self.comms_audit = None
+        # static roofline audit of the training step (ISSUE 13): dict
+        # via fit(audit_roofline=True) / PADDLE_TPU_AUDIT_ROOFLINE
+        self.roofline_audit = None
         # generation fit(resume=True) restored from (gang mode: the
         # AGREED generation — every rank reports the same number), or
         # None when the run started fresh (ISSUE 12)
@@ -138,7 +141,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
             resume=False, checkpoint_freq=None, audit_memory=None,
-            audit_comms=None, coordinator=None):
+            audit_comms=None, audit_roofline=None, coordinator=None):
         """reference: hapi/model.py fit (:1807).
 
         Resilience extensions (paddle_tpu.resilience):
@@ -196,6 +199,15 @@ class Model:
         the bytes-on-wire report + TPU801/802/803 diagnostics land on
         `self.comms_audit` with a `comms.audit` observability event.
         One-shot per fit call; failures degrade to a warning.
+
+        Static roofline audit (ISSUE 13): `audit_roofline=True`
+        (default: FLAGS_audit_roofline / PADDLE_TPU_AUDIT_ROOFLINE,
+        implied by PADDLE_TPU_LINT=1) traces the TRAINING STEP through
+        `analysis/roofline.py` — per-eqn FLOPs + fusion-aware HBM
+        bytes against the device-spec table, predicted step time +
+        MFU + bound class, TPU901/902/903 diagnostics — onto
+        `self.roofline_audit` with a `roofline.audit` event. One-shot
+        per fit call; failures degrade to a warning.
         """
         if audit_memory is not False:  # False skips the analysis import
             from ..analysis.memory import resolve_audit_memory
@@ -207,6 +219,11 @@ class Model:
 
             audit_comms = resolve_audit_comms(audit_comms)
         comms_pending = bool(audit_comms)
+        if audit_roofline is not False:
+            from ..analysis.roofline import resolve_audit_roofline
+
+            audit_roofline = resolve_audit_roofline(audit_roofline)
+        roofline_pending = bool(audit_roofline)
         loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
@@ -279,9 +296,22 @@ class Model:
                     if audit_pending:
                         audit_pending = False
                         self._audit_memory(ins)
-                    if comms_pending:
-                        comms_pending = False
-                        self._audit_comms(ins, labs)
+                    if comms_pending or roofline_pending:
+                        do_c, do_r = comms_pending, roofline_pending
+                        comms_pending = roofline_pending = False
+                        # ONE trace of the training step serves both
+                        # auditors (their passes memoize on the Graph)
+                        # — under PADDLE_TPU_LINT=1, which implies
+                        # both, the most expensive trace in the repo
+                        # must not run twice (same contract as the
+                        # engine's shared _traced_inventory)
+                        traced = self._trace_step_for_audits(ins, labs) \
+                            if do_c and do_r else None
+                        if do_c:
+                            self._audit_comms(ins, labs, traced=traced)
+                        if do_r:
+                            self._audit_roofline(ins, labs,
+                                                 traced=traced)
                     update = (step + 1) % accumulate_grad_batches == 0
                     if tr is None and mt is None:
                         res = self.train_batch(ins, labs, update=update)
@@ -424,7 +454,120 @@ class Model:
             warnings.warn(f"fit(audit_memory=True) failed: "
                           f"{type(e).__name__}: {e}")
 
-    def _audit_comms(self, ins, labs):
+    def _audit_step_target(self, ins, labs):
+        """(loss_fn, params, batch) for the static auditors: the pure
+        loss-of-(params, batch) function the training step
+        differentiates, at the first batch's shapes — shared by the
+        comms (ISSUE 11) and roofline (ISSUE 13) audit hooks so both
+        trace the SAME step."""
+        import jax.numpy as jnp
+
+        from ..core import tape as _tape
+        from ..core.tensor import unwrap
+
+        ins_arr = [np.asarray(i.numpy() if isinstance(i, Tensor)
+                              else i) for i in _to_list(ins)]
+        lab_arr = [np.asarray(l.numpy() if isinstance(l, Tensor)
+                              else l) for l in _to_list(labs)]
+        n_in = len(ins_arr)
+        state = dict(self.network.raw_state())
+        # only inexact leaves are differentiable; int/bool buffers
+        # ride the closure (their grads would be float0 anyway)
+        params = {k: v for k, v in state.items()
+                  if jnp.issubdtype(jnp.asarray(v).dtype,
+                                    jnp.inexact)}
+        rest = {k: v for k, v in state.items() if k not in params}
+        has_loss = self._loss is not None and bool(lab_arr)
+
+        def loss_fn(p, *batch):
+            with _tape.no_grad():
+                out = self.network.func_call(
+                    {**rest, **p},
+                    *(Tensor(b) for b in batch[:n_in]))
+                if has_loss:
+                    loss = unwrap(self._compute_loss(
+                        out, [Tensor(l) for l in batch[n_in:]]))
+                else:
+                    loss = sum(jnp.sum(unwrap(o).astype(jnp.float32))
+                               for o in _to_list(out))
+            return jnp.asarray(loss).astype(jnp.float32)
+
+        return loss_fn, params, tuple(ins_arr + lab_arr)
+
+    def _audit_step_program(self, ins, labs, hook):
+        """(target, name, params, batch) — the FULL traced training
+        step the static auditors price, dp handling included: when the
+        global mesh carries a dp axis (size > 1) and the batch shards,
+        the step is built under shard_map with the explicit gradient
+        psum (GSPMD inserts it at compile time, invisible to a traced
+        jaxpr). Shared by the comms and roofline hooks so both audit
+        the SAME program; `hook` names the caller in the dp-fallback
+        warning."""
+        import jax
+
+        from ..parallel import mesh as mesh_mod
+
+        loss_fn, params, batch = self._audit_step_target(ins, labs)
+
+        def step(p, *b):
+            return jax.value_and_grad(loss_fn)(p, *b)
+
+        target, name = step, "fit.step"
+        mesh = mesh_mod.get_global_mesh()
+        dp = int(mesh.shape["dp"]) if mesh is not None \
+            and "dp" in getattr(mesh, "axis_names", ()) else 1
+        dp_shardable = batch and all(
+            b.ndim >= 1 and b.shape[0] % dp == 0 for b in batch)
+        if dp > 1 and not dp_shardable:
+            # the fallback audits the single-chip step — zero
+            # collectives — while the REAL compiled step pays the
+            # dp gradient all-reduce; a silent clean report here
+            # would hide exactly the bytes the audit exists for
+            import warnings
+
+            warnings.warn(
+                f"fit({hook}=True): global mesh has dp={dp} "
+                "but a batch leaf is 0-d or its leading dim does "
+                "not divide by dp — auditing the single-chip step; "
+                "the dp gradient psum is NOT counted")
+        if dp > 1 and dp_shardable:
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            from ..parallel.shard_map_compat import shard_map
+
+            dp_mesh = Mesh(np.asarray(jax.devices()[:dp]), ("dp",))
+            p_specs = jax.tree.map(lambda _: P(), params)
+
+            def dp_step(p, *b):
+                loss, grads = jax.value_and_grad(loss_fn)(p, *b)
+                # THE dp gradient sync: one fused all-reduce over
+                # every grad leaf — explicit so the wire pass (and
+                # TPU803) can see what GSPMD emits
+                grads = jax.lax.psum(grads, "dp")
+                return jax.lax.psum(loss, "dp") / dp, grads
+
+            target = shard_map(
+                dp_step, mesh=dp_mesh,
+                in_specs=(p_specs,) + (P("dp"),) * len(batch),
+                out_specs=(P(), p_specs), check_vma=False)
+            name = f"fit.step[dp={dp}]"
+        return target, name, params, batch
+
+    def _trace_step_for_audits(self, ins, labs):
+        """(Graph, name) of the training step, traced ONCE for the
+        comms + roofline hooks to share; None on failure (each hook
+        then traces — and warns — on its own)."""
+        try:
+            from ..analysis import memory as _mem
+
+            target, name, params, batch = self._audit_step_program(
+                ins, labs, "audit_comms/audit_roofline")
+            return _mem.trace_auto(target, params, *batch,
+                                   name=name), name
+        except Exception:
+            return None
+
+    def _audit_comms(self, ins, labs, traced=None):
         """One-shot static communication audit of the training step at
         the first batch's shapes (fit(audit_comms=True)): traces loss +
         backward, host-side only. Data parallelism here is batch
@@ -436,88 +579,17 @@ class Model:
         compiled step pays. An audit failure must never take down
         training — it degrades to a warning."""
         try:
-            import jax
-            import jax.numpy as jnp
-
             from ..analysis import comms as _comms
             from ..analysis import memory as _mem
             from ..analysis.pipeline import analyze as _analyze
-            from ..core import tape as _tape
-            from ..core.tensor import unwrap
             from ..observability import record_event
-            from ..parallel import mesh as mesh_mod
 
-            ins_arr = [np.asarray(i.numpy() if isinstance(i, Tensor)
-                                  else i) for i in _to_list(ins)]
-            lab_arr = [np.asarray(l.numpy() if isinstance(l, Tensor)
-                                  else l) for l in _to_list(labs)]
-            n_in = len(ins_arr)
-            state = dict(self.network.raw_state())
-            # only inexact leaves are differentiable; int/bool buffers
-            # ride the closure (their grads would be float0 anyway)
-            params = {k: v for k, v in state.items()
-                      if jnp.issubdtype(jnp.asarray(v).dtype,
-                                        jnp.inexact)}
-            rest = {k: v for k, v in state.items() if k not in params}
-            has_loss = self._loss is not None and bool(lab_arr)
-
-            def loss_fn(p, *batch):
-                with _tape.no_grad():
-                    out = self.network.func_call(
-                        {**rest, **p},
-                        *(Tensor(b) for b in batch[:n_in]))
-                    if has_loss:
-                        loss = unwrap(self._compute_loss(
-                            out, [Tensor(l) for l in batch[n_in:]]))
-                    else:
-                        loss = sum(jnp.sum(unwrap(o).astype(jnp.float32))
-                                   for o in _to_list(out))
-                return jnp.asarray(loss).astype(jnp.float32)
-
-            def step(p, *batch):
-                return jax.value_and_grad(loss_fn)(p, *batch)
-
-            target, name = step, "fit.step"
-            batch = tuple(ins_arr + lab_arr)
-            mesh = mesh_mod.get_global_mesh()
-            dp = int(mesh.shape["dp"]) if mesh is not None \
-                and "dp" in getattr(mesh, "axis_names", ()) else 1
-            dp_shardable = batch and all(
-                b.ndim >= 1 and b.shape[0] % dp == 0 for b in batch)
-            if dp > 1 and not dp_shardable:
-                # the fallback audits the single-chip step — zero
-                # collectives — while the REAL compiled step pays the
-                # dp gradient all-reduce; a silent clean report here
-                # would hide exactly the bytes the audit exists for
-                import warnings
-
-                warnings.warn(
-                    f"fit(audit_comms=True): global mesh has dp={dp} "
-                    "but a batch leaf is 0-d or its leading dim does "
-                    "not divide by dp — auditing the single-chip step; "
-                    "the dp gradient psum is NOT counted")
-            if dp > 1 and dp_shardable:
-                from jax.sharding import Mesh, PartitionSpec as P
-
-                from ..parallel.shard_map_compat import shard_map
-
-                dp_mesh = Mesh(np.asarray(jax.devices()[:dp]), ("dp",))
-                p_specs = jax.tree.map(lambda _: P(), params)
-
-                def dp_step(p, *b):
-                    loss, grads = jax.value_and_grad(loss_fn)(p, *b)
-                    # THE dp gradient sync: one fused all-reduce over
-                    # every grad leaf — explicit so the wire pass (and
-                    # TPU803) can see what GSPMD emits
-                    grads = jax.lax.psum(grads, "dp")
-                    return jax.lax.psum(loss, "dp") / dp, grads
-
-                target = shard_map(
-                    dp_step, mesh=dp_mesh,
-                    in_specs=(p_specs,) + (P("dp"),) * len(batch),
-                    out_specs=(P(), p_specs), check_vma=False)
-                name = f"fit.step[dp={dp}]"
-            g = _mem.trace_auto(target, params, *batch, name=name)
+            if traced is not None:
+                g, name = traced
+            else:
+                target, name, params, batch = self._audit_step_program(
+                    ins, labs, "audit_comms")
+                g = _mem.trace_auto(target, params, *batch, name=name)
             rep = _comms.audit_graph(g)
             lint = _analyze(None, graph=g,
                             rules=["TPU801", "TPU802", "TPU803"])
@@ -532,6 +604,50 @@ class Model:
             import warnings
 
             warnings.warn(f"fit(audit_comms=True) failed: "
+                          f"{type(e).__name__}: {e}")
+
+    def _audit_roofline(self, ins, labs, traced=None):
+        """One-shot static roofline audit of the training step at the
+        first batch's shapes (fit(audit_roofline=True)): traces loss +
+        backward through `analysis/roofline.py` — per-eqn FLOPs +
+        fusion-aware HBM bytes against the device-spec table, the
+        predicted step time / bound class / MFU `bench.py` measures,
+        and the TPU901/902/903 diagnostics. Same traced step as the
+        comms audit (`_audit_step_program` — under a dp mesh the
+        sharded step with its explicit gradient psum, so the per-chip
+        numbers and the wire term are real). Host-side only; failures
+        degrade to a warning."""
+        try:
+            from ..analysis import memory as _mem
+            from ..analysis import roofline as _roof
+            from ..analysis.pipeline import analyze as _analyze
+            from ..observability import record_event
+
+            if traced is not None:
+                g, name = traced
+            else:
+                target, name, params, batch = self._audit_step_program(
+                    ins, labs, "audit_roofline")
+                g = _mem.trace_auto(target, params, *batch, name=name)
+            rep = _roof.audit_graph(g)
+            lint = _analyze(
+                None, graph=g, rules=["TPU901", "TPU902", "TPU903"],
+                rule_config={"TPU901.device": rep.spec.name,
+                             "TPU902.device": rep.spec.name,
+                             "TPU903.device": rep.spec.name})
+            self.roofline_audit = {
+                **rep.to_dict(),
+                "diagnostics": lint.to_dict()["diagnostics"],
+            }
+            record_event("roofline.audit", target=name,
+                         device=rep.spec.name,
+                         predicted_step_ms=rep.predicted_step_ms,
+                         predicted_mfu=rep.predicted_mfu,
+                         bound=rep.bound, mp=rep.mp)
+        except Exception as e:  # pragma: no cover - defensive
+            import warnings
+
+            warnings.warn(f"fit(audit_roofline=True) failed: "
                           f"{type(e).__name__}: {e}")
 
     def _save_checkpoint(self, mgr, epoch, step_in_epoch, global_step,

@@ -68,6 +68,24 @@ def fit_vmem_block(block: int, extent: int, row_bytes: int, *,
         bs -= 1
     return bs
 
+# dtype-name -> bytes per element, for the pure-shape roofline models
+# (no numpy/jax in checker context by contract)
+_ITEMSIZE: Dict[str, int] = {
+    "int8": 1, "uint8": 1, "int4": 1, "uint4": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float64": 8, "int64": 8, "uint64": 8,
+}
+
+
+def dtype_itemsize(name, default: int = 2) -> int:
+    """Bytes per element of a dtype NAME string (pure lookup — the
+    roofline models run under the same no-jax purity contract as the
+    checkers)."""
+    return _ITEMSIZE.get(str(name), default)
+
+
 # second-minor (sublane) tile dimension by dtype
 SUBLANE: Dict[str, int] = {
     "float32": 8,
@@ -122,6 +140,16 @@ class KernelConstraint:
     "error" for shapes the kernel rejects outright, "warning" for silent
     perf hazards (padding, fallback routes). Checkers must be pure shape
     math (no jax calls) so the lint can run on CPU against any graph.
+
+    `roofline(shapes, dtypes)` is the kernel's closed-form cost model
+    for the static roofline auditor (analysis/roofline.py): a
+    ``{"flops": int, "hbm_bytes": int}`` dict for one launch, or None
+    when the shapes don't resolve (the auditor then falls back to its
+    generic operand/result accounting). It lives HERE — next to the
+    kernel whose streaming pattern it describes — so paged attention
+    can count the pool PAGES its block table names rather than the
+    whole gathered pool, and can never drift from the block math. Same
+    purity contract as `checker`.
     """
 
     name: str
@@ -135,6 +163,11 @@ class KernelConstraint:
     # kernels use `_fwd_kernel`/`_kernel`): matched against the traced
     # pallas name_and_src_info string, e.g. "flash_attention.py"
     source: str = ""
+    # optional roofline cost model (see class docstring)
+    roofline: Optional[
+        Callable[[Sequence[Tuple[int, ...]], Sequence[str]],
+                 Optional[dict]]
+    ] = None
 
     def check(self, shapes: Sequence[Tuple[int, ...]],
               dtypes: Sequence[str]) -> list:

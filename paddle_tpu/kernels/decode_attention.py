@@ -66,6 +66,49 @@ def _check_decode_shapes(shapes, dtypes):
     return out
 
 
+def _decode_attention_roofline(shapes, dtypes):
+    """Roofline model for one decode-attention launch (contiguous and
+    paged, bf16 and int8 pools): FLOPs = qk^T + p·v = 4·B·Hq·D·ctx;
+    HBM bytes = q in + out + the K/V actually STREAMED — for the paged
+    grids that is the `B x n_blocks` POOL PAGES the block table names
+    (plus their f32 scale rows when quantized), never the whole pool.
+    Pure shape math (the KernelConstraint contract); None when the
+    operand layout doesn't resolve."""
+    from .constraints import dtype_itemsize
+
+    arrs = [(s, d) for s, d in zip(shapes, dtypes) if len(s) >= 3]
+    if len(arrs) < 3 or not arrs[0][0][0]:
+        return None
+    (q_s, q_d), (pool_s, pool_d) = arrs[0], arrs[1]
+    d_head = q_s[-1]
+    q_elems = math.prod(q_s)               # == B*Hq*D in every layout
+    tables = next((s for s, dt in zip(shapes, dtypes)
+                   if len(s) == 2 and dt.startswith("int")), None)
+    if tables is not None:                 # paged: stream table pages
+        b, n_blocks = tables
+        # rank-4 pool [P, Hkv, page, D]; rank-3 (GQA grid) collapses
+        # (page, kv head) -> [P*Hkv, page, D]
+        page = pool_s[2] if len(pool_s) >= 4 else pool_s[1]
+        hkv = pool_s[1] if len(pool_s) >= 4 \
+            else max(q_s[0] // max(b, 1), 1)
+        ctx = n_blocks * page
+        kv_bytes = 2 * b * ctx * hkv * d_head * dtype_itemsize(pool_d)
+        # int8 pools travel with per-(page, kv head) f32 scale rows
+        n_scales = sum(1 for s, dt in zip(shapes, dtypes)
+                       if len(s) == 2 and dt == "float32")
+        if n_scales:
+            kv_bytes += n_scales * b * n_blocks * hkv * 4
+    else:                                  # contiguous: whole cache
+        if len(pool_s) >= 4:               # [B, H, S, D]
+            ctx = pool_s[-2]
+        else:                              # GQA collapse [B*Hkv*nb, bs, D]
+            ctx = (pool_s[0] // max(q_s[0], 1)) * pool_s[1]
+        kv_bytes = 2 * math.prod(pool_s) * dtype_itemsize(pool_d)
+    q_bytes = q_elems * dtype_itemsize(q_d)
+    return {"flops": 4 * q_elems * ctx,
+            "hbm_bytes": 2 * q_bytes + kv_bytes}
+
+
 CONSTRAINT = register_constraint(KernelConstraint(
     name="decode_attention",
     kernel_fns=("_decode_kernel", "_paged_decode_kernel",
@@ -75,6 +118,7 @@ CONSTRAINT = register_constraint(KernelConstraint(
          f"a divisor >= {MIN_BLOCK_S} under the VMEM double-buffer cap",
     checker=_check_decode_shapes,
     source="decode_attention.py",
+    roofline=_decode_attention_roofline,
 ))
 
 
@@ -98,6 +142,7 @@ CONSTRAINT_Q8 = register_constraint(KernelConstraint(
          "bf16 pool never materializes",
     checker=_check_q8_decode_shapes,
     source="decode_attention.py",
+    roofline=_decode_attention_roofline,
 ))
 
 

@@ -73,6 +73,30 @@ def _check_attention_shapes(shapes, dtypes):
     return out
 
 
+def _flash_attention_roofline(shapes, dtypes):
+    """Roofline model for one flash-attention launch: FLOPs =
+    qk^T + p·v = 4·BH·Sq·Sk·D (full-mask upper bound — causality is a
+    kernel param invisible to shape math), HBM bytes = q/k/v in + out.
+    The whole point of the kernel is that the [Sq, Sk] score matrix
+    never round-trips HBM, so intensity ~ O(S) and the static pass
+    classifies it compute-bound — TPU901 stays silent here. Covers the
+    backward kernels too (same O(S^2 D) shape class). Pure shape math;
+    None when the layout doesn't resolve."""
+    from .constraints import dtype_itemsize
+
+    arrs = [(s, d) for s, d in zip(shapes, dtypes) if len(s) >= 3]
+    if len(arrs) < 3:
+        return None
+    (q_s, q_d), (k_s, _), _ = arrs[0], arrs[1], arrs[2]
+    bh, sq, d = q_s[0], q_s[-2], q_s[-1]
+    sk = k_s[-2]
+    io_bytes = sum(math.prod(s) * dtype_itemsize(dt)
+                   for s, dt in arrs[:3])
+    out_bytes = math.prod(q_s) * dtype_itemsize(q_d)
+    return {"flops": 4 * bh * sq * sk * d,
+            "hbm_bytes": io_bytes + out_bytes}
+
+
 CONSTRAINT = register_constraint(KernelConstraint(
     name="flash_attention",
     kernel_fns=("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel"),
@@ -81,6 +105,7 @@ CONSTRAINT = register_constraint(KernelConstraint(
          "(clamped) q/kv blocks and head_dim should be 128-lane aligned",
     checker=_check_attention_shapes,
     source="flash_attention.py",
+    roofline=_flash_attention_roofline,
 ))
 
 

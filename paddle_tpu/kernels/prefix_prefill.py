@@ -101,6 +101,45 @@ def _check_prefix_prefill_shapes(shapes, dtypes):
     return out
 
 
+def _prefix_prefill_roofline(shapes, dtypes):
+    """Roofline model for one prefix-prefill launch. The kernel's
+    collapsed rank-3 layout (q [b·nkv·nq, bq·g, dh], suffix k/v
+    [b·nkv·n_suf, bs, dh], pools [P·nkv, page, dh], tables [b, w])
+    hides nkv/nq individually, but the PRODUCTS cancel: the prefix
+    phase streams one (page x kv head) tile per (b, h, q-tile, page)
+    grid step, so prefix bytes = q_rows · w · page · dh · itemsize per
+    cache — the POOL PAGES the table names, exact. The causal suffix
+    terms use the one-block-per-tile shape of the short-suffix regime
+    this kernel targets (the prefix stream dominates there). Pure
+    shape math; None when the layout doesn't resolve."""
+    from .constraints import dtype_itemsize
+
+    arrs = [(s, d) for s, d in zip(shapes, dtypes) if len(s) >= 3]
+    tables = next((s for s, dt in zip(shapes, dtypes)
+                   if len(s) == 2 and dt.startswith("int")), None)
+    if len(arrs) < 5 or tables is None:
+        return None
+    # operand order (see the pallas_call below): q, k_pool, v_pool,
+    # [scales rank-2], k_suf, v_suf — suffix k/v are the LAST two
+    (q_s, q_d), (pool_s, pool_d) = arrs[0], arrs[1]
+    (ks_s, ks_d) = arrs[-2]
+    q_rows, dh = q_s[0], q_s[-1]
+    w, page = tables[1], pool_s[-2]
+    q_elems = math.prod(q_s)
+    prefix_ctx = w * page
+    kv_item = dtype_itemsize(pool_d)
+    prefix_bytes = 2 * q_rows * w * page * dh * kv_item
+    n_scales = sum(1 for s, dt in zip(shapes, dtypes)
+                   if len(s) == 2 and dt == "float32")
+    if n_scales:
+        prefix_bytes += n_scales * q_rows * w * 4
+    suffix_bytes = 2 * math.prod(ks_s) * dtype_itemsize(ks_d)
+    q_bytes = 2 * q_elems * dtype_itemsize(q_d)
+    flops = 4 * q_elems * (prefix_ctx + ks_s[1])
+    return {"flops": flops,
+            "hbm_bytes": q_bytes + prefix_bytes + suffix_bytes}
+
+
 CONSTRAINT = register_constraint(KernelConstraint(
     name="prefix_prefill",
     kernel_fns=("_prefix_prefill_kernel",),
@@ -110,6 +149,7 @@ CONSTRAINT = register_constraint(KernelConstraint(
          "never issues sub-page DMAs",
     checker=_check_prefix_prefill_shapes,
     source="prefix_prefill.py",
+    roofline=_prefix_prefill_roofline,
 ))
 
 
@@ -134,6 +174,7 @@ CONSTRAINT_Q8 = register_constraint(KernelConstraint(
          "whole-page multiples like the bf16 grid",
     checker=_check_q8_prefix_prefill_shapes,
     source="prefix_prefill.py",
+    roofline=_prefix_prefill_roofline,
 ))
 
 

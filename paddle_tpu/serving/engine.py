@@ -345,6 +345,7 @@ class ContinuousBatchingEngine:
         self._kv_pool_budget = kv_pool_bytes
         self._memory_audit = None   # fleet report from the last audit
         self._comms_audit = None    # wire-side twin (ISSUE 11)
+        self._roofline_audit = None  # compute-time leg (ISSUE 13)
         self.mgr = PagedKVManager(max_pages, block_size)
         self.mgr.set_pool_geometry(n_layers=cfg.num_hidden_layers,
                                    num_kv_heads=nkv, head_dim=dh,
@@ -581,6 +582,10 @@ class ContinuousBatchingEngine:
             # fleet report from the last audit_comms() /
             # warm(audit_comms=True) run — None until one ran
             "comms_audit": self._comms_audit,
+            # static roofline audit (ISSUE 13): predicted step time /
+            # MFU fleet report from the last audit_roofline() /
+            # warm(audit_roofline=True) run — None until one ran
+            "roofline_audit": self._roofline_audit,
         }
 
     @staticmethod
@@ -859,7 +864,7 @@ class ContinuousBatchingEngine:
         return bsz
 
     def warm(self, buckets=None, prefix_widths=None, audit_memory=None,
-             audit_comms=None):
+             audit_comms=None, audit_roofline=None):
         """Compile (and cache) every program the engine can need for the
         given prompt buckets — each power-of-two prefill batch (cold AND
         cached-prefix variants) plus the decode chunk — by running them
@@ -888,7 +893,16 @@ class ContinuousBatchingEngine:
         `predicted_bytes_on_wire_per_token` gauge — onto
         `metrics()['comms_audit']`. Default (None) follows
         FLAGS_audit_comms / PADDLE_TPU_AUDIT_COMMS, also implied by
-        PADDLE_TPU_LINT=1."""
+        PADDLE_TPU_LINT=1.
+
+        `audit_roofline` (ISSUE 13): likewise runs the static ROOFLINE
+        auditor (`analysis/roofline.py`) over the cache — per-program
+        FLOPs/HBM-bytes against the device-spec table, predicted step
+        time + MFU + bound class, TPU901/902/903 diagnostics, and the
+        `predicted_step_ms` / `predicted_mfu` gauges — onto
+        `metrics()['roofline_audit']`. Default (None) follows
+        FLAGS_audit_roofline / PADDLE_TPU_AUDIT_ROOFLINE, also implied
+        by PADDLE_TPU_LINT=1."""
         buckets = [self.max_prompt_len] if buckets is None else buckets
         if prefix_widths is None:
             prefix_widths = self._prefix_width_ladder()
@@ -953,19 +967,23 @@ class ContinuousBatchingEngine:
         np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
         from ..analysis.comms import resolve_audit_comms
         from ..analysis.memory import resolve_audit_memory
+        from ..analysis.roofline import resolve_audit_roofline
 
         do_mem = resolve_audit_memory(audit_memory)
         do_comms = resolve_audit_comms(audit_comms)
-        # one jaxpr trace per program serves BOTH auditors (their
+        do_roof = resolve_audit_roofline(audit_roofline)
+        # one jaxpr trace per program serves EVERY auditor (their
         # passes memoize on the Graph) — under PADDLE_TPU_LINT=1,
-        # which implies both, the warm path must not trace the whole
-        # fleet twice
-        shared = self._traced_inventory() if do_mem and do_comms \
-            else None
+        # which implies all three, the warm path must not trace the
+        # whole fleet three times
+        shared = self._traced_inventory() \
+            if do_mem + do_comms + do_roof >= 2 else None
         if do_mem:
             self.audit_memory(graphs=shared)
         if do_comms:
             self.audit_comms(graphs=shared)
+        if do_roof:
+            self.audit_roofline(graphs=shared)
 
     # ---- static memory audit (ISSUE 10) ---------------------------------
 
@@ -1218,6 +1236,118 @@ class ContinuousBatchingEngine:
                 mt.gauge("predicted_bytes_on_wire_per_token",
                          "static auditor per-chip wire bytes per "
                          "decoded token (decode chunk)").set(per_token)
+        return report
+
+    def audit_roofline(self, device=None, programs=None,
+                       rule_config=None, graphs=None) -> dict:
+        """Static roofline audit (ISSUE 13): run the jaxpr FLOPs/bytes
+        pass (`analysis/roofline.py`) over every program in the cache
+        and return ONE fleet report — per-program predicted step time
+        (max of compute / HBM / wire time + launch overhead), bound
+        class, predicted MFU, and the TPU901/902/903 diagnostics, all
+        against one `analysis/device_specs.py` row. The headline
+        gauges are `predicted_step_ms` and `predicted_mfu` for the
+        decode chunk — the numbers the gated OPBENCH serving rows
+        record next to their measured latencies, so the next TPU run
+        lands an estimate/actual ratio (same contract as the
+        memory/comms gauges). `predicted_ms_per_token` divides the
+        chunk by the tokens it produces (steps_per_sync x slots).
+
+        `device` picks the spec row (name or DeviceSpec; None =
+        detect live TPU, else the v5e baseline). `programs` filters by
+        inventory name like the other audits; filtered runs return a
+        `partial` report without touching the fleet sinks. `graphs`
+        (pre-traced pairs from `_traced_inventory`) shares one trace
+        with the other auditors. Host-side tracing only."""
+        from ..analysis import roofline as _roof
+        from ..analysis.pipeline import analyze as _analyze
+        from ..analysis.rules import RULES, rule_config_for
+
+        spec = _roof.get_spec(device)
+        rc = dict(rule_config or {})
+
+        def _rules():
+            # instantiated directly so the rules price against the
+            # EXACT spec object the report uses — a caller-built
+            # DeviceSpec has no row name a string knob could route,
+            # and diagnostics priced on a different device than the
+            # predicted_step_ms beside them would be contradictory.
+            # An explicit TPUxxx.device knob still wins.
+            out = []
+            for rid in ("TPU901", "TPU902", "TPU903"):
+                knobs = rule_config_for(rid, rc)
+                knobs.setdefault("device", spec)
+                out.append(RULES[rid](**knobs))
+            return out
+
+        if graphs is None:
+            graphs = self._traced_inventory(programs)
+        out, diags = {}, 0
+        for name, g in graphs:
+            rep = _roof.audit_graph(g, spec)
+            lint = _analyze(None, graph=g, rules=_rules())
+            diags += len(lint)
+            d = rep.to_dict(max_events=4)
+            out[name] = {
+                "predicted_step_ms": d["predicted_step_ms"],
+                "predicted_mfu": d["predicted_mfu"],
+                "bound": d["bound"],
+                "flops": d["flops"],
+                "hbm_bytes": d["hbm_bytes"],
+                "wire_bytes": d["wire_bytes"],
+                "kernel_launches": d["kernel_launches"],
+                "compute_ms": d["compute_ms"],
+                "bandwidth_ms": d["bandwidth_ms"],
+                "wire_ms": d["wire_ms"],
+                "launch_overhead_ms": d["launch_overhead_ms"],
+                "padding_waste_fraction": d["padding_waste_fraction"],
+                "mp": rep.mp,
+                "bottlenecks": d["bottlenecks"],
+                "diagnostics": lint.to_dict()["diagnostics"],
+            }
+        # the decode chunk produces steps_per_sync tokens per slot
+        step_ms = mfu = per_token_ms = None
+        if "decode" in out:
+            step_ms = out["decode"]["predicted_step_ms"]
+            mfu = out["decode"]["predicted_mfu"]
+            per_token_ms = step_ms / max(self.steps * self.slots, 1)
+        report = {
+            "programs": out,
+            "programs_audited": len(out),
+            "device": spec.name,
+            "per_chip": True,
+            "mp": self.mp,
+            "predicted_step_ms": step_ms,
+            "predicted_mfu": mfu,
+            "predicted_ms_per_token": per_token_ms,
+            "roofline_clean": diags == 0,
+            "n_diagnostics": diags,
+            "partial": programs is not None,
+        }
+        if report["partial"]:
+            # same contract as the other audits: a narrowed run must
+            # not overwrite the FLEET report monitoring reads
+            return report
+        self._roofline_audit = report
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("roofline.audit", device=spec.name,
+                       predicted_step_ms=step_ms, predicted_mfu=mfu,
+                       programs=len(out), mp=self.mp,
+                       roofline_clean=report["roofline_clean"])
+        if mt is not None:
+            mt.event("roofline.audit", device=spec.name,
+                     predicted_step_ms=step_ms, predicted_mfu=mfu,
+                     programs=len(out), mp=self.mp,
+                     roofline_clean=report["roofline_clean"],
+                     n_diagnostics=diags)
+            if step_ms is not None:
+                mt.gauge("predicted_step_ms",
+                         "static roofline auditor predicted decode "
+                         "chunk latency").set(step_ms)
+                mt.gauge("predicted_mfu",
+                         "static roofline auditor predicted decode "
+                         "chunk MFU").set(mfu)
         return report
 
     def _check_owner(self, token: Optional[int]):
