@@ -518,12 +518,12 @@ def _op_bench(only=None):
             paired_slope_ms(trun, 1, 13, pairs=6), 4)
         # per decoded token per chip: every layer all-gathers the
         # [b, 1, nh_local*dh] o-proj activations — each chip RECEIVES
-        # (mp-1)/mp of the full head axis. Itemsize 4: the comms
-        # auditor (ISSUE 11) exposed that the decode step gathers the
-        # attention output at its f32 accumulation dtype (the bf16
-        # downcast happens at the o-proj, after the gather) — the
-        # earlier *2 formula under-reported the wire bytes 2x, and the
-        # f32 payload is TPU803's first quantization customer
+        # (mp-1)/mp of the full head axis. Itemsize 2: ISSUE 14's
+        # satellite casts the payload to BF16 BEFORE the gather
+        # (ServingTP.gather_heads) — PR 11's auditor had exposed an
+        # f32 activation stream shipping f32 here with the downcast
+        # landing after the wire; the pre-cast halves the mp seam's
+        # bytes, and EQuARX-style int8 remains the follow-up
         mp_, tcfg = teng.mp, teng.cfg
         # ONE decode trace serves all three static auditors
         tgraphs = teng._traced_inventory(programs=("decode",))
@@ -533,7 +533,7 @@ def _op_bench(only=None):
             "mp": mp_,
             "bytes_all_gathered_per_token": int(
                 tcfg.num_hidden_layers * tcfg.num_attention_heads
-                * tcfg.head_dim * 4 * (mp_ - 1) // mp_),
+                * tcfg.head_dim * 2 * (mp_ - 1) // mp_),
             # static comms auditor (ISSUE 11): jaxpr-derived wire bytes
             # per decoded token per chip — next to the hand formula
             # above so the next TPU run lands an estimate/actual ratio
@@ -552,6 +552,79 @@ def _op_bench(only=None):
             "predicted_bound": troof["bound"],
         }
         del teng, trun
+
+    if want("ragged_step"):
+        # unified ragged serving step (ISSUE 14): ONE program running a
+        # full mixed cycle — 8 slots x 16 decode tokens PLUS a
+        # 128-token prefill window streamed through
+        # ragged_paged_attention — at the 1B serving shape. The slope
+        # prices what a mixed scheduling sync costs once chunked
+        # prefill rides the decode dispatch; predicted_step_ms /
+        # predicted_mfu / kernels_per_step land beside it so the next
+        # TPU run gets estimate/actual ratios (and the
+        # FLAGS_unified_step silicon default has its number).
+        from bench_util import paired_slope_ms
+        from paddle_tpu.analysis import roofline as _roof
+        from paddle_tpu.models import (LlamaConfig,
+                                       init_quant_serving_params)
+        from paddle_tpu.serving import ContinuousBatchingEngine
+
+        ucfg = LlamaConfig.llama_1b(dtype="bfloat16")
+        up = init_quant_serving_params(ucfg, "weight_only_int8", seed=0)
+        np.asarray(jax.tree.leaves(up)[-1])
+        ueng = ContinuousBatchingEngine(
+            ucfg, up, slots=8, prompt_bucket=128, max_prompt_len=128,
+            max_new_tokens=64, block_size=64, steps_per_sync=16,
+            prefill_batch=1, prefix_cache=False, unified_step=True,
+            token_budget=128)
+        tn = ueng.token_budget
+        n_win = tn // ueng.block_size
+        utables = jnp.full((ueng.slots, ueng.table_width),
+                           ueng.scratch_page, jnp.int32)
+        ulive = jnp.ones((ueng.slots,), bool)
+        ulens = jnp.full((ueng.slots,), 96, jnp.int32)
+        uids = jnp.ones((1, tn), jnp.int32)
+        uctbl = jnp.full((1, ueng.table_width), ueng.scratch_page,
+                         jnp.int32)
+        uwin = jnp.full((1, n_win), ueng.scratch_page, jnp.int32)
+        uone = jnp.asarray(1.0, jnp.float32)
+        ukey = jax.random.PRNGKey(0)
+
+        def urun(n):
+            # chained donated invocations, synced once — the slope
+            # cancels the tunnel RTT like the decode-chunk rig; a full
+            # 64-token cached window keeps per-call cost constant
+            toks = jnp.zeros((ueng.slots,), jnp.int32)
+            lens = ulens
+            for _ in range(int(n)):
+                out, lens, _, _, ueng.kcs, ueng.vcs = ueng._unified(
+                    ueng.p, ueng.kcs, ueng.vcs, toks, lens, ulens,
+                    utables, ulive, uids, uctbl,
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), tn, jnp.int32), uwin, ukey, uone,
+                    uone)
+                toks = out[:, -1]
+            return float(jnp.sum(lens))
+
+        urun(1)  # compile once
+        ops["ragged_step"] = round(paired_slope_ms(urun, 1, 13,
+                                                   pairs=6), 4)
+        ugraphs = ueng._traced_inventory(programs=("unified",))
+        uroof = ueng.audit_roofline(
+            programs=("unified",), graphs=ugraphs)["programs"]["unified"]
+        OP_INFO["ragged_step"] = {
+            "token_budget": tn,
+            "decode_tokens_per_step": ueng.slots * ueng.steps,
+            "kernels_per_step": _roof.count_kernel_launches(
+                ugraphs[0][1].jaxpr),
+            "predicted_step_ms": round(uroof["predicted_step_ms"], 4),
+            "predicted_mfu": uroof["predicted_mfu"],
+            "predicted_bound": uroof["bound"],
+            "predicted_peak_hbm_bytes": ueng.audit_memory(
+                programs=("unified",),
+                graphs=ugraphs)["fleet_peak_hbm_bytes"],
+        }
+        del ueng, urun
 
     # eager dispatch overhead: one tiny op, eager, host-timed — tracks the
     # per-op cost of the eager tape + device round-trip over rounds

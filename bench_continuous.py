@@ -32,6 +32,15 @@ Traces:
   on half the HBM) and int8kv_token_match_rate guards accuracy
   (>= 0.99 is the acceptance bar).
 
+- mixed (ISSUE 14): interleaved 1024-token cold prefills + steady
+  short decode traffic, served by the SPLIT program zoo vs the
+  UNIFIED ragged step (FLAGS_unified_step) at the same pools — the
+  summary line reports decode TPOT p99 (the head-of-line-blocking
+  number chunked prefill removes), TTFT p99, useful tok/s, per-policy
+  compiled-program counts (`n_programs`: the bucket x batch x
+  prefix-width zoo vs ONE decode+unified pair) and the
+  unified-vs-split token match rate.
+
 - sharded (ISSUE 7): the shared_prefix traffic served by the
   TENSOR-PARALLEL engine (FLAGS_serving_mp) at mp=1/2/4 plus a
   disaggregated prefill/decode mp=2 run — kv-head-sharded paged pools,
@@ -98,6 +107,7 @@ MAX_NEW = 64
 PROMPT_BUCKET = 128
 BLOCK = 64
 STEPS_PER_SYNC = 16
+LONG_PROMPT = 1024              # the head-of-line-blocking prefill
 SHARED_PREFIX_LEN = 2 * BLOCK   # block-aligned system prompt
 DEEP_PREFIX_LEN = 16 * BLOCK    # ~1k-token system prompt (16 pages)
 
@@ -126,6 +136,30 @@ def make_trace(n, seed, rate_req_s, variance="uniform"):
                              MAX_NEW).tolist()
     else:
         targets = rng.integers(8, MAX_NEW + 1, n).tolist()
+    return arrivals, prompts, targets
+
+
+def make_mixed_trace(n, seed, rate_req_s):
+    """Interleaved LONG prefills + steady short decode traffic (ISSUE
+    14): every 8th request is a LONG_PROMPT-token cold prompt with a
+    short target; the rest are short prompts decoding a full budget.
+    In the split engine a long cold prefill occupies the device for
+    its whole bucket — every decode slot head-of-line-blocks behind
+    it, which is exactly what decode TPOT p99 measures; the unified
+    engine slices it into token-budget windows interleaved with the
+    decode chunks."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_req_s, n))
+    prompts, targets = [], []
+    for i in range(n):
+        if i % 8 == 4:
+            prompts.append(rng.integers(1, 32000,
+                                        (LONG_PROMPT,)).tolist())
+            targets.append(8)
+        else:
+            prompts.append(rng.integers(
+                1, 32000, (int(rng.integers(16, 64)),)).tolist())
+            targets.append(MAX_NEW)
     return arrivals, prompts, targets
 
 
@@ -159,6 +193,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
                warm_prefix_widths=None, prefix_kernel=True,
                prefill_batch=4, kv_cache_dtype=None, kv_pool_bytes=None,
                megakernel=False, serving_mp=1, disaggregated=False,
+               unified=False, token_budget=None,
                tracer=None, with_metrics=True):
     import paddle_tpu as paddle
 
@@ -185,6 +220,10 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
             double_buffer=double_buffer, kv_cache_dtype=kv_cache_dtype,
             kv_pool_bytes=kv_pool_bytes, decode_megakernel=megakernel,
             serving_mp=serving_mp, disaggregated=disaggregated,
+            # policies are pinned explicitly: existing rows keep the
+            # SPLIT scheduler they were written against; the `mixed`
+            # trace runs both and compares (ISSUE 14)
+            unified_step=unified, token_budget=token_budget,
             tracer=tracer if tracer is not None else False,
             metrics=mt if mt is not None else False)
         # compile every (bucket, prefill-batch) program + the decode
@@ -238,6 +277,10 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
         "p99_latency_s": round(pct(lat, 99), 3),
         "p50_ttft_s": round(pct(ttft, 50), 3),
         "sched_syncs": em["device_steps"],
+        # distinct compiled programs this policy warmed/served with —
+        # the program-zoo-vs-one-program comparison (ISSUE 14)
+        "n_programs": len(em["compile_stats"]),
+        "prefill_chunks": em["prefill_chunks"],
         "prefix_hit_rate": round(em["prefix_hit_rate"], 3),
         "blocked_syncs": em["blocked_syncs"],
         "blocked_syncs_per_ktok": round(1000 * em["blocked_syncs"]
@@ -553,6 +596,61 @@ def main():
             mega["useful_tok_s"] / max(kern["useful_tok_s"], 1e-9), 3),
         "megakernel_token_match_rate": _token_match_rate(toks[2],
                                                          toks[4]),
+    }), flush=True)
+
+    # mixed trace (ISSUE 14): interleaved long prefills + steady
+    # decode — the unified ragged step's reason to exist. The split
+    # engine serializes each 1024-token prefill ahead of every decode
+    # chunk (decode TPOT p99 spikes for rows waiting behind it); the
+    # unified engine chunks the prompt through token-budget windows
+    # dispatched WITH the decode chunks, and warms ONE program pair
+    # instead of the bucket x batch x width zoo (n_programs in-row).
+    arrivals, prompts, targets = make_mixed_trace(n, seed,
+                                                  rate_req_s=20.0)
+    mpl = LONG_PROMPT + PROMPT_BUCKET
+    # warm the bucket the long prompts actually land in (LONG_PROMPT
+    # itself) — warming ceil(mpl) would leave the split rows compiling
+    # the 1024-bucket prefill mid-trace, polluting exactly the TPOT
+    # p99 comparison this summary exists for
+    long_bucket = -(-LONG_PROMPT // PROMPT_BUCKET) * PROMPT_BUCKET
+    mixed_rows = []
+    for pol, uni, db in (("mixed+split", False, False),
+                         ("mixed+split+db", False, True),
+                         ("mixed+unified", True, False),
+                         ("mixed+unified+db", True, True)):
+        mixed_rows.append(run_engine(
+            cfg, p, arrivals, prompts, targets, policy=pol,
+            prefix_cache=True, double_buffer=db, max_prompt_len=mpl,
+            warm_buckets=[PROMPT_BUCKET, long_bucket], prefill_batch=1,
+            unified=uni, token_budget=PROMPT_BUCKET))
+    toks_mixed = [row.pop("_tokens", None) for row in mixed_rows]
+    for row in mixed_rows:
+        row["trace"] = "mixed"
+        print(json.dumps(row), flush=True)
+    msplit, msplit_db, muni, muni_db = mixed_rows
+
+    def _p99(row, name):
+        h = (row.get("metrics") or {}).get(name) or {}
+        return h.get("p99")
+
+    print(json.dumps({
+        "trace": "mixed", "summary": True,
+        # the acceptance number: decode TPOT p99 under the long-
+        # prefill bursts — head-of-line blocking removed
+        "decode_tpot_p99_ms": {r["policy"]: _p99(r, "tpot_ms")
+                               for r in mixed_rows},
+        "tpot_p99_improved": (_p99(muni, "tpot_ms") or 1e9)
+        < (_p99(msplit, "tpot_ms") or 0),
+        "ttft_p99_ms": {r["policy"]: _p99(r, "ttft_ms")
+                        for r in mixed_rows},
+        "useful_tok_s": {r["policy"]: r["useful_tok_s"]
+                         for r in mixed_rows},
+        # ONE program pair vs the split zoo
+        "n_programs": {r["policy"]: r["n_programs"]
+                       for r in mixed_rows},
+        "prefill_chunks": muni["prefill_chunks"],
+        "unified_token_match_rate": _token_match_rate(toks_mixed[0],
+                                                      toks_mixed[2]),
     }), flush=True)
 
     # sharded trace (ISSUE 7): the shared_prefix traffic across a

@@ -1,7 +1,11 @@
 """Long-context benchmark: seq 8192 train step + attention kernel on one
-chip (SURVEY.md §5.7 — the axis this rebuild is chartered to leapfrog).
+chip (SURVEY.md §5.7 — the axis this rebuild is chartered to leapfrog),
+plus the long-context SERVING row (ISSUE 14): chunked prefill through
+the unified ragged step vs the split engine's one-shot prefill —
+decode TPOT p99 while a 2k-token prompt streams in.
 
 Usage: python bench_longcontext.py [bs ...]   (default bs 1 2)
+       python bench_longcontext.py serving [prompt_len]
 
 Prints one JSON line per config:
 - full train step (fwd+bwd+AdamW, per-layer remat) tok/s + MFU at
@@ -139,11 +143,79 @@ def train_step_8k(bs: int, recompute: bool = True):
             "mfu_6N": round(mfu, 3), "loss": round(float(loss), 3)}
 
 
+def serving_chunked_prefill(prompt_len: int = 2048):
+    """Long-context SERVING row (ISSUE 14): a `prompt_len`-token cold
+    prompt lands while 7 slots stream steady decode — the head-of-line
+    regime chunked prefill exists for. Served twice over the same 1B
+    int8-weight engine shapes: the SPLIT program zoo (the whole prompt
+    prefills in one bucketed call, every decode slot stalls behind it)
+    vs the UNIFIED ragged step (the prompt streams through
+    token-budget windows dispatched WITH the decode chunks). Reports
+    decode TPOT percentiles (the p99 is the blocking number), the long
+    prompt's TTFT, warmed program counts, and the unified window
+    count."""
+    from bench_util import hist_percentiles_ms
+    from paddle_tpu.models import (LlamaConfig,
+                                   init_quant_serving_params)
+    from paddle_tpu.observability import MetricsRegistry
+    from paddle_tpu.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.llama_1b(dtype="bfloat16")
+    p = init_quant_serving_params(cfg, "weight_only_int8", seed=0)
+    np.asarray(jax.tree.leaves(p)[-1])
+    bucket, block = 128, 64
+    mpl = prompt_len + bucket
+    # the long prompt buckets at ceil(prompt_len/bucket) — warm THAT,
+    # or the split row compiles its prefill inside the timed run and
+    # the TPOT comparison measures compile time, not scheduling
+    long_bucket = -(-prompt_len // bucket) * bucket
+    row = {"config": f"serving_chunked_prefill_{prompt_len}"}
+    for name, unified in (("split", False), ("unified", True)):
+        rng = np.random.default_rng(0)
+        mt = MetricsRegistry()
+        eng = ContinuousBatchingEngine(
+            cfg, p, slots=8, prompt_bucket=bucket, max_prompt_len=mpl,
+            max_new_tokens=64, block_size=block, steps_per_sync=8,
+            prefill_batch=1, prefix_cache=False, unified_step=unified,
+            token_budget=bucket, metrics=mt, tracer=False)
+        eng.warm([bucket, long_bucket])
+        for _ in range(7):
+            eng.add_request(rng.integers(1, 32000, (48,)).tolist(),
+                            max_new=64)
+        for _ in range(2):   # decode reaches steady state first
+            eng.step()
+        long_req = eng.add_request(
+            rng.integers(1, 32000, (prompt_len,)).tolist(), max_new=8)
+        t0 = time.perf_counter()
+        eng.run(max_iters=100000)
+        row[name] = {
+            "decode_tpot_ms": hist_percentiles_ms(
+                mt.histogram("tpot_s")),
+            "long_ttft_s": round(long_req.prefill_time
+                                 - long_req.arrival_time, 3),
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "n_programs": len(eng.compile_stats()),
+            "prefill_chunks": eng.metrics()["prefill_chunks"],
+        }
+        del eng
+    sp = (row["split"]["decode_tpot_ms"] or {}).get("p99")
+    up = (row["unified"]["decode_tpot_ms"] or {}).get("p99")
+    if sp and up:
+        row["tpot_p99_gain"] = round(sp / up, 3)
+        row["tpot_p99_improved"] = bool(up < sp)
+    return row
+
+
 if __name__ == "__main__":
     # args: batch sizes, optionally suffixed "nr" for no-remat (the
     # bs4@2048 matrix lesson: fewer tokens in flight can drop remat);
-    # "trainonly" skips the attention kernel sweep
+    # "trainonly" skips the attention kernel sweep; "serving [len]"
+    # runs ONLY the chunked-prefill serving row (ISSUE 14)
     args = sys.argv[1:] or ["1", "2"]
+    if args and args[0] == "serving":
+        plen = int(args[1]) if len(args) > 1 else 2048
+        print(json.dumps(serving_chunked_prefill(plen)), flush=True)
+        sys.exit(0)
     train_only = "trainonly" in args
     for a in args:
         if a == "trainonly":
@@ -164,3 +236,11 @@ if __name__ == "__main__":
             row["train"] = {"oom": True} if oom else {
                 "error": f"{type(e).__name__}: {msg[:160]}"}
         print(json.dumps(row), flush=True)
+    # the long-context SERVING story (ISSUE 14): chunked prefill keeps
+    # decode TPOT flat while a long prompt streams in
+    try:
+        print(json.dumps(serving_chunked_prefill()), flush=True)
+    except Exception as e:  # train rows stay useful without serving
+        print(json.dumps({"config": "serving_chunked_prefill",
+                          "error": f"{type(e).__name__}: "
+                                   f"{str(e)[:160]}"}), flush=True)

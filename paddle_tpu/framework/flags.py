@@ -147,6 +147,21 @@ define_flag("decode_megakernel", False,
             "(also: PADDLE_TPU_DECODE_MEGAKERNEL)",
             env_aliases=("PADDLE_TPU_DECODE_MEGAKERNEL",))
 
+define_flag("unified_step", "auto",
+            "serve mixed prefill+decode traffic through the UNIFIED "
+            "ragged step (ISSUE 14): the engine's program zoo (cold + "
+            "prefix prefill keyed over suffix bucket x batch x "
+            "prefix-width rung) collapses to ONE chunked-prefill+decode "
+            "program over the ragged_paged_attention kernel, admission "
+            "becomes token-budget packing, and long prompts prefill in "
+            "chunks so decode latency is immune to prefill bursts. "
+            "'auto' (default) = on off-TPU (interpret-mode parity is "
+            "cheap; silicon default flips with the gated ragged_step "
+            "OPBENCH row), '1'/'0' force. The split-program path stays "
+            "the oracle. Read when the engine is BUILT "
+            "(also: PADDLE_TPU_UNIFIED_STEP)",
+            env_aliases=("PADDLE_TPU_UNIFIED_STEP",))
+
 define_flag("serving_mp", 1,
             "tensor-parallel degree of the PAGED serving stack: the "
             "engine's K/V pools (and their int8 scale sidecars) shard "

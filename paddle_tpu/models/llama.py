@@ -881,6 +881,95 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size, tp=None):
     return prefill
 
 
+def _make_chunk_prefill(cfg, tn, tp=None):
+    """Chunk-lane transformer body of the UNIFIED serving step (ISSUE
+    14): one ragged prefill WINDOW of `tn` tokens for ONE request,
+    attending its already-committed tokens (earlier chunks, or a cached
+    prefix — both are just pool pages named by the row's block table)
+    plus the window itself causally, through `ragged_paged_attention`.
+    A cold prompt is a window with ``cached_len 0``; a long prompt is
+    several windows across engine steps (chunked prefill — the thing
+    that stops a 100k-token prompt head-of-line-blocking decode).
+
+    Per-window state is traced, so ONE compiled program serves every
+    (cached_len, new_len) mix at this window shape: `chunk_table`
+    [1, w] names the request's pages, `cached_len` [1] is the
+    committed token count (page-aligned by the engine's chunking, but
+    the kernel accepts arbitrary), `new_len` [1] the true chunk length
+    (window rows beyond it are pad — zeroed by the kernel and scattered
+    at the scratch page by the caller).
+
+    Attention follows FLAGS_prefix_prefill_kernel at program-build
+    time exactly like `_make_prefill_with_prefix`: the Pallas
+    `ragged_paged_attention` grid by default, the
+    `ragged_paged_attention_reference` masked softmax as fallback and
+    oracle. int8 pools (FLAGS_kv_cache_dtype) pass kcs/vcs entries as
+    (int8 pool, f32 scale) tuples — both paths dequantize against the
+    scales.
+
+    With `tp` (ServingTP, inside a shard_map body): shard-local q/k/v
+    heads + pool shards, per-shard outputs all-gather (bf16 payload)
+    before the replicated o-proj — the same one collective per layer
+    as every other serving program.
+
+    Returns prefill(p, kcs, vcs, ids, chunk_table, cached_len,
+    new_len) -> (h_final [1, tn, hidden], [(k_i, v_i)]) with
+    rotary-applied window K/V [1, tn, nkv_l, dh] per layer — the
+    caller owns the page scatter."""
+    nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    nh_l = tp.nh_local if tp is not None else nh
+    nkv_l = tp.nkv_local if tp is not None else nkv
+    n_layers = cfg.num_hidden_layers
+    eps = cfg.rms_norm_eps
+    scale = 1.0 / math.sqrt(dh)
+    from ..framework.flags import flag as _flag
+
+    use_kernel = bool(_flag("prefix_prefill_kernel"))
+
+    def prefill(p, kcs, vcs, ids, chunk_table, cached_len, new_len):
+        from ..kernels.ragged_attention import (
+            ragged_paged_attention, ragged_paged_attention_reference)
+
+        h = p["llama.embed_tokens.weight"][ids]          # [1, tn, h]
+        pos_ids = cached_len[:, None] + jnp.arange(tn)[None, :]
+        kvs = []
+        for i in range(n_layers):
+            pre = f"llama.layers.{i}."
+            x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
+            q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
+                1, tn, nh_l, dh)
+            k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
+                1, tn, nkv_l, dh)
+            v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
+                1, tn, nkv_l, dh)
+            q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
+                                    base=cfg.rope_theta)
+            kvs.append((k, v))
+            kc_i, ksc_i = kcs[i] if isinstance(kcs[i], tuple) \
+                else (kcs[i], None)
+            vc_i, vsc_i = vcs[i] if isinstance(vcs[i], tuple) \
+                else (vcs[i], None)
+            attn_fn = ragged_paged_attention if use_kernel \
+                else ragged_paged_attention_reference
+            attn = attn_fn(q, k, v, kc_i, vc_i, chunk_table, cached_len,
+                           new_len, scale=scale, k_scale=ksc_i,
+                           v_scale=vsc_i).astype(h.dtype)
+            if tp is not None:
+                attn = tp.gather_heads(attn)
+            h = h + _mm(attn.reshape(1, tn, nh * dh),
+                        p[pre + "self_attn.o_proj.weight"])
+            x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
+            gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+            up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+            h = h + _mm(jax.nn.silu(gate) * up,
+                        p[pre + "mlp.down_proj.weight"])
+        h = _k_rms(h, p["llama.norm.weight"], eps)
+        return h, kvs
+
+    return prefill
+
+
 def build_quant_generate(cfg, b, sb, max_new, max_seq=None,
                          eos_token_id=None, do_sample=False, top_k=0):
     """Model-free serving program over QUANTIZED weights only: prefill AND
@@ -994,6 +1083,35 @@ def resolve_decode_megakernel(decode_megakernel: Optional[bool] = None) \
     return bool(decode_megakernel)
 
 
+def resolve_unified_step(unified_step=None) -> bool:
+    """Whether the serving engine runs the UNIFIED ragged step (ISSUE
+    14) — one chunked-prefill+decode program over
+    `ragged_paged_attention` instead of the split cold/prefix-prefill
+    program zoo — from the argument or FLAGS_unified_step /
+    PADDLE_TPU_UNIFIED_STEP. 'auto' (the default) resolves ON off-TPU,
+    where interpret-mode parity is cheap; on silicon the default stays
+    the split oracle until the gated `ragged_step` OPBENCH row
+    confirms. Read at engine-BUILD time like every other serving
+    flag."""
+    if unified_step is None:
+        from ..framework.flags import flag as _flag
+
+        unified_step = _flag("unified_step")
+    if isinstance(unified_step, str):
+        s = unified_step.strip().lower()
+        if s in ("auto", ""):
+            from ..kernels.decode_attention import _on_tpu
+
+            return not _on_tpu()
+        if s in ("1", "true", "on", "yes"):
+            return True
+        if s in ("0", "false", "off", "no"):
+            return False
+        raise ValueError(
+            f"unified_step must be 'auto'/'1'/'0', got {unified_step!r}")
+    return bool(unified_step)
+
+
 SERVING_MP_FALLBACK_MSG = (
     "kv heads not divisible by serving_mp; falling back to "
     "replicated-KV head-sharded-Q (each shard streams the FULL kv "
@@ -1082,9 +1200,18 @@ class ServingTP:
         """All-gather the per-shard attention outputs along the head
         axis — THE one cross-chip collective per layer (the o-proj
         activations; shard i's block lands at head offset i*nh_local,
-        matching the column-sharded q projection). EQuARX (PAPERS.md)
-        is the follow-up for quantizing this payload; TPU401's
-        collective-size lint watches it meanwhile."""
+        matching the column-sharded q projection). The payload is cast
+        to bf16 BEFORE the gather (ISSUE 14 satellite: PR 11's comms
+        auditor proved an f32 activation stream shipped f32 here, with
+        the downcast landing at the o-proj AFTER the wire — the
+        pre-cast halves the mp seam's bytes; a bf16 stream is
+        untouched, so production serving numerics don't move and every
+        shard applies the same rounding, keeping mp token-identical to
+        itself across degrees). EQuARX (PAPERS.md) remains the
+        follow-up for quantizing it further; TPU401/TPU803 watch the
+        seam meanwhile."""
+        if ctx.dtype == jnp.float32:
+            ctx = ctx.astype(jnp.bfloat16)
         return jax.lax.all_gather(ctx, self.axis, axis=ctx.ndim - 2,
                                   tiled=True)
 

@@ -99,15 +99,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.llama import (PagedKVManager, _make_decode_step,
-                            _make_head_logits, _make_prefill,
-                            _make_prefill_with_prefix,
+from ..models.llama import (PagedKVManager, _make_chunk_prefill,
+                            _make_decode_step, _make_head_logits,
+                            _make_prefill, _make_prefill_with_prefix,
                             _megakernel_or_fallback_step, _sample_next,
                             hash_prefix_blocks, make_paged_kv_helpers,
                             make_paged_kv_q8_helpers, make_serving_tp,
                             resolve_decode_megakernel,
                             resolve_kv_cache_dtype, resolve_serving_mp,
-                            serving_param_specs, shard_serving_params)
+                            resolve_unified_step, serving_param_specs,
+                            shard_serving_params)
 from ..observability import metrics as obs_metrics
 from ..observability import trace as obs_trace
 from ..resilience import chaos
@@ -192,6 +193,9 @@ class ContinuousBatchingEngine:
     # sat idle waiting on the device) — the stat double buffering exists
     # to shrink
     stall_threshold_s = 1e-3
+    # class-level default: the watchdog's no-live-slot path reads this
+    # on engines that never reached the unified-path init
+    _prefilling = None
 
     def __init__(self, cfg, dec_params, *, slots: int = 8,
                  prompt_bucket: int = 64, max_prompt_len: int = 512,
@@ -207,6 +211,7 @@ class ContinuousBatchingEngine:
                  decode_megakernel: Optional[bool] = None,
                  serving_mp: Optional[int] = None,
                  disaggregated: bool = False,
+                 unified_step=None, token_budget: Optional[int] = None,
                  tracer=None, metrics=None):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
@@ -230,6 +235,31 @@ class ContinuousBatchingEngine:
         identical to a build without the flag. Models whose kv heads
         don't divide mp (MQA) fall back to replicated-KV
         head-sharded-Q with a build-time warning.
+
+        `unified_step` (ISSUE 14; default from FLAGS_unified_step /
+        PADDLE_TPU_UNIFIED_STEP, 'auto' = ON off-TPU, resolved HERE at
+        build time like every other serving flag) serves prefill
+        through the UNIFIED ragged step: ONE
+        chunked-prefill+decode-chunk program over
+        `ragged_paged_attention` replaces the whole (suffix bucket x
+        batch x prefix-width rung) prefill program zoo. Admission
+        becomes token-budget packing — the FIFO head request is
+        admitted when its EXACT page reservation
+        (ceil((prompt - cached + max_new)/block) private pages, no
+        bucket rounding, cached prefix blocks free and never trimmed)
+        fits the pool, and its prompt then streams through
+        `token_budget`-token windows interleaved with every live
+        slot's decode chunk — a 100k-token prompt can no longer
+        head-of-line-block decode. Pure-decode steps keep dispatching
+        the plain decode-chunk program (bitwise the split engine's
+        steady state, multi-step sync amortization and megakernel
+        composition included). The split path stays available as the
+        oracle (`unified_step=False`).
+
+        `token_budget` is the prefill window width in tokens (a
+        multiple of `block_size`; default = the prompt bucket): each
+        mixed step advances the prefilling prompt by up to this many
+        tokens next to `slots x steps_per_sync` decode tokens.
 
         `disaggregated` splits scheduling into a PREFILL worker and a
         DECODE worker with paged-KV handoff: admission prefills up to
@@ -284,6 +314,19 @@ class ContinuousBatchingEngine:
         # decode-chunk program is compiled once per engine, so the flag
         # is part of this engine's identity (warm() covers it)
         self.use_megakernel = resolve_decode_megakernel(decode_megakernel)
+        # unified ragged step (FLAGS_unified_step, ISSUE 14), resolved
+        # at build time like the flags above: ONE chunked-prefill +
+        # decode program instead of the split prefill program zoo
+        self.unified = resolve_unified_step(unified_step)
+        if token_budget is None:
+            token_budget = prompt_bucket
+        if token_budget % block_size or token_budget < block_size:
+            raise ValueError(
+                f"token_budget {token_budget} must be a whole number "
+                f"of KV pages (multiple of block_size {block_size}) so "
+                "chunk boundaries stay page-aligned and the window "
+                "scatter writes whole pages")
+        self.token_budget = int(token_budget)
         # tensor-parallel degree (FLAGS_serving_mp), resolved at build
         # time like the flags above; mp=1 builds exactly the single-chip
         # programs (no mesh, no shard_map — byte-identical)
@@ -401,6 +444,17 @@ class ContinuousBatchingEngine:
         self._decode = jax.jit(
             self._shard_program(self._build_decode_chunk(), 8, 3),
             donate_argnums=(1, 2))
+        # the ONE mixed prefill+decode program (ISSUE 14) — built only
+        # on the unified path; its shape key is (token_budget, slots,
+        # steps, kv-dtype, mp) and warm() compiles it once
+        self._unified = jax.jit(
+            self._shard_program(self._build_unified_step(), 13, 4),
+            donate_argnums=(1, 2)) if self.unified else None
+        # the request currently streaming prefill windows through the
+        # unified step: {"req": ServeRequest, "done": tokens committed}
+        self._prefilling = None
+        self.prefill_chunks = 0  # unified prefill windows dispatched
+        self.chunk_tokens = 0    # prompt tokens prefilled via windows
         self.device_steps = 0    # decode-chunk dispatches (for metrics)
         self.prefill_calls = 0   # batched-admission device calls
         self.hung_retired = 0    # slots retired by the watchdog
@@ -519,7 +573,7 @@ class ContinuousBatchingEngine:
     @property
     def has_work(self) -> bool:
         return bool(self.waiting) or bool(self._handoff) \
-            or self.n_active > 0
+            or self._prefilling is not None or self.n_active > 0
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -533,6 +587,8 @@ class ContinuousBatchingEngine:
         """jit cache sizes for every engine program — the steady-state
         guard: after warm(), serving traffic must not grow any entry."""
         stats = {"decode": self._jit_cache_size(self._decode)}
+        if self._unified is not None:
+            stats["unified"] = self._jit_cache_size(self._unified)
         for key, fn in self._prefill_cache.items():
             stats["prefill:" + ":".join(str(k) for k in key)] = \
                 self._jit_cache_size(fn)
@@ -554,6 +610,11 @@ class ContinuousBatchingEngine:
             "prefill_calls": self.prefill_calls,
             "device_steps": self.device_steps,
             "prefill_handoffs": self.prefill_handoffs,
+            # unified ragged step (ISSUE 14)
+            "unified_step": self.unified,
+            "token_budget": self.token_budget,
+            "prefill_chunks": self.prefill_chunks,
+            "chunk_tokens": self.chunk_tokens,
             "hung_retired": self.hung_retired,
             "hung_requeued": self.hung_requeued,
             # prefix cache
@@ -733,18 +794,13 @@ class ContinuousBatchingEngine:
 
         return run
 
-    def _build_decode_chunk(self):
-        """`steps` decode tokens for every slot in one program. Retired /
-        free rows point their table at the scratch page and freeze their
-        length, so they compute (fixed shape) but touch nothing live.
-        `budgets` [slots] freezes each row on-device at prompt+max_new —
-        the guarantee that a speculatively-dispatched chunk (double
-        buffering) can never write past a request's reserved pages."""
+    def _decode_step_maker(self):
+        """make_step(tables, p, kcs, vcs) -> per-layer decode body,
+        shared by the decode-chunk program AND the unified step's
+        decode lane (megakernel-aware on both)."""
         from ..kernels.decode_attention import paged_decode_attention
 
         cfg, b, bs = self.cfg, self.slots, self.block_size
-        steps = self.steps
-        do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
         quant = self.kv_dtype == "int8"
         use_mega = self.use_megakernel
         nkv_eff = self._nkv_eff
@@ -780,6 +836,19 @@ class ContinuousBatchingEngine:
             return _megakernel_or_fallback_step(cfg, b, tables, p, kcs,
                                                 vcs, base, tp=tp)
 
+        return make_step
+
+    def _build_decode_chunk(self):
+        """`steps` decode tokens for every slot in one program. Retired /
+        free rows point their table at the scratch page and freeze their
+        length, so they compute (fixed shape) but touch nothing live.
+        `budgets` [slots] freezes each row on-device at prompt+max_new —
+        the guarantee that a speculatively-dispatched chunk (double
+        buffering) can never write past a request's reserved pages."""
+        b, steps = self.slots, self.steps
+        do_sample, top_k, eos = self.do_sample, self.top_k, self.eos
+        make_step = self._decode_step_maker()
+
         def run(p, kcs, vcs, toks, lens, budgets, tables, live, key,
                 temperature, top_p):
             decode_step = make_step(tables, p, kcs, vcs)
@@ -807,6 +876,60 @@ class ContinuousBatchingEngine:
                 step, (toks, lens, kcs, vcs, done0, key), None,
                 length=steps)
             return jnp.swapaxes(out, 0, 1), lens, done, kcs, vcs
+
+        return run
+
+    def _build_unified_step(self):
+        """ONE program for mixed prefill + decode (ISSUE 14 tentpole):
+        the decode-chunk scan (every live slot advances `steps` tokens
+        — bitwise the split program's math) composed with ONE ragged
+        prefill WINDOW of `token_budget` tokens for the request
+        currently prefilling, through `ragged_paged_attention` (decode
+        rows, prefill rows and prefill chunks coexist over the same
+        pools). The lanes touch disjoint pages by construction (the
+        chunk's window pages belong to a request no decode slot maps),
+        so their order inside the program is free and the pools thread
+        straight through — donated, exactly like the split programs.
+
+        Replaces the entire (suffix bucket x batch x prefix-width rung)
+        prefill program zoo: cold prompts are windows with cached_len
+        0, cache-hit prompts start at their prefix depth, long prompts
+        stream across steps (chunked prefill — decode latency becomes
+        immune to a 100k-token prompt). The program's shape key is just
+        (token_budget, slots, steps, kv-dtype, mp)."""
+        cfg, b, bs = self.cfg, self.slots, self.block_size
+        tn = self.token_budget
+        n_win = tn // bs
+        do_sample, top_k = self.do_sample, self.top_k
+        decode_chunk = self._build_decode_chunk()
+        chunk_body = _make_chunk_prefill(cfg, tn, tp=self._tp)
+        head_logits = _make_head_logits(cfg)
+        scatter = self._page_scatter(1, n_win)
+
+        def run(p, kcs, vcs, toks, lens, budgets, tables, live,
+                chunk_ids, chunk_table, chunk_cached, chunk_len,
+                chunk_pages, key, temperature, top_p):
+            key, kd, ks = jax.random.split(key, 3)
+            # ---- decode lane: the split decode chunk, verbatim ----
+            out, lens_o, done, kcs, vcs = decode_chunk(
+                p, kcs, vcs, toks, lens, budgets, tables, live, kd,
+                temperature, top_p)
+            # ---- chunk lane: one ragged prefill window ----
+            h, kvs = chunk_body(p, kcs, vcs, chunk_ids, chunk_table,
+                                chunk_cached, chunk_len)
+            for i, (k, v) in enumerate(kvs):
+                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v,
+                                         chunk_pages)
+            # first-token logits at the chunk's true last position —
+            # meaningful only when this window completes the prompt
+            # (the host ignores it otherwise)
+            h_last = jax.lax.dynamic_index_in_dim(
+                h, jnp.maximum(chunk_len[0] - 1, 0), axis=1,
+                keepdims=True)
+            logits = head_logits(h_last, p)[:, -1]
+            first = _sample_next(logits.astype(jnp.float32), ks,
+                                 do_sample, temperature, top_k, top_p)
+            return out, lens_o, done, first, kcs, vcs
 
         return run
 
@@ -904,9 +1027,16 @@ class ContinuousBatchingEngine:
         FLAGS_audit_roofline / PADDLE_TPU_AUDIT_ROOFLINE, also implied
         by PADDLE_TPU_LINT=1."""
         buckets = [self.max_prompt_len] if buckets is None else buckets
+        if self.unified:
+            # ONE program covers every prompt shape (cold, cached,
+            # chunked): warm it once against the scratch page.
+            # `buckets` / `prefix_widths` are accepted for driver
+            # compatibility but meaningless — there is no program
+            # ladder to enumerate, which is the point.
+            buckets = []
         if prefix_widths is None:
             prefix_widths = self._prefix_width_ladder()
-        else:
+        elif not self.unified:
             bad = [w for w in prefix_widths
                    if w not in self._prefix_width_ladder()]
             if bad:
@@ -951,11 +1081,31 @@ class ContinuousBatchingEngine:
                 if bsz >= cap:
                     break
                 bsz *= 2
-        self._key, k = jax.random.split(self._key)
         # scratch-only tables: warming against the live tables would
         # scatter the warm token's K/V into an admitted request's pages
         scratch_tables = jnp.full((self.slots, self.table_width),
                                   self.scratch_page, jnp.int32)
+        if self.unified:
+            # the unified mixed program: an all-scratch window of
+            # chunk_len 0 (every window row is pad — the ragged kernel
+            # emits zeros, the scatter hits only the scratch page)
+            self._key, k = jax.random.split(self._key)
+            tn = self.token_budget
+            n_win = tn // self.block_size
+            uout = self._unified(
+                self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
+                jnp.zeros((self.slots,), jnp.int32),
+                jnp.zeros((self.slots,), jnp.int32), scratch_tables,
+                jnp.zeros((self.slots,), bool),
+                jnp.zeros((1, tn), jnp.int32),
+                jnp.full((1, self.table_width), self.scratch_page,
+                         jnp.int32),
+                jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+                jnp.full((1, n_win), self.scratch_page, jnp.int32), k,
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
+            _, _, _, _, self.kcs, self.vcs = uout
+        self._key, k = jax.random.split(self._key)
         out = self._decode(
             self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
             jnp.zeros((self.slots,), jnp.int32),
@@ -1016,12 +1166,29 @@ class ContinuousBatchingEngine:
                            jnp.zeros((bsz,), jnp.int32)) + tail
         return head + tail
 
+    def _unified_example_args(self):
+        b, tn, W = self.slots, self.token_budget, self.table_width
+        n_win = tn // self.block_size
+        return (self.p, self.kcs, self.vcs,
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b, W), jnp.int32),
+                jnp.zeros((b,), bool), jnp.zeros((1, tn), jnp.int32),
+                jnp.zeros((1, W), jnp.int32), jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1, n_win), jnp.int32), jax.random.PRNGKey(0),
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
+
     def _program_inventory(self):
         """(name, jitted_fn, example_args) for every program this
-        engine can dispatch: the decode chunk plus every compiled
-        prefill variant — the enumeration the fleet audit (and any
-        future whole-cache tooling) walks."""
+        engine can dispatch: the decode chunk, the unified mixed
+        program (ISSUE 14, when enabled), plus every compiled prefill
+        variant — the enumeration the fleet audit (and any future
+        whole-cache tooling) walks."""
         progs = [("decode", self._decode, self._decode_example_args())]
+        if self._unified is not None:
+            progs.append(("unified", self._unified,
+                          self._unified_example_args()))
         for key, fn in sorted(self._prefill_cache.items(),
                               key=lambda kv: str(kv[0])):
             name = "prefill:" + ":".join(str(k) for k in key)
@@ -1645,6 +1812,267 @@ class ContinuousBatchingEngine:
                 if slot.req is None:
                     self._bind_slot(slot_id, self._handoff.pop(0))
 
+    # ---- unified ragged step scheduling (ISSUE 14) ----------------------
+
+    def _plan_unified(self, req: ServeRequest) -> _Plan:
+        """Token-budget admission plan: the EXACT page reservation for
+        one waiting request — ceil((prompt - cached + max_new)/block)
+        private pages next to its cached prefix blocks. No bucket
+        rounding, and therefore no prefix TRIM: cached + private =
+        ceil((prompt + max_new)/block) exactly, the cold-path bound the
+        pool and table_width are sized to."""
+        bs = self.block_size
+        L = len(req.prompt)
+        n_cached = n_lru = 0
+        if self.prefix_cache:
+            # at least one window token always prefills (the
+            # first-token logits must be computed even on a full hit);
+            # no trim ever shrinks n_cached, so ONE lookup serves both
+            # the depth and the refcount-0 count (the split planner
+            # must re-look-up after trimming)
+            max_blocks = (L - 1) // bs
+            if max_blocks > 0:
+                n_cached, n_lru = self.mgr.prefix_lookup(
+                    req.prompt, max_blocks, hashes=req.block_hashes)
+        suffix = L - n_cached * bs
+        need = -(-(suffix + req.max_new) // bs)
+        return _Plan(None, n_cached, n_lru, need, suffix)
+
+    def _admit_unified(self, token: Optional[int] = None):
+        """Unified-path admission: start prefilling the FIFO head when
+        the chunk lane is free, its pages fit, and a decode slot will
+        exist at completion (disaggregated: handoff headroom instead —
+        prefill admission never queues behind decode occupancy). The
+        request's WHOLE reservation (cached prefix pinned + private
+        pages) commits here; its prompt then streams through
+        `token_budget` windows across steps."""
+        if self._prefilling is not None or not self.waiting:
+            return
+        req = self.waiting[0]
+        plan = self._plan_unified(req)
+        if self.disaggregated:
+            if len(self._handoff) >= self.slots:
+                return
+        else:
+            # one free slot now guarantees one at completion: only
+            # completion binds slots in unified mode, retires only add
+            if not any(s.req is None for s in self._slots):
+                return
+        if plan.need + plan.n_lru > self.mgr.n_available:
+            return
+        tr, mt = self._tracer, self._metrics
+        with self._commit_lock:
+            self._check_owner(token)
+            cached = self.mgr.acquire_prefix(
+                req.prompt, plan.n_cached,
+                hashes=req.block_hashes) if plan.n_cached else []
+            priv = self.mgr.alloc_pages(plan.need)
+            self.waiting.pop(0)
+            req.pages = cached + priv
+            req.n_prefix = len(cached)
+            req.cached_tokens = len(cached) * self.block_size
+            req.bucket = self.token_budget
+            # "dispatched" flips once a window of this request has
+            # actually ridden a device dispatch — the watchdog blames
+            # the prefilling request only then (a timeout draining a
+            # pure-decode chunk dispatched BEFORE this admission is
+            # the decode program's fault, not this request's)
+            self._prefilling = {"req": req, "done": req.cached_tokens,
+                                "t0": time.perf_counter(),
+                                "dispatched": False}
+        ev_delta = self.mgr.prefix_evictions - self._evictions_seen
+        if ev_delta:
+            self._evictions_seen = self.mgr.prefix_evictions
+            if tr is not None:
+                tr.instant("prefix.evict", n=ev_delta)
+            if mt is not None:
+                mt.counter("prefix_evictions").inc(ev_delta)
+
+    def _dispatch_commit_unified(self, token: Optional[int] = None) -> int:
+        """One MIXED step: dispatch the unified program — every live
+        slot's decode chunk + the next prefill window of the active
+        request — and commit both lanes. The decode lane commits
+        through `_commit_chunk` unchanged; the chunk lane advances the
+        prefill cursor and, on the final window, turns the sampled
+        first token into a slot bind (or disaggregated handoff)."""
+        st = self._prefilling
+        req = st["req"]
+        L = len(req.prompt)
+        done = st["done"]
+        tn, bs = self.token_budget, self.block_size
+        n_win = tn // bs
+        this_chunk = min(L - done, tn)
+        wp0 = done // bs
+        win_pages = req.pages[wp0:wp0 + n_win]
+        win_pages += [self.scratch_page] * (n_win - len(win_pages))
+        ids = np.zeros((1, tn), np.int32)
+        ids[0, :this_chunk] = req.prompt[done:done + this_chunk]
+        tbl = np.full((1, self.table_width), self.scratch_page, np.int32)
+        tbl[0, :len(req.pages)] = req.pages
+        if self._watchdog is not None:
+            self._watchdog.phase = "decode"
+        chaos.maybe_hang("decode")
+        tr, mt = self._tracer, self._metrics
+        t_disp0 = time.perf_counter()
+        with self._commit_lock:
+            self._check_owner(token)
+            st["dispatched"] = True
+            self._key, k = jax.random.split(self._key)
+            live = np.asarray([s.req is not None for s in self._slots])
+            res = self._unified(
+                self.p, self.kcs, self.vcs, jnp.asarray(self._tokens),
+                jnp.asarray(np.asarray([s.length for s in self._slots],
+                                       np.int32)),
+                jnp.asarray(self._budgets), jnp.asarray(self._tables),
+                jnp.asarray(live), jnp.asarray(ids), jnp.asarray(tbl),
+                jnp.asarray([done], np.int32),
+                jnp.asarray([this_chunk], np.int32),
+                jnp.asarray([win_pages], np.int32), k,
+                jnp.asarray(self.temperature, jnp.float32),
+                jnp.asarray(self.top_p, jnp.float32))
+            out, new_lens, dn, first_dev, self.kcs, self.vcs = res
+            self.device_steps += 1
+            self.prefill_chunks += 1
+            # a mixed step is authoritative host state — never chain a
+            # pipelined decode chunk across it
+            self._chain_tok = None
+            self._chain_lens = None
+            self._override[:] = True
+            if tr is not None:
+                tr.complete("decode.dispatch", int(t_disp0 * 1e9),
+                            time.perf_counter_ns(),
+                            chunk=self.device_steps,
+                            live=int(live.sum()), prefill_window=True,
+                            req_id=req.req_id)
+            if mt is not None:
+                mt.gauge("live_slots", "slots decoding").set(
+                    int(live.sum()))
+                mt.gauge("kv_pages_available",
+                         "free + evictable pool pages").set(
+                             self.mgr.n_available)
+            rec = {"out": out, "lens": new_lens, "done": dn,
+                   "reqs": [s.req for s in self._slots],
+                   "t_disp0": t_disp0}
+        produced = self._commit_chunk(rec, token)
+        first = int(np.asarray(first_dev)[0])
+        if tr is not None:
+            # the window is this request's prefill work for the step —
+            # span-coverage checks see the same prefill.dispatch
+            # lifecycle event the split engine's batched admit emits
+            tr.complete("prefill.dispatch", int(t_disp0 * 1e9),
+                        time.perf_counter_ns(),
+                        bucket=self.token_budget, batch=1,
+                        cached_prefix=req.n_prefix > 0,
+                        chunk_tokens=this_chunk,
+                        req_ids=[req.req_id])
+        if mt is not None:
+            mt.histogram(
+                "prefill_chunk_s",
+                "prefill dispatch + first-token readback").observe(
+                    time.perf_counter() - t_disp0)
+        with self._commit_lock:
+            self._check_owner(token)
+            st["done"] = done + this_chunk
+            self.chunk_tokens += this_chunk
+            if st["done"] >= L:
+                self._prefilling = None
+                self._finish_unified_prefill(req, first, st["t0"])
+        return produced
+
+    def _finish_unified_prefill(self, req: ServeRequest, first: int,
+                                t_disp0: float):
+        """The final window of a prompt committed: account the
+        admission, register freshly computed full prompt blocks into
+        the prefix cache, and install the request — a decode slot bind,
+        or the disaggregated handoff (EOS-first / max_new==1 retires at
+        the handoff without ever taking a slot, as in the split path).
+        Called under `_commit_lock`."""
+        bs = self.block_size
+        req.tokens.append(first)
+        now = time.perf_counter()
+        req.prefill_time = now
+        self.prompt_tokens += len(req.prompt)
+        self.prefix_hit_tokens += req.cached_tokens
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("req.admit", req_id=req.req_id,
+                       cached_tokens=req.cached_tokens,
+                       suffix_bucket=self.token_budget)
+        if mt is not None:
+            mt.histogram("ttft_s", "arrival to first token").observe(
+                now - req.arrival_time)
+            mt.histogram("queue_wait_s",
+                         "arrival to prefill dispatch").observe(
+                             max(t_disp0 - req.arrival_time, 0.0))
+            mt.counter("requests_admitted").inc()
+            mt.counter("prompt_tokens").inc(len(req.prompt))
+            mt.counter("prefix_hit_tokens").inc(req.cached_tokens)
+        if self.prefix_cache:
+            full = len(req.prompt) // bs
+            if full > req.n_prefix:
+                self.prefix_inserts += self.mgr.insert_prefix(
+                    req.prompt, req.pages[req.n_prefix:full],
+                    start_block=req.n_prefix, hashes=req.block_hashes)
+        if self.disaggregated:
+            self.prefill_handoffs += 1
+            if tr is not None:
+                tr.instant("req.handoff", req_id=req.req_id)
+            if mt is not None:
+                mt.counter("prefill_handoffs").inc()
+            if (self.eos is not None and first == self.eos) \
+                    or req.max_new == 1:
+                self._finish_prefilled(req)
+            else:
+                self._handoff.append(req)
+            return
+        free = [i for i, s in enumerate(self._slots) if s.req is None]
+        if not free:
+            raise RuntimeError(
+                "no free decode slot at unified prefill completion — "
+                "_admit_unified guarantees one (slots only free up "
+                "between admission and completion)")
+        self._bind_slot(free[0], req)
+
+    def _step_unified(self, token: Optional[int], pipeline: bool) -> int:
+        """One unified-path scheduling iteration. Pure-decode phases
+        dispatch the plain decode-chunk program — synchronous or
+        double-buffered exactly like the split engine (bitwise the same
+        program). When a request is prefilling, the step becomes a
+        MIXED dispatch of the unified program; any pipelined chunk in
+        flight commits first (its device-side chain cannot span a
+        program that rewrites host state)."""
+        wd = self._watchdog
+        if wd is not None:
+            wd.phase = "admit"
+        self._admit_unified(token)
+        if self.disaggregated:
+            self._install_handoffs(token)
+        if self._prefilling is not None:
+            with self._commit_lock:
+                self._check_owner(token)
+                prev, self._inflight = self._inflight, None
+            n = 0
+            if prev is not None:
+                if wd is not None:
+                    wd.phase = "commit"
+                n = self._commit_chunk(prev, token)
+                if wd is not None:
+                    wd.phase = "admit"
+            return n + self._dispatch_commit_unified(token)
+        rec = self._dispatch_chunk(token, chain=pipeline)
+        if pipeline:
+            with self._commit_lock:
+                self._check_owner(token)
+                prev, self._inflight = self._inflight, rec
+            if prev is not None:
+                if wd is not None:
+                    wd.phase = "commit"
+                return self._commit_chunk(prev, token)
+            return 0
+        if rec is None:
+            return 0
+        return self._commit_chunk(rec, token)
+
     def _retire(self, slot_id: int, failed: bool = False,
                 error: Optional[str] = None):
         slot = self._slots[slot_id]
@@ -1811,6 +2239,8 @@ class ContinuousBatchingEngine:
         # bumps _step_epoch and every later commit point in THIS thread
         # raises _AbandonedStep instead of racing the live loop
         token = self._step_epoch if wd is not None else None
+        if self.unified:
+            return self._step_unified(token, pipeline=False)
         if wd is not None:
             wd.phase = "admit"
         self._admit(token)
@@ -1831,6 +2261,8 @@ class ContinuousBatchingEngine:
         speculative chunk harmless (see module docstring)."""
         wd = self._watchdog
         token = self._step_epoch if wd is not None else None
+        if self.unified:
+            return self._step_unified(token, pipeline=True)
         if wd is not None:
             wd.phase = "admit"
         self._admit(token)
@@ -1937,26 +2369,104 @@ class ContinuousBatchingEngine:
         the epoch bump, so the abandoned step thread can never commit
         tokens into (or dispatch against the pages of) the request we
         reset here."""
+        # unified path: a timeout while the prefilling request's
+        # window HAS ridden a dispatch blames THAT request first — the
+        # decode scan is the long-proven program, and blaming decode
+        # would serially fail up to `slots` innocent rows against a
+        # deterministically hanging window (the same window
+        # re-dispatches every step — `done` never advanced).
+        # requeue_hung still gives it its one retry; its committed
+        # chunks release through the refcounted pool. A freshly
+        # admitted request whose window never dispatched (the timeout
+        # hit the drain of a pure-decode chunk from BEFORE admission)
+        # is innocent — the split decode-victim policy applies.
+        if self._prefilling is not None                 and self._prefilling.get("dispatched"):
+            self._fail_prefilling(exc)
+            return True
         live = [i for i, s in enumerate(self._slots) if s.req is not None]
         if not live:
+            if self._prefilling is not None:
+                # nothing else to blame: the undispatched-window edge
+                # collapses back onto the prefilling request
+                self._fail_prefilling(exc)
+                return True
             return False
         victim = live[0]
-        tr, mt = self._tracer, self._metrics
         if self._requeue_hung and not self._slots[victim].req.requeued:
             self._requeue_slot(victim)
             return True
         self.hung_retired += 1
+        self._emit_hung_retire(victim, exc)
+        self._retire(victim, failed=True, error=str(exc))
+        return True
+
+    def _emit_hung_retire(self, slot, exc):
+        """The watchdog.retire_hung_slot tracer/metrics emission shared
+        by the slot-victim and prefilling-victim paths (slot is None
+        for the latter)."""
+        tr, mt = self._tracer, self._metrics
         if tr is not None:
-            tr.instant("watchdog.retire_hung_slot", slot=victim,
+            tr.instant("watchdog.retire_hung_slot", slot=slot,
                        phase=getattr(exc, "phase", None),
                        elapsed_s=getattr(exc, "elapsed_s", None))
         if mt is not None:
             mt.counter("hung_slots_retired").inc()
-            mt.event("watchdog.retire_hung_slot", slot=victim,
+            mt.event("watchdog.retire_hung_slot", slot=slot,
                      phase=getattr(exc, "phase", None),
                      timeout_s=getattr(exc, "timeout_s", None))
-        self._retire(victim, failed=True, error=str(exc))
-        return True
+
+    def _emit_hung_requeue(self, slot, req):
+        """The watchdog.requeue_hung_slot emission shared by both
+        requeue paths."""
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("watchdog.requeue_hung_slot", slot=slot,
+                       req_id=req.req_id)
+        if mt is not None:
+            mt.counter("hung_slots_requeued").inc()
+            mt.event("watchdog.requeue_hung_slot", slot=slot,
+                     req_id=req.req_id)
+
+    def _fail_prefilling(self, exc):
+        """Watchdog victim = the request mid-chunked-prefill (unified
+        path, no decode slot to blame): release its reservation through
+        the refcount-aware pool (shared prefix pages another slot maps
+        stay pinned) and fail it — or, under `requeue_hung`, give it
+        its one retry from the head of `waiting` (prefill restarts at
+        the prompt; the committed windows' pages were released, never
+        recycled in place). Called under `_commit_lock` after the epoch
+        bump, like `_retire_hung_slot`."""
+        st, self._prefilling = self._prefilling, None
+        req = st["req"]
+        self.mgr.free(req.pages)
+        req.pages = None
+        req.n_prefix = 0
+        req.cached_tokens = 0
+        req.bucket = None
+        if self._requeue_hung and not req.requeued:
+            req.requeued = True
+            self.hung_requeued += 1
+            self.waiting.insert(0, req)
+            self._emit_hung_requeue(None, req)
+            return
+        self.hung_retired += 1
+        req.finish_time = time.perf_counter()
+        # every request in `finished` carries a prefill_time (the split
+        # path prefills before any failure can land) — a mid-prefill
+        # failure pins it to finish_time so TTFT consumers iterating
+        # `finished` never hit a None hole
+        if req.prefill_time is None:
+            req.prefill_time = req.finish_time
+        req.failed = True
+        req.error = str(exc)
+        self.finished.append(req)
+        self._emit_hung_retire(None, exc)
+        tr, mt = self._tracer, self._metrics
+        if tr is not None:
+            tr.instant("req.retire", req_id=req.req_id, slot=None,
+                       tokens=len(req.tokens), failed=True)
+        if mt is not None:
+            mt.counter("requests_failed").inc()
 
     def _requeue_slot(self, slot_id: int):
         """Put a hung slot's request back at the head of `waiting` for
@@ -1985,11 +2495,4 @@ class ContinuousBatchingEngine:
         self._budgets[slot_id] = 0
         self._override[slot_id] = True
         self.waiting.insert(0, req)
-        tr, mt = self._tracer, self._metrics
-        if tr is not None:
-            tr.instant("watchdog.requeue_hung_slot", slot=slot_id,
-                       req_id=req.req_id)
-        if mt is not None:
-            mt.counter("hung_slots_requeued").inc()
-            mt.event("watchdog.requeue_hung_slot", slot=slot_id,
-                     req_id=req.req_id)
+        self._emit_hung_requeue(slot_id, req)

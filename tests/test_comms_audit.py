@@ -468,14 +468,15 @@ class TestEngineAudit(unittest.TestCase):
     def test_mp2_decode_wire_matches_hand_reference(self):
         """ACCEPTANCE: the mp=2 decode chunk's predicted bytes-on-wire
         matches the hand-computed one-all-gather-per-layer reference
-        within 10%. The gathered payload is the attention output at its
-        f32 accumulation dtype (itemsize 4 — the auditor surfaced that
-        the bf16 downcast happens at the o-proj, AFTER the gather):
-        per token per chip = layers x nh x dh x 4 x (mp-1)/mp."""
+        within 10%. The gathered payload is BF16 (itemsize 2 — ISSUE
+        14 satellite: `ServingTP.gather_heads` now casts an f32
+        attention output to bf16 BEFORE the wire; PR 11's auditor had
+        surfaced the downcast landing at the o-proj, after it):
+        per token per chip = layers x nh x dh x 2 x (mp-1)/mp."""
         eng, cfg = _tiny_engine(mp=2)
         fleet = eng.audit_comms(programs=("decode",))
         ref = cfg.num_hidden_layers * cfg.num_attention_heads \
-            * cfg.head_dim * 4 * (2 - 1) / 2
+            * cfg.head_dim * 2 * (2 - 1) / 2
         got = fleet["predicted_bytes_on_wire_per_token"]
         self.assertLessEqual(abs(got - ref) / ref, 0.10,
                              f"est {got} vs ref {ref}")
@@ -508,7 +509,7 @@ class TestEngineAudit(unittest.TestCase):
         prefill variants carry their own per-layer gathers; TPU803
         fires on the unquantized decode gather once its threshold
         covers the payload (ACCEPTANCE)."""
-        eng, cfg = _tiny_engine(mp=2)
+        eng, cfg = _tiny_engine(mp=2, unified_step=False)  # split fleet
         eng.warm([16], prefix_widths=[1], audit_comms=True)
         fleet = eng.metrics()["comms_audit"]
         self.assertIsNotNone(fleet)

@@ -294,7 +294,8 @@ class TestEngineInt8(unittest.TestCase):
         eng = ContinuousBatchingEngine(
             cfg, params, slots=2, prompt_bucket=8, max_prompt_len=24,
             max_new_tokens=6, block_size=8, steps_per_sync=3,
-            prefill_batch=2, prefix_cache=True, kv_cache_dtype="int8")
+            prefill_batch=2, prefix_cache=True, kv_cache_dtype="int8",
+            unified_step=False)  # split program keys under test
         eng.warm([8, 16, 24])
         before = eng.compile_stats()
         self.assertTrue(all(":int8" in k or k == "decode"

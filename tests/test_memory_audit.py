@@ -304,11 +304,19 @@ class TestEngineAudit(unittest.TestCase):
         """The acceptance gate: every pool-threading program the engine
         warms is donation-clean — TPU701 silent across the whole cache
         at mp=1 AND mp=2."""
-        for mp in (1, 2):
-            eng = _tiny_engine(mp=mp)
+        # mp=1 audits the SPLIT fleet (decode + every prefill
+        # variant), mp=2 the UNIFIED fleet (decode + the one mixed
+        # prefill+decode program, ISSUE 14) — both must thread the
+        # donated pools cleanly
+        for mp, unified in ((1, False), (2, True)):
+            eng = _tiny_engine(mp=mp, unified_step=unified)
             eng.warm([16, 32])
             fleet = eng.audit_memory()
-            self.assertGreaterEqual(fleet["programs_audited"], 5)
+            if unified:
+                self.assertEqual(fleet["programs_audited"], 2)
+                self.assertIn("unified", fleet["programs"])
+            else:
+                self.assertGreaterEqual(fleet["programs_audited"], 5)
             self.assertTrue(fleet["donation_clean"], fleet)
             for name, prog in fleet["programs"].items():
                 self.assertEqual(prog["donation_misses"], 0, name)

@@ -1,0 +1,211 @@
+"""Ragged paged attention (ISSUE 14 tentpole): interpret-mode kernel
+parity vs the jnp oracle across mixed (cached_len, new_len) rows —
+decode rows (new_len=1), cold prefill rows (cached_len=0), chunked
+prefill rows, pad rows (new_len=0) — including token-granular cached
+lengths that end MID-PAGE (the generalization beyond
+prefix_prefill's whole-page contract), GQA group 1/2/4 and full MQA,
+int8 pools, and explicit block overrides."""
+import math
+import unittest
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.ragged_attention import (
+    fit_blocks, ragged_paged_attention, ragged_paged_attention_reference)
+from paddle_tpu.models.llama import quantize_kv_pages
+
+
+def _setup(b=3, tn=16, nh=4, nkv=2, dh=128, page=8, max_pages=32,
+           seed=0, quant=False):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, tn, nh, dh)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(b, tn, nkv, dh)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(b, tn, nkv, dh)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(max_pages, nkv, page, dh)),
+                     jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(max_pages, nkv, page, dh)),
+                     jnp.float32)
+    if quant:
+        kc, ks = quantize_kv_pages(kc)
+        vc, vs = quantize_kv_pages(vc)
+        return q, k_new, v_new, kc, vc, ks, vs, rng
+    return q, k_new, v_new, kc, vc, None, None, rng
+
+
+def _tables(rng, b, w, max_pages):
+    """Distinct page ids per row (rows must not alias pages)."""
+    ids = rng.permutation(max_pages)[:b * w]
+    return jnp.asarray(ids.reshape(b, w), jnp.int32)
+
+
+class TestRaggedKernelParity(unittest.TestCase):
+    def _check(self, cached, new, *, b=None, tn=16, nh=4, nkv=2, dh=128,
+               page=8, quant=False, blocks=None, seed=0, atol=2e-5):
+        b = len(cached) if b is None else b
+        w = max(1, -(-max(cached) // page)) if max(cached) else 1
+        q, k_new, v_new, kc, vc, ks, vs, rng = _setup(
+            b=b, tn=tn, nh=nh, nkv=nkv, dh=dh, page=page,
+            max_pages=max(2 * b * w, 8), seed=seed, quant=quant)
+        tbl = _tables(rng, b, w, max(2 * b * w, 8))
+        clens = jnp.asarray(cached, jnp.int32)
+        nlens = jnp.asarray(new, jnp.int32)
+        kw = dict(k_scale=ks, v_scale=vs) if quant else {}
+        got = ragged_paged_attention(
+            q, k_new, v_new, kc, vc, tbl, clens, nlens,
+            **(dict(block_q=blocks[0], block_n=blocks[1])
+               if blocks else {}), **kw)
+        want = ragged_paged_attention_reference(
+            q, k_new, v_new, kc, vc, tbl, clens, nlens, **kw)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), atol=atol,
+                                   err_msg=f"cached={cached} new={new}")
+        return got
+
+    def test_mixed_decode_prefill_chunk_rows_one_grid(self):
+        """THE tentpole shape: a decode row (new=1, deep cache), a cold
+        prefill row (cached=0, full window), a chunked prefill row
+        (both nonzero), and a pad row (new=0) in ONE launch."""
+        out = self._check(cached=[24, 0, 16, 0], new=[1, 16, 8, 0])
+        # the pad row emits exact zeros everywhere
+        self.assertEqual(float(jnp.abs(out[3]).max()), 0.0)
+        # decode row: positions >= new_len are exact zeros too
+        self.assertEqual(float(jnp.abs(out[0][1:]).max()), 0.0)
+        self.assertTrue(bool(jnp.all(jnp.isfinite(out))))
+
+    def test_mid_page_cached_lens(self):
+        """Token-granular cached lengths ending mid-page — the partial
+        last page streams (ceil pinning) and masks inside."""
+        self._check(cached=[5, 13, 21], new=[1, 4, 16], b=3)
+
+    def test_decode_rows_all_depths(self):
+        """All-decode launch (every row new_len=1) across ragged depths
+        incl. exact page boundaries."""
+        self._check(cached=[1, 8, 9, 24], new=[1, 1, 1, 1], b=4)
+
+    def test_gqa_groups(self):
+        for nh, nkv in ((2, 2), (4, 2), (8, 2), (4, 1)):  # 1/2/4, MQA
+            self._check(cached=[10, 0, 17], new=[2, 16, 5],
+                        nh=nh, nkv=nkv, seed=nh * 10 + nkv)
+
+    def test_bf16_window(self):
+        q, k_new, v_new, kc, vc, _, _, rng = _setup(seed=3)
+        to16 = lambda x: x.astype(jnp.bfloat16)
+        tbl = _tables(rng, 3, 3, 32)
+        clens = jnp.asarray([20, 0, 7], jnp.int32)
+        nlens = jnp.asarray([1, 16, 9], jnp.int32)
+        got = ragged_paged_attention(to16(q), to16(k_new), to16(v_new),
+                                     to16(kc), to16(vc), tbl, clens,
+                                     nlens)
+        want = ragged_paged_attention_reference(
+            to16(q), to16(k_new), to16(v_new), to16(kc), to16(vc), tbl,
+            clens, nlens)
+        self.assertEqual(got.dtype, jnp.bfloat16)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), atol=2e-2)
+
+    def test_int8_pools(self):
+        self._check(cached=[24, 0, 13], new=[1, 16, 6], quant=True,
+                    atol=2e-4)
+
+    def test_int8_decode_rows_mid_page(self):
+        self._check(cached=[3, 11, 19, 22], new=[1, 1, 1, 1], b=4,
+                    quant=True, atol=2e-4)
+
+    def test_explicit_blocks_multi_tile(self):
+        """Explicit (block_q, block_n) exercising multiple q tiles and
+        window blocks per row."""
+        self._check(cached=[16, 9, 0], new=[16, 3, 12], blocks=(4, 8))
+        self._check(cached=[16, 9, 0], new=[16, 3, 12], blocks=(8, 4))
+
+    def test_window_not_page_granular(self):
+        """tn that is not a whole number of KV pages is legal — only
+        the cached phase is page-granular."""
+        self._check(cached=[8, 16], new=[12, 1], tn=12, b=2)
+
+    def test_bad_blocks_raise(self):
+        q, k_new, v_new, kc, vc, _, _, rng = _setup()
+        tbl = _tables(rng, 3, 2, 32)
+        clens = jnp.zeros((3,), jnp.int32)
+        with self.assertRaisesRegex(ValueError, "must divide"):
+            ragged_paged_attention(q, k_new, v_new, kc, vc, tbl, clens,
+                                   block_q=5)
+
+    def test_int8_without_scales_raises(self):
+        q, k_new, v_new, kc, vc, ks, vs, rng = _setup(quant=True)
+        tbl = _tables(rng, 3, 2, 32)
+        clens = jnp.zeros((3,), jnp.int32)
+        with self.assertRaisesRegex(ValueError, "k_scale"):
+            ragged_paged_attention(q, k_new, v_new, kc, vc, tbl, clens)
+        with self.assertRaisesRegex(ValueError, "int8"):
+            ragged_paged_attention(q, k_new, v_new, kc.astype(jnp.float32),
+                                   vc.astype(jnp.float32), tbl, clens,
+                                   k_scale=ks, v_scale=vs)
+
+    def test_fit_blocks_divide(self):
+        for tn in (1, 12, 16, 64, 96, 256):
+            bq, bn = fit_blocks(tn, 2, 128)
+            self.assertEqual(tn % bq, 0)
+            self.assertEqual(tn % bn, 0)
+
+    def test_matches_prefix_prefill_on_whole_page_lens(self):
+        """On prefix_prefill's home turf (whole-page cached lens) the
+        ragged kernel agrees with the prefix kernel bitwise at the same
+        blocks — the unified engine's cached-prefix rows reproduce the
+        split engine's math."""
+        from paddle_tpu.kernels.prefix_prefill import \
+            prefix_prefill_attention
+
+        q, k_new, v_new, kc, vc, _, _, rng = _setup(seed=5)
+        tbl = _tables(rng, 3, 3, 32)
+        clens = jnp.asarray([24, 8, 0], jnp.int32)
+        nlens = jnp.asarray([16, 9, 16], jnp.int32)
+        got = ragged_paged_attention(q, k_new, v_new, kc, vc, tbl,
+                                     clens, nlens, block_q=8, block_n=8)
+        want = prefix_prefill_attention(q, k_new, v_new, kc, vc, tbl,
+                                        clens, nlens, block_q=8,
+                                        block_s=8)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestConstraintRegistry(unittest.TestCase):
+    def test_registered_with_roofline(self):
+        from paddle_tpu.kernels.constraints import constraint_for_kernel_fn
+
+        for fn, cname in (("_ragged_attention_kernel",
+                           "ragged_attention"),
+                          ("_ragged_attention_q8_kernel",
+                           "ragged_attention_q8")):
+            c = constraint_for_kernel_fn(fn, "ragged_attention.py")
+            self.assertIsNotNone(c, fn)
+            self.assertEqual(c.name, cname)
+            self.assertIsNotNone(c.roofline)
+
+    def test_roofline_counts_table_pages_not_pool(self):
+        """The cached-phase byte model prices the POOL PAGES the table
+        names (q_rows * w * page * dh), never the whole pool."""
+        from paddle_tpu.kernels.ragged_attention import \
+            _ragged_attention_roofline
+
+        b, nkv, nq, bqg, dh, page, w, bn = 2, 2, 1, 16, 128, 8, 3, 16
+        shapes = [(b, w), (b,), (b,),
+                  (b * nkv * nq, bqg, dh), (64 * nkv, page, dh),
+                  (64 * nkv, page, dh), (b * nkv, bn, dh),
+                  (b * nkv, bn, dh)]
+        dtypes = ["int32", "int32", "int32", "bfloat16", "bfloat16",
+                  "bfloat16", "bfloat16", "bfloat16"]
+        out = _ragged_attention_roofline(shapes, dtypes)
+        q_rows = b * nkv * nq
+        q_elems = q_rows * bqg * dh
+        want_bytes = (2 * q_elems * 2                 # q + out
+                      + 2 * q_rows * w * page * dh * 2  # table pages
+                      + 2 * b * nkv * bn * dh * 2)      # window k/v
+        self.assertEqual(out["hbm_bytes"], want_bytes)
+        self.assertEqual(out["flops"], 4 * q_elems * (w * page + bn))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -812,10 +812,14 @@ class TestEngineWatchdog(unittest.TestCase):
         pb = shared + rng.integers(1, cfg.vocab_size, (4,)).tolist()
 
         def engine(prefix):
+            # split path pinned: the hang must land on A's DECODE
+            # dispatch (post-insert) — the unified engine's first seam
+            # crossing is A's prefill window, a different victim
+            # (test_unified_step covers the unified watchdog timeline)
             return ContinuousBatchingEngine(
                 cfg, params, slots=2, prompt_bucket=8, max_prompt_len=16,
                 max_new_tokens=4, block_size=8, steps_per_sync=2,
-                prefix_cache=prefix)
+                prefix_cache=prefix, unified_step=False)
 
         ref = engine(False)
         ref_b = ref.add_request(pb)

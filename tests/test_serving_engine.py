@@ -123,7 +123,7 @@ class TestContinuousBatchingEngine(unittest.TestCase):
         eng = ContinuousBatchingEngine(
             cfg, params, slots=4, prompt_bucket=8, max_prompt_len=8,
             max_new_tokens=5, block_size=8, steps_per_sync=5,
-            prefill_batch=4)
+            prefill_batch=4, unified_step=False)  # split-path contract
         for pr in prompts:
             eng.add_request(pr)
         eng.run(max_iters=50)
@@ -258,7 +258,8 @@ class TestPrefixCacheEngine(unittest.TestCase):
             eng = ContinuousBatchingEngine(
                 cfg, params, slots=2, prompt_bucket=8, max_prompt_len=24,
                 max_new_tokens=6, block_size=8, steps_per_sync=3,
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache,
+                unified_step=False)  # split batched-admission counts
             for pr in prompts:
                 eng.add_request(pr)
             eng.run(max_iters=200)
@@ -295,7 +296,8 @@ class TestPrefixCacheEngine(unittest.TestCase):
         eng = ContinuousBatchingEngine(
             cfg, params, slots=1, prompt_bucket=16, max_prompt_len=32,
             max_new_tokens=8, block_size=8, steps_per_sync=4,
-            prefix_cache=True)  # default max_pages: cold-path sized
+            prefix_cache=True,
+            unified_step=False)  # split planner's trim under test
         r1 = eng.add_request(prompt)   # cold: 5 pages, inserts 3 blocks
         r2 = eng.add_request(prompt)   # hit: untrimmed would need 3+3=6
         eng.run(max_iters=100)
@@ -416,7 +418,8 @@ class TestPerRequestAdmission(unittest.TestCase):
         eng = ContinuousBatchingEngine(
             cfg, params, slots=2, prompt_bucket=8, max_prompt_len=8,
             max_new_tokens=8, block_size=4, steps_per_sync=2,
-            max_pages=7, prefill_batch=2, prefix_cache=False)
+            max_pages=7, prefill_batch=2, prefix_cache=False,
+            unified_step=False)  # split batched-admission packing
         # per-request: ceil((8+1)/4)=3 pages each; 3+3=6 <= 6 available.
         # engine-budget math (ceil((8+8)/4)=4) would stop the batch at 1.
         r1 = eng.add_request(rng.integers(1, cfg.vocab_size, (5,)).tolist(),
@@ -478,7 +481,8 @@ class TestCompileGuard(unittest.TestCase):
         eng = ContinuousBatchingEngine(
             cfg, params, slots=2, prompt_bucket=8, max_prompt_len=24,
             max_new_tokens=4, block_size=8, steps_per_sync=2,
-            prefill_batch=1, prefix_cache=True)
+            prefill_batch=1, prefix_cache=True,
+            unified_step=False)  # split program-key ladder under test
         self.assertEqual(eng._prefix_width_ladder(), [1, 2])
         eng.warm(buckets=[8, 16, 24])
         before = eng.compile_stats()

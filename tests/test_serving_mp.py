@@ -26,7 +26,16 @@ def _tiny_setup(nkv=2, seed=21):
     cfg = dataclasses.replace(LlamaConfig.tiny(), num_key_value_heads=nkv)
     paddle.seed(seed)
     model = LlamaForCausalLM(cfg)
-    return cfg, model, dict(model.raw_state())
+    # bf16 params = the production serving regime: the o-proj gather
+    # payload is bf16 on BOTH the mp=1 and mp>1 paths (ISSUE 14
+    # satellite casts an f32 stream to bf16 before the wire — identity
+    # across mp degrees is asserted at the dtype serving actually runs)
+    import jax.numpy as jnp
+
+    params = {k: (v.astype(jnp.bfloat16) if v.dtype == jnp.float32
+                  else v)
+              for k, v in dict(model.raw_state()).items()}
+    return cfg, model, params
 
 
 def _engine(cfg, params, mp=1, disaggregated=False, kv="bf16",
@@ -265,7 +274,8 @@ class TestCompileGuardMP(unittest.TestCase):
         cfg, _, params = _tiny_setup()
         rng = np.random.default_rng(19)
         eng = _engine(cfg, params, mp=2, prefill_batch=1,
-                      prefix_cache=True)
+                      prefix_cache=True,
+                      unified_step=False)  # split program keys under test
         eng.warm(buckets=[8, 16])
         before = eng.compile_stats()
         self.assertNotIn(-1, before.values(),
@@ -363,7 +373,8 @@ class TestWatchdogSharded(unittest.TestCase):
         ref.run(max_iters=100)
 
         eng = _engine(cfg, params, mp=2, max_new_tokens=4,
-                      steps_per_sync=2)
+                      steps_per_sync=2,
+                      unified_step=False)  # split watchdog semantics
         ra = eng.add_request(pa)
         eng.warm(buckets=[8, 16])  # compiles land before the deadline
         eng.step()                 # A prefills, inserts the shared block

@@ -220,6 +220,52 @@ def check_prefix_prefill():
             if err > 5e-2 else None)
 
 
+def check_ragged_step():
+    """Unified ragged paged attention on silicon (ISSUE 14): decode
+    rows (new_len=1), a cold prefill row, and a chunked row whose
+    cached length ends MID-PAGE coexist in ONE grid at the serving GQA
+    ratio — against the gathered masked-softmax oracle (= the unified
+    engine's fallback path). Runs the bf16 pools; the int8 variant
+    rides check_kv_quant's scale plumbing, so here the bf16 grid is
+    the contract."""
+    from paddle_tpu.kernels.ragged_attention import (
+        ragged_paged_attention, ragged_paged_attention_reference)
+
+    rng = np.random.default_rng(9)
+    B, TN, HQ, HK, D, BS, W = 4, 128, 16, 4, 128, 64, 4
+    max_pages = B * W + 1
+    q = jnp.asarray(rng.normal(size=(B, TN, HQ, D)), jnp.bfloat16)
+    kn = jnp.asarray(rng.normal(size=(B, TN, HK, D)), jnp.bfloat16)
+    vn = jnp.asarray(rng.normal(size=(B, TN, HK, D)), jnp.bfloat16)
+    kc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)),
+                     jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(max_pages, HK, BS, D)),
+                     jnp.bfloat16)
+    tables = jnp.asarray([[j * B + i + 1 for j in range(W)]
+                          for i in range(B)], jnp.int32)
+    # decode row / decode row mid-page / cold prefill / chunked partial
+    clens = jnp.asarray([4 * BS, 2 * BS + 17, 0, BS + 5], jnp.int32)
+    nlens = jnp.asarray([1, 1, TN, 70], jnp.int32)
+    out = jax.jit(lambda a: ragged_paged_attention(
+        a, kn, vn, kc, vc, tables, clens, nlens))(q)
+    if not bool(jnp.isfinite(out.astype(jnp.float32)).all()):
+        return "ragged step emitted non-finite values"
+    ref = jax.jit(lambda a: ragged_paged_attention_reference(
+        a, kn, vn, kc, vc, tables, clens, nlens))(q)
+    err = 0.0
+    for row, nl in enumerate([1, 1, TN, 70]):
+        err = max(err, float(jnp.max(jnp.abs(
+            out[row, :nl].astype(jnp.float32) - ref[row, :nl]))))
+    if err > 5e-2:
+        return f"ragged step max err {err:.4f} > 5e-2"
+    # pad rows beyond new_lens must be exact zeros on chip too
+    for row, nl in enumerate([1, 1, TN, 70]):
+        if nl < TN and float(jnp.max(jnp.abs(
+                out[row, nl:].astype(jnp.float32)))) != 0.0:
+            return f"ragged step row {row} pad positions not zero"
+    return None
+
+
 def check_kv_quant():
     """int8 paged KV cache on silicon (ISSUE 5): the dequantize-in-kernel
     paged GQA decode and prefix-prefill paths against (a) the same math
@@ -471,6 +517,7 @@ CHECKS = [
     ("decode_paged", check_decode_paged),
     ("decode_paged_gqa", check_decode_paged_gqa),
     ("prefix_prefill", check_prefix_prefill),
+    ("ragged_step", check_ragged_step),
     ("kv_quant", check_kv_quant),
     ("decode_megakernel", check_decode_megakernel),
     ("int4_matmul", check_int4_matmul),
