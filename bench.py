@@ -393,7 +393,8 @@ def _op_bench(only=None):
             }
         del gp
 
-    def _serving_chunk_harness(serving_mp=1):
+    def _serving_chunk_harness(serving_mp=1, quantized_collectives=False,
+                               compile_run=True):
         """The 1B engine decode-chunk timing rig shared by the
         serving_decode_chunk and decode_step_1b_mp rows: an 8-slot
         steps_per_sync=16 engine whose chunks are timed by chaining N
@@ -424,7 +425,8 @@ def _op_bench(only=None):
             # describes the multi-kernel path's bf16 o-proj all-gather;
             # the megakernel TP path's collective is an f32 psum at
             # full hidden width (its own row when the default flips)
-            decode_megakernel=False)
+            decode_megakernel=False,
+            quantized_collectives=quantized_collectives)
         stables = jnp.full((eng.slots, eng.table_width), eng.scratch_page,
                            jnp.int32)
         slive = jnp.ones((eng.slots,), bool)
@@ -454,7 +456,8 @@ def _op_bench(only=None):
 
             return run
 
-        make_run()(1)  # compile once
+        if compile_run:
+            make_run()(1)  # compile once
         return eng, make_run
 
     if want("serving_decode_chunk"):
@@ -529,6 +532,26 @@ def _op_bench(only=None):
         tgraphs = teng._traced_inventory(programs=("decode",))
         troof = teng.audit_roofline(programs=("decode",),
                                     graphs=tgraphs)["programs"]["decode"]
+        # quantized-collectives twin (ISSUE 15): the SAME chunk
+        # program with FLAGS_quantized_collectives ON — the o-proj
+        # gather ships int8 + an f32 scale sidecar. Audit-only (no
+        # timing until the default flips): the predicted wire bytes
+        # land next to the bf16 row's so the ~2x ratio is recorded,
+        # and the hand formula prices payload (1 byte/elt) + sidecar
+        # (4 bytes per block of min(128, dh) elements).
+        from paddle_tpu.parallel.collectives import QCOLL_BLOCK
+
+        # the SAME rig, quantized (audit-only: no compile) — one set of
+        # engine literals lives in _serving_chunk_harness
+        qeng, _ = _serving_chunk_harness(serving_mp=2,
+                                         quantized_collectives=True,
+                                         compile_run=False)
+        qwire = qeng.audit_comms(
+            programs=("decode",),
+            graphs=qeng._traced_inventory(programs=("decode",))
+        )["predicted_bytes_on_wire_per_token"]
+        dh_ = tcfg.head_dim
+        nblk = -(-dh_ // min(QCOLL_BLOCK, dh_))
         OP_INFO["decode_step_1b_mp"] = {
             "mp": mp_,
             "bytes_all_gathered_per_token": int(
@@ -540,6 +563,14 @@ def _op_bench(only=None):
             "predicted_bytes_on_wire_per_token": int(
                 teng.audit_comms(programs=("decode",), graphs=tgraphs)
                 ["predicted_bytes_on_wire_per_token"]),
+            # int8 quantized-collectives twin (ISSUE 15): payload
+            # 1 byte/elt + f32 sidecar per min(128, dh)-elt block —
+            # ~0.5x the bf16 hand formula above; the measured row
+            # rides the next TPU run once the flag default flips
+            "bytes_all_gathered_per_token_int8coll": int(
+                tcfg.num_hidden_layers * tcfg.num_attention_heads
+                * (tcfg.head_dim * 1 + nblk * 4) * (mp_ - 1) // mp_),
+            "predicted_bytes_on_wire_per_token_int8coll": int(qwire),
             # per-chip under kv-head sharding — pairs with the mp=1
             # row's estimate to confirm the 1/mp pool scaling on device
             "predicted_peak_hbm_bytes": teng.audit_memory(
@@ -551,7 +582,52 @@ def _op_bench(only=None):
             "predicted_mfu": troof["predicted_mfu"],
             "predicted_bound": troof["bound"],
         }
-        del teng, trun
+        # the recorded ~2x: bf16 wire / int8coll wire per decoded token
+        OP_INFO["decode_step_1b_mp"]["int8coll_wire_ratio"] = round(
+            OP_INFO["decode_step_1b_mp"]
+            ["predicted_bytes_on_wire_per_token"] / max(qwire, 1), 3)
+        del teng, trun, qeng
+
+    if want("fit_dp_psum") and len(jax.devices()) >= 2:
+        # dp gradient-sync wire bytes, unquantized vs int8coll (ISSUE
+        # 15): Model.fit(audit_comms=True) under a dp=2 mesh audits
+        # the EXPLICIT dp step — `lax.psum` over the grads (what GSPMD
+        # inserts), or the quantized two-hop exchange with
+        # quantized_collectives=True, which also RUNS one real
+        # quantized-dp training batch. The bytes delta is the
+        # quantized-collectives win on the training seam; audit-only
+        # info, the gated OPBENCH row update rides the next TPU run.
+        import paddle_tpu as _pd
+        from paddle_tpu import nn as _nn, optimizer as _opt
+        from paddle_tpu.parallel import mesh as _mesh
+
+        prev_mesh = _mesh.get_global_mesh()
+        try:
+            _mesh.set_global_mesh(_mesh.build_mesh(
+                {"dp": 2}, devices=jax.devices()[:2]))
+            fit_rows = {}
+            for tag, qc in (("bytes_on_wire", False),
+                            ("bytes_on_wire_int8coll", True)):
+                _pd.seed(5)
+                fnet = _nn.Linear(512, 512)
+                fm = _pd.Model(fnet)
+                fm.prepare(
+                    optimizer=_opt.Adam(learning_rate=0.01,
+                                        parameters=fnet.parameters()),
+                    loss=lambda out, y: ((out - y) ** 2).mean())
+                frng = np.random.default_rng(0)
+                fb = [(frng.normal(size=(4, 512)).astype(np.float32),
+                       frng.normal(size=(4, 512)).astype(np.float32))]
+                fm.fit(fb, epochs=1, verbose=0, audit_comms=True,
+                       quantized_collectives=qc)
+                fit_rows[tag] = int(fm.comms_audit["bytes_on_wire"])
+            fit_rows["int8coll_wire_ratio"] = round(
+                fit_rows["bytes_on_wire"]
+                / max(fit_rows["bytes_on_wire_int8coll"], 1), 3)
+            fit_rows["quantized_dp_steps"] = fm.quantized_dp_steps
+            OP_INFO["fit_dp_psum"] = fit_rows
+        finally:
+            _mesh.set_global_mesh(prev_mesh)
 
     if want("ragged_step"):
         # unified ragged serving step (ISSUE 14): ONE program running a

@@ -50,7 +50,12 @@ Traces:
   the sharded programs are token-identical by construction),
   aggregate_cacheable_pages (equal across mp at the same per-chip
   budget ratio) and kv_pool_bytes_per_chip_ratio (~1/mp). Rows whose
-  mp exceeds the visible device count are skipped with a note.
+  mp exceeds the visible device count are skipped with a note. A
+  fifth policy ("sharded mp=2+int8coll", ISSUE 15) serves the mp=2
+  row with FLAGS_quantized_collectives ON — the o-proj gather ships
+  int8 + f32 scale sidecars; token_match_vs_mp1 guards accuracy at
+  the int8-KV bar and int8coll_wire_bytes_ratio records the
+  predicted ~2x wire win.
 
 Every engine row also reports pool capacity at trace end
 (kv_cache_dtype, kv_pool_bytes via PagedKVManager.kv_pool_bytes(),
@@ -193,6 +198,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
                warm_prefix_widths=None, prefix_kernel=True,
                prefill_batch=4, kv_cache_dtype=None, kv_pool_bytes=None,
                megakernel=False, serving_mp=1, disaggregated=False,
+               quantized_collectives=None,
                unified=False, token_budget=None,
                tracer=None, with_metrics=True):
     import paddle_tpu as paddle
@@ -220,6 +226,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
             double_buffer=double_buffer, kv_cache_dtype=kv_cache_dtype,
             kv_pool_bytes=kv_pool_bytes, decode_megakernel=megakernel,
             serving_mp=serving_mp, disaggregated=disaggregated,
+            quantized_collectives=quantized_collectives,
             # policies are pinned explicitly: existing rows keep the
             # SPLIT scheduler they were written against; the `mixed`
             # trace runs both and compares (ISSUE 14)
@@ -667,11 +674,20 @@ def main():
     arrivals, prompts, targets = make_trace(n, seed, rate_req_s=20.0,
                                             variance="shared_prefix")
     mpl, buckets = 2 * PROMPT_BUCKET, [PROMPT_BUCKET, 2 * PROMPT_BUCKET]
-    sharded = [("sharded mp=1", 1, False), ("sharded mp=2", 2, False),
-               ("sharded mp=4", 4, False),
-               ("sharded mp=2+disagg", 2, True)]
+    # sharded-mp2+int8coll (ISSUE 15): the mp=2 row with
+    # FLAGS_quantized_collectives ON — the per-layer o-proj gather
+    # ships int8 + an f32 scale sidecar. token_match_vs_mp1 guards
+    # accuracy (bar: the int8-KV match rate, not identity — the
+    # payload is quantized), and the summary's
+    # int8coll_wire_bytes_ratio records the predicted wire win vs the
+    # bf16 mp=2 gather.
+    sharded = [("sharded mp=1", 1, False, False),
+               ("sharded mp=2", 2, False, False),
+               ("sharded mp=4", 4, False, False),
+               ("sharded mp=2+disagg", 2, True, False),
+               ("sharded mp=2+int8coll", 2, False, True)]
     rows, toks = [], []
-    for pol, mp, disagg in sharded:
+    for pol, mp, disagg, qcoll in sharded:
         if n_dev < mp:
             print(json.dumps({"trace": "sharded", "policy": pol,
                               "skipped": f"needs {mp} devices, "
@@ -680,7 +696,8 @@ def main():
         row = run_engine(cfg, p, arrivals, prompts, targets,
                          policy=pol, prefix_cache=True,
                          max_prompt_len=mpl, warm_buckets=buckets,
-                         serving_mp=mp, disaggregated=disagg)
+                         serving_mp=mp, disaggregated=disagg,
+                         quantized_collectives=qcoll)
         toks.append(row.pop("_tokens", None))
         row["trace"] = "sharded"
         print(json.dumps(row), flush=True)
@@ -690,6 +707,9 @@ def main():
         print(json.dumps({
             "trace": "sharded", "summary": True,
             # token identity vs single-chip is the acceptance bar (1.0)
+            # for the bf16-gather rows; the +int8coll row is
+            # quantization noise BY DESIGN — its bar is the int8-KV
+            # match rate, not 1.0
             "token_match_vs_mp1": {
                 r["policy"]: _token_match_rate(toks[0], t)
                 for r, t in zip(rows[1:], toks[1:])},
@@ -703,6 +723,16 @@ def main():
                 r["policy"]: round(r["kv_pool_bytes"]
                                    / max(base["kv_pool_bytes"], 1), 3)
                 for r in rows[1:]},
+            # predicted per-token wire bytes of the int8coll row over
+            # the bf16 mp=2 gather (ISSUE 15: ~0.5x at serving head
+            # dims; payload + f32 scale sidecar both priced)
+            "int8coll_wire_bytes_ratio": next(
+                (round(q["predicted_bytes_on_wire_per_token"]
+                       / max(r2["predicted_bytes_on_wire_per_token"],
+                             1e-9), 3)
+                 for q in rows if q["policy"] == "sharded mp=2+int8coll"
+                 for r2 in rows if r2["policy"] == "sharded mp=2"),
+                None),
         }), flush=True)
 
 

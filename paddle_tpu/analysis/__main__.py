@@ -122,20 +122,23 @@ def _decode_demo():
     return eng._decode, eng._decode_example_args(), {}
 
 
-def _sharded_decode_demo():
+def _sharded_decode_demo(quantized=False):
     """Default --comms target: the tiny-llama paged decode program
     SHARDED at mp=2 — one o-proj activation all-gather per layer inside
     the decode scan, the program the bytes-on-wire accounting exists
     for. Single-device hosts cannot build an mp=2 mesh: note the
     downgrade and audit the mp=1 program (zero collectives) so the
-    schema + exit-status gate stay scriptable everywhere."""
+    schema + exit-status gate stay scriptable everywhere.
+    `quantized=True` builds the FLAGS_quantized_collectives twin
+    (ISSUE 15: int8 payload + f32 scale sidecar on the gather) — the
+    CLI audits both and reports the wire-bytes ratio."""
     import jax
 
     from ..models.llama import LlamaConfig, LlamaForCausalLM
     from ..serving import ContinuousBatchingEngine
 
     mp = 2 if len(jax.devices()) >= 2 else 1
-    if mp == 1:
+    if mp == 1 and not quantized:
         print("note: single-device host — auditing the mp=1 decode "
               "program (zero collectives); run with >= 2 devices "
               "(e.g. XLA_FLAGS=--xla_force_host_platform_device_count"
@@ -145,9 +148,11 @@ def _sharded_decode_demo():
     eng = ContinuousBatchingEngine(
         cfg, dict(model.raw_state()), slots=2, prompt_bucket=16,
         max_prompt_len=32, max_new_tokens=8, block_size=16,
-        steps_per_sync=4, serving_mp=mp)
+        steps_per_sync=4, serving_mp=mp,
+        quantized_collectives=quantized)
+    tag = "+int8coll" if quantized else ""
     return (eng._decode, eng._decode_example_args(), {},
-            f"models.llama tiny sharded decode (mp={mp})")
+            f"models.llama tiny sharded decode (mp={mp}){tag}")
 
 
 def _resolve_target(spec, shapes, memory_mode=False, comms_mode=False,
@@ -258,6 +263,7 @@ def main(argv=None) -> int:
             rule_config.setdefault(f"{rid}.device", args.device)
 
     mem_report = comms_report = roofline_report = None
+    quantized_decode = None
     if args.memory or args.comms or args.roofline:
         # trace_auto, not trace_for_memory: a factory may return a
         # framework Layer, which only the lint tracer can thread. ONE
@@ -273,6 +279,25 @@ def main(argv=None) -> int:
             from .comms import audit_graph as comms_audit_graph
 
             comms_report = comms_audit_graph(graph)
+            if args.target is None and comms_report.total_wire_bytes:
+                # quantized-collectives twin (ISSUE 15): re-audit the
+                # same demo decode with FLAGS_quantized_collectives ON
+                # (int8 payload + f32 scale sidecar on the o-proj
+                # gather) and record the wire-bytes ratio — the CI
+                # gate asserts ~0.5x of the bf16 baseline via this
+                # stable schema
+                fq, aq, kq, lq = _sharded_decode_demo(quantized=True)
+                qrep = comms_audit_graph(
+                    trace_auto(fq, *aq, name=lq, **kq))
+                quantized_decode = {
+                    "target": lq,
+                    "bytes_on_wire": qrep.total_wire_bytes,
+                    "quantized_wire_bytes": qrep.quantized_wire_bytes,
+                    "n_quantized_sites": qrep.n_quantized_sites,
+                    "wire_bytes_ratio_vs_unquantized": round(
+                        qrep.total_wire_bytes
+                        / comms_report.total_wire_bytes, 4),
+                }
         if args.roofline:
             from .roofline import audit_graph as roofline_audit_graph
 
@@ -289,6 +314,8 @@ def main(argv=None) -> int:
             out["memory"] = mem_report.to_dict()
         if comms_report is not None:
             out["comms"] = comms_report.to_dict()
+            if quantized_decode is not None:
+                out["comms"]["quantized_decode"] = quantized_decode
         if roofline_report is not None:
             out["roofline"] = roofline_report.to_dict()
         print(json.dumps(out, sort_keys=True, indent=2))
@@ -299,6 +326,12 @@ def main(argv=None) -> int:
             print(mem_report.format())
         if comms_report is not None:
             print(comms_report.format())
+            if quantized_decode is not None:
+                print(f"  int8coll twin: "
+                      f"{quantized_decode['bytes_on_wire'] / 1024:.2f} "
+                      f"KiB on wire = "
+                      f"{quantized_decode['wire_bytes_ratio_vs_unquantized']}"
+                      f"x the unquantized demo")
         if roofline_report is not None:
             print(roofline_report.format())
     if args.fail_on != "never" and \

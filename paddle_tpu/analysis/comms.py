@@ -24,6 +24,13 @@ a reduce-scatter rewrite, or prefill/decode disaggregation pays.
   lengths)``, so "1 all-gather per layer x 32 layers x 16 steps" is
   first-class. `while` bodies have no static trip count: their events
   keep ``count`` as-is but are marked ``in_loop``.
+- **Quantized-collective recognition** (ISSUE 15): the pass marks the
+  (int8 payload + f32 scale sidecar) pair `parallel/collectives.py`
+  emits — BOTH tensors are priced (``quantized_wire_bytes`` /
+  ``n_quantized_sites`` in the report), the int8 half never fires
+  TPU803 by design, and the sidecar stays far under its floor, so a
+  site rewritten through `quantized_all_gather` / `quantized_psum`
+  goes silent at the DEFAULT threshold.
 
 Three rules ride the one (memoized) pass:
 
@@ -155,6 +162,12 @@ class CommEvent:
     in_loop: bool
     implicit: bool = False  # reshard the author never wrote
     detail: str = ""        # reshard: "P(src) -> P(dst)"
+    # one half of a recognized quantized-collective pair (ISSUE 15):
+    # an int8 payload collective + its small float scale-sidecar twin
+    # (same kind/axes, adjacent in the same subjaxpr) — the
+    # parallel/collectives.py emission pattern. Both tensors are
+    # priced; TPU803 never fires on the int8 half by design.
+    quantized: bool = False
 
     @property
     def total_wire_bytes(self) -> int:
@@ -174,7 +187,7 @@ class CommEvent:
             "total_wire_bytes": self.total_wire_bytes,
             "shape": list(self.shape), "dtype": self.dtype,
             "in_loop": self.in_loop, "implicit": self.implicit,
-            "detail": self.detail,
+            "detail": self.detail, "quantized": self.quantized,
         }
 
 
@@ -222,6 +235,24 @@ class CommsReport:
         scan counts 16 per site."""
         return sum(max(e.count, 1) for e in self.collectives)
 
+    @property
+    def quantized_events(self) -> List[CommEvent]:
+        return [e for e in self.events if e.quantized]
+
+    @property
+    def quantized_wire_bytes(self) -> int:
+        """Per-chip amplified wire bytes of recognized quantized
+        collectives — int8 payloads AND their f32 scale sidecars (both
+        halves of each pair are priced)."""
+        return sum(e.total_wire_bytes for e in self.quantized_events)
+
+    @property
+    def n_quantized_sites(self) -> int:
+        """Recognized quantized-collective PAIRS (payload + sidecar
+        count as one site)."""
+        return sum(1 for e in self.quantized_events
+                   if "int" in e.dtype)
+
     def per_axis(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
         for e in self.events:
@@ -251,6 +282,8 @@ class CommsReport:
             "bytes_on_wire": self.total_wire_bytes,
             "float_payload_bytes": self.total_float_payload_bytes,
             "implicit_reshard_bytes": self.implicit_reshard_bytes,
+            "quantized_wire_bytes": self.quantized_wire_bytes,
+            "n_quantized_sites": self.n_quantized_sites,
             "per_axis": self.per_axis(),
             "per_kind": self.per_kind(),
             "top_talkers": [e.to_dict()
@@ -271,13 +304,19 @@ class CommsReport:
         ]
         for axis, b in sorted(self.per_axis().items()):
             lines.append(f"  axis {axis}: {b * kb:.2f} KiB")
+        if self.n_quantized_sites:
+            lines.append(
+                f"  quantized (int8+scale) sites: "
+                f"{self.n_quantized_sites}, "
+                f"{self.quantized_wire_bytes * kb:.2f} KiB on wire")
         for e in self.top_talkers(top):
             amp = f" x{e.count}" if e.count > 1 else ""
             imp = "  IMPLICIT " + e.detail if e.implicit else ""
+            q = "  [q8]" if e.quantized else ""
             lines.append(
                 f"    {e.total_wire_bytes * kb:9.2f} KiB  {e.kind}"
                 f"[{','.join(e.axes)}] {e.dtype}{list(e.shape)}{amp}"
-                f"  {e.path}{imp}")
+                f"  {e.path}{imp}{q}")
         return "\n".join(lines)
 
 
@@ -376,6 +415,7 @@ class _CommsAuditor:
 
     def run(self) -> CommsReport:
         self._walk(self.closed.jaxpr, self.name, {}, 1, False)
+        _mark_quantized(self.events)
         return CommsReport(self.name, self.events, self.mp)
 
     # -- walk ----------------------------------------------------------
@@ -511,6 +551,36 @@ class _CommsAuditor:
             self.mp = max(self.mp, int(mesh.size))
         except Exception:
             pass
+
+
+def _mark_quantized(events: List[CommEvent]) -> None:
+    """Recognize the quantized-collective emission pattern of
+    `parallel/collectives.py` (ISSUE 15): an int8-payload collective
+    immediately followed — same subjaxpr, same kind, same axes — by a
+    small float SCALE-SIDECAR collective (<= half the payload's bytes:
+    one f32 per block of >= 8 int8 elements — blocks clamp to the
+    payload's last dim, so narrow payloads carry proportionally wider
+    sidecars). Both halves are marked `quantized` so
+    reports can attribute the pair's wire bytes (payload AND sidecar)
+    to the rewrite; the int8 half never fires TPU803 by design, and
+    the sidecar stays far under its floor."""
+    def parent(path: str) -> str:
+        return path.rsplit("/", 1)[0]
+
+    for a, b in zip(events, events[1:]):
+        if a.kind == "reshard" or a.kind != b.kind:
+            continue
+        if a.axes != b.axes or parent(a.path) != parent(b.path):
+            continue
+        if "int8" not in a.dtype:
+            continue
+        if not b.float_payload_bytes:
+            continue
+        if b.float_payload_bytes * 2 > max(a.payload_bytes, 1):
+            continue
+        a.quantized = b.quantized = True
+        a.detail = a.detail or "int8 payload (scales follow)"
+        b.detail = b.detail or "f32 scale sidecar"
 
 
 def _fmt_spec(spec: Tuple[tuple, ...]) -> str:
@@ -667,6 +737,9 @@ class QuantizableCollectiveRule(Rule):
     Config: `min_bytes` (default 1 MiB, compared against the
     loop-amplified float payload)."""
 
+    # A site rewritten through parallel/collectives.py (ISSUE 15) goes
+    # SILENT here by design: the payload is int8 (never fires) and the
+    # f32 scale sidecar is ~payload/32 — far under any sane min_bytes.
     id = "TPU803"
     name = "quantizable-collective"
     default_severity = Severity.WARNING

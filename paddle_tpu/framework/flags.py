@@ -176,6 +176,22 @@ define_flag("serving_mp", 1,
             "warming) an engine (also: PADDLE_TPU_SERVING_MP)",
             env_aliases=("PADDLE_TPU_SERVING_MP",))
 
+define_flag("quantized_collectives", False,
+            "ship the hot cross-chip payloads as absmax-scaled int8 "
+            "with an f32 scale sidecar (parallel/collectives.py, "
+            "EQuARX-style — the int8 KV pools' proven scheme): the "
+            "per-layer o-proj activation all-gather at serving_mp > 1 "
+            "(and the megakernel path's partial-sum psum), and the dp "
+            "gradient psum in Model.fit (reduce-scatter on int8 "
+            "shards + f32 dequant-accumulate + all-gather). ~0.5x the "
+            "bf16 wire bytes, ~0.25x f32. Off (default) = every wire "
+            "byte-identical to today. Read at program-BUILD time like "
+            "every serving flag (it joins the jit program keys; "
+            "warm() covers it), so flip it before constructing (or "
+            "warming) an engine or calling fit "
+            "(also: PADDLE_TPU_QUANTIZED_COLLECTIVES)",
+            env_aliases=("PADDLE_TPU_QUANTIZED_COLLECTIVES",))
+
 # --- observability (paddle_tpu.observability) ---
 define_flag("trace", "",
             "host span tracing: a non-empty value arms the global "

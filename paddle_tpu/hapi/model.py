@@ -60,6 +60,13 @@ class Model:
         # AGREED generation — every rank reports the same number), or
         # None when the run started fresh (ISSUE 12)
         self.restored_generation = None
+        # quantized collectives (ISSUE 15): the last fit()'s resolved
+        # FLAGS_quantized_collectives (None until a fit ran) — the
+        # audit hooks build the dp step with the SAME wire the
+        # training path runs; quantized_dp_steps counts batches that
+        # went through the explicit quantized dp-sync step
+        self._quantized_collectives = None
+        self.quantized_dp_steps = 0
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -141,7 +148,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
             resume=False, checkpoint_freq=None, audit_memory=None,
-            audit_comms=None, audit_roofline=None, coordinator=None):
+            audit_comms=None, audit_roofline=None, coordinator=None,
+            quantized_collectives=None):
         """reference: hapi/model.py fit (:1807).
 
         Resilience extensions (paddle_tpu.resilience):
@@ -208,6 +216,24 @@ class Model:
         MFU + bound class, TPU901/902/903 diagnostics — onto
         `self.roofline_audit` with a `roofline.audit` event. One-shot
         per fit call; failures degrade to a warning.
+
+        Quantized collectives (ISSUE 15): `quantized_collectives=True`
+        (default: FLAGS_quantized_collectives /
+        PADDLE_TPU_QUANTIZED_COLLECTIVES, resolved HERE at fit time —
+        the training-side program-build point) routes training through
+        the EXPLICIT dp step when the global mesh carries a `dp` axis
+        (size > 1): loss + backward run as one jitted shard_map program
+        with the batch sharded over dp and the gradient sync as the
+        QUANTIZED psum (`parallel.collectives.quantized_psum_tree` —
+        reduce-scatter on int8 shards + f32 dequant-accumulate +
+        all-gather, so accumulation error does not scale with world
+        size); the synced mean grads install into the parameters and
+        the regular optimizer step applies them. `audit_comms=` /
+        `audit_roofline=` trace the SAME step, so the wire report
+        prices the int8 payload + f32 sidecar the training actually
+        ships. Without a dp mesh (or a batch whose leading dim does
+        not divide dp) fit warns and keeps the eager path; flag OFF
+        (default) is byte-identical to today.
         """
         if audit_memory is not False:  # False skips the analysis import
             from ..analysis.memory import resolve_audit_memory
@@ -224,6 +250,16 @@ class Model:
 
             audit_roofline = resolve_audit_roofline(audit_roofline)
         roofline_pending = bool(audit_roofline)
+        from ..parallel.collectives import resolve_quantized_collectives
+
+        self._quantized_collectives = resolve_quantized_collectives(
+            quantized_collectives)
+        self.quantized_dp_steps = 0
+        train_batch_fn = self.train_batch
+        if self._quantized_collectives:
+            dp_fn = self._make_dp_train_batch()
+            if dp_fn is not None:
+                train_batch_fn = dp_fn
         loader = self._make_loader(train_data, batch_size, shuffle)
         eval_loader = self._make_loader(eval_data, batch_size, False)
         cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
@@ -314,18 +350,18 @@ class Model:
                                                  traced=traced)
                     update = (step + 1) % accumulate_grad_batches == 0
                     if tr is None and mt is None:
-                        res = self.train_batch(ins, labs, update=update)
+                        res = train_batch_fn(ins, labs, update=update)
                     else:
                         t0 = time.perf_counter()
                         if tr is not None:
                             # StepTraceAnnotation bridging: host steps
                             # align with a live XPlane device trace
                             with tr.step_span("fit.step", it_count):
-                                res = self.train_batch(ins, labs,
-                                                       update=update)
+                                res = train_batch_fn(ins, labs,
+                                                     update=update)
                         else:
-                            res = self.train_batch(ins, labs,
-                                                   update=update)
+                            res = train_batch_fn(ins, labs,
+                                                 update=update)
                         if mt is not None:
                             mt.histogram(
                                 "fit_step_s",
@@ -494,18 +530,80 @@ class Model:
 
         return loss_fn, params, tuple(ins_arr + lab_arr)
 
+    def _build_dp_step(self, loss_fn, params, n_batch, dp,
+                       quantized=False):
+        """The EXPLICIT dp training step: loss + backward under
+        shard_map over a dp mesh, batch sharded on dim 0, and the
+        gradient sync written out — `lax.psum` (exactly the all-reduce
+        GSPMD inserts at compile time, invisible to a traced jaxpr),
+        or the QUANTIZED two-hop exchange when
+        FLAGS_quantized_collectives resolves ON (ISSUE 15:
+        reduce-scatter on int8 shards + f32 dequant-accumulate +
+        all-gather via `quantized_psum_tree`). Loss and grads come
+        back as dp-MEANS, so the step matches the eager full-batch
+        step's math. ONE builder serves the real quantized-dp
+        training path AND the comms/roofline audit hooks — the
+        audited program IS the trained one."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from ..parallel.shard_map_compat import shard_map
+
+        dp_mesh = Mesh(np.asarray(jax.devices()[:dp]), ("dp",))
+        p_specs = jax.tree.map(lambda _: P(), params)
+
+        def dp_step(p, *b):
+            # inside shard_map the dp axis is MANUAL: a model whose
+            # forward applies with_sharding_constraint against the
+            # GLOBAL mesh (llama's activation specs) would trip the
+            # manual-axes check — the body is already per-shard, so
+            # the constraints are meaningless here. Clearing the
+            # global mesh is trace-scoped (this body runs at trace
+            # time only).
+            from ..parallel import mesh as mesh_mod
+
+            prev_mesh = mesh_mod.get_global_mesh()
+            mesh_mod.set_global_mesh(None)
+            try:
+                loss, grads = jax.value_and_grad(loss_fn)(p, *b)
+            finally:
+                mesh_mod.set_global_mesh(prev_mesh)
+            if quantized:
+                from ..parallel.collectives import quantized_psum_tree
+
+                # THE dp gradient sync, quantized: int8 payload + f32
+                # scale sidecar on the wire, accumulation in f32 (one
+                # rounding per contribution — error does not scale
+                # with dp)
+                grads = quantized_psum_tree(grads, "dp")
+            else:
+                # THE dp gradient sync: one fused all-reduce over
+                # every grad leaf — explicit so the wire pass (and
+                # TPU803) can see what GSPMD emits
+                grads = jax.lax.psum(grads, "dp")
+            grads = jax.tree.map(
+                lambda g: (g / dp).astype(g.dtype), grads)
+            return jax.lax.psum(loss, "dp") / dp, grads
+
+        return shard_map(
+            dp_step, mesh=dp_mesh,
+            in_specs=(p_specs,) + (P("dp"),) * n_batch,
+            out_specs=(P(), p_specs), check_vma=False)
+
     def _audit_step_program(self, ins, labs, hook):
         """(target, name, params, batch) — the FULL traced training
         step the static auditors price, dp handling included: when the
         global mesh carries a dp axis (size > 1) and the batch shards,
         the step is built under shard_map with the explicit gradient
-        psum (GSPMD inserts it at compile time, invisible to a traced
-        jaxpr). Shared by the comms and roofline hooks so both audit
-        the SAME program; `hook` names the caller in the dp-fallback
-        warning."""
+        psum — quantized (int8 payload + f32 sidecar) when the last
+        fit's FLAGS_quantized_collectives resolved ON, so the audit
+        prices the wire training actually ships. Shared by the comms
+        and roofline hooks so both audit the SAME program; `hook`
+        names the caller in the dp-fallback warning."""
         import jax
 
         from ..parallel import mesh as mesh_mod
+        from ..parallel.collectives import resolve_quantized_collectives
 
         loss_fn, params, batch = self._audit_step_target(ins, labs)
 
@@ -513,6 +611,9 @@ class Model:
             return jax.value_and_grad(loss_fn)(p, *b)
 
         target, name = step, "fit.step"
+        quantized = self._quantized_collectives
+        if quantized is None:
+            quantized = resolve_quantized_collectives(None)
         mesh = mesh_mod.get_global_mesh()
         dp = int(mesh.shape["dp"]) if mesh is not None \
             and "dp" in getattr(mesh, "axis_names", ()) else 1
@@ -531,27 +632,100 @@ class Model:
                 "not divide by dp — auditing the single-chip step; "
                 "the dp gradient psum is NOT counted")
         if dp > 1 and dp_shardable:
-            from jax.sharding import Mesh, PartitionSpec as P
-
-            from ..parallel.shard_map_compat import shard_map
-
-            dp_mesh = Mesh(np.asarray(jax.devices()[:dp]), ("dp",))
-            p_specs = jax.tree.map(lambda _: P(), params)
-
-            def dp_step(p, *b):
-                loss, grads = jax.value_and_grad(loss_fn)(p, *b)
-                # THE dp gradient sync: one fused all-reduce over
-                # every grad leaf — explicit so the wire pass (and
-                # TPU803) can see what GSPMD emits
-                grads = jax.lax.psum(grads, "dp")
-                return jax.lax.psum(loss, "dp") / dp, grads
-
-            target = shard_map(
-                dp_step, mesh=dp_mesh,
-                in_specs=(p_specs,) + (P("dp"),) * len(batch),
-                out_specs=(P(), p_specs), check_vma=False)
-            name = f"fit.step[dp={dp}]"
+            target = self._build_dp_step(loss_fn, params, len(batch),
+                                         dp, quantized=quantized)
+            name = f"fit.step[dp={dp}]" \
+                + ("+int8coll" if quantized else "")
         return target, name, params, batch
+
+    def _make_dp_train_batch(self):
+        """train_batch-compatible callable running the EXPLICIT
+        quantized dp-sync step (ISSUE 15), or None — with a warning —
+        when no global mesh carries a dp axis (there is no gradient
+        sync to quantize; fit keeps the eager path). Per batch: one
+        jitted shard_map step (built at the first batch's shapes,
+        cached; `_build_dp_step` with the quantized wire) computes
+        (mean loss, synced mean grads); grads ACCUMULATE into the
+        parameters like `loss.backward()` does (so
+        accumulate_grad_batches composes) and the regular optimizer
+        step applies them. Metrics, if any, ride one extra no-grad
+        eager forward."""
+        import warnings
+
+        from ..parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.get_global_mesh()
+        dp = int(mesh.shape["dp"]) if mesh is not None \
+            and "dp" in getattr(mesh, "axis_names", ()) else 1
+        if dp <= 1:
+            warnings.warn(
+                "fit(quantized_collectives=True): no global mesh with "
+                "a dp axis (size > 1) is set — there is no gradient "
+                "sync to quantize; training on the eager single-chip "
+                "path")
+            return None
+        built = {}
+
+        def dp_train_batch(ins, labs, update=True):
+            import jax
+
+            self.network.train()
+            ins_arr = [np.asarray(i.numpy() if isinstance(i, Tensor)
+                                  else i) for i in _to_list(ins)]
+            lab_arr = [np.asarray(l.numpy() if isinstance(l, Tensor)
+                                  else l) for l in _to_list(labs)]
+            batch = ins_arr + lab_arr
+            if not batch or not all(b.ndim >= 1 and b.shape[0] % dp == 0
+                                    for b in batch):
+                if "warned" not in built:
+                    built["warned"] = True
+                    warnings.warn(
+                        f"fit(quantized_collectives=True): a batch "
+                        f"leaf is 0-d or its leading dim does not "
+                        f"divide dp={dp} — falling back to the eager "
+                        "single-chip step for such batches")
+                return self.train_batch(ins, labs, update=update)
+            key = tuple((b.shape, str(b.dtype)) for b in batch)
+            if key not in built:
+                # one compiled step per batch shape (kept, not
+                # replaced: a short trailing batch must not retrace
+                # the full-size step every epoch)
+                loss_fn, params, _ = self._audit_step_target(ins, labs)
+                built[key] = (sorted(params), jax.jit(
+                    self._build_dp_step(loss_fn, params, len(batch),
+                                        dp, quantized=True)))
+            pkeys, step = built[key]
+            raw = self.network.raw_state()
+            p = {k: raw[k] for k in pkeys}
+            loss, grads = step(p, *batch)
+            named = dict(self.network.named_parameters())
+            for k, g in grads.items():
+                t = named.get(k)
+                if t is None or t.stop_gradient:
+                    continue
+                # accumulate like backward() so update=False batches
+                # (accumulate_grad_batches) compose
+                t._grad = g if t._grad is None else t._grad + g
+            self.quantized_dp_steps += 1
+            if update and self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            metrics = []
+            if self._metrics:
+                from ..core import tape as _tape
+
+                with _tape.no_grad():
+                    outputs = self.network(
+                        *(Tensor(a) for a in ins_arr))
+                labels = [Tensor(l) for l in lab_arr]
+                for m in self._metrics:
+                    m.update(*_to_list(m.compute(
+                        *_to_list(outputs), *labels)))
+                    metrics.append(m.accumulate())
+            out = [float(loss)]
+            return (out, metrics) if metrics else out
+
+        return dp_train_batch
 
     def _trace_step_for_audits(self, ins, labs):
         """(Graph, name) of the training step, traced ONCE for the

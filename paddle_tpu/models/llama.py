@@ -516,9 +516,16 @@ class LlamaForCausalLM(Layer):
             if cache_layout == "paged" else None
         serving_mp = resolve_serving_mp() if cache_layout == "paged" \
             else None
+        if cache_layout == "paged":
+            from ..parallel.collectives import \
+                resolve_quantized_collectives
+
+            qcoll = resolve_quantized_collectives()
+        else:
+            qcoll = None
         sig = (b, sb, max_new_tokens, eos_token_id, do_sample, int(top_k),
                quant, prefill_with_quant, cache_layout, kv_block_size,
-               kv_dtype, megakernel, serving_mp)
+               kv_dtype, megakernel, qcoll, serving_mp)
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = {}
@@ -1168,7 +1175,14 @@ class ServingTP:
     math derives from LOCAL head counts, never the full-model config).
     """
 
-    def __init__(self, cfg, mp: int, axis: str = MP_AXIS):
+    def __init__(self, cfg, mp: int, axis: str = MP_AXIS,
+                 quantized: Optional[bool] = None):
+        # quantized collectives (ISSUE 15): resolved HERE at geometry-
+        # build time like every serving flag — the engine threads its
+        # own resolution through so the flag joins its program keys
+        from ..parallel.collectives import resolve_quantized_collectives
+
+        self.quantized = resolve_quantized_collectives(quantized)
         nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
         if nh % mp:
             raise ValueError(
@@ -1207,21 +1221,54 @@ class ServingTP:
         pre-cast halves the mp seam's bytes; a bf16 stream is
         untouched, so production serving numerics don't move and every
         shard applies the same rounding, keeping mp token-identical to
-        itself across degrees). EQuARX (PAPERS.md) remains the
-        follow-up for quantizing it further; TPU401/TPU803 watch the
-        seam meanwhile."""
+        itself across degrees).
+
+        With FLAGS_quantized_collectives (ISSUE 15, the cashed EQuARX
+        follow-up) the payload ships as absmax-scaled int8 blocks with
+        an f32 scale sidecar (`parallel.collectives.
+        quantized_all_gather` — the int8 KV pools' proven scheme):
+        ~0.5x the bf16 wire bytes again, at quantization-noise
+        accuracy (the serving gate is the int8-KV token-match bar, not
+        identity). TPU803 goes silent on the rewritten seam by design
+        (int8 payloads never fire); the comms auditor prices payload
+        AND sidecar."""
         if ctx.dtype == jnp.float32:
             ctx = ctx.astype(jnp.bfloat16)
+        if self.quantized:
+            from ..parallel.collectives import quantized_all_gather
+
+            return quantized_all_gather(ctx, self.axis,
+                                        axis=ctx.ndim - 2, tiled=True)
         return jax.lax.all_gather(ctx, self.axis, axis=ctx.ndim - 2,
                                   tiled=True)
 
+    def psum_partial(self, partial):
+        """Sum per-shard PARTIAL results over the mp axis — the
+        megakernel decode path's collective (the fused kernel emits the
+        f32 o-proj partial contraction instead of the pre-o-proj
+        activations; same wire bytes as the all-gather at f32). With
+        FLAGS_quantized_collectives the sum runs as the two-hop
+        quantized exchange (int8 reduce-scatter via all_to_all + f32
+        dequant-accumulate + int8 all-gather,
+        `parallel.collectives.quantized_psum`), composing the
+        megakernel with the quantized wire."""
+        if self.quantized:
+            from ..parallel.collectives import quantized_psum
 
-def make_serving_tp(cfg, serving_mp: Optional[int] = None) \
+            return quantized_psum(partial, self.axis)
+        return jax.lax.psum(partial, self.axis)
+
+
+def make_serving_tp(cfg, serving_mp: Optional[int] = None,
+                    quantized_collectives: Optional[bool] = None) \
         -> Optional[ServingTP]:
     """ServingTP geometry for the resolved mp degree, or None at mp=1
-    (the single-chip path takes no TP plumbing at all)."""
+    (the single-chip path takes no TP plumbing at all).
+    `quantized_collectives` (default: the flag) arms the int8
+    all-gather / psum wire (ISSUE 15)."""
     mp = resolve_serving_mp(serving_mp)
-    return ServingTP(cfg, mp) if mp > 1 else None
+    return ServingTP(cfg, mp, quantized=quantized_collectives) \
+        if mp > 1 else None
 
 
 def _tp_weight_spec(name: str, w, tp: ServingTP):
@@ -1400,9 +1447,10 @@ def _make_decode_step_megakernel(cfg, b, tables, tp=None):
                 h = h_out
             else:
                 # h_out is the f32 o-proj PARTIAL (no residual): psum
-                # over the shards' contraction slices, then residual
+                # over the shards' contraction slices (quantized when
+                # FLAGS_quantized_collectives is on), then residual
                 h = (h.astype(jnp.float32)
-                     + jax.lax.psum(h_out, tp.axis)).astype(h.dtype)
+                     + tp.psum_partial(h_out)).astype(h.dtype)
             new_kcs.append(kc_new)
             new_vcs.append(vc_new)
             x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)

@@ -75,6 +75,11 @@ from .trainer import (  # noqa: F401
 )
 from . import mpu  # noqa: F401
 from . import collective as communication  # noqa: F401
+from . import collectives  # noqa: F401
+from .collectives import (  # noqa: F401
+    quantized_all_gather, quantized_psum, quantized_psum_tree,
+    quantized_reduce_scatter, resolve_quantized_collectives,
+)
 
 
 def init_parallel_env():

@@ -210,6 +210,7 @@ class ContinuousBatchingEngine:
                  kv_pool_bytes: Optional[int] = None,
                  decode_megakernel: Optional[bool] = None,
                  serving_mp: Optional[int] = None,
+                 quantized_collectives: Optional[bool] = None,
                  disaggregated: bool = False,
                  unified_step=None, token_budget: Optional[int] = None,
                  tracer=None, metrics=None):
@@ -235,6 +236,19 @@ class ContinuousBatchingEngine:
         identical to a build without the flag. Models whose kv heads
         don't divide mp (MQA) fall back to replicated-KV
         head-sharded-Q with a build-time warning.
+
+        `quantized_collectives` (ISSUE 15; default from
+        FLAGS_quantized_collectives /
+        PADDLE_TPU_QUANTIZED_COLLECTIVES, resolved HERE at build time
+        like every serving flag — it joins every program key and
+        `warm()` covers it) ships the per-layer o-proj activation
+        all-gather at mp > 1 (and the megakernel path's partial-sum
+        psum) as absmax-scaled int8 blocks + an f32 scale sidecar
+        (`parallel/collectives.py`, the int8 KV pools' proven scheme):
+        ~0.5x the bf16 wire bytes per token at quantization-noise
+        accuracy (the token-match gate is the int8-KV bar, not
+        identity). OFF (default) keeps every wire byte-identical; at
+        mp=1 the flag is key-only (no collectives exist).
 
         `unified_step` (ISSUE 14; default from FLAGS_unified_step /
         PADDLE_TPU_UNIFIED_STEP, 'auto' = ON off-TPU, resolved HERE at
@@ -331,7 +345,16 @@ class ContinuousBatchingEngine:
         # time like the flags above; mp=1 builds exactly the single-chip
         # programs (no mesh, no shard_map — byte-identical)
         self.mp = resolve_serving_mp(serving_mp)
-        self._tp = make_serving_tp(cfg, self.mp)
+        # quantized collectives (ISSUE 15), resolved at build time like
+        # the flags above — resolved even at mp=1 so the flag rides the
+        # program keys uniformly (it is a no-op there: no collectives)
+        from ..parallel.collectives import resolve_quantized_collectives
+
+        self.quantized_collectives = resolve_quantized_collectives(
+            quantized_collectives)
+        self._tp = make_serving_tp(
+            cfg, self.mp,
+            quantized_collectives=self.quantized_collectives)
         self.mp_mesh = None
         if self._tp is not None:
             from ..parallel.mesh import serving_mesh
@@ -626,6 +649,9 @@ class ContinuousBatchingEngine:
             # sync-wait telemetry (what double buffering hides)
             "sync_wait_s": self.sync_wait_s,
             "blocked_syncs": self.blocked_syncs,
+            # quantized collectives (ISSUE 15): int8 wire on the mp
+            # o-proj gather / megakernel psum when True
+            "quantized_collectives": self.quantized_collectives,
             # pool occupancy: pages not reclaimable right now / bytes
             "kv_cache_dtype": self.kv_dtype,
             "kv_pool_bytes": mgr.kv_pool_bytes(),
@@ -941,7 +967,8 @@ class ContinuousBatchingEngine:
         dtype rides every key: an engine only ever builds programs at
         its own kv_cache_dtype, and the key makes that self-evident in
         compile_stats()."""
-        key = ("cold", sb, bsz, self.kv_dtype, self.mp)
+        key = ("cold", sb, bsz, self.kv_dtype,
+               int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._shard_program(self._build_prefill(sb, bsz), 6, 1),
@@ -949,7 +976,8 @@ class ContinuousBatchingEngine:
         return self._prefill_cache[key]
 
     def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
-        key = ("prefix", sb, bsz, w_pre, self.kv_dtype, self.mp)
+        key = ("prefix", sb, bsz, w_pre, self.kv_dtype,
+               int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._shard_program(
@@ -1354,6 +1382,10 @@ class ContinuousBatchingEngine:
             diags += len(lint)
             out[name] = {
                 "bytes_on_wire": rep.total_wire_bytes,
+                # recognized int8+sidecar pairs (ISSUE 15): bytes
+                # attributed to the quantized-collective rewrite
+                "quantized_wire_bytes": rep.quantized_wire_bytes,
+                "n_quantized_sites": rep.n_quantized_sites,
                 "n_collective_sites": rep.n_collective_sites,
                 "n_collectives": rep.n_collectives,
                 "n_implicit_reshards": len(rep.reshards),
