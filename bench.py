@@ -460,6 +460,43 @@ def _op_bench(only=None):
             make_run()(1)  # compile once
         return eng, make_run
 
+    def _tuned_info(serving_mp=1, budget_candidates=6):
+        """Auditor-driven autotuner stub (ISSUE 16) for the chunk rig:
+        rank a capped slice of the engine config space for the SAME 1B
+        geometry `_serving_chunk_harness` times, and record the winning
+        knobs + their predicted step/MFU/wire numbers in OPBENCH
+        `info`. Static only (trace + auditor passes per candidate, no
+        compiles) — the next TPU run lands estimate/actual ratios for
+        the config the tuner actually recommends, not just the
+        defaults."""
+        from paddle_tpu.analysis import autotune
+        from paddle_tpu.models import (LlamaConfig,
+                                       init_quant_serving_params)
+
+        scfg = LlamaConfig.llama_1b(dtype="bfloat16")
+        sp = init_quant_serving_params(scfg, "weight_only_int8", seed=0)
+        rep = autotune(
+            scfg, sp, budget_candidates=budget_candidates,
+            engine_kwargs=dict(
+                slots=8, prompt_bucket=128, max_prompt_len=128,
+                max_new_tokens=64, block_size=64, steps_per_sync=16,
+                prefill_batch=1, prefix_cache=False,
+                serving_mp=serving_mp))
+        best = rep.best
+        out = dict(best.config)
+        out.update({
+            "predicted_step_ms": round(best.predicted_step_ms, 4),
+            "predicted_ms_per_token": round(
+                best.predicted_ms_per_token, 6),
+            "predicted_mfu": best.predicted_mfu,
+            "predicted_wire_bytes_per_token": int(
+                best.predicted_wire_bytes_per_token),
+            "predicted_peak_hbm_bytes": int(best.peak_hbm_bytes),
+            "predicted_speedup_vs_default":
+                rep.to_dict(top_k=1)["predicted_speedup_vs_default"],
+        })
+        return out
+
     if want("serving_decode_chunk"):
         # the engine's decode hot loop under the gate (ISSUE 3): one
         # steps_per_sync=16 chunk for 8 slots over the PAGED pools —
@@ -501,6 +538,11 @@ def _op_bench(only=None):
             "predicted_step_ms": round(sroof["predicted_step_ms"], 4),
             "predicted_mfu": sroof["predicted_mfu"],
             "predicted_bound": sroof["bound"],
+            # auditor-driven autotuner (ISSUE 16): the config the
+            # static tuner recommends for THIS rig and its predicted
+            # numbers — calibration stub, the next TPU run lands the
+            # measured chunk slope next to the winner's prediction
+            "tuned": _tuned_info(),
         }
         del eng, smake
 
@@ -581,6 +623,10 @@ def _op_bench(only=None):
             "predicted_step_ms": round(troof["predicted_step_ms"], 4),
             "predicted_mfu": troof["predicted_mfu"],
             "predicted_bound": troof["bound"],
+            # autotuner stub (ISSUE 16) at mp=2: the recommended
+            # sharded-serving config and its predictions — includes
+            # whether int8 collectives / kv int8 win on this rig
+            "tuned": _tuned_info(serving_mp=2),
         }
         # the recorded ~2x: bf16 wire / int8coll wire per decoded token
         OP_INFO["decode_step_1b_mp"]["int8coll_wire_ratio"] = round(

@@ -49,13 +49,23 @@ from .roofline import (  # noqa: F401
     RooflineReport, audit_roofline, count_kernel_launches,
     count_step_kernels,
 )
+# the auditor-driven static autotuner (ISSUE 16): turns the three
+# passes above into an objective function over the engine's config
+# space; its TunedConfig artifact is what
+# ContinuousBatchingEngine(config=...) loads
+from . import tuner  # noqa: F401,E402
+from .device_specs import auto_hbm_budget  # noqa: F401
+from .tuner import (  # noqa: F401
+    TunedConfig, TuningReport, autotune,
+)
 
 __all__ = [
     "CommsReport", "DeviceSpec", "Diagnostic", "Graph", "LintError",
     "MemoryReport", "Pipeline", "Report", "RooflineReport", "RULES",
-    "Rule", "Severity", "analyze", "audit_comms", "audit_graph",
-    "audit_memory", "audit_roofline", "comms", "count_kernel_launches",
+    "Rule", "Severity", "TunedConfig", "TuningReport", "analyze",
+    "audit_comms", "audit_graph", "audit_memory", "audit_roofline",
+    "auto_hbm_budget", "autotune", "comms", "count_kernel_launches",
     "count_step_kernels", "default_rules", "device_specs", "get_spec",
     "lint", "memory", "register_rule", "roofline", "trace_for_memory",
-    "trace_graph",
+    "trace_graph", "tuner",
 ]

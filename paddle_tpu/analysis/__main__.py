@@ -7,6 +7,8 @@
     python -m paddle_tpu.analysis --comms --format json    # wire-side twin
     python -m paddle_tpu.analysis --roofline --format json  # compute-time leg
     python -m paddle_tpu.analysis --roofline --device tpu-v5p
+    python -m paddle_tpu.analysis --tune --format json      # autotuner demo
+    python -m paddle_tpu.analysis --tune --device tpu-v5e --budget-candidates 8
     python -m paddle_tpu.analysis --rule-config TPU401.max_collective_bytes=65536
     python -m paddle_tpu.analysis --comms --rule-config TPU801.max_step_wire_bytes=1048576
 
@@ -41,6 +43,18 @@ baseline), predicted step latency + MFU + bound class, and the
 TPU901/902/903 rules riding the same trace. With no target it audits
 the bundled tiny-llama PAGED DECODE program (same demo as ``--memory``
 — the bandwidth-bound program the roofline exists to classify).
+
+``--tune`` runs the auditor-driven static autotuner (`analysis/
+tuner.py`) over the bundled tiny-llama serving demo: enumerate the
+engine config space (block size, kv dtype, megakernel, unified step,
+quantized collectives, token budget), prune over-HBM candidates
+against a demo budget chosen to exercise BOTH feasibility gates
+(static params+pool bound before tracing, traced liveness peak
+after), rank the rest by predicted step time then wire bytes, then
+lint the decode program of an engine rebuilt THROUGH the winning
+`TunedConfig` artifact. ``--tune-out PATH`` saves the artifact;
+``--budget-candidates N`` caps the scored set. Exit status follows
+``--fail-on`` against the winner's lint findings.
 
 ``--rule-config KEY=VALUE`` (repeatable) passes rule knobs: bare keys
 reach every rule (``max_collective_bytes=65536``), ``TPUxxx.``-prefixed
@@ -106,19 +120,35 @@ def _llama_demo():
     return model, (ids,), {}
 
 
+def _tiny_serving_setup(**overrides):
+    """ONE builder behind every serving-side demo target (--memory,
+    --comms, --roofline, --tune): the tiny-llama config, its params,
+    and the shared demo engine geometry. Overrides layer demo-specific
+    knobs (serving_mp for the comms demo, block_size/unified_step for
+    the tune demo) on top of the ONE base, so the demos audit the same
+    engine instead of four drifting copies of its kwargs."""
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    kw = dict(slots=2, prompt_bucket=16, max_prompt_len=32,
+              max_new_tokens=8, block_size=16, steps_per_sync=4)
+    kw.update(overrides)
+    return cfg, dict(model.raw_state()), kw
+
+
+def _tiny_engine(**overrides):
+    from ..serving import ContinuousBatchingEngine
+
+    cfg, params, kw = _tiny_serving_setup(**overrides)
+    return ContinuousBatchingEngine(cfg, params, **kw)
+
+
 def _decode_demo():
     """Default --memory target: the tiny-llama PAGED DECODE program —
     the serving engine's jitted decode chunk with its donated KV pools,
     exactly what the donation/peak-HBM audit exists to check."""
-    from ..models.llama import LlamaConfig, LlamaForCausalLM
-    from ..serving import ContinuousBatchingEngine
-
-    cfg = LlamaConfig.tiny()
-    model = LlamaForCausalLM(cfg)
-    eng = ContinuousBatchingEngine(
-        cfg, dict(model.raw_state()), slots=2, prompt_bucket=16,
-        max_prompt_len=32, max_new_tokens=8, block_size=16,
-        steps_per_sync=4)
+    eng = _tiny_engine()
     return eng._decode, eng._decode_example_args(), {}
 
 
@@ -134,25 +164,42 @@ def _sharded_decode_demo(quantized=False):
     CLI audits both and reports the wire-bytes ratio."""
     import jax
 
-    from ..models.llama import LlamaConfig, LlamaForCausalLM
-    from ..serving import ContinuousBatchingEngine
-
     mp = 2 if len(jax.devices()) >= 2 else 1
     if mp == 1 and not quantized:
         print("note: single-device host — auditing the mp=1 decode "
               "program (zero collectives); run with >= 2 devices "
               "(e.g. XLA_FLAGS=--xla_force_host_platform_device_count"
               "=2) for the sharded mp=2 demo", file=sys.stderr)
-    cfg = LlamaConfig.tiny()
-    model = LlamaForCausalLM(cfg)
-    eng = ContinuousBatchingEngine(
-        cfg, dict(model.raw_state()), slots=2, prompt_bucket=16,
-        max_prompt_len=32, max_new_tokens=8, block_size=16,
-        steps_per_sync=4, serving_mp=mp,
-        quantized_collectives=quantized)
+    eng = _tiny_engine(serving_mp=mp, quantized_collectives=quantized)
     tag = "+int8coll" if quantized else ""
     return (eng._decode, eng._decode_example_args(), {},
             f"models.llama tiny sharded decode (mp={mp}){tag}")
+
+
+def _tune_demo(device=None, budget_candidates=None):
+    """--tune target: autotune the tiny-llama engine (ISSUE 16). The
+    demo baseline shrinks block_size to 8 (so a larger candidate class
+    exists above it) and takes the split decode path (the unified
+    step's chunk-prefill activations dwarf the tiny pools); the HBM
+    budget is set just UNDER the largest candidate's static
+    params+pool bound, so the demo provably exercises both gates:
+    the top block-size class prunes BEFORE tracing on static bounds
+    alone, the unified candidates prune on traced liveness peaks, and
+    the all-defaults baseline stays feasible for the speedup
+    comparison."""
+    from . import tuner
+
+    cfg, params, kw = _tiny_serving_setup(block_size=8,
+                                          unified_step=False)
+    space = tuner.default_space(cfg, kw)
+    geo = tuner._engine_geometry(dict(kw))
+    bounds = [tuner.static_candidate_bound(cfg, params, c, kw)
+              for c in tuner.enumerate_candidates(space, geo)]
+    report = tuner.autotune(
+        cfg, params, engine_kwargs=kw, device=device,
+        hbm_budget_bytes=max(bounds) - 1,
+        budget_candidates=budget_candidates)
+    return report, cfg, params, kw
 
 
 def _resolve_target(spec, shapes, memory_mode=False, comms_mode=False,
@@ -180,6 +227,52 @@ def _resolve_target(spec, shapes, memory_mode=False, comms_mode=False,
         args = tuple(_parse_shape(s) for s in shapes)
         kwargs = {}
     return fn, args, kwargs, spec
+
+
+def _run_tune(args, rules, mesh_axes, rule_config) -> int:
+    """--tune mode: autotune the bundled serving demo, lint the
+    winner's decode program (the --fail-on gate prices the config the
+    tuner actually recommends, not the default one), and emit the
+    ranked TuningReport."""
+    from . import Severity, analyze
+    from .memory import trace_auto
+    from .tuner import KNOBS
+
+    if args.target is not None:
+        raise SystemExit(
+            "--tune runs the bundled tiny-llama serving demo; it does "
+            "not take a target (tune your own model via "
+            "paddle_tpu.analysis.autotune(cfg, params, ...))")
+    report, cfg, params, kw = _tune_demo(
+        device=args.device, budget_candidates=args.budget_candidates)
+    tuned = report.tuned_config()
+    if args.tune_out:
+        path = tuned.save(args.tune_out)
+        print(f"tuned config -> {path}", file=sys.stderr)
+    # rebuild the engine THROUGH the artifact — the lint target is the
+    # exact program `ContinuousBatchingEngine(config=...)` would serve
+    from ..serving import ContinuousBatchingEngine
+
+    geometry = {k: v for k, v in kw.items() if k not in KNOBS}
+    eng = ContinuousBatchingEngine(cfg, dict(params), config=tuned,
+                                   **geometry)
+    label = "models.llama tiny paged decode (tuned)"
+    graph = trace_auto(eng._decode, *eng._decode_example_args(),
+                       name=label)
+    lint = analyze(None, graph=graph, rules=rules, mesh_axes=mesh_axes,
+                   rule_config=rule_config)
+    if args.format == "json":
+        out = lint.to_dict()
+        out["tuning"] = report.to_dict()
+        print(json.dumps(out, sort_keys=True, indent=2))
+    else:
+        print(report.format())
+        print(lint.format(
+            min_severity=Severity[args.min_severity.upper()]))
+    if args.fail_on != "never" and \
+            lint.at_least(Severity[args.fail_on.upper()]):
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -224,12 +317,32 @@ def main(argv=None) -> int:
              "bytes against the --device spec row, predicted step "
              "latency + MFU + bound class in the output; with no "
              "target, audits the tiny-llama paged decode demo")
+    parser.add_argument(
+        "--tune", action="store_true",
+        help="run the auditor-driven static autotuner over the "
+             "tiny-llama serving demo: enumerate the engine config "
+             "space, prune over-HBM candidates, rank the rest by "
+             "predicted step time (roofline) then wire bytes (comms), "
+             "and lint the winning config's decode program; json "
+             "output gains a 'tuning' key (TuningReport schema)")
+    parser.add_argument(
+        "--budget-candidates", type=int, default=None, metavar="N",
+        help="with --tune: score at most N candidates (the all-"
+             "defaults baseline always rides along for the speedup "
+             "comparison)")
+    parser.add_argument(
+        "--tune-out", default=None, metavar="PATH",
+        help="with --tune: save the winning TunedConfig artifact to "
+             "PATH (a directory gets " + "'.paddle_tpu_tune.json'"
+             + "; load it with ContinuousBatchingEngine(config=...) "
+             "or FLAGS_tuned_config)")
     from .device_specs import DEVICE_SPECS
 
     parser.add_argument(
         "--device", default=None, choices=sorted(DEVICE_SPECS),
-        help="device-spec row for --roofline (analysis/device_specs."
-             "py; default: detect a live TPU, else tpu-v5e)")
+        help="device-spec row for --roofline / --tune (analysis/"
+             "device_specs.py; default: detect a live TPU, else "
+             "tpu-v5e)")
     parser.add_argument(
         "--format", default="text", choices=["text", "json"],
         help="output format; json prints one stable machine-readable "
@@ -248,19 +361,24 @@ def main(argv=None) -> int:
 
     from . import Severity, analyze
 
-    fn, call_args, call_kwargs, label = _resolve_target(
-        args.target, args.shape, memory_mode=args.memory,
-        comms_mode=args.comms, roofline_mode=args.roofline)
     rules = args.rules.split(",") if args.rules else None
     mesh_axes = args.mesh_axes.split(",") if args.mesh_axes else None
     rule_config = _parse_rule_config(args.rule_config) or None
     if args.device:
         # the TPU90x rules run in EVERY mode (registered defaults), so
         # an explicit --device must price them against the requested
-        # row even without --roofline
+        # row even without --roofline; TPU702's auto-armed budget
+        # likewise derives from the requested device row
         rule_config = dict(rule_config or {})
-        for rid in ("TPU901", "TPU902", "TPU903"):
+        for rid in ("TPU901", "TPU902", "TPU903", "TPU702"):
             rule_config.setdefault(f"{rid}.device", args.device)
+
+    if args.tune:
+        return _run_tune(args, rules, mesh_axes, rule_config)
+
+    fn, call_args, call_kwargs, label = _resolve_target(
+        args.target, args.shape, memory_mode=args.memory,
+        comms_mode=args.comms, roofline_mode=args.roofline)
 
     mem_report = comms_report = roofline_report = None
     quantized_decode = None

@@ -28,7 +28,8 @@ import dataclasses
 from typing import Dict, Optional
 
 __all__ = [
-    "DEVICE_SPECS", "DeviceSpec", "DEFAULT_DEVICE", "get_spec",
+    "DEFAULT_HBM_HEADROOM", "DEVICE_SPECS", "DeviceSpec",
+    "DEFAULT_DEVICE", "auto_hbm_budget", "get_spec",
     "spec_for_device_kind",
 ]
 
@@ -133,6 +134,29 @@ def spec_for_device_kind(kind: str) -> DeviceSpec:
     if "v4" in k:
         return DEVICE_SPECS["tpu-v4"]
     return DEVICE_SPECS[DEFAULT_DEVICE]
+
+
+# fraction of a device row's HBM held back from the auto-derived
+# budget: XLA workspace, runtime reserves, and the fragmentation slack
+# a liveness estimate cannot see. 10% of 16 GiB leaves the v5e row a
+# ~14.4 GiB budget — the same order as the usable-HBM figures serving
+# stacks report on that chip.
+DEFAULT_HBM_HEADROOM = 0.10
+
+
+def auto_hbm_budget(device: Optional[object] = None, *,
+                    headroom: float = DEFAULT_HBM_HEADROOM) -> int:
+    """Default per-chip HBM byte budget for a device row: capacity
+    minus a `headroom` fraction. The ONE derivation shared by TPU702's
+    auto-armed budget and the autotuner's feasibility gate
+    (analysis/tuner.py) — both compare per-chip byte estimates from
+    the liveness pass against it, so they must agree on what "fits"
+    means."""
+    spec = get_spec(device)
+    if not 0.0 <= headroom < 1.0:
+        raise ValueError(
+            f"headroom must be a fraction in [0, 1), got {headroom!r}")
+    return int(spec.hbm_bytes * (1.0 - headroom))
 
 
 def get_spec(device: Optional[object] = None) -> DeviceSpec:

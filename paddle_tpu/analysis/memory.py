@@ -1050,11 +1050,16 @@ class DonationMissRule(Rule):
 
 @register_rule
 class HBMBudgetRule(Rule):
-    """TPU702: the liveness pass predicts a peak over the configured
-    HBM budget. Off by default — arm it with
-    `rule_config={'TPU702.hbm_budget_bytes': ...}` (the serving
-    engine's audit derives a budget from its `kv_pool_bytes=` sizing;
-    CI passes one via `--rule-config`)."""
+    """TPU702: the liveness pass predicts a peak over the HBM budget.
+    The budget AUTO-ARMS from the device row's capacity minus a
+    headroom fraction (`device_specs.auto_hbm_budget` — the same
+    derivation the autotuner's feasibility gate uses): by default the
+    `TPU702.device` row (or the detected/default device) caps every
+    audited program at ~90% of its HBM. An explicit
+    `rule_config={'TPU702.hbm_budget_bytes': ...}` overrides it (the
+    serving engine's audit derives one from its `kv_pool_bytes=`
+    sizing; CI passes one via `--rule-config`); an explicit 0 disables
+    the rule outright."""
 
     id = "TPU702"
     name = "hbm-over-budget"
@@ -1062,6 +1067,12 @@ class HBMBudgetRule(Rule):
 
     def __init__(self, severity: Optional[Severity] = None, **config):
         super().__init__(severity, **config)
+        self._auto = "hbm_budget_bytes" not in self.config
+        if self._auto:
+            from .device_specs import auto_hbm_budget
+
+            self._budget = auto_hbm_budget(self.config.get("device"))
+            return
         raw = self.config.get("hbm_budget_bytes", 0)
         try:
             self._budget = int(raw or 0)
@@ -1083,9 +1094,11 @@ class HBMBudgetRule(Rule):
         top = ", ".join(
             f"{b.label} {b.bytes / (1 << 20):.1f} MiB"
             for b in rep.peak_buffers(3))
+        src = "auto device-row" if self._auto else "configured"
         yield self.diag(
             f"predicted peak HBM {rep.peak_bytes / (1 << 20):.2f} MiB "
-            f"per chip exceeds the {budget / (1 << 20):.2f} MiB budget "
+            f"per chip exceeds the {src} "
+            f"{budget / (1 << 20):.2f} MiB budget "
             f"(peak at {rep.peak_where}; largest: {top})",
             where=graph.name,
             hint="shrink the pool budget / batch, donate threaded "

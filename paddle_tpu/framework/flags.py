@@ -192,6 +192,30 @@ define_flag("quantized_collectives", False,
             "(also: PADDLE_TPU_QUANTIZED_COLLECTIVES)",
             env_aliases=("PADDLE_TPU_QUANTIZED_COLLECTIVES",))
 
+define_flag("compile_cache", "",
+            "persistent XLA compile-cache directory for the serving "
+            "engine (serving/compile_cache.py): non-empty enables "
+            "jax's compilation cache there at engine build, so a "
+            "fleet restart / elastic scale-out serves warm()'s "
+            "program zoo from disk instead of recompiling "
+            "(warm_compile_stats in engine.metrics() reports cold vs "
+            "warm counts). Empty (default) = off "
+            "(also: PADDLE_TPU_COMPILE_CACHE)",
+            env_aliases=("PADDLE_TPU_COMPILE_CACHE",))
+define_flag("tuned_config", "",
+            "path of a persisted TunedConfig artifact "
+            "(analysis/tuner.py, .paddle_tpu_tune.json; a directory "
+            "means <dir>/.paddle_tpu_tune.json): non-empty makes "
+            "ContinuousBatchingEngine default its build-time knobs "
+            "(kv_cache_dtype, decode_megakernel, unified_step, "
+            "serving_mp, quantized_collectives, token_budget, "
+            "block_size) from the autotuner's winner; explicit "
+            "engine kwargs still win per knob. A stale artifact "
+            "(schema/model mismatch) is ignored with a warning. "
+            "Empty (default) = off "
+            "(also: PADDLE_TPU_TUNED_CONFIG)",
+            env_aliases=("PADDLE_TPU_TUNED_CONFIG",))
+
 # --- observability (paddle_tpu.observability) ---
 define_flag("trace", "",
             "host span tracing: a non-empty value arms the global "

@@ -68,6 +68,38 @@ def fit_vmem_block(block: int, extent: int, row_bytes: int, *,
         bs -= 1
     return bs
 
+def vmem_block_candidates(extent: int, row_bytes: int, *,
+                          n_buffers: int = 4, reserve_bytes: int = 0,
+                          budget: int = VMEM_BUDGET_BYTES,
+                          max_candidates: int = 0) -> list:
+    """Every distinct block size `fit_vmem_block` can return for this
+    `extent` as the requested block sweeps upward: the divisors of
+    `extent` that keep `n_buffers` resident [bs, row_bytes] copies
+    under the scoped-VMEM budget, ascending. This is the kernel-side
+    block axis the static autotuner (analysis/tuner.py) enumerates —
+    candidates come from the SAME cap rule the kernels size against,
+    so a tuned block can never be one `fit_vmem_block` would clamp.
+    `max_candidates` > 0 keeps only the largest that many (larger
+    blocks amortize grid overhead; the small tail is rarely worth
+    scoring). `row_bytes=0` disables the cap (all divisors)."""
+    if extent < 1:
+        return []
+    if row_bytes > 0:
+        cap = vmem_row_cap(row_bytes, n_buffers=n_buffers,
+                           reserve_bytes=reserve_bytes, budget=budget)
+    else:
+        cap = extent
+    out = [d for d in range(1, extent + 1)
+           if extent % d == 0 and d <= cap]
+    if not out:
+        out = [fit_vmem_block(extent, extent, row_bytes,
+                              n_buffers=n_buffers,
+                              reserve_bytes=reserve_bytes, budget=budget)]
+    if max_candidates > 0:
+        out = out[-max_candidates:]
+    return out
+
+
 # dtype-name -> bytes per element, for the pure-shape roofline models
 # (no numpy/jax in checker context by contract)
 _ITEMSIZE: Dict[str, int] = {

@@ -1119,6 +1119,30 @@ def resolve_unified_step(unified_step=None) -> bool:
     return bool(unified_step)
 
 
+def serving_block_size_candidates(cfg, *, prompt_bucket: int,
+                                  kv_cache_dtype: str = "bf16",
+                                  max_candidates: int = 2) -> list:
+    """KV page sizes (``block_size``) a serving engine could be built
+    at for this model, ascending: the divisors of `prompt_bucket`
+    (whole pages per bucket — the engine's admission invariant) whose
+    per-token K+V row keeps double-buffered page blocks under the
+    streaming kernels' scoped-VMEM cap. Candidates come from
+    `kernels.constraints.vmem_block_candidates` — the SAME
+    `fit_vmem_block` rule the decode / prefix-prefill kernels size
+    their blocks with — so the static autotuner (analysis/tuner.py)
+    can only propose pages the kernels would actually tile at.
+    `max_candidates` keeps the largest few (big pages amortize block
+    tables and scatter launches; a deep small-page tail is never
+    competitive)."""
+    itemsize = 1 if resolve_kv_cache_dtype(kv_cache_dtype) == "int8" \
+        else 2
+    row = 2 * cfg.num_key_value_heads * cfg.head_dim * itemsize
+    from ..kernels.constraints import vmem_block_candidates
+
+    return vmem_block_candidates(int(prompt_bucket), row,
+                                 max_candidates=max_candidates)
+
+
 SERVING_MP_FALLBACK_MSG = (
     "kv heads not divisible by serving_mp; falling back to "
     "replicated-KV head-sharded-Q (each shard streams the FULL kv "

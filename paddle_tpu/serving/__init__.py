@@ -3,5 +3,11 @@ cache (reference contract: block_multihead_attention.py:25 — block
 tables + per-sequence lengths exist to serve ragged, changing batches).
 """
 from .engine import ContinuousBatchingEngine, ServeRequest
+from .compile_cache import (  # noqa: F401
+    cache_dir, enable_compile_cache,
+)
 
-__all__ = ["ContinuousBatchingEngine", "ServeRequest"]
+__all__ = [
+    "ContinuousBatchingEngine", "ServeRequest", "cache_dir",
+    "enable_compile_cache",
+]

@@ -1,0 +1,148 @@
+"""Persistent compile cache for the serving engine (ISSUE 16).
+
+`ContinuousBatchingEngine.warm()` compiles the whole program fleet —
+the decode chunk, the unified ragged step, and (on the split path) the
+prefill zoo. On a fleet restart or an elastic scale-out every replica
+pays that compile storm again for byte-identical programs. JAX already
+ships the fix — the persistent compilation cache keys compiled
+executables by program fingerprint and serves them from disk — this
+module wires it to the engine:
+
+- `enable_compile_cache(dir)` turns the cache on (idempotent;
+  FLAGS_compile_cache / PADDLE_TPU_COMPILE_CACHE is the zero-code
+  path: the engine enables it at build time when the flag is set);
+- a process-global monitoring listener counts compile requests vs
+  cache hits, so `warm()` can report COLD vs WARM compile counts
+  (`engine.warm_compile_stats`, surfaced through `metrics()`): a
+  second process warming the same engine off the same cache dir must
+  report zero misses — the scriptable "no compile storm" gate;
+- the tuned-config artifact (`analysis/tuner.py`,
+  `.paddle_tpu_tune.json`) is designed to live IN the cache dir, so
+  the tuned knobs and the executables they compiled travel together.
+
+The listener rides jax's internal monitoring events
+(``/jax/compilation_cache/*``). That API is private; every touch is
+guarded, and `counters_available` in the stats says whether the
+counts are real — callers must not treat an un-instrumented runtime
+as a cache miss.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = [
+    "cache_dir", "enable_compile_cache", "snapshot", "stats_since",
+]
+
+# compile-request / cache-hit counts since process start, fed by the
+# one registered monitoring listener
+_COUNTS = {"requests": 0, "hits": 0}
+_LISTENING = False
+_AVAILABLE = None   # None = listener not yet attempted
+_CACHE_DIR: Optional[str] = None
+
+_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+
+def _listener(event, **kwargs):
+    if event == _REQUEST_EVENT:
+        _COUNTS["requests"] += 1
+    elif event == _HIT_EVENT:
+        _COUNTS["hits"] += 1
+
+
+def _ensure_listener() -> bool:
+    """Register the monitoring listener once; False when the private
+    monitoring API is unavailable (counts then stay zero and stats
+    report counters_available=False)."""
+    global _LISTENING, _AVAILABLE
+    if _LISTENING:
+        return True
+    if _AVAILABLE is False:
+        return False
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_listener)
+        _LISTENING = True
+        _AVAILABLE = True
+    except Exception:
+        _AVAILABLE = False
+    return _LISTENING
+
+
+def enable_compile_cache(directory: Optional[str] = None) -> \
+        Optional[str]:
+    """Turn the persistent compilation cache on at `directory`
+    (default: FLAGS_compile_cache / PADDLE_TPU_COMPILE_CACHE; empty =
+    no-op returning None). Idempotent — re-enabling with the same dir
+    is free, a different dir repoints the cache. The min-compile-time
+    and min-entry-size floors are zeroed so EVERY engine program
+    persists: the fleet-restart win is the whole warm() zoo, and tiny
+    CI-model programs must exercise the same path the 70B fleet
+    relies on."""
+    global _CACHE_DIR
+    if directory is None:
+        from ..framework.flags import flag
+
+        directory = str(flag("compile_cache") or "")
+    if not directory:
+        return None
+    directory = os.path.abspath(str(directory))
+    os.makedirs(directory, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # jax latches its is-the-cache-on decision at the FIRST compile of
+    # the process; any jax op before this call (model init, engine
+    # pools) leaves the latch stuck on "disabled" — reads then consult
+    # a None cache and writes silently no-op. Reset so the next
+    # compile re-initializes against the directory just configured.
+    try:
+        from jax._src import compilation_cache as _jcc
+
+        cache = getattr(_jcc, "_cache", None)      # not is_initialized
+        live = cache is not None \
+            and str(getattr(cache, "_path", "")) == directory
+        if not live:
+            _jcc.reset_cache()
+    except Exception:
+        pass                # private API: config alone still works
+                            # when the latch was never tripped
+    _ensure_listener()
+    _CACHE_DIR = directory
+    return directory
+
+
+def cache_dir() -> Optional[str]:
+    """The enabled cache directory, or None when the persistent cache
+    is off (this process, via this module)."""
+    return _CACHE_DIR
+
+
+def snapshot() -> dict:
+    """Current cumulative counter values — take one before a compile
+    burst, hand it to `stats_since` after."""
+    _ensure_listener()
+    return dict(_COUNTS)
+
+
+def stats_since(snap: dict) -> dict:
+    """Compile-cache traffic since `snap`: requests that consulted the
+    persistent cache, hits served from it, and misses (fresh
+    compilations that wrote new entries). With the cache disabled jax
+    emits no events, so all three read 0 — `persistent_cache_dir`
+    (None) and `counters_available` disambiguate "no compiles" from
+    "not measured"."""
+    return {
+        "persistent_cache_dir": _CACHE_DIR,
+        "counters_available": bool(_AVAILABLE),
+        "compile_requests": _COUNTS["requests"] - snap.get("requests", 0),
+        "cache_hits": _COUNTS["hits"] - snap.get("hits", 0),
+        "cache_misses": (_COUNTS["requests"] - snap.get("requests", 0))
+        - (_COUNTS["hits"] - snap.get("hits", 0)),
+    }

@@ -199,7 +199,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg, dec_params, *, slots: int = 8,
                  prompt_bucket: int = 64, max_prompt_len: int = 512,
-                 max_new_tokens: int = 64, block_size: int = 64,
+                 max_new_tokens: int = 64,
+                 block_size: Optional[int] = None,
                  max_pages: Optional[int] = None, steps_per_sync: int = 8,
                  prefill_batch: int = 4,
                  eos_token_id: Optional[int] = None, do_sample: bool = False,
@@ -213,7 +214,7 @@ class ContinuousBatchingEngine:
                  quantized_collectives: Optional[bool] = None,
                  disaggregated: bool = False,
                  unified_step=None, token_budget: Optional[int] = None,
-                 tracer=None, metrics=None):
+                 config=None, tracer=None, metrics=None):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
         paged-pool element type: int8 pools halve the HBM bytes every
@@ -296,7 +297,54 @@ class ContinuousBatchingEngine:
         FLAGS_metrics), i.e. off unless the operator opted in — every
         instrumented site is then one `is None` check. Pass False to
         force OFF even when the global flags are armed (an untraced
-        baseline must stay untraced)."""
+        baseline must stay untraced).
+
+        `config` (ISSUE 16): a `TunedConfig` artifact from the static
+        autotuner (`analysis/tuner.py`) — or a dict / a path to a
+        persisted `.paddle_tpu_tune.json` — that DEFAULTS every
+        build-time knob the tuner swept (kv_cache_dtype,
+        decode_megakernel, unified_step, serving_mp,
+        quantized_collectives, token_budget, block_size). Explicit
+        kwargs win per knob; the flag-registry defaults only apply to
+        knobs neither the caller nor the artifact set. None follows
+        FLAGS_tuned_config / PADDLE_TPU_TUNED_CONFIG (a stale
+        flag-loaded artifact warns and is ignored; a stale EXPLICIT
+        one raises — the operator named it, so silence would serve
+        the wrong config); False forces OFF even when the flag is
+        set. With FLAGS_compile_cache / PADDLE_TPU_COMPILE_CACHE the
+        persistent compile cache (serving/compile_cache.py) is
+        enabled at build time, and `warm()` reports cold-vs-warm
+        compile counts on `metrics()['warm_compile_stats']`."""
+        # tuned-config artifact (analysis/tuner.py): fill unset
+        # build-time knobs from the autotuner's winner BEFORE any flag
+        # resolution below — the resolve_* helpers only see a value
+        # when the caller or the artifact pinned one
+        self.tuned_config = self._resolve_tuned_config(config, cfg)
+        if self.tuned_config is not None:
+            merged = self.tuned_config.apply(dict(
+                kv_cache_dtype=kv_cache_dtype,
+                decode_megakernel=decode_megakernel,
+                unified_step=unified_step, serving_mp=serving_mp,
+                quantized_collectives=quantized_collectives,
+                token_budget=token_budget, block_size=block_size))
+            kv_cache_dtype = merged["kv_cache_dtype"]
+            decode_megakernel = merged["decode_megakernel"]
+            unified_step = merged["unified_step"]
+            serving_mp = merged["serving_mp"]
+            quantized_collectives = merged["quantized_collectives"]
+            token_budget = merged["token_budget"]
+            block_size = merged["block_size"]
+        if block_size is None:
+            block_size = 64
+        block_size = int(block_size)
+        # persistent compile cache (FLAGS_compile_cache): enabled at
+        # build time so this engine's warm() compiles persist to (and
+        # load from) disk; a no-op when the flag is empty and the
+        # cache was not enabled explicitly
+        from . import compile_cache as _compile_cache
+
+        _compile_cache.enable_compile_cache(None)
+        self.warm_compile_stats = None  # set by warm()
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a whole number of "
@@ -673,6 +721,13 @@ class ContinuousBatchingEngine:
             # MFU fleet report from the last audit_roofline() /
             # warm(audit_roofline=True) run — None until one ran
             "roofline_audit": self._roofline_audit,
+            # autotuner artifact + persistent compile cache (ISSUE 16):
+            # the knobs this engine was built from (None = registry
+            # defaults) and cold-vs-warm compile traffic from the last
+            # warm() — cache_misses == 0 is the "no compile storm" gate
+            "tuned_config": (self.tuned_config.to_dict()
+                             if self.tuned_config is not None else None),
+            "warm_compile_stats": self.warm_compile_stats,
         }
 
     @staticmethod
@@ -681,6 +736,54 @@ class ContinuousBatchingEngine:
             return int(fn._cache_size())
         except Exception:
             return -1
+
+    @staticmethod
+    def _resolve_tuned_config(config, cfg):
+        """`config=` -> a validated TunedConfig or None. Accepts a
+        TunedConfig, a dict (its to_dict form), or a path; None falls
+        back to FLAGS_tuned_config / PADDLE_TPU_TUNED_CONFIG, False
+        forces off. Staleness (schema version / model-shape mismatch)
+        raises for an explicit artifact and warns+ignores for a
+        flag-loaded one — a fleet-wide env var must not brick engines
+        built for a different model."""
+        import warnings
+
+        if config is False:
+            return None
+        from ..analysis.tuner import TunedConfig
+
+        explicit = config is not None
+        if config is None:
+            from ..framework.flags import flag
+
+            path = str(flag("tuned_config") or "")
+            if not path:
+                return None
+            try:
+                tuned = TunedConfig.load(path)
+            except Exception as e:
+                warnings.warn(
+                    f"FLAGS_tuned_config {path!r} unreadable ({e}); "
+                    "building with registry defaults", stacklevel=3)
+                return None
+        elif isinstance(config, TunedConfig):
+            tuned = config
+        elif isinstance(config, dict):
+            tuned = TunedConfig.from_dict(config)
+        else:
+            tuned = TunedConfig.load(str(config))
+        stale = tuned.stale_reason(cfg=cfg)
+        if stale is not None:
+            if explicit:
+                raise ValueError(
+                    f"stale TunedConfig (config=): {stale}; re-run "
+                    "the autotuner (python -m paddle_tpu.analysis "
+                    "--tune) for this model/device")
+            warnings.warn(
+                f"FLAGS_tuned_config artifact is stale ({stale}); "
+                "building with registry defaults", stacklevel=3)
+            return None
+        return tuned
 
     def add_request(self, prompt, max_new: Optional[int] = None,
                     arrival_time: Optional[float] = None) -> ServeRequest:
@@ -1053,7 +1156,17 @@ class ContinuousBatchingEngine:
         `predicted_step_ms` / `predicted_mfu` gauges — onto
         `metrics()['roofline_audit']`. Default (None) follows
         FLAGS_audit_roofline / PADDLE_TPU_AUDIT_ROOFLINE, also implied
-        by PADDLE_TPU_LINT=1."""
+        by PADDLE_TPU_LINT=1.
+
+        Compile-cache accounting (ISSUE 16): the persistent-cache
+        counter delta over this warm() lands on
+        `self.warm_compile_stats` / `metrics()['warm_compile_stats']`
+        — a COLD warm reports misses (fresh compiles written to the
+        cache), a WARM one off a populated cache dir must report
+        `cache_misses == 0`."""
+        from . import compile_cache as _compile_cache
+
+        cc_snap = _compile_cache.snapshot()
         buckets = [self.max_prompt_len] if buckets is None else buckets
         if self.unified:
             # ONE program covers every prompt shape (cold, cached,
@@ -1143,6 +1256,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.top_p, jnp.float32))
         _, _, _, self.kcs, self.vcs = out
         np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
+        self.warm_compile_stats = _compile_cache.stats_since(cc_snap)
         from ..analysis.comms import resolve_audit_comms
         from ..analysis.memory import resolve_audit_memory
         from ..analysis.roofline import resolve_audit_roofline
@@ -1259,7 +1373,10 @@ class ContinuousBatchingEngine:
         `hbm_budget_bytes` arms TPU702; default (None) derives a
         budget from the engine's explicit `kv_pool_bytes=` sizing when
         one was given — pool budget + per-chip param bytes + 25%
-        activation headroom — and leaves TPU702 off otherwise.
+        activation headroom — and otherwise from the device-spec row
+        (`analysis.device_specs.auto_hbm_budget`: HBM capacity minus
+        the default headroom fraction), the same gate the autotuner
+        prunes against. Pass 0 to disarm TPU702 entirely.
         `programs` filters by inventory name ("decode",
         "prefill:cold:..."); unknown names raise, and a filtered run
         returns a `partial` report WITHOUT touching the fleet sinks.
@@ -1281,9 +1398,17 @@ class ContinuousBatchingEngine:
             base = self._kv_pool_budget \
                 + _mem.pytree_local_bytes(self.p)
             hbm_budget_bytes = base + max(base // 4, 1 << 20)
-        rule_config = {}
-        if hbm_budget_bytes:
-            rule_config["TPU702.hbm_budget_bytes"] = int(hbm_budget_bytes)
+        elif hbm_budget_bytes is None:
+            # no explicit pool sizing to derive from: fall back to the
+            # device row's capacity minus headroom (ISSUE 16 satellite)
+            # — ONE budget helper shared with the autotuner's
+            # feasibility gate and TPU702's auto-arm default
+            from ..analysis.device_specs import auto_hbm_budget
+            hbm_budget_bytes = auto_hbm_budget()
+        # always pass the resolved budget through: 0 explicitly
+        # DISARMS TPU702 (the rule auto-arms from the device row when
+        # the key is absent, so omission would re-enable it)
+        rule_config = {"TPU702.hbm_budget_bytes": int(hbm_budget_bytes)}
         if graphs is None:
             graphs = self._traced_inventory(programs)
         min_miss = _mem.DonationMissRule.MIN_BYTES
