@@ -10,6 +10,20 @@ Usage: python bench_serving.py CONFIG [CONFIG...] [--trace out.json]
   Perfetto JSON; each result row then embeds a `metrics` snapshot
   (generate-call latency histogram percentiles).
 
+Loadgen mode (ISSUE 17): drive the fleet front-end with a timed
+arrival process instead of steady-state slopes::
+
+    python bench_serving.py --arrivals poisson:2,8,32 --workers 2
+    python bench_serving.py --arrivals replay:trace.json
+
+Each offered rate prints one JSON row: useful tok/s (tokens of
+FINISHED requests over the serve wall time), shed rate, and router
+TTFT/TPOT p99 per priority class — sweep rates to find the saturation
+knee, the point where useful tok/s flattens while shed rate climbs.
+``replay:FILE`` reads ``{"arrivals": [t..], "prompts": [[tok..]..]}``
+(optional ``priorities``, ``max_new``) and replays the recorded
+arrival clock.
+
 Measures ms/decode-step by paired slope (bench_util.paired_slope_ms):
 the program runs at max_new=2 and max_new=130, the step cost is the
 MEDIAN over 8 adjacent-pair slopes (t_130 - t_2)/128 — prefill and
@@ -215,8 +229,134 @@ def run_paged_config(name: str, b: int = 4, sb: int = 128,
     return result
 
 
+# ---------------------------------------------------------------------
+# loadgen mode (ISSUE 17): trace-driven arrivals against the SLO router
+# ---------------------------------------------------------------------
+
+def _loadgen_trace(spec: str, n: int, max_new: int, seed: int, vocab: int):
+    """One arrival trace: (arrival_offsets_s, prompts, priorities,
+    max_new, offered_rate). `poisson:R` draws exponential interarrivals
+    at R req/s; `replay:FILE` replays a recorded clock."""
+    rng = np.random.default_rng(seed)
+    kind, _, arg = spec.partition(":")
+    if kind == "poisson":
+        rate = float(arg)
+        gaps = rng.exponential(1.0 / rate, n)
+        arrivals = np.cumsum(gaps).tolist()
+        prompts = [rng.integers(1, vocab, (int(rng.integers(3, 9)),))
+                   .tolist() for _ in range(n)]
+        prios = [("high", "normal", "low")[i % 3] for i in range(n)]
+        return arrivals, prompts, prios, [max_new] * n, rate
+    if kind == "replay":
+        with open(arg) as f:
+            doc = json.load(f)
+        arrivals = [float(t) for t in doc["arrivals"]]
+        prompts = [[int(t) for t in p] for p in doc["prompts"]]
+        n = len(arrivals)
+        prios = list(doc.get("priorities") or ["normal"] * n)
+        mn = doc.get("max_new") or max_new
+        mns = [int(mn)] * n if isinstance(mn, (int, float)) \
+            else [int(v) for v in mn]
+        span = arrivals[-1] - arrivals[0] if n > 1 else 1.0
+        return arrivals, prompts, prios, mns, n / max(span, 1e-9)
+    raise SystemExit(f"--arrivals must be poisson:RATE or replay:FILE, "
+                     f"got {spec!r}")
+
+
+def run_loadgen(argv):
+    import argparse
+    import dataclasses
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.serving import (ContinuousBatchingEngine, Fleet,
+                                    Rejected, Router)
+
+    ap = argparse.ArgumentParser(
+        prog="python bench_serving.py --arrivals ...")
+    ap.add_argument("--arrivals", required=True,
+                    help="poisson:RATE[,RATE...] | replay:FILE")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=24,
+                    help="requests per offered rate (poisson mode)")
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ttft-slo", type=float, default=None,
+                    help="per-request TTFT budget handed to admission")
+    ap.add_argument("--model", default="tiny")
+    args = ap.parse_args(argv)
+
+    cfg = getattr(LlamaConfig, args.model)()
+    if args.model == "tiny":
+        cfg = dataclasses.replace(cfg, num_key_value_heads=2)
+    paddle.seed(args.seed)
+    params = dict(LlamaForCausalLM(cfg).raw_state())
+
+    def factory(*, metrics, tracer):
+        return ContinuousBatchingEngine(
+            cfg, params, slots=2, prompt_bucket=8, max_prompt_len=32,
+            max_new_tokens=max(args.max_new, 4), block_size=8,
+            steps_per_sync=2, metrics=metrics, tracer=tracer)
+
+    kind, _, arg = args.arrivals.partition(":")
+    specs = ([f"poisson:{r}" for r in arg.split(",")]
+             if kind == "poisson" else [args.arrivals])
+    rows = []
+    for spec in specs:
+        arrivals, prompts, prios, mns, rate = _loadgen_trace(
+            spec, args.requests, args.max_new, args.seed,
+            cfg.vocab_size)
+        fleet = Fleet(factory, heartbeat_s=0.25)
+        router = Router(fleet, max_queue=8)
+        for _ in range(args.workers):
+            fleet.add_worker()
+        t0 = time.perf_counter()
+        base = arrivals[0]
+        results = []
+        for t, p, pr, mn in zip(arrivals, prompts, prios, mns):
+            delay = (t - base) - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            results.append(router.submit(
+                p, mn, priority=pr, ttft_deadline_s=args.ttft_slo))
+            router.poll()
+        router.join(timeout=600)
+        wall = time.perf_counter() - t0
+        fleet.stop()
+        m = router.metrics()
+        live = [r for r in results if not isinstance(r, Rejected)]
+        useful_tokens = sum(len(r.tokens) for r in live
+                            if r.state == "finished")
+        row = {
+            "bench": "serving_loadgen", "arrivals": spec,
+            "workers": args.workers, "offered_req_s": round(rate, 3),
+            "submitted": len(results), "finished": len(
+                [r for r in live if r.state == "finished"]),
+            "shed": len(results) - len(live),
+            "shed_rate": round((len(results) - len(live))
+                               / max(len(results), 1), 3),
+            "shed_by_reason": {k: v for k, v
+                               in m["shed_by_reason"].items() if v},
+            "useful_tok_s": round(useful_tokens / wall, 2),
+            "wall_s": round(wall, 2),
+            "deadline_miss": m["deadline_miss"],
+        }
+        for p in ("high", "normal", "low"):
+            for which in ("ttft", "tpot"):
+                h = router.mt.histogram(f"router_{which}_s_{p}")
+                if h.count:
+                    row[f"{which}_p99_s_{p}"] = round(
+                        h.percentile(99), 4)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    return rows
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
+    if "--arrivals" in args:
+        run_loadgen(args)
+        sys.exit(0)
     from bench_util import pop_trace_arg
 
     trace_path = pop_trace_arg(

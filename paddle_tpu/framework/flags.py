@@ -215,6 +215,22 @@ define_flag("tuned_config", "",
             "Empty (default) = off "
             "(also: PADDLE_TPU_TUNED_CONFIG)",
             env_aliases=("PADDLE_TPU_TUNED_CONFIG",))
+define_flag("fleet_heartbeat_s", 0.25,
+            "decode-fleet worker heartbeat interval in seconds "
+            "(serving/fleet.py): each worker renews a TTL lease in the "
+            "fleet store every interval; a lease older than 4x the "
+            "interval marks the worker dead and triggers fencing + "
+            "in-flight request recovery "
+            "(also: PADDLE_TPU_FLEET_HEARTBEAT_S)",
+            env_aliases=("PADDLE_TPU_FLEET_HEARTBEAT_S",))
+define_flag("router_max_queue", 64,
+            "SLO router queue-depth bound (serving/router.py): the "
+            "admission cap for LOW-priority requests; normal gets 2x, "
+            "high 4x. Beyond its class cap a request is shed with a "
+            "structured Rejected(reason='overloaded', retry_after_s) "
+            "instead of growing an unbounded backlog "
+            "(also: PADDLE_TPU_ROUTER_MAX_QUEUE)",
+            env_aliases=("PADDLE_TPU_ROUTER_MAX_QUEUE",))
 
 # --- observability (paddle_tpu.observability) ---
 define_flag("trace", "",

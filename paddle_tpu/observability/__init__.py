@@ -37,11 +37,12 @@ from . import metrics, trace  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, get_metrics)
 from .trace import (Tracer, TraceUnderJitError,  # noqa: F401
-                    get_tracer, write_chrome_trace)
+                    get_tracer, merge_chrome_traces, write_chrome_trace)
 
 __all__ = ["trace", "metrics", "Tracer", "TraceUnderJitError",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "get_tracer", "get_metrics", "record_event"]
+           "get_tracer", "get_metrics", "record_event",
+           "write_chrome_trace", "merge_chrome_traces"]
 
 
 def record_event(name: str, **fields) -> None:

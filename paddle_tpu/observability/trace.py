@@ -45,8 +45,8 @@ from collections import deque
 from typing import Optional
 
 __all__ = ["Tracer", "TraceUnderJitError", "write_chrome_trace",
-           "get_tracer", "enable", "disable", "span", "instant",
-           "export_global"]
+           "merge_chrome_traces", "get_tracer", "enable", "disable",
+           "span", "instant", "export_global"]
 
 
 class TraceUnderJitError(RuntimeError):
@@ -87,6 +87,48 @@ def write_chrome_trace(events, path: str, *, metadata: Optional[dict] = None,
     with open(path, "w") as f:
         json.dump(doc, f)
     return path
+
+
+def merge_chrome_traces(paths, out: Optional[str] = None, *,
+                        labels=None) -> dict:
+    """Merge per-worker chrome traces into ONE Perfetto JSON document
+    (the ROADMAP cross-host trace-merge follow-up, ISSUE 17).
+
+    Every input file becomes one PROCESS in the merged timeline: its
+    events are re-stamped ``pid=i`` (in-process fleet workers all share
+    the real pid — without the re-stamp their tracks would interleave
+    into one unreadable process) and a ``process_name`` metadata row
+    names the track (``labels[i]`` or the file's basename). Wall-clock
+    ``ts`` values are left untouched: all workers of one serving group
+    share a clock, so cross-worker causality (kill -> requeue ->
+    re-prefill) reads directly off the merged view. Returns the merged
+    document; also writes it when `out` is given."""
+    paths = list(paths)
+    merged: list = []
+    meta: dict = {"merged_from": []}
+    for i, p in enumerate(paths):
+        with open(p) as f:
+            doc = json.load(f)
+        if isinstance(doc, list):       # bare event-array form
+            doc = {"traceEvents": doc}
+        events = doc.get("traceEvents") or []
+        label = labels[i] if labels and i < len(labels) else None
+        if label is None:
+            label = os.path.splitext(os.path.basename(p))[0]
+        merged.append({"name": "process_name", "ph": "M", "pid": i,
+                       "tid": 0, "args": {"name": label}})
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = i
+            merged.append(ev)
+        meta["merged_from"].append({"pid": i, "label": label,
+                                    "path": str(p)})
+        for k, v in (doc.get("metadata") or {}).items():
+            meta.setdefault(k, v)
+    doc = {"traceEvents": merged, "metadata": meta}
+    if out:
+        write_chrome_trace(merged, out, metadata=meta)
+    return doc
 
 
 class _SpanHandle:

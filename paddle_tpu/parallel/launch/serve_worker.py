@@ -1,0 +1,210 @@
+"""Decode-fleet subprocess worker entrypoint (ISSUE 17).
+
+The cross-process twin of `serving.fleet.FleetWorker`: one engine per
+PROCESS, talking to the fleet through a `resilience.store.FileStore`
+mailbox instead of an in-memory one, so workers can live in separate
+processes (and, with a shared filesystem, separate hosts). Launch one
+directly::
+
+    python -m paddle_tpu.parallel.launch.serve_worker \
+        --store /tmp/fleet --job f1 --worker-id w0 --index 0
+
+or a gang of them under the PR 12 supervisor (worker id / index
+default from ``PADDLE_GANG_RANK``)::
+
+    python -m paddle_tpu.parallel.launch.gang -n 2 -- \
+        python -m paddle_tpu.parallel.launch.serve_worker --store ...
+
+Store protocol (all keys under ``fleet/<job>/``; values are JSON):
+
+- ``info/<wid>``     worker -> fleet: engine capacities, written once
+  at startup (readiness marker);
+- ``hb/<wid>``       TTL heartbeat lease, renewed every
+  ``--heartbeat-s`` (death = expired lease);
+- ``req/<wid>/<seq>`` fleet -> worker: one dispatch
+  ``{rid, prompt, max_new, priority, deadline_s}`` (deleted on
+  accept);
+- ``prog/<wid>/<rid>`` worker -> fleet: delivered-token stream for
+  in-flight recovery;
+- ``done/<wid>/<rid>`` worker -> fleet: terminal result
+  ``{tokens, failed, error}``;
+- ``requeue/<wid>/<rid>`` worker -> fleet: unstarted requests handed
+  back by a drain;
+- ``ctl/<wid>``      fleet -> worker: ``stop`` | ``drain``.
+
+Chaos: the loop runs the same ``fleet.worker`` seam as the in-process
+worker; `ChaosKilled` is translated into a real ``SIGKILL`` (no
+cleanup, no flush — the `preempt_host` semantics)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _parse(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.parallel.launch.serve_worker")
+    ap.add_argument("--store", required=True,
+                    help="FileStore root shared with the fleet")
+    ap.add_argument("--job", default="fleet")
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--index", type=int, default=None)
+    ap.add_argument("--lease-epoch", type=int, default=0)
+    ap.add_argument("--heartbeat-s", type=float, default=0.25)
+    ap.add_argument("--poll-s", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--model", default="tiny",
+                    help="LlamaConfig classmethod name (tiny, llama_1b)")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--prompt-bucket", type=int, default=8)
+    ap.add_argument("--max-prompt-len", type=int, default=32)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--steps-per-sync", type=int, default=2)
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse(argv)
+    from ...resilience import chaos
+    from ...resilience.store import FileStore
+
+    rank = chaos.gang_rank()
+    wid = args.worker_id or f"w{rank if rank is not None else 0}"
+    index = args.index if args.index is not None \
+        else (rank if rank is not None else 0)
+    store = FileStore(args.store)
+    pre = f"fleet/{args.job}"
+    hb_key = f"{pre}/hb/{wid}"
+    ttl = 4.0 * args.heartbeat_s
+
+    def heartbeat(step):
+        store.put(hb_key, json.dumps(
+            {"t": time.time(), "epoch": args.lease_epoch,
+             "step": step}), ttl=ttl)
+
+    heartbeat(0)  # lease exists before the (slow) engine build
+
+    import dataclasses
+
+    import paddle_tpu as paddle
+    from ...models import LlamaConfig, LlamaForCausalLM
+    from ...serving.engine import ContinuousBatchingEngine
+
+    cfg = getattr(LlamaConfig, args.model)()
+    if args.model == "tiny":
+        cfg = dataclasses.replace(cfg, num_key_value_heads=2)
+    paddle.seed(args.seed)
+    params = dict(LlamaForCausalLM(cfg).raw_state())
+    eng = ContinuousBatchingEngine(
+        cfg, params, slots=args.slots, prompt_bucket=args.prompt_bucket,
+        max_prompt_len=args.max_prompt_len, max_new_tokens=args.max_new,
+        block_size=args.block_size, steps_per_sync=args.steps_per_sync)
+    heartbeat(0)
+    store.put(f"{pre}/info/{wid}", json.dumps(
+        {"slots": eng.slots, "max_prompt_len": eng.max_prompt_len,
+         "max_new": eng.max_new, "pid": os.getpid()}))
+
+    active = {}      # engine req_id -> rid
+    last_len = {}    # rid -> tokens reported
+    fin_seen = 0
+    state = {"steps": 0}
+    draining = False
+
+    # renew the lease from a sidecar thread: a blocking engine.step()
+    # (first-step compile takes seconds) must not expire it, but a
+    # SIGKILLed process takes the thread with it and the lease lapses
+    import threading
+
+    hb_stop = threading.Event()
+
+    def _hb_loop():
+        while not hb_stop.is_set():
+            heartbeat(state["steps"])
+            hb_stop.wait(args.heartbeat_s)
+
+    threading.Thread(target=_hb_loop, daemon=True).start()
+
+    def accept():
+        for key in sorted(store.prefix(f"{pre}/req/{wid}/")):
+            raw = store.get(key)
+            store.delete(key)
+            if raw is None:
+                continue
+            d = json.loads(raw)
+            if draining:
+                store.put(f"{pre}/requeue/{wid}/{d['rid']}",
+                          json.dumps({"rid": d["rid"]}))
+                continue
+            try:
+                ereq = eng.add_request(
+                    d["prompt"], d["max_new"],
+                    priority=d.get("priority") or "normal",
+                    deadline_s=d.get("deadline_s"))
+            except Exception as e:
+                store.put(f"{pre}/done/{wid}/{d['rid']}", json.dumps(
+                    {"tokens": [], "failed": True, "error": str(e)}))
+                continue
+            active[ereq.req_id] = d["rid"]
+            last_len[d["rid"]] = 0
+
+    def report():
+        nonlocal fin_seen
+        while fin_seen < len(eng.finished):
+            ereq = eng.finished[fin_seen]
+            fin_seen += 1
+            rid = active.pop(ereq.req_id, None)
+            if rid is None:
+                continue
+            last_len.pop(rid, None)
+            store.delete(f"{pre}/prog/{wid}/{rid}")
+            store.put(f"{pre}/done/{wid}/{rid}", json.dumps(
+                {"tokens": list(ereq.tokens), "failed": ereq.failed,
+                 "error": ereq.error}))
+        for ereq in (eng.export_progress() if active else ()):
+            rid = active.get(ereq["req_id"])
+            if rid is not None and \
+                    len(ereq["tokens"]) > last_len.get(rid, 0):
+                last_len[rid] = len(ereq["tokens"])
+                store.put(f"{pre}/prog/{wid}/{rid}",
+                          json.dumps({"tokens": ereq["tokens"]}))
+
+    try:
+        while True:
+            chaos.maybe_kill_worker(index, state["steps"])
+            ctl = store.get(f"{pre}/ctl/{wid}")
+            if ctl == "stop":
+                break
+            if ctl == "drain":
+                draining = True
+                eng.pause_admission(True)
+            accept()
+            if eng.n_active > 0 or eng._prefilling is not None \
+                    or eng._handoff or (eng.waiting and not draining):
+                eng.step()
+                report()
+            elif draining:
+                for ereq in eng.take_waiting():
+                    rid = active.pop(ereq.req_id, None)
+                    if rid is not None:
+                        store.put(f"{pre}/requeue/{wid}/{rid}",
+                                  json.dumps({"rid": rid}))
+                break
+            else:
+                time.sleep(args.poll_s)
+            state["steps"] += 1
+    except chaos.ChaosKilled:
+        # a hard worker death: no flush, no lease deregistration —
+        # exactly what a preempted host looks like from the outside
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+    hb_stop.set()
+    store.delete(hb_key)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entrypoint
+    raise SystemExit(main())

@@ -18,7 +18,7 @@ matrix):
   barriers AND `parallel/elastic.py` rendezvous through;
 - `chaos`         — deterministic seed-driven fault injection armed via
   ``PADDLE_TPU_CHAOS`` (io_error / corrupt / preempt_at /
-  preempt_host:K@N / hang);
+  preempt_host:K@N / hang / kill_worker:K@N);
 - `preemption`    — SIGTERM/SIGINT -> step-boundary flag -> emergency
   checkpoint + clean exit;
 - `watchdog`      — wall-clock deadlines around step callables, raising
@@ -38,7 +38,9 @@ from .checkpoint import (  # noqa: F401
     CheckpointManager, CheckpointNotFoundError, restore_checkpoint,
     save_checkpoint,
 )
-from .chaos import ChaosError, ChaosHang, ChaosMonkey  # noqa: F401
+from .chaos import (  # noqa: F401
+    ChaosError, ChaosHang, ChaosKilled, ChaosMonkey,
+)
 from .coordination import (  # noqa: F401
     Barrier, BarrierTimeout, Coordinator, GangCheckpointManager,
 )
@@ -50,7 +52,8 @@ from .watchdog import StepTimeout, Watchdog  # noqa: F401
 __all__ = [
     "Barrier", "BarrierTimeout", "Checkpoint", "CheckpointCorruptError",
     "CheckpointError", "CheckpointManager", "CheckpointNotFoundError",
-    "ChaosError", "ChaosHang", "ChaosMonkey", "Coordinator",
+    "ChaosError", "ChaosHang", "ChaosKilled", "ChaosMonkey",
+    "Coordinator",
     "DictStore", "EXIT_PREEMPTED", "FileStore", "GangCheckpointManager",
     "PreemptionGuard", "RetryPolicy", "RetryStats", "StepTimeout",
     "Watchdog", "chaos", "restore_checkpoint", "retry",

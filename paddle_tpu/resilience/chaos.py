@@ -31,6 +31,15 @@ Fault grammar (comma-separated ``kind:arg[:arg2]``):
     hang:SEAM[:SECS]    stall SEAM for SECS (default 60) once, then
                         raise ChaosHang so the abandoned worker thread
                         unwinds without side effects
+    kill_worker:K@N     `preempt_host`-style kill of FLEET worker K at
+                        its `fleet.worker` loop step N (exactly, once):
+                        an in-process decode worker thread unwinds on
+                        ChaosKilled WITHOUT deregistering, flushing
+                        progress, or returning its queue — the fleet
+                        must detect the death (dead thread / expired
+                        heartbeat lease), fence the worker, and recover
+                        its in-flight requests; a subprocess worker
+                        entrypoint translates ChaosKilled into SIGKILL
 
 Faults count their firings in `.counters` so benches
 (``bench_checkpoint_stream.py --inject io_error``) can report how much
@@ -55,6 +64,14 @@ class ChaosHang(RuntimeError):
     """Raised after a chaos hang elapses — the stall is over and the
     (typically watchdog-abandoned) thread must unwind WITHOUT touching
     shared state it no longer owns."""
+
+
+class ChaosKilled(BaseException):
+    """An injected hard worker death (`kill_worker:K@N`). Deliberately
+    NOT an Exception: a fleet worker's defensive `except Exception`
+    around its serve loop must not swallow it — death means no final
+    progress report, no heartbeat deregistration, no cleanup, exactly
+    like a SIGKILLed process."""
 
 
 @dataclass
@@ -111,10 +128,19 @@ class ChaosMonkey:
                 f = _Fault(kind, seam=bits[1] if len(bits) > 1 else "",
                            seconds=float(bits[2]) if len(bits) > 2
                            else 60.0)
+            elif kind == "kill_worker":
+                rank_s, sep, step_s = (bits[1] if len(bits) > 1
+                                       else "").partition("@")
+                if not sep:
+                    raise ValueError(
+                        f"kill_worker needs K@N (kill fleet worker K at "
+                        f"its step N), got {part!r} in spec {spec!r}")
+                f = _Fault(kind, rank=int(rank_s), step=int(step_s))
             else:
                 raise ValueError(
                     f"unknown chaos fault {kind!r} in spec {spec!r}; "
-                    "known: io_error, corrupt, preempt_at, hang")
+                    "known: io_error, corrupt, preempt_at, "
+                    "preempt_host, hang, kill_worker")
             self.faults.append(f)
 
     def _count(self, fault: _Fault, seam: str = ""):
@@ -185,6 +211,21 @@ class ChaosMonkey:
                 import signal
 
                 os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_kill_worker(self, worker: int, step: int):
+        """The `fleet.worker` seam: called once per fleet-worker loop
+        iteration; raises ChaosKilled when THIS worker index executes
+        the armed step exactly (once per fault — mirrors
+        `preempt_host`'s one-shot equality so a recovered/requeued
+        request does not re-kill a relaunched worker at the same
+        step)."""
+        for f in self.faults:
+            if f.kind == "kill_worker" and not f.fired \
+                    and step == f.step and worker == f.rank:
+                self._count(f, f"fleet.worker:{worker}")
+                raise ChaosKilled(
+                    f"chaos: fleet worker {worker} killed at step "
+                    f"{step} (kill_worker:{f.rank}@{f.step})")
 
     def maybe_hang(self, seam: str):
         """Stall once at `seam`, then raise ChaosHang (the stalled
@@ -269,6 +310,12 @@ def maybe_hang(seam: str):
     c = get_chaos()
     if c is not None:
         c.maybe_hang(seam)
+
+
+def maybe_kill_worker(worker: int, step: int):
+    c = get_chaos()
+    if c is not None:
+        c.maybe_kill_worker(worker, step)
 
 
 def counters() -> Dict[str, int]:

@@ -6,8 +6,11 @@ from .engine import ContinuousBatchingEngine, ServeRequest
 from .compile_cache import (  # noqa: F401
     cache_dir, enable_compile_cache,
 )
+from .fleet import Fleet, FleetWorker, SubprocessWorker  # noqa: F401
+from .router import Rejected, Request, Router  # noqa: F401
 
 __all__ = [
     "ContinuousBatchingEngine", "ServeRequest", "cache_dir",
-    "enable_compile_cache",
+    "enable_compile_cache", "Fleet", "FleetWorker",
+    "SubprocessWorker", "Rejected", "Request", "Router",
 ]

@@ -138,6 +138,11 @@ class ServeRequest:
     # chained block hashes, computed ONCE at add_request — _plan runs
     # for every waiting request on every scheduling step
     block_hashes: Optional[list] = None
+    # SLO metadata (ISSUE 17): set by the caller, carried through
+    # handoff untouched, surfaced in req.enqueue/req.retire instants —
+    # the engine itself never sheds on them (that is the router's job)
+    priority: str = "normal"
+    deadline_s: Optional[float] = None
 
     @property
     def done(self) -> bool:
@@ -531,6 +536,7 @@ class ContinuousBatchingEngine:
         self.hung_retired = 0    # slots retired by the watchdog
         self.hung_requeued = 0   # hung slots requeued (requeue_hung=)
         self._requeue_hung = False  # armed per run()
+        self._admission_paused = False  # pause_admission() / drain()
         self.prefix_hit_tokens = 0   # prompt tokens served from cache
         self.prompt_tokens = 0       # prompt tokens admitted in total
         self.prefix_inserts = 0      # blocks registered into the cache
@@ -786,10 +792,19 @@ class ContinuousBatchingEngine:
         return tuned
 
     def add_request(self, prompt, max_new: Optional[int] = None,
-                    arrival_time: Optional[float] = None) -> ServeRequest:
+                    arrival_time: Optional[float] = None,
+                    priority: Optional[str] = None,
+                    deadline_s: Optional[float] = None) -> ServeRequest:
         """Validate + enqueue. Every reject happens HERE, before the
         request owns a slot or pages — failing deep inside `_admit` /
-        prefill bucketing would wedge scheduling state."""
+        prefill bucketing would wedge scheduling state.
+
+        `priority` / `deadline_s` (ISSUE 17 satellite) are pure
+        metadata: they ride the request through prefill handoff and
+        show up in the `req.enqueue` / `req.retire` trace instants so a
+        single-engine deployment gets deadline observability without
+        the fleet router. The engine never reorders or sheds on them —
+        SLO policy lives in `serving/router.py`."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not 1 <= len(prompt) <= self.max_prompt_len:
             raise ValueError(f"prompt length {len(prompt)} outside "
@@ -822,15 +837,80 @@ class ContinuousBatchingEngine:
                 f"pool holds only {self.mgr.max_pages - 1}")
         if self.prefix_cache:
             req.block_hashes = hash_prefix_blocks(prompt, self.block_size)
+        if priority is not None:
+            req.priority = str(priority)
+        if deadline_s is not None:
+            req.deadline_s = float(deadline_s)
         self._next_id += 1
         self.waiting.append(req)
         tr, mt = self._tracer, self._metrics
         if tr is not None:
             tr.instant("req.enqueue", req_id=req.req_id,
-                       prompt_len=len(prompt), max_new=req.max_new)
+                       prompt_len=len(prompt), max_new=req.max_new,
+                       priority=req.priority, deadline_s=req.deadline_s)
         if mt is not None:
             mt.counter("requests_enqueued").inc()
         return req
+
+    # ---- fleet hooks (ISSUE 17): drain / progress export ----------------
+
+    def pause_admission(self, paused: bool = True) -> None:
+        """Stop (or resume) pulling from `waiting`. In-flight slots,
+        the streaming unified prefill, and parked handoffs keep
+        running to completion — only NEW admissions stop. The building
+        block of an elastic drain: a worker being scaled in finishes
+        what it owns while its queued requests move elsewhere."""
+        self._admission_paused = bool(paused)
+
+    def take_waiting(self) -> list:
+        """Remove and return every not-yet-admitted request. They own
+        no slot and no pages (admission is where reservations happen),
+        so they re-enqueue on any engine as if freshly added."""
+        taken, self.waiting = self.waiting, []
+        return taken
+
+    def export_progress(self) -> list:
+        """Per-request progress snapshot for every request the engine
+        still owns — what a fleet checkpoints/streams so a worker
+        death preserves completed tokens. Pure host bookkeeping (safe
+        from another thread); tokens lists are copied."""
+        out = []
+
+        def row(req, state):
+            out.append({
+                "req_id": req.req_id, "state": state,
+                "prompt": list(req.prompt), "tokens": list(req.tokens),
+                "max_new": req.max_new,
+                "remaining": max(req.max_new - len(req.tokens), 0),
+                "priority": req.priority, "deadline_s": req.deadline_s,
+            })
+
+        for req in self.waiting:
+            row(req, "waiting")
+        if self._prefilling is not None:
+            row(self._prefilling["req"], "prefilling")
+        for req in self._handoff:
+            row(req, "handoff")
+        for slot in self._slots:
+            if slot.req is not None:
+                row(slot.req, "active")
+        return out
+
+    def drain(self, max_iters: int = 100000) -> list:
+        """Graceful drain: pause admission, run the in-flight work
+        (live slots + streaming prefill + parked handoffs) to
+        completion, and return the untouched `waiting` requests for
+        re-admission elsewhere. Admission stays paused afterwards —
+        `pause_admission(False)` to serve again."""
+        self.pause_admission(True)
+        while (self.n_active > 0 or self._prefilling is not None
+               or self._handoff) and max_iters:
+            self.step()
+            max_iters -= 1
+        if self.n_active > 0 or self._prefilling is not None \
+                or self._handoff:
+            raise RuntimeError("engine did not drain within max_iters")
+        return self.take_waiting()
 
     # ---- device programs ------------------------------------------------
 
@@ -1725,6 +1805,8 @@ class ContinuousBatchingEngine:
         flash-attention prefill path unchanged. After commit, every
         freshly computed full prompt block is inserted into the prefix
         cache for future requests."""
+        if self._admission_paused:
+            return
         bs = self.block_size
         while self.waiting:
             self._check_owner(token)
@@ -2003,6 +2085,8 @@ class ContinuousBatchingEngine:
         request's WHOLE reservation (cached prefix pinned + private
         pages) commits here; its prompt then streams through
         `token_budget` windows across steps."""
+        if self._admission_paused:
+            return
         if self._prefilling is not None or not self.waiting:
             return
         req = self.waiting[0]
@@ -2241,7 +2325,12 @@ class ContinuousBatchingEngine:
         tr, mt = self._tracer, self._metrics
         if tr is not None:
             tr.instant("req.retire", req_id=req.req_id, slot=slot_id,
-                       tokens=len(req.tokens), failed=failed)
+                       tokens=len(req.tokens), failed=failed,
+                       priority=req.priority, deadline_s=req.deadline_s,
+                       deadline_miss=(
+                           req.deadline_s is not None
+                           and req.finish_time - req.arrival_time
+                           > req.deadline_s))
         if mt is not None:
             mt.counter("requests_failed" if failed
                        else "requests_finished").inc()

@@ -18,6 +18,34 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache for the whole suite (rides serving/compile_cache,
+# ISSUE 16). The suite builds hundreds of byte-identical tiny-llama programs
+# across test files; jax's in-memory jit cache cannot dedupe them (every
+# engine/fit builds fresh closures) but the persistent cache keys on the HLO
+# fingerprint and serves repeats from disk — on the 1-core CI box this keeps
+# tier-1 inside ROADMAP's 870 s budget. Must run before the FIRST compile of
+# the process (jax latches the cache-on decision there; enable_compile_cache
+# resets the latch, but earliest is safest). Opt out / repoint with
+# PADDLE_TPU_TEST_COMPILE_CACHE=0 / =<dir>; subprocess tests are unaffected
+# (the env flag is deliberately NOT exported to children).
+_cache_spec = os.environ.get("PADDLE_TPU_TEST_COMPILE_CACHE", "")
+if _cache_spec != "0":
+    import tempfile
+
+    from paddle_tpu.serving.compile_cache import enable_compile_cache
+
+    enable_compile_cache(
+        _cache_spec
+        or os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+        or os.path.join(tempfile.gettempdir(), "paddle_tpu-test-compile-cache"))
+    # enable_compile_cache zeroes the min-compile-time floor (the engine
+    # wants EVERY program persisted); for the test suite that floor would
+    # serialize thousands of unique sub-second jits — pure write overhead.
+    # Only cache compiles expensive enough that a disk hit beats redoing
+    # them. Tests that exercise the zeroed floor (test_tuner) re-enable it
+    # through enable_compile_cache with their own directory.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.75)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -29,6 +57,19 @@ def _seed():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# The fleet suite spins real engines, serve threads, and subprocess
+# workers — by far the most wall-clock-expensive file. Schedule it after
+# the rest of the suite so the budgeted tier-1 run (ROADMAP: 870 s)
+# finishes the fast unit tests first; a truncation then eats the newest
+# integration tests, never the long-standing ones. sort() is stable, so
+# relative order inside and outside the fleet file is untouched.
+_LAST_FILES = ("test_fleet.py",)
+
+
+def pytest_collection_modifyitems(config, items):
+    items.sort(key=lambda it: it.fspath.basename in _LAST_FILES)
 
 
 # partial-auto shard_map (axis_names= manual subset) is second-class on

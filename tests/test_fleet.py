@@ -1,0 +1,583 @@
+"""SLO front-end + fault-tolerant decode fleet (ISSUE 17).
+
+The acceptance spine: kill one of two in-process workers mid-trace via
+the `fleet.worker` chaos seam — every non-shed request must complete
+TOKEN-IDENTICAL to an undisturbed single-engine oracle (greedy decode
+is Markov in the sequence, so the host-bounce re-prefill of
+``prompt + delivered_tokens`` continues the exact stream), shed
+requests must carry structured `Rejected` reasons, a second kill of the
+same requeued request must fail it cleanly (requeue-once), and the
+whole recovery must be observable through `router.metrics()` counters.
+
+Router admission is unit-tested against fake workers (deterministic
+depth/deadline/tpot sheds, fencing, poison breaker) so tier-1 does not
+pay an engine compile per shed reason; real-engine legs cover the
+chaos kill, elastic drain, overload bias, and the subprocess smoke
+gate (mirroring the --memory/--tune CI gates). The cross-process
+FileStore worker is @slow."""
+import dataclasses
+import functools
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import unittest
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import MetricsRegistry, Tracer
+from paddle_tpu.observability.trace import merge_chrome_traces
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import (ContinuousBatchingEngine, Fleet,
+                                Rejected, Router)
+
+
+@functools.lru_cache(maxsize=None)
+def _setup():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), num_key_value_heads=2)
+    paddle.seed(21)
+    params = dict(LlamaForCausalLM(cfg).raw_state())
+    return cfg, params
+
+
+_KW = dict(slots=2, prompt_bucket=8, max_prompt_len=32,
+           max_new_tokens=8, block_size=8, steps_per_sync=2)
+
+
+def _engine(cfg, params, **over):
+    kw = dict(_KW)
+    kw.update(over)
+    return ContinuousBatchingEngine(cfg, dict(params), **kw)
+
+
+def _factory(cfg, params, **over):
+    def factory(*, metrics, tracer):
+        return _engine(cfg, params, metrics=metrics, tracer=tracer,
+                       **over)
+
+    return factory
+
+
+def _prompts(cfg, n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size,
+                         (int(rng.integers(3, 9)),)).tolist()
+            for _ in range(n)]
+
+
+def _wait(pred, timeout=90.0, poll=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _join(router, fleet, timeout):
+    """router.join, re-raised with fleet forensics on timeout — a bare
+    'still pending' tells you nothing about WHICH layer wedged."""
+    try:
+        return router.join(timeout=timeout)
+    except TimeoutError as e:
+        forensics = {
+            "deaths": fleet.deaths, "fenced": sorted(fleet.fenced),
+            "live": sorted(fleet.live()),
+            "requests": [(r.req_id, r.state, r.kills, len(r.tokens))
+                         for r in router.requests],
+            "metrics": {k: v for k, v in router.metrics().items()
+                        if isinstance(v, float) and v},
+        }
+        raise TimeoutError(f"{e}; forensics: {forensics}") from None
+
+
+# ---------------------------------------------------------------------
+# engine hooks (satellite: priority/deadline metadata + drain/export)
+# ---------------------------------------------------------------------
+
+class TestEngineSLOMetadata(unittest.TestCase):
+    def test_priority_deadline_in_lifecycle_instants(self):
+        cfg, params = _setup()
+        tr = Tracer()
+        eng = _engine(cfg, params, tracer=tr,
+                      metrics=MetricsRegistry())
+        pr = _prompts(cfg, 2)
+        eng.add_request(pr[0], max_new=2, priority="high",
+                        deadline_s=120.0)
+        eng.add_request(pr[1], max_new=2)  # defaults
+        eng.run(max_iters=100)
+        self.assertEqual(len(eng.finished), 2)
+        enq = {e["args"]["req_id"]: e["args"] for e in tr.events()
+               if e["name"] == "req.enqueue"}
+        ret = {e["args"]["req_id"]: e["args"] for e in tr.events()
+               if e["name"] == "req.retire"}
+        self.assertEqual(enq[0]["priority"], "high")
+        self.assertEqual(enq[0]["deadline_s"], 120.0)
+        self.assertEqual(enq[1]["priority"], "normal")
+        self.assertIsNone(enq[1]["deadline_s"])
+        # retire instants carry the class + a deadline_miss verdict
+        self.assertEqual(ret[0]["priority"], "high")
+        self.assertFalse(ret[0]["deadline_miss"])
+        self.assertFalse(ret[1]["deadline_miss"])
+
+    def test_drain_pause_and_export_progress(self):
+        cfg, params = _setup()
+        eng = _engine(cfg, params)
+        pr = _prompts(cfg, 4, seed=11)
+        reqs = [eng.add_request(p, max_new=3) for p in pr]
+        states = {e["req_id"]: e["state"]
+                  for e in eng.export_progress()}
+        self.assertEqual(set(states), {r.req_id for r in reqs})
+        self.assertEqual(set(states.values()), {"waiting"})
+        # a drain finishes whatever holds a slot and hands back the
+        # untouched queue; admission stays paused afterwards
+        eng.step()  # let the first prefill start
+        leftovers = eng.drain()
+        self.assertEqual(eng.n_active, 0)
+        done = {r.req_id for r in eng.finished}
+        left = {r.req_id for r in leftovers}
+        self.assertEqual(done | left, {r.req_id for r in reqs})
+        self.assertTrue(done.isdisjoint(left))
+        for r in eng.finished:
+            self.assertEqual(len(r.tokens), 3)
+        late = eng.add_request(pr[0], max_new=2)
+        eng.step()
+        self.assertEqual(eng.n_active, 0)  # paused: never admitted
+        self.assertIn(late, eng.waiting)
+        self.assertEqual(eng.take_waiting(), [late])
+
+
+# ---------------------------------------------------------------------
+# merge_chrome_traces (satellite 3)
+# ---------------------------------------------------------------------
+
+class TestMergeChromeTraces(unittest.TestCase):
+    def test_merge_restamps_pids_and_names_processes(self):
+        with tempfile.TemporaryDirectory() as td:
+            pa = os.path.join(td, "w0.json")
+            pb = os.path.join(td, "w1.json")
+            with open(pa, "w") as f:
+                json.dump({"traceEvents": [
+                    {"name": "step", "ph": "X", "pid": 4242, "tid": 1,
+                     "ts": 0, "dur": 5}],
+                    "metadata": {"n_recorded": 1}}, f)
+            with open(pb, "w") as f:  # bare-list form
+                json.dump([{"name": "step", "ph": "X", "pid": 7,
+                            "tid": 1, "ts": 2, "dur": 5}], f)
+            out = os.path.join(td, "merged.json")
+            doc = merge_chrome_traces([pa, pb], out,
+                                      labels=["worker:w0", None])
+            with open(out) as f:
+                disk = json.load(f)
+            evs = doc["traceEvents"]
+            # every file's events restamped to its own pid lane
+            self.assertEqual({e["pid"] for e in evs}, {0, 1})
+            names = {e["args"]["name"]: e["pid"] for e in evs
+                     if e["name"] == "process_name"}
+            self.assertEqual(names["worker:w0"], 0)
+            self.assertEqual(names["w1"], 1)  # basename fallback
+            self.assertEqual(
+                [m["label"] for m in doc["metadata"]["merged_from"]],
+                ["worker:w0", "w1"])
+            self.assertEqual(len(disk["traceEvents"]), len(evs))
+
+
+# ---------------------------------------------------------------------
+# router admission unit tests (fake workers: no engine compiles)
+# ---------------------------------------------------------------------
+
+class _FakeWorker:
+    def __init__(self, wid, lease, slots=2):
+        self.worker_id = wid
+        self.lease_epoch = lease
+        self.slots = slots
+        self.max_prompt_len = 32
+        self.max_new_budget = 8
+        self.metrics = MetricsRegistry()
+        self.tracer = None
+        self.alive = True
+        self.submitted = []
+
+    def submit(self, d):
+        self.submitted.append(d)
+
+    def queue_len(self):
+        return len(self.submitted)
+
+    def heartbeat_age_s(self):
+        return 0.0
+
+
+class _FakeFleet:
+    def __init__(self, *workers):
+        self.workers = {w.worker_id: w for w in workers}
+        self.epoch = len(workers)
+        self._sink = None
+        self.pending_deaths = []
+
+    def bind(self, sink):
+        self._sink = sink
+
+    def live(self):
+        return dict(self.workers)
+
+    def check_health(self):
+        dead, self.pending_deaths = self.pending_deaths, []
+        for wid, _lease, _r in dead:
+            self.workers.pop(wid, None)
+        if dead:
+            self.epoch += 1
+        return dead
+
+    def kill(self, wid, reason="chaos_kill"):
+        w = self.workers[wid]
+        w.alive = False
+        self.pending_deaths.append((wid, w.lease_epoch, reason))
+
+
+class TestRouterAdmission(unittest.TestCase):
+    def test_no_workers_and_size_sheds(self):
+        router = Router(_FakeFleet(), max_queue=4)
+        r = router.submit([1, 2, 3])
+        self.assertIsInstance(r, Rejected)
+        self.assertEqual(r.reason, "no_workers")
+        router = Router(_FakeFleet(_FakeWorker("a", 1)), max_queue=4)
+        self.assertEqual(router.submit([1] * 40).reason, "too_large")
+        self.assertEqual(router.submit([1, 2], 99).reason, "too_large")
+        self.assertEqual(
+            router.metrics()["shed_by_reason"]["too_large"], 2.0)
+
+    def test_depth_caps_shed_low_first(self):
+        # max_queue=1 -> caps low 1 / normal 2 / high 4; one 2-slot
+        # worker gives a dispatch window of 4, and dispatched requests
+        # still count against depth
+        w = _FakeWorker("a", 1)
+        router = Router(_FakeFleet(w), max_queue=1)
+        self.assertNotIsInstance(
+            router.submit([1, 2], 4, priority="low"), Rejected)
+        shed_low = router.submit([1, 2], 4, priority="low")
+        self.assertEqual(shed_low.reason, "overloaded")
+        self.assertNotIsInstance(
+            router.submit([1, 2], 4, priority="normal"), Rejected)
+        self.assertEqual(
+            router.submit([1, 2], 4, priority="normal").reason,
+            "overloaded")
+        self.assertNotIsInstance(
+            router.submit([1, 2], 4, priority="high"), Rejected)
+        self.assertNotIsInstance(
+            router.submit([1, 2], 4, priority="high"), Rejected)
+        self.assertEqual(
+            router.submit([1, 2], 4, priority="high").reason,
+            "overloaded")
+        m = router.metrics()
+        self.assertEqual(m["admitted"], 4.0)
+        self.assertEqual(m["shed_by_reason"]["overloaded"], 3.0)
+
+    def test_measured_slo_sheds(self):
+        w = _FakeWorker("a", 1)
+        w.metrics.histogram("tpot_s", "t").observe(0.5)
+        w.metrics.histogram("ttft_s", "t").observe(1.0)
+        router = Router(_FakeFleet(w), max_queue=8)
+        # the fleet measurably sustains 0.5 s/token: a 0.1 s TPOT
+        # budget can never be met, so it sheds immediately
+        r = router.submit([1, 2], 4, tpot_deadline_s=0.1)
+        self.assertEqual(r.reason, "tpot")
+        # build a decode backlog, then ask for a TTFT under the
+        # measured baseline + backlog/rate prediction
+        for _ in range(6):
+            router.submit([1, 2], 8)
+        r = router.submit([1, 2], 8, ttft_deadline_s=1.1)
+        self.assertEqual(r.reason, "deadline")
+        self.assertGreater(r.retry_after_s, 0.0)
+        self.assertGreater(router.predicted_ttft_s("normal"), 1.0)
+        # a generous budget still admits
+        self.assertNotIsInstance(
+            router.submit([1, 2], 2, priority="high",
+                          ttft_deadline_s=600.0), Rejected)
+
+    def test_requeue_once_then_poison_and_fencing(self):
+        fleet = _FakeFleet(_FakeWorker("a", 1))
+        router = Router(fleet, max_queue=8)
+        req = router.submit([5, 6, 7], 6)
+        self.assertEqual(req.worker_id, "a")
+        d = fleet.workers["a"].submitted[0]
+        router._on_event("a", 1, "progress", d, {"tokens": [9, 8]})
+        self.assertEqual(req.tokens, [9, 8])
+        fleet.kill("a")
+        router.poll()
+        # first death: requeued with its delivered tokens intact
+        self.assertEqual((req.state, req.kills), ("queued", 1))
+        self.assertEqual(req.tokens, [9, 8])
+        # the dead worker's lease is fenced: a late report is dropped
+        router._on_event("a", 1, "finished", d,
+                         {"tokens": [9, 8, 1, 1, 1, 1]})
+        self.assertEqual(req.state, "queued")
+        m = router.metrics()
+        self.assertEqual(m["fenced_reports"], 1.0)
+        self.assertEqual((m["worker_deaths"], m["requeued"]),
+                         (1.0, 1.0))
+        # a survivor joins: the continuation re-prefills prompt+tokens
+        fleet.workers["b"] = _FakeWorker("b", 3)
+        router.poll()
+        d2 = fleet.workers["b"].submitted[0]
+        self.assertEqual(d2.prompt, [5, 6, 7, 9, 8])
+        self.assertEqual((d2.max_new, d2.base), (4, 2))
+        # second death under the same request: the poison breaker
+        fleet.kill("b")
+        router.poll()
+        self.assertEqual((req.state, req.kills), ("failed", 2))
+        self.assertIn("died twice", req.error)
+        self.assertEqual(router.metrics()["poison_failed"], 1.0)
+
+    def test_drain_requeue_rejoins_queue(self):
+        fleet = _FakeFleet(_FakeWorker("a", 1), _FakeWorker("b", 2))
+        router = Router(fleet, max_queue=8)
+        req = router.submit([1, 2, 3], 4)
+        wid = req.worker_id
+        d = fleet.workers[wid].submitted[0]
+        router._on_event(wid, fleet.workers[wid].lease_epoch,
+                         "requeued", d, {})
+        self.assertEqual(req.state, "queued")
+        self.assertEqual(req.requeues, 1)
+        self.assertEqual(router.metrics()["drain_requeued"], 1.0)
+        router.poll()  # redispatches somewhere live
+        self.assertEqual(req.state, "dispatched")
+
+    def test_prometheus_exposition(self):
+        router = Router(_FakeFleet(_FakeWorker("a", 1)), max_queue=2)
+        router.submit([1, 2], 2)
+        router.submit([1] * 40)  # too_large
+        router.poll()
+        text = router.prometheus_text()
+        self.assertIn("paddle_tpu_router_submitted", text)
+        self.assertIn("paddle_tpu_router_shed_too_large", text)
+        self.assertIn("paddle_tpu_router_live_workers", text)
+
+
+# ---------------------------------------------------------------------
+# chaos acceptance: kill-and-recover against a single-engine oracle
+# ---------------------------------------------------------------------
+
+class TestFleetChaosRecovery(unittest.TestCase):
+    def tearDown(self):
+        chaos.uninstall()
+
+    def test_kill_one_of_two_workers_token_identical(self):
+        cfg, params = _setup()
+        prompts = _prompts(cfg, 8)
+        oracle = _engine(cfg, params)
+        want = []
+        for p in prompts:
+            want.append(oracle.add_request(p, max_new=6))
+        oracle.run(max_iters=400)
+        want = [list(r.tokens) for r in want]
+
+        fleet = Fleet(_factory(cfg, params), heartbeat_s=0.1,
+                      trace=True)
+        router = Router(fleet, max_queue=32)
+        fleet.add_worker()
+        fleet.add_worker()
+        self.addCleanup(fleet.stop)
+        reqs = []
+        for p in prompts:
+            reqs.append(router.submit(p, 6))
+            router.poll()
+        target = fleet.workers["w1"]
+        # wait until w1 holds in-flight work WITH delivered tokens,
+        # then arm the chaos seam a couple of loop steps ahead — the
+        # kill deterministically lands mid-request
+        self.assertTrue(_wait(lambda: any(
+            r.worker_id == "w1" and r.tokens and not r.done
+            for r in reqs)), "no in-flight progress on w1")
+        chaos.install(f"kill_worker:1@{target.steps + 2}")
+        self.assertTrue(_wait(lambda: not target.alive),
+                        "chaos kill did not fire")
+        self.assertTrue(target.killed)
+        _join(router, fleet, 180.0)
+
+        m = router.metrics()
+        self.assertEqual(m["worker_deaths"], 1.0)
+        self.assertGreaterEqual(m["requeued"], 1.0)
+        self.assertEqual(m["poison_failed"], 0.0)
+        self.assertEqual(fleet.deaths[0]["reason"], "chaos_kill")
+        self.assertIn("w1", fleet.fenced)
+        self.assertGreater(m["membership_epoch"], 2)
+        recovered = [r for r in reqs if r.kills > 0]
+        self.assertGreaterEqual(len(recovered), 1)
+        for r, w in zip(reqs, want):
+            self.assertEqual(r.state, "finished")
+            self.assertEqual(
+                r.tokens, w,
+                f"req {r.req_id} diverged after {r.kills} kill(s)")
+        # the merged fleet trace names one process lane per survivor
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "fleet.json")
+            self.assertEqual(fleet.export_merged_trace(out), out)
+            with open(out) as f:
+                doc = json.load(f)
+            lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                     if e["name"] == "process_name"}
+            self.assertEqual(lanes, {"worker:w0"})
+
+    def test_second_kill_fails_poison_request(self):
+        cfg, params = _setup()
+        # steps_per_sync=1 + a deep token budget: progress streams
+        # every loop step, so a kill armed 2 steps ahead of the first
+        # progress report always lands while the request is in flight
+        fleet = Fleet(_factory(cfg, params, max_new_tokens=16,
+                               steps_per_sync=1), heartbeat_s=0.1)
+        router = Router(fleet, max_queue=8)
+        w0 = fleet.add_worker()  # index 0
+        self.addCleanup(fleet.stop)
+        req = router.submit(_prompts(cfg, 1, seed=3)[0], 16)
+        target = fleet.workers[w0]
+        self.assertTrue(_wait(lambda: len(req.tokens) > 0),
+                        "no progress before first kill")
+        chaos.install(f"kill_worker:0@{target.steps + 2}")
+        self.assertTrue(_wait(lambda: not target.alive))
+        router.poll()
+        self.assertEqual((req.state, req.kills), ("queued", 1))
+        kept = list(req.tokens)
+        self.assertTrue(kept)
+
+        w1 = fleet.add_worker()  # index 1
+        router.poll()
+        target = fleet.workers[w1]
+        self.assertTrue(_wait(lambda: len(req.tokens) > len(kept)),
+                        "no progress on the replacement worker")
+        chaos.install(f"kill_worker:1@{target.steps + 2}")
+        self.assertTrue(_wait(lambda: not target.alive))
+        router.poll()
+        self.assertEqual((req.state, req.kills), ("failed", 2))
+        self.assertIn("died twice", req.error)
+        m = router.metrics()
+        self.assertEqual(m["worker_deaths"], 2.0)
+        self.assertEqual(m["poison_failed"], 1.0)
+
+
+# ---------------------------------------------------------------------
+# elastic scale + overload bias (real engines)
+# ---------------------------------------------------------------------
+
+class TestElasticAndOverload(unittest.TestCase):
+    def test_scale_in_drains_and_survivor_finishes(self):
+        cfg, params = _setup()
+        fleet = Fleet(_factory(cfg, params), heartbeat_s=0.1)
+        router = Router(fleet, max_queue=16)
+        w0 = fleet.add_worker()
+        self.addCleanup(fleet.stop)
+        # max_new=8 (the geometry cap): ~10 engine dispatches of work, so
+        # the drain control (written microseconds after submit, checked at
+        # every worker loop step) always lands while work is still queued —
+        # max_new=3 raced a warm compile cache and could finish first
+        reqs = [router.submit(p, 8) for p in _prompts(cfg, 6, seed=5)]
+        self.assertTrue(all(not isinstance(r, Rejected) for r in reqs))
+        # drain w0: in-flight slots finish, the rest hands back
+        fleet.remove_worker(w0, drain=True, timeout=120)
+        self.assertNotIn(w0, fleet.workers)
+        self.assertIn(w0, fleet.fenced)
+        m = router.metrics()
+        self.assertGreaterEqual(m["drain_requeued"], 1.0)
+        self.assertEqual(m["worker_deaths"], 0.0)
+        # scale out again: the queue drains on the new worker
+        fleet.add_worker()
+        _join(router, fleet, 180.0)
+        for r in reqs:
+            self.assertEqual(r.state, "finished")
+            self.assertEqual(len(r.tokens), 8)
+
+    def test_overload_sheds_only_low_high_ttft_holds(self):
+        cfg, params = _setup()
+        fleet = Fleet(_factory(cfg, params), heartbeat_s=0.1)
+        # max_queue=1: low cap 1, normal 2, high 4 — the 2-slot worker
+        # dispatches up to 4, so the burst saturates depth immediately
+        router = Router(fleet, max_queue=1)
+        fleet.add_worker()
+        self.addCleanup(fleet.stop)
+        pr = _prompts(cfg, 10, seed=9)
+        high = [router.submit(p, 2, priority="high",
+                              ttft_deadline_s=120.0)
+                for p in pr[:4]]
+        low = [router.submit(p, 2, priority="low") for p in pr[4:7]]
+        norm = [router.submit(p, 2, priority="normal")
+                for p in pr[7:]]
+        self.assertTrue(all(not isinstance(r, Rejected)
+                            for r in high), "high class was shed")
+        self.assertTrue(all(isinstance(r, Rejected)
+                            and r.reason == "overloaded"
+                            for r in low + norm))
+        _join(router, fleet, 180.0)
+        m = router.metrics()
+        self.assertEqual(m["requests_finished"], 4.0)
+        self.assertEqual(m["deadline_miss"]["ttft"], 0.0)
+        self.assertLess(m["ttft_high"]["p99"], 120.0)
+        self.assertEqual(m["shed_by_reason"]["overloaded"], 6.0)
+
+
+# ---------------------------------------------------------------------
+# tier-1 subprocess smoke (satellite 6) + cross-process worker (@slow)
+# ---------------------------------------------------------------------
+
+class TestSubprocessGates(unittest.TestCase):
+    def test_fleet_smoke_under_chaos_kill(self):
+        """`python -m paddle_tpu.serving.fleet` under a kill_worker
+        fault must exit 0 with the documented JSON summary row — the
+        CI gate that the recovery path stays wired end to end."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                   PADDLE_TPU_CHAOS="kill_worker:1@6")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.serving.fleet",
+             "--workers", "2", "--requests", "8"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+            timeout=520)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        for key in ("bench", "workers", "submitted", "finished",
+                    "shed", "worker_deaths", "requeued",
+                    "membership_epoch", "chaos", "ok"):
+            self.assertIn(key, row)
+        self.assertEqual(row["bench"], "fleet_smoke")
+        self.assertTrue(row["ok"])
+        self.assertEqual(row["finished"] + row["shed"], 8)
+        self.assertEqual(row["chaos"].get("kill_worker"), 1)
+        self.assertEqual(row["worker_deaths"], 1.0)
+
+    @pytest.mark.slow  # spawns an engine-building subprocess worker
+    def test_filestore_subprocess_worker_serves(self):
+        from paddle_tpu.resilience.store import FileStore
+
+        cfg, params = _setup()
+        with tempfile.TemporaryDirectory() as td:
+            fleet = Fleet(_factory(cfg, params),
+                          store=FileStore(td), job_id="t",
+                          heartbeat_s=0.25)
+            router = Router(fleet, max_queue=8)
+            env = {"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=1"}
+            wid = fleet.add_subprocess_worker(
+                extra_args=("--max-new", "6", "--seed", "21"),
+                env=env)
+            self.addCleanup(fleet.stop)
+            w = fleet.workers[wid]
+            self.assertIsNotNone(w.heartbeat_age_s())
+            reqs = [router.submit(p, 4)
+                    for p in _prompts(cfg, 3, seed=13)]
+            _join(router, fleet, 240.0)
+            for r in reqs:
+                self.assertEqual(r.state, "finished")
+                self.assertEqual(len(r.tokens), 4)
+            fleet.remove_worker(wid, drain=True, timeout=60)
+            self.assertNotIn(wid, fleet.workers)
+            self.assertEqual(w.proc.returncode, 0)
+
+
+if __name__ == "__main__":
+    unittest.main()
