@@ -6,6 +6,7 @@ decode TPOT p99 while a 2k-token prompt streams in.
 
 Usage: python bench_longcontext.py [bs ...]   (default bs 1 2)
        python bench_longcontext.py serving [prompt_len]
+       python bench_longcontext.py serving-cp [prompt_len]
 
 Prints one JSON line per config:
 - full train step (fwd+bwd+AdamW, per-layer remat) tok/s + MFU at
@@ -206,6 +207,99 @@ def serving_chunked_prefill(prompt_len: int = 2048):
     return row
 
 
+def serving_cp_sweep(prompt_len: int = 4096):
+    """Context-parallel serving leg (ISSUE 18): the same long-prompt
+    trace over cp=1/2/4 PAGE-sharded engines (FLAGS_serving_cp) at a
+    per-chip `kv_pool_bytes` budget HALVED against what one request
+    needs — sized so the cp=1 build provably cannot hold the context
+    (its capacity check raises, and the row records that error as the
+    wall) while cp>=2 serves it from the same per-chip bytes. Served
+    rows carry tok_s, the cp-merge wire bytes per decoded token
+    (m/l/acc partials crossing chips — never the KV), and the three
+    static-auditor `predicted_*` twins, so the silicon run lands an
+    estimate/actual ratio per cp. cp degrees beyond the local device
+    count emit a skipped-row note instead of failing the sweep."""
+    from paddle_tpu.models import (LlamaConfig,
+                                   init_quant_serving_params)
+    from paddle_tpu.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.llama_1b(dtype="bfloat16")
+    p = init_quant_serving_params(cfg, "weight_only_int8", seed=0)
+    np.asarray(jax.tree.leaves(p)[-1])
+    bucket, block, max_new = 128, 64, 32
+    mpl = prompt_len + bucket
+    long_bucket = -(-prompt_len // bucket) * bucket
+    # one full request's pages (the engine's own capacity formula:
+    # a full-length prompt plus its new tokens, ceil per block) — the
+    # per-chip budget buys HALF that, so cp=1 (fleet pages == per-chip
+    # pages) fails its `cap + 2` admission floor by construction and
+    # cp=2 (fleet = 2x per-chip) clears it from identical bytes
+    cap = -(-(mpl + max_new) // block)
+    from paddle_tpu.models.llama import PagedKVManager
+    page_bytes = PagedKVManager.page_bytes(
+        block, n_layers=cfg.num_hidden_layers,
+        num_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim)
+    budget = ((cap + 3) // 2) * page_bytes
+    row = {"config": f"serving_cp_{prompt_len}",
+           "kv_pool_bytes_per_chip": budget,
+           "one_request_pages": cap}
+    n_dev = len(jax.devices())
+    for cp in (1, 2, 4):
+        key = f"cp{cp}"
+        if cp > n_dev:
+            row[key] = {"skipped":
+                        f"needs {cp} devices, found {n_dev}"}
+            continue
+        rng = np.random.default_rng(0)
+        try:
+            eng = ContinuousBatchingEngine(
+                cfg, dict(p), slots=4, prompt_bucket=bucket,
+                max_prompt_len=mpl, max_new_tokens=max_new,
+                block_size=block, steps_per_sync=8, prefill_batch=1,
+                prefix_cache=False, serving_cp=cp,
+                kv_pool_bytes=budget, tracer=False)
+        except ValueError as e:
+            # the acceptance wall: this per-chip pool cannot hold the
+            # context at this cp degree
+            row[key] = {"oom_build": str(e)[:200]}
+            continue
+        eng.warm([bucket, long_bucket])
+        eng.add_request(rng.integers(1, 32000, (prompt_len,)).tolist(),
+                        max_new=max_new)
+        for _ in range(2):
+            eng.add_request(rng.integers(1, 32000, (48,)).tolist(),
+                            max_new=max_new)
+        t0 = time.perf_counter()
+        eng.run(max_iters=100000)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in eng.finished)
+        graphs = eng._traced_inventory()
+        mem = eng.audit_memory(graphs=graphs)
+        com = eng.audit_comms(graphs=graphs)
+        roof = eng.audit_roofline(graphs=graphs)
+        dec = com["programs"].get("decode", {})
+        # the cp merge is every wire byte on a cp-containing axis of
+        # the decode chunk; a chunk decodes steps_per_sync tokens for
+        # each slot
+        merge = sum(b for a, b in dec.get("per_axis", {}).items()
+                    if "cp" in a.split(","))
+        row[key] = {
+            "tok_s": round(toks / wall, 2),
+            "wall_s": round(wall, 2),
+            "merge_wire_bytes_per_token":
+                round(merge / max(eng.steps * eng.slots, 1), 1),
+            "predicted_bytes_on_wire_per_token":
+                com["predicted_bytes_on_wire_per_token"],
+            "predicted_peak_hbm_bytes": mem["fleet_peak_hbm_bytes"],
+            "predicted_step_ms": roof["predicted_step_ms"],
+            "predicted_mfu": roof["predicted_mfu"],
+            "fleet_pages": eng.mgr.max_pages,
+            "kv_pool_bytes_per_chip": eng.mgr.kv_pool_bytes(),
+        }
+        del eng
+    return row
+
+
 if __name__ == "__main__":
     # args: batch sizes, optionally suffixed "nr" for no-remat (the
     # bs4@2048 matrix lesson: fewer tokens in flight can drop remat);
@@ -215,6 +309,10 @@ if __name__ == "__main__":
     if args and args[0] == "serving":
         plen = int(args[1]) if len(args) > 1 else 2048
         print(json.dumps(serving_chunked_prefill(plen)), flush=True)
+        sys.exit(0)
+    if args and args[0] == "serving-cp":
+        plen = int(args[1]) if len(args) > 1 else 4096
+        print(json.dumps(serving_cp_sweep(plen)), flush=True)
         sys.exit(0)
     train_only = "trainonly" in args
     for a in args:
@@ -242,5 +340,13 @@ if __name__ == "__main__":
         print(json.dumps(serving_chunked_prefill()), flush=True)
     except Exception as e:  # train rows stay useful without serving
         print(json.dumps({"config": "serving_chunked_prefill",
+                          "error": f"{type(e).__name__}: "
+                                   f"{str(e)[:160]}"}), flush=True)
+    # the context-parallel ceiling lift (ISSUE 18): page-sharded pools
+    # serve a depth the cp=1 per-chip pool provably cannot hold
+    try:
+        print(json.dumps(serving_cp_sweep()), flush=True)
+    except Exception as e:
+        print(json.dumps({"config": "serving_cp",
                           "error": f"{type(e).__name__}: "
                                    f"{str(e)[:160]}"}), flush=True)

@@ -25,12 +25,13 @@ a reduce-scatter rewrite, or prefill/decode disaggregation pays.
   first-class. `while` bodies have no static trip count: their events
   keep ``count`` as-is but are marked ``in_loop``.
 - **Quantized-collective recognition** (ISSUE 15): the pass marks the
-  (int8 payload + f32 scale sidecar) pair `parallel/collectives.py`
-  emits — BOTH tensors are priced (``quantized_wire_bytes`` /
-  ``n_quantized_sites`` in the report), the int8 half never fires
-  TPU803 by design, and the sidecar stays far under its floor, so a
-  site rewritten through `quantized_all_gather` / `quantized_psum`
-  goes silent at the DEFAULT threshold.
+  packed int8 buffers `parallel/collectives.py` emits (the f32 scale
+  sidecar rides bitcast-int8 INSIDE the payload since ISSUE 18, one
+  collective per hop) — priced as ``quantized_wire_bytes`` /
+  ``n_quantized_sites`` in the report. int8 payloads never fire
+  TPU803 by design, so a site rewritten through
+  `quantized_all_gather` / `quantized_psum` goes silent at the
+  DEFAULT threshold.
 
 Three rules ride the one (memoized) pass:
 
@@ -162,11 +163,11 @@ class CommEvent:
     in_loop: bool
     implicit: bool = False  # reshard the author never wrote
     detail: str = ""        # reshard: "P(src) -> P(dst)"
-    # one half of a recognized quantized-collective pair (ISSUE 15):
-    # an int8 payload collective + its small float scale-sidecar twin
-    # (same kind/axes, adjacent in the same subjaxpr) — the
-    # parallel/collectives.py emission pattern. Both tensors are
-    # priced; TPU803 never fires on the int8 half by design.
+    # a recognized quantized collective (ISSUE 15): an int8 payload
+    # with the f32 scale sidecar packed bitcast-int8 into the same
+    # buffer (ISSUE 18 shrank the old payload+sidecar pair to one
+    # collective) — the parallel/collectives.py emission. The full
+    # packed bytes are priced; TPU803 never fires on int8 by design.
     quantized: bool = False
 
     @property
@@ -242,14 +243,14 @@ class CommsReport:
     @property
     def quantized_wire_bytes(self) -> int:
         """Per-chip amplified wire bytes of recognized quantized
-        collectives — int8 payloads AND their f32 scale sidecars (both
-        halves of each pair are priced)."""
+        collectives — the packed int8 buffers carry payload AND
+        bitcast scale sidecar, so this prices both."""
         return sum(e.total_wire_bytes for e in self.quantized_events)
 
     @property
     def n_quantized_sites(self) -> int:
-        """Recognized quantized-collective PAIRS (payload + sidecar
-        count as one site)."""
+        """Recognized quantized-collective hops (one packed int8
+        buffer each since the ISSUE 18 sidecar packing)."""
         return sum(1 for e in self.quantized_events
                    if "int" in e.dtype)
 
@@ -554,33 +555,21 @@ class _CommsAuditor:
 
 
 def _mark_quantized(events: List[CommEvent]) -> None:
-    """Recognize the quantized-collective emission pattern of
-    `parallel/collectives.py` (ISSUE 15): an int8-payload collective
-    immediately followed — same subjaxpr, same kind, same axes — by a
-    small float SCALE-SIDECAR collective (<= half the payload's bytes:
-    one f32 per block of >= 8 int8 elements — blocks clamp to the
-    payload's last dim, so narrow payloads carry proportionally wider
-    sidecars). Both halves are marked `quantized` so
-    reports can attribute the pair's wire bytes (payload AND sidecar)
-    to the rewrite; the int8 half never fires TPU803 by design, and
-    the sidecar stays far under its floor."""
-    def parent(path: str) -> str:
-        return path.rsplit("/", 1)[0]
-
-    for a, b in zip(events, events[1:]):
-        if a.kind == "reshard" or a.kind != b.kind:
+    """Recognize the quantized-collective emission of
+    `parallel/collectives.py` (ISSUE 15; packed single-buffer form
+    since ISSUE 18): each quantized hop ships ONE int8 collective
+    whose payload carries the f32 scale sidecar bitcast-int8 and
+    concatenated onto the payload's last axis. Nothing else in the
+    stack puts int8 on a collective — pools are sharded in place,
+    activations/grads/partials travel float — so any non-reshard
+    int8-dtype collective IS the rewrite's wire. Marked events
+    attribute their full (payload + packed sidecar) bytes to the
+    rewrite; an int8 payload never fires TPU803 by design."""
+    for e in events:
+        if e.kind == "reshard" or "int8" not in e.dtype:
             continue
-        if a.axes != b.axes or parent(a.path) != parent(b.path):
-            continue
-        if "int8" not in a.dtype:
-            continue
-        if not b.float_payload_bytes:
-            continue
-        if b.float_payload_bytes * 2 > max(a.payload_bytes, 1):
-            continue
-        a.quantized = b.quantized = True
-        a.detail = a.detail or "int8 payload (scales follow)"
-        b.detail = b.detail or "f32 scale sidecar"
+        e.quantized = True
+        e.detail = e.detail or "int8 payload + packed f32 scales"
 
 
 def _fmt_spec(spec: Tuple[tuple, ...]) -> str:

@@ -14,8 +14,13 @@ lets them DECIDE instead of lint:
   `unified_step` (split program zoo vs ONE ragged step),
   `token_budget` (unified prefill window), `serving_mp` (kv-head
   sharding degree; only degrees the host's device count and the
-  model's kv heads admit), and `quantized_collectives` (int8 wire;
-  collapsed at mp=1 where no collectives exist). The megakernel's
+  model's kv heads admit), `serving_cp` (page-axis context-parallel
+  degree, ISSUE 18; only degrees that divide a pinned `max_pages` —
+  the default pool rounds itself — with cp*mp meshes the host cannot
+  build pruned by name), and `quantized_collectives` (int8 wire;
+  collapsed at mp=1 AND cp=1 — the cp merge ships quantized acc
+  partials, so the knob is live whenever either axis is). The
+  megakernel's
   PAGES_PER_STEP is a kernel constant, not an engine kwarg — it is
   recorded in the space metadata but not swept until the kernel takes
   it as a parameter.
@@ -73,8 +78,8 @@ __all__ = [
 # ContinuousBatchingEngine kwarg of the same name, which is what makes
 # TunedConfig.apply() a plain dict merge
 KNOBS = ("block_size", "decode_megakernel", "kv_cache_dtype",
-         "quantized_collectives", "serving_mp", "token_budget",
-         "unified_step")
+         "quantized_collectives", "serving_cp", "serving_mp",
+         "token_budget", "unified_step")
 
 SCHEMA_VERSION = 1
 # the artifact the engine loads; lives next to the persistent compile
@@ -133,7 +138,8 @@ def baseline_config(cfg, engine_kwargs: Optional[dict] = None) -> dict:
     predict worse than what the operator would get by doing nothing."""
     from ..models.llama import (resolve_decode_megakernel,
                                 resolve_kv_cache_dtype,
-                                resolve_serving_mp, resolve_unified_step)
+                                resolve_serving_cp, resolve_serving_mp,
+                                resolve_unified_step)
     from ..parallel.collectives import resolve_quantized_collectives
 
     kw = dict(engine_kwargs or {})
@@ -146,6 +152,7 @@ def baseline_config(cfg, engine_kwargs: Optional[dict] = None) -> dict:
             kw.get("kv_cache_dtype")),
         "quantized_collectives": resolve_quantized_collectives(
             kw.get("quantized_collectives")),
+        "serving_cp": resolve_serving_cp(kw.get("serving_cp")),
         "serving_mp": resolve_serving_mp(kw.get("serving_mp")),
         "token_budget": int(kw.get("token_budget")
                             or geo["prompt_bucket"]),
@@ -157,11 +164,13 @@ def baseline_config(cfg, engine_kwargs: Optional[dict] = None) -> dict:
 def canonical_config(config: dict, geo: dict) -> dict:
     """Collapse knob combinations that build byte-identical programs,
     so the enumeration never scores the same program twice under two
-    names: `quantized_collectives` is meaningless at mp=1 (no
-    collectives exist) and `token_budget` is meaningless on the split
-    path (no unified window program is built)."""
+    names: `quantized_collectives` is meaningless at mp=1 AND cp=1
+    (no collectives exist; with cp>1 the partial merge ships
+    quantized acc partials even head-unsharded) and `token_budget` is
+    meaningless on the split path (no unified window program is
+    built)."""
     out = dict(config)
-    if out["serving_mp"] == 1:
+    if out["serving_mp"] == 1 and out.get("serving_cp", 1) == 1:
         out["quantized_collectives"] = False
     if not out["unified_step"]:
         out["token_budget"] = geo["prompt_bucket"]
@@ -190,12 +199,22 @@ def default_space(cfg, engine_kwargs: Optional[dict] = None) -> dict:
     nkv = cfg.num_key_value_heads
     mps = [m for m in (1, 2, 4, 8)
            if m <= n_dev and (m == 1 or nkv % m == 0)]
+    # serving_cp shards the PAGE axis, so divisibility is against the
+    # pool page count, not the model: a pinned max_pages filters the
+    # degrees here; the default pool rounds itself up to a cp
+    # multiple, so every host-buildable degree is admissible. cp*mp
+    # meshes the host cannot build are pruned by autotune per
+    # candidate (a per-knob list cannot express the product bound).
+    cps = [c for c in (1, 2, 4, 8)
+           if c <= n_dev and (geo["max_pages"] is None
+                              or int(geo["max_pages"]) % c == 0)]
     tb = geo["prompt_bucket"]
     return {
         "block_size": blocks,
         "decode_megakernel": [False, True],
         "kv_cache_dtype": ["bf16", "int8"],
         "quantized_collectives": [False, True],
+        "serving_cp": cps,
         "serving_mp": mps,
         "token_budget": sorted({tb, 2 * tb}),
         "unified_step": [False, True],
@@ -237,17 +256,23 @@ def static_candidate_bound(cfg, params, config: dict,
     geo = _engine_geometry(engine_kwargs)
     bs = int(config["block_size"])
     mp = int(config["serving_mp"])
+    cp = int(config.get("serving_cp", 1))
     nkv = cfg.num_key_value_heads
     # engine __init__'s own sizing: every slot simultaneously
     # full-length, +1 scratch page (kv_pool_bytes sizing would make
-    # the pool the budget itself)
+    # the pool the budget itself). serving_cp shards the page axis,
+    # so the PER-CHIP bound carries fleet_pages/cp local pages — the
+    # whole point of the knob is that this term shrinks with cp.
     if geo["kv_pool_bytes"] is not None:
+        # kv_pool_bytes is the engine's per-chip budget contract
+        # already (pages_for_bytes buys budget*cp fleet pages)
         pool_bytes = int(geo["kv_pool_bytes"])
     else:
         cap = -(-(geo["max_prompt_len"] + geo["max_new_tokens"]) // bs)
-        max_pages = geo["max_pages"] or geo["slots"] * cap + 1
+        fleet = geo["max_pages"] or -(-(geo["slots"] * cap + 1)
+                                      // cp) * cp
         kv_shards = mp if (mp > 1 and nkv % mp == 0) else 1
-        pool_bytes = max_pages * PagedKVManager.page_bytes(
+        pool_bytes = (fleet // cp) * PagedKVManager.page_bytes(
             bs, n_layers=cfg.num_hidden_layers, num_kv_heads=nkv,
             head_dim=cfg.head_dim,
             kv_cache_dtype=config["kv_cache_dtype"], mp=kv_shards)
@@ -571,12 +596,28 @@ def autotune(cfg, params, *, engine_kwargs: Optional[dict] = None,
         if base_cfg not in kept:
             kept.append(base_cfg)
         candidates = kept
+    import jax
+
+    n_dev = len(jax.devices())
     ranking, pruned = [], []
     baseline_result = None
     for config in candidates:
         bound = static_candidate_bound(cfg, params, config,
                                        engine_kwargs)
-        if bound > budget:
+        chips = int(config.get("serving_cp", 1)) \
+            * int(config["serving_mp"])
+        if chips > n_dev:
+            # the knob lists are each host-buildable alone, but the
+            # 2-D serving mesh needs cp*mp chips — an unbuildable
+            # product is a hardware miss, not an HBM miss, so it gets
+            # its own named prune instead of an engine-build crash
+            res = CandidateResult(
+                config=config, feasible=False,
+                static_bound_bytes=bound,
+                pruned_reason=(
+                    f"serving mesh needs serving_cp*serving_mp = "
+                    f"{chips} chips; host has {n_dev}"))
+        elif bound > budget:
             res = CandidateResult(
                 config=config, feasible=False,
                 static_bound_bytes=bound,

@@ -176,6 +176,23 @@ define_flag("serving_mp", 1,
             "warming) an engine (also: PADDLE_TPU_SERVING_MP)",
             env_aliases=("PADDLE_TPU_SERVING_MP",))
 
+define_flag("serving_cp", 1,
+            "context-parallel degree of the PAGED serving stack: the "
+            "engine's K/V pools shard by PAGE across a `cp` mesh axis "
+            "of this many devices (composable with serving_mp as a 2-D "
+            "cp x mp serving mesh), each shard streams only its LOCAL "
+            "pages of a request through the attention programs and "
+            "emits online-softmax partials (m, l, acc), and a small "
+            "cross-chip merge of those stats — never the KV pages — "
+            "applies the kernel's own rescale recurrence one level up "
+            "(ServingTP.merge_attn_partials). Lifts the per-request "
+            "context ceiling to cp x one chip's pool. 1 (default) = "
+            "today's page-replicated path, byte-identical. Read when a "
+            "paged program / engine is BUILT (it joins every program "
+            "key), so flip it before constructing (or warming) an "
+            "engine (also: PADDLE_TPU_SERVING_CP)",
+            env_aliases=("PADDLE_TPU_SERVING_CP",))
+
 define_flag("quantized_collectives", False,
             "ship the hot cross-chip payloads as absmax-scaled int8 "
             "with an f32 scale sidecar (parallel/collectives.py, "

@@ -1,0 +1,195 @@
+"""Partial-emitting paged attention for context parallelism (ISSUE 18).
+
+The paged kernels (`ragged_attention`, `prefix_prefill`,
+`decode_attention`) carry online-softmax state — running max ``m``,
+normalizer ``l``, weighted accumulator ``acc`` — between page tiles and
+normalize only in their epilogue. Context parallelism
+(FLAGS_serving_cp) shards the pools along the PAGE axis, so one chip
+never sees a request's whole context: each cp shard streams its LOCAL
+pages and must emit that per-row (m, l, acc) state UN-normalized, to be
+merged across chips by ``ServingTP.merge_attn_partials`` — the same
+rescale recurrence the kernels run between tiles, lifted one level.
+
+This module is those partial-emitting wrappers, in the exact masked jnp
+formulation of the reference oracles (`ragged_paged_attention_reference`
+/ `prefix_prefill_reference`): the cached phase scores every gathered
+pool position and masks with (position valid) AND (page OWNED by this
+cp shard); the fresh-token window/suffix phase is computed REPLICATED —
+every cp shard derives identical new K/V from the replicated
+activations — and combined exactly ONCE after the cross-chip merge.
+Pallas twins that keep the partial state in scoped VMEM are the silicon
+follow-up (ROADMAP); on the page counts cp targets the pool stream
+dominates either way.
+
+Numerics: all partials are f32. Masked/empty rows carry the FINITE
+``_NEG_INF`` sentinel (-1e30, the repo-wide kernel convention), never a
+true -inf — ``exp(m - M)`` in the merge then stays exp(0) = 1 on
+all-empty shards instead of NaN, and ``finalize_partials``'s l == 0
+guard zeros such rows exactly (matching the kernels' pad-row
+contract)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .prefix_prefill import _NEG_INF
+
+
+def cp_local_view(tables, pps: int, cp_axis: str = "cp"):
+    """Ownership view of a GLOBAL-id block table on the current cp
+    shard (inside shard_map over `cp_axis`). Global page id g lives on
+    shard g // pps at local row g % pps, where ``pps`` is the per-shard
+    page count (fleet max_pages / cp) — the contiguous split a
+    ``NamedSharding(P('cp', ...))`` pool layout induces, so the owner
+    map is pure arithmetic and every chip derives it locally from the
+    same replicated table.
+
+    Returns (local [like tables], owned [like tables] bool): `local`
+    is the in-range local row for gathers (non-owned entries clamp to
+    0 — their scores are masked by `owned`, so the garbage gather is
+    never observed). Scatters must NOT use the clamped ids: translate
+    non-owned writes out of range (`jnp.where(owned, local, pps)`) and
+    scatter with mode='drop' instead."""
+    idx = jax.lax.axis_index(cp_axis)
+    owned = (tables // pps) == idx
+    return jnp.where(owned, tables % pps, 0), owned
+
+
+def paged_partials(q, key_cache, value_cache, tables, valid, *,
+                   scale: float | None = None, k_scale=None,
+                   v_scale=None):
+    """Online-softmax partials of q against this shard's pool pages.
+
+    q: [b, tn, nh, dh]; key_cache/value_cache: the LOCAL pool shard
+    [pps, nkv, page, dh] (int8 with ``k_scale``/``v_scale``
+    [pps, nkv] dequantizes in f32 at the gather, like the reference
+    oracles); tables: [b, w] LOCAL page rows (from `cp_local_view` —
+    already clamped); valid: [b, w*page] bool marking gathered
+    positions that are BOTH in-length and owned here — the caller
+    bakes its phase's length rule (cached_len / prefix_len exclusive,
+    decode lens inclusive) together with the ownership mask.
+
+    Returns (m [b, tn, nh], l [b, tn, nh], acc [b, tn, nh, dh]) f32,
+    un-normalized. Rows with no valid position: m = _NEG_INF, l = 0,
+    acc = 0 (merge- and finalize-safe)."""
+    b, tn, nh, dh = q.shape
+    nkv, page = key_cache.shape[1], key_cache.shape[2]
+    P = tables.shape[1] * page
+    group = nh // nkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    quant = key_cache.dtype == jnp.int8
+    gk = key_cache[tables]              # [b, w, nkv, page, dh]
+    gv = value_cache[tables]
+    if quant:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "int8 KV pools need k_scale/v_scale (TPU103 lints a "
+                "quantized pool consumed without its scales)")
+        gk = gk.astype(jnp.float32) \
+            * k_scale[tables][..., None, None]
+        gv = gv.astype(jnp.float32) \
+            * v_scale[tables][..., None, None]
+    pk = jnp.transpose(gk, (0, 1, 3, 2, 4)).reshape(b, P, nkv, dh)
+    pv = jnp.transpose(gv, (0, 1, 3, 2, 4)).reshape(b, P, nkv, dh)
+    q5 = q.reshape(b, tn, nkv, group, dh)
+    s = jnp.einsum("bsngd,btnd->bsngt", q5.astype(jnp.float32),
+                   pk.astype(jnp.float32)) * scale
+    mask = valid[:, None, None, None, :]          # [b, 1, 1, 1, P]
+    s = jnp.where(mask, s, jnp.asarray(_NEG_INF, jnp.float32))
+    m = jnp.max(s, axis=-1)                       # [b, tn, nkv, group]
+    # exp under the mask, NOT of the sentinel-filled scores: on an
+    # all-masked row m == _NEG_INF and exp(s - m) would be exp(0) = 1
+    # per masked column — zeroing keeps l = 0 there
+    p = jnp.where(mask, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bsngt,btnd->bsngd", p, pv.astype(jnp.float32))
+    return (m.reshape(b, tn, nh), l.reshape(b, tn, nh),
+            acc.reshape(b, tn, nh, dh))
+
+
+def decode_paged_partials(q1, key_cache, value_cache, tables, lens,
+                          owned, *, scale: float | None = None,
+                          k_scale=None, v_scale=None):
+    """Decode-shaped twin of `paged_partials`: q1 [b, nh, dh] (the
+    single new token), lens [b] cached counts with the decode kernels'
+    INCLUSIVE visibility (the current token's K/V was committed at
+    position lens before the attend, so positions pos <= lens are
+    live). Returns (m [b, nh], l [b, nh], acc [b, nh, dh]) f32."""
+    b = q1.shape[0]
+    page = key_cache.shape[2]
+    P = tables.shape[1] * page
+    pos_ok = jnp.arange(P)[None, :] <= lens[:, None]
+    valid = pos_ok & jnp.repeat(owned, page, axis=1)
+    m, l, acc = paged_partials(q1[:, None], key_cache, value_cache,
+                               tables, valid, scale=scale,
+                               k_scale=k_scale, v_scale=v_scale)
+    return m[:, 0], l[:, 0], acc[:, 0]
+
+
+def causal_window_partials(q, k_new, v_new, new_lens=None, *,
+                           scale: float | None = None):
+    """Online-softmax partials of the fresh-token window against
+    itself, causally — the phase every cp shard computes REPLICATED
+    (new K/V derive from replicated activations, so all shards hold
+    identical copies; combine this exactly once, after the cp merge).
+
+    q/k_new/v_new: [b, tn, nh/nkv, dh]; window column j is visible to
+    window row i iff j <= i and (with `new_lens` [b]) j < new_lens[b]
+    — the `ragged_paged_attention_reference` window rule; new_lens
+    None = all columns live (the prefix-prefill suffix phase, where
+    pad query rows are don't-care). Returns (m, l, acc) f32 shaped
+    like `paged_partials`."""
+    b, tn, nh, dh = q.shape
+    nkv = k_new.shape[2]
+    group = nh // nkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    causal = jnp.arange(tn)[None, :] <= jnp.arange(tn)[:, None]
+    if new_lens is None:
+        mask = jnp.broadcast_to(causal[None], (b, tn, tn))
+    else:
+        mask = causal[None] \
+            & (jnp.arange(tn)[None, None, :] < new_lens[:, None, None])
+    q5 = q.reshape(b, tn, nkv, group, dh)
+    s = jnp.einsum("bsngd,btnd->bsngt", q5.astype(jnp.float32),
+                   k_new.astype(jnp.float32)) * scale
+    mk = mask[:, :, None, None, :]
+    s = jnp.where(mk, s, jnp.asarray(_NEG_INF, jnp.float32))
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(mk, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bsngt,btnd->bsngd", p,
+                     v_new.astype(jnp.float32))
+    return (m.reshape(b, tn, nh), l.reshape(b, tn, nh),
+            acc.reshape(b, tn, nh, dh))
+
+
+def combine_partials(a, b):
+    """Merge two (m, l, acc) partial states over the SAME rows — the
+    two-way form of the kernels' between-tile rescale recurrence (and
+    of `ServingTP.merge_attn_partials`, which runs it as pmax/psum).
+    Associative and commutative up to float rounding."""
+    ma, la, acca = a
+    mb, lb, accb = b
+    m = jnp.maximum(ma, mb)
+    wa = jnp.exp(ma - m)
+    wb = jnp.exp(mb - m)
+    return (m, la * wa + lb * wb,
+            acca * wa[..., None] + accb * wb[..., None])
+
+
+def finalize_partials(m, l, acc, live=None):
+    """Normalize merged partials to the attention output acc / l, with
+    the kernels' pad contract: rows with l == 0 (no valid key
+    anywhere) emit exact zeros, and `live` (bool, broadcastable to l)
+    additionally zeros rows the caller knows are pad — e.g. window
+    rows at positions >= new_lens, whose l is nonzero (they see live
+    causal columns) but whose output the kernel zeros. f32 in, f32
+    out; callers cast to their stream dtype."""
+    safe = jnp.where(l > 0, l, 1.0)
+    out = acc / safe[..., None]
+    dead = l <= 0 if live is None else (l <= 0) | ~live
+    return jnp.where(dead[..., None], 0.0, out)

@@ -169,7 +169,7 @@ def _constrain(x, mesh, *dims):
 # context-parallel over sep (reference: 5-D topo [data,pipe,sharding,sep,model],
 # fleet/base/topology.py:188)
 from ..parallel.mesh import (BATCH_AXES,  # noqa: E402 (single topology source)
-                             MP_AXIS)
+                             CP_AXIS, MP_AXIS)
 
 SEQ_AXIS = "sep"
 
@@ -857,7 +857,29 @@ def _make_prefill_with_prefix(cfg, b, sb, w_pre, block_size, tp=None):
                 else (kcs[i], None)
             vc_i, vsc_i = vcs[i] if isinstance(vcs[i], tuple) \
                 else (vcs[i], None)
-            if use_kernel:
+            if tp is not None and tp.cp > 1:
+                # context parallelism (ISSUE 18): prefix-phase partials
+                # over the LOCAL pool pages, merged cross-chip; the
+                # causal suffix phase is replicated (fresh K/V derive
+                # from replicated activations) and folds in once
+                from ..kernels.partial_attention import (
+                    causal_window_partials, combine_partials,
+                    cp_local_view, finalize_partials, paged_partials)
+
+                loc, owned = cp_local_view(prefix_tables,
+                                           kc_i.shape[0], tp.cp_axis)
+                page = kc_i.shape[2]
+                pos_ok = jnp.arange(loc.shape[1] * page)[None, :] \
+                    < prefix_lens[:, None]
+                valid = pos_ok & jnp.repeat(owned, page, axis=1)
+                part = paged_partials(q, kc_i, vc_i, loc, valid,
+                                      scale=scale, k_scale=ksc_i,
+                                      v_scale=vsc_i)
+                part = tp.merge_attn_partials(*part)
+                suf = causal_window_partials(q, k, v, scale=scale)
+                attn = finalize_partials(
+                    *combine_partials(part, suf)).astype(h.dtype)
+            elif use_kernel:
                 from ..kernels.prefix_prefill import \
                     prefix_prefill_attention
 
@@ -957,11 +979,39 @@ def _make_chunk_prefill(cfg, tn, tp=None):
                 else (kcs[i], None)
             vc_i, vsc_i = vcs[i] if isinstance(vcs[i], tuple) \
                 else (vcs[i], None)
-            attn_fn = ragged_paged_attention if use_kernel \
-                else ragged_paged_attention_reference
-            attn = attn_fn(q, k, v, kc_i, vc_i, chunk_table, cached_len,
-                           new_len, scale=scale, k_scale=ksc_i,
-                           v_scale=vsc_i).astype(h.dtype)
+            if tp is not None and tp.cp > 1:
+                # context parallelism (ISSUE 18): this shard holds only
+                # 1/cp of the pool pages — stream the LOCAL pages as
+                # online-softmax partials (position-valid AND owned),
+                # merge the stats cross-chip (never the KV), then fold
+                # in the replicated causal window exactly once
+                from ..kernels.partial_attention import (
+                    causal_window_partials, combine_partials,
+                    cp_local_view, finalize_partials, paged_partials)
+
+                loc, owned = cp_local_view(chunk_table, kc_i.shape[0],
+                                           tp.cp_axis)
+                page = kc_i.shape[2]
+                pos_ok = jnp.arange(loc.shape[1] * page)[None, :] \
+                    < cached_len[:, None]
+                valid = pos_ok & jnp.repeat(owned, page, axis=1)
+                part = paged_partials(q, kc_i, vc_i, loc, valid,
+                                      scale=scale, k_scale=ksc_i,
+                                      v_scale=vsc_i)
+                part = tp.merge_attn_partials(*part)
+                win = causal_window_partials(q, k, v, new_len,
+                                             scale=scale)
+                mm_, ll_, aa_ = combine_partials(part, win)
+                live = jnp.arange(tn)[None, :] < new_len[:, None]
+                attn = finalize_partials(
+                    mm_, ll_, aa_, live[..., None]).astype(h.dtype)
+            else:
+                attn_fn = ragged_paged_attention if use_kernel \
+                    else ragged_paged_attention_reference
+                attn = attn_fn(q, k, v, kc_i, vc_i, chunk_table,
+                               cached_len, new_len, scale=scale,
+                               k_scale=ksc_i, v_scale=vsc_i
+                               ).astype(h.dtype)
             if tp is not None:
                 attn = tp.gather_heads(attn)
             h = h + _mm(attn.reshape(1, tn, nh * dh),
@@ -1165,6 +1215,31 @@ def resolve_serving_mp(serving_mp: Optional[int] = None) -> int:
     return serving_mp
 
 
+def resolve_serving_cp(serving_cp: Optional[int] = None) -> int:
+    """Context-parallel degree of the paged serving stack (pools shard
+    by PAGE), from the argument or FLAGS_serving_cp /
+    PADDLE_TPU_SERVING_CP. Read at program-BUILD time (like
+    FLAGS_serving_mp): flip it before constructing or warming an
+    engine. 1 (default) = the page-replicated path, byte-identical to
+    a build without the flag."""
+    if serving_cp is None:
+        from ..framework.flags import flag as _flag
+
+        serving_cp = int(_flag("serving_cp"))
+    serving_cp = int(serving_cp)
+    if serving_cp < 1:
+        raise ValueError(f"serving_cp must be >= 1, got {serving_cp}")
+    return serving_cp
+
+
+class PageShardingError(ValueError):
+    """A paged-pool geometry cannot shard along the PAGE axis as asked:
+    the fleet page count does not split evenly across the `cp` shards.
+    Named (rather than a bare ValueError) so admission / tuner /
+    engine-build callers can distinguish 'this cp degree is
+    geometrically impossible here' from argument typos."""
+
+
 class ServingTP:
     """Head-sharding geometry of a tensor-parallel serving program.
 
@@ -1200,13 +1275,18 @@ class ServingTP:
     """
 
     def __init__(self, cfg, mp: int, axis: str = MP_AXIS,
-                 quantized: Optional[bool] = None):
+                 quantized: Optional[bool] = None, cp: int = 1,
+                 cp_axis: str = CP_AXIS):
         # quantized collectives (ISSUE 15): resolved HERE at geometry-
         # build time like every serving flag — the engine threads its
         # own resolution through so the flag joins its program keys
         from ..parallel.collectives import resolve_quantized_collectives
 
         self.quantized = resolve_quantized_collectives(quantized)
+        self.cp = int(cp)
+        self.cp_axis = cp_axis
+        if self.cp < 1:
+            raise ValueError(f"serving_cp must be >= 1, got {cp}")
         nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
         if nh % mp:
             raise ValueError(
@@ -1256,6 +1336,11 @@ class ServingTP:
         identity). TPU803 goes silent on the rewritten seam by design
         (int8 payloads never fire); the comms auditor prices payload
         AND sidecar."""
+        if self.mp <= 1:
+            # cp-only geometry: every chip already holds all heads —
+            # no head seam to gather (and no dtype cast: byte-identity
+            # with the single-chip path is per-element)
+            return ctx
         if ctx.dtype == jnp.float32:
             ctx = ctx.astype(jnp.bfloat16)
         if self.quantized:
@@ -1276,23 +1361,71 @@ class ServingTP:
         dequant-accumulate + int8 all-gather,
         `parallel.collectives.quantized_psum`), composing the
         megakernel with the quantized wire."""
+        if self.mp <= 1:
+            return partial
         if self.quantized:
             from ..parallel.collectives import quantized_psum
 
             return quantized_psum(partial, self.axis)
         return jax.lax.psum(partial, self.axis)
 
+    def merge_attn_partials(self, m, l, acc):
+        """Merge per-cp-shard online-softmax partials into the global
+        attention state — the context-parallel seam next to
+        `gather_heads` (ISSUE 18). Each cp shard streams only its LOCAL
+        pages and emits (m [rows...], l [rows...], acc [rows..., dh])
+        f32 partials; this applies the SAME rescale recurrence the
+        paged kernels run between page tiles, lifted one level to run
+        between CHIPS:
+
+            M     = pmax(m, cp)             # global running max
+            w     = exp(m - M)              # per-shard rescale
+            l_g   = psum(l * w, cp)
+            acc_g = psum(acc * w[..., None], cp)
+
+        Only the stats + weighted accumulator cross the wire — never
+        the KV pages — so the merge costs O(rows * nh * dh) f32 per
+        layer against the O(ctx * nkv * dh) pool stream it shards.
+        Rows with no valid key anywhere carry the finite _NEG_INF
+        sentinel (never true -inf), so w = exp(0) = 1 and l_g = 0 —
+        the caller's finalize zeros them, and no NaN can form.
+
+        With FLAGS_quantized_collectives the weighted accumulator —
+        the only payload with real width — ships via the int8
+        two-hop psum (`parallel.collectives.quantized_psum`); the
+        scalar m/l stats always merge exact."""
+        if self.cp <= 1:
+            return m, l, acc
+        m_g = jax.lax.pmax(m, self.cp_axis)
+        w = jnp.exp(m - m_g)
+        l_g = jax.lax.psum(l * w, self.cp_axis)
+        acc_w = acc * w[..., None]
+        if self.quantized:
+            from ..parallel.collectives import quantized_psum
+
+            acc_g = quantized_psum(acc_w, self.cp_axis)
+        else:
+            acc_g = jax.lax.psum(acc_w, self.cp_axis)
+        return m_g, l_g, acc_g
+
 
 def make_serving_tp(cfg, serving_mp: Optional[int] = None,
-                    quantized_collectives: Optional[bool] = None) \
+                    quantized_collectives: Optional[bool] = None,
+                    serving_cp: Optional[int] = None) \
         -> Optional[ServingTP]:
-    """ServingTP geometry for the resolved mp degree, or None at mp=1
-    (the single-chip path takes no TP plumbing at all).
+    """ServingTP geometry for the resolved (mp, cp) degrees, or None at
+    mp=1 and cp=1 (the single-chip path takes no TP plumbing at all).
     `quantized_collectives` (default: the flag) arms the int8
-    all-gather / psum wire (ISSUE 15)."""
+    all-gather / psum wire (ISSUE 15); `serving_cp` (default: the
+    flag) the page-sharded context-parallel geometry (ISSUE 18) —
+    at cp > 1 with mp == 1 the head seams (`gather_heads` /
+    `psum_partial`) are identity and only `merge_attn_partials`
+    crosses chips."""
     mp = resolve_serving_mp(serving_mp)
-    return ServingTP(cfg, mp, quantized=quantized_collectives) \
-        if mp > 1 else None
+    cp = resolve_serving_cp(serving_cp)
+    if mp <= 1 and cp <= 1:
+        return None
+    return ServingTP(cfg, mp, quantized=quantized_collectives, cp=cp)
 
 
 def _tp_weight_spec(name: str, w, tp: ServingTP):
@@ -1378,6 +1511,12 @@ def _megakernel_reason(cfg, b, p, kcs, vcs, tables, tp=None) \
     counts derive from the local shard, never the full model config."""
     from ..kernels.decode_megakernel import megakernel_supported
 
+    if tp is not None and tp.cp > 1:
+        # the fused kernel normalizes in-epilogue — it has no
+        # partial-softmax (m, l, acc) emit for merge_attn_partials to
+        # consume, so context parallelism serves the multi-kernel path
+        return ("serving_cp > 1: the fused layer kernel cannot emit "
+                "online-softmax partials for the cross-chip cp merge")
     kc0, vc0 = kcs[0], vcs[0]
     ksc = vsc = None
     if isinstance(kc0, tuple):
@@ -1630,50 +1769,73 @@ class PagedKVManager:
     @classmethod
     def pages_for_bytes(cls, budget_bytes: int, block_size: int, *,
                         n_layers: int, num_kv_heads: int, head_dim: int,
-                        kv_cache_dtype: str = "bf16", mp: int = 1) -> int:
-        """Pages a PER-CHIP device byte budget holds — the capacity side
-        of the int8 win (at the same budget an int8 pool holds ~2x the
-        pages) AND of kv-head sharding: at mp shards a per-chip budget
-        buys ~mp x the AGGREGATE cacheable pages, because each chip
-        stores only its 1/mp slice of every page."""
+                        kv_cache_dtype: str = "bf16", mp: int = 1,
+                        cp: int = 1) -> int:
+        """FLEET pages a PER-CHIP device byte budget holds — the
+        capacity side of the int8 win (at the same budget an int8 pool
+        holds ~2x the pages) AND of both sharding axes: at mp shards a
+        per-chip budget buys ~mp x the aggregate cacheable pages
+        (each chip stores only its 1/mp head slice of every page), and
+        at cp shards it buys cp x the PAGE COUNT outright (each chip
+        stores only its 1/cp of the fleet's pages — the context-
+        parallel axis, ISSUE 18). The result is divisible by cp by
+        construction (per-chip count x cp), satisfying
+        `set_pool_geometry`'s sharding invariant."""
         per_page = cls.page_bytes(block_size, n_layers=n_layers,
                                   num_kv_heads=num_kv_heads,
                                   head_dim=head_dim,
                                   kv_cache_dtype=kv_cache_dtype, mp=mp)
-        return max(0, int(budget_bytes) // per_page)
+        return max(0, int(budget_bytes) // per_page) * max(1, int(cp))
 
     def set_pool_geometry(self, *, n_layers: int, num_kv_heads: int,
                           head_dim: int, kv_cache_dtype: str = "bf16",
-                          mp: int = 1):
+                          mp: int = 1, cp: int = 1):
         """Record the pool geometry this manager's page ids index into,
         enabling `kv_pool_bytes()` (benches attribute capacity-driven
         hit-rate changes with it). `mp` is the kv-head shard count (1
-        when the pools are replicated — including the MQA fallback), so
-        byte accounting reports PER-CHIP cost while page capacity math
-        stays aggregate."""
+        when the pools are replicated — including the MQA fallback) and
+        `cp` the PAGE shard count (ISSUE 18: global page id g lives on
+        cp shard g // (max_pages // cp)), so byte accounting reports
+        PER-CHIP cost while page ids / capacity math stay fleet-wide.
+        A fleet page count that does not split evenly across the cp
+        shards raises `PageShardingError` — silent remainder pages
+        would desynchronize the contiguous owner map every chip
+        derives locally."""
         resolve_kv_cache_dtype(kv_cache_dtype)
         if mp > 1 and num_kv_heads % mp:
             raise ValueError(
                 f"kv heads {num_kv_heads} not divisible by mp {mp}; "
                 "replicated pools record mp=1")
+        cp = int(cp)
+        if cp < 1:
+            raise ValueError(f"cp must be >= 1, got {cp}")
+        if self.max_pages % cp:
+            raise PageShardingError(
+                f"fleet page count {self.max_pages} not divisible by "
+                f"cp {cp}: the page axis shards contiguously "
+                f"({self.max_pages} % {cp} == {self.max_pages % cp} "
+                "pages would have no owner)")
         self._geometry = dict(n_layers=int(n_layers),
                               num_kv_heads=int(num_kv_heads),
                               head_dim=int(head_dim),
                               kv_cache_dtype=kv_cache_dtype,
-                              mp=int(mp))
+                              mp=int(mp), cp=cp)
 
     def kv_pool_bytes(self, aggregate: bool = False) -> int:
         """Device bytes of the K/V pools (+ int8 scale arrays) this
         manager allocates pages of — PER CHIP by default (the number an
-        HBM budget constrains); `aggregate=True` multiplies the kv-head
-        shard count back in (the whole-fleet footprint). Requires
+        HBM budget constrains; at cp > 1 each chip holds only
+        max_pages/cp of the fleet's pages); `aggregate=True` multiplies
+        both shard counts back in (the whole-fleet footprint). Requires
         `set_pool_geometry`."""
         if self._geometry is None:
             raise RuntimeError(
                 "kv_pool_bytes() needs set_pool_geometry(...) first")
-        per_chip = self.max_pages * self.page_bytes(self.block_size,
-                                                    **self._geometry)
-        return per_chip * self._geometry["mp"] if aggregate else per_chip
+        geo = dict(self._geometry)
+        cp = geo.pop("cp", 1)
+        per_chip = (self.max_pages // cp) \
+            * self.page_bytes(self.block_size, **geo)
+        return per_chip * geo["mp"] * cp if aggregate else per_chip
 
     @property
     def n_free(self) -> int:

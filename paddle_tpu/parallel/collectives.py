@@ -10,15 +10,16 @@ int8 KV pools (per-block absmax/127, zero block -> scale 0 ->
 exact-zero dequant), block-quantized along the LAST dim so the sidecar
 stays ~3% of the payload at block 128:
 
-- **quantized_all_gather**: quantize locally, all-gather (int8 payload,
-  f32 scales), dequantize locally. One rounding per element; wire bytes
-  ~0.5x a bf16 payload, ~0.25x an f32 one.
+- **quantized_all_gather**: quantize locally, all-gather ONE int8
+  buffer (the f32 scales ride bitcast-int8, concatenated onto the
+  payload's last axis), dequantize locally. One rounding per element;
+  wire bytes ~0.5x a bf16 payload, ~0.25x an f32 one.
 - **quantized_psum**: reduce-scatter on int8 shards (an `all_to_all` of
-  per-destination quantized chunks + sidecars), local dequant-ACCUMULATE
-  in f32 (so accumulation error does NOT scale with world size — each
-  contribution is rounded once, the sum is exact f32), then a quantized
-  all-gather of the reduced shard. Two roundings per element total,
-  independent of n.
+  per-destination quantized chunks, sidecar packed in), local
+  dequant-ACCUMULATE in f32 (so accumulation error does NOT scale with
+  world size — each contribution is rounded once, the sum is exact
+  f32), then a quantized all-gather of the reduced shard. Two roundings
+  per element total, independent of n.
 - **quantized_reduce_scatter**: the first hop alone (the
   `lax.psum_scatter(tiled=True)` shape contract).
 - **quantized_psum_tree**: the dp gradient sync — flattens a grad
@@ -36,20 +37,22 @@ Numerics guards (never silent corruption):
   0-d arrays, a gather along the block axis) fall back to the plain
   collective with a build-time warning.
 
-Cost model note: each quantized hop issues TWO collectives (the int8
-payload and the f32 sidecar) where the plain op issues one — wire
-bytes halve but launch count doubles, so a launch-bound tiny-payload
-path may not win; the static comms/roofline auditors and the gated
-silicon rows are the referee, and packing the sidecar bitcast-int8
-into the payload buffer is the named follow-up if dispatch dominates.
+Cost model note: each quantized hop issues ONE collective — the f32
+sidecar is bitcast to int8 and PACKED into the payload buffer
+(`_pack_scales` / `_unpack_scales`), so the launch count matches the
+plain op exactly and a launch-bound tiny-payload path (the per-layer
+decode gather the ROADMAP silicon note flagged) cannot lose on
+dispatch. The bitcast is a free relayout on both ends; the wire sees
+the identical byte count the two-collective form shipped.
 
 Flag: FLAGS_quantized_collectives / PADDLE_TPU_QUANTIZED_COLLECTIVES,
 default OFF, resolved at program-BUILD time like every serving flag
 (`resolve_quantized_collectives`): it joins the serving jit program
 keys and `warm()` covers it; flag OFF is byte-identical to a build
-without it. `analysis/comms.py` recognizes the (int8 payload + f32
-sidecar) pattern and prices BOTH tensors; TPU803 never fires on the
-int8 payload by design.
+without it. `analysis/comms.py` recognizes the packed int8 buffers
+(the only int8 tensors the stack ever puts on a collective) and
+prices them as quantized wire; TPU803 never fires on an int8 payload
+by design.
 """
 from __future__ import annotations
 
@@ -138,12 +141,36 @@ def dequantize_blocks(q, scale, out_dim: Optional[int] = None,
     return x.astype(dtype) if dtype is not None else x
 
 
+def _pack_scales(q, s):
+    """ONE wire buffer per hop: bitcast the f32 sidecar to int8 (4
+    bytes per scale, a free relayout) and concatenate it onto the
+    payload's last axis — q [..., nb*be] + s [..., nb] -> packed
+    [..., nb*be + 4*nb] int8. The collective then ships a single
+    tensor, so the quantized hop's launch count matches the plain
+    op's (the ROADMAP launch-bound-decode note)."""
+    sb = jax.lax.bitcast_convert_type(s, jnp.int8)   # [..., nb, 4]
+    return jnp.concatenate(
+        [q, sb.reshape(s.shape[:-1] + (4 * s.shape[-1],))], axis=-1)
+
+
+def _unpack_scales(packed, nb: int):
+    """Inverse of `_pack_scales` after the collective: split the
+    trailing 4*nb sidecar bytes off the last axis and bitcast them
+    back to the f32 [..., nb] scale."""
+    split = packed.shape[-1] - 4 * nb
+    q, sb = packed[..., :split], packed[..., split:]
+    s = jax.lax.bitcast_convert_type(
+        sb.reshape(packed.shape[:-1] + (nb, 4)), jnp.float32)
+    return q, s
+
+
 def quantized_all_gather(x, axis_name: str, *, axis: int = 0,
                          tiled: bool = True, block: int = QCOLL_BLOCK):
-    """`lax.all_gather` shipping an int8 payload + f32 scale sidecar:
-    quantize locally (blocks along the last dim), gather BOTH tensors
-    along `axis`, dequantize locally at x.dtype. One rounding per
-    element. Gathering along the block axis itself (the last dim) would
+    """`lax.all_gather` shipping an int8 payload with the f32 scale
+    sidecar packed in: quantize locally (blocks along the last dim),
+    gather ONE int8 buffer along `axis`, split + dequantize locally at
+    x.dtype. One rounding per element, one collective per hop.
+    Gathering along the block axis itself (the last dim) would
     interleave shards' blocks, so that case — like non-float or empty
     payloads — falls back to the plain collective with a warning."""
     nd = getattr(x, "ndim", 0)
@@ -152,8 +179,9 @@ def quantized_all_gather(x, axis_name: str, *, axis: int = 0,
                       stacklevel=2)
         return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
     q, s = quantize_blocks(x, block)
-    qg = jax.lax.all_gather(q, axis_name, axis=axis, tiled=tiled)
-    sg = jax.lax.all_gather(s, axis_name, axis=axis, tiled=tiled)
+    pg = jax.lax.all_gather(_pack_scales(q, s), axis_name, axis=axis,
+                            tiled=tiled)
+    qg, sg = _unpack_scales(pg, int(s.shape[-1]))
     return dequantize_blocks(qg, sg, out_dim=int(x.shape[-1]),
                              dtype=x.dtype)
 
@@ -163,14 +191,16 @@ def quantized_psum(x, axis_name: str, *, block: int = QCOLL_BLOCK):
 
     1. each chip flattens its addend to f32, splits it into n
        per-destination chunks, quantizes each chunk and `all_to_all`s
-       the int8 payload + f32 sidecar — the reduce-scatter hop;
+       ONE int8 buffer per chunk (sidecar packed in) — the
+       reduce-scatter hop;
     2. every chip dequantizes the n received chunks and ACCUMULATES in
        f32 — one rounding per contribution, exact summation, so the
        error does not grow with world size;
-    3. the reduced shard re-quantizes and all-gathers (payload +
-       sidecar), dequantizing back to x's shape and dtype.
+    3. the reduced shard re-quantizes and all-gathers its packed
+       buffer, dequantizing back to x's shape and dtype.
 
-    Two roundings per element total. Zero addends stay exactly zero;
+    Two roundings per element total, two collectives total (exactly
+    the plain-psum ring's hop count). Zero addends stay exactly zero;
     non-finite addends poison their block visibly (see module doc).
     Non-float payloads fall back to the plain psum with a warning."""
     if not _quantizable(x):
@@ -186,13 +216,15 @@ def quantized_psum(x, axis_name: str, *, block: int = QCOLL_BLOCK):
     padded = jnp.pad(flat, (0, n * chunk - flat.size))
     parts = padded.reshape(n, chunk)
     q, s = quantize_blocks(parts, block)
-    qx = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
-    sx = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    px = jax.lax.all_to_all(_pack_scales(q, s), axis_name,
+                            split_axis=0, concat_axis=0)
+    qx, sx = _unpack_scales(px, int(s.shape[-1]))
     red = jnp.sum(dequantize_blocks(qx, sx), axis=0)        # f32 [chunk]
     q2, s2 = quantize_blocks(red, block)
-    qg = jax.lax.all_gather(q2, axis_name, axis=0, tiled=True)
-    sg = jax.lax.all_gather(s2, axis_name, axis=0, tiled=True)
-    out = dequantize_blocks(qg, sg)[:flat.size]
+    pg = jax.lax.all_gather(_pack_scales(q2, s2), axis_name, axis=0,
+                            tiled=False)
+    qg, sg = _unpack_scales(pg, int(s2.shape[-1]))
+    out = dequantize_blocks(qg, sg).reshape(-1)[:flat.size]
     return out.reshape(shape).astype(dtype)
 
 
@@ -219,8 +251,9 @@ def quantized_reduce_scatter(x, axis_name: str, *,
     parts = x.astype(jnp.float32).reshape((n, x.shape[0] // n)
                                           + x.shape[1:])
     q, s = quantize_blocks(parts, block)
-    qx = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
-    sx = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    px = jax.lax.all_to_all(_pack_scales(q, s), axis_name,
+                            split_axis=0, concat_axis=0)
+    qx, sx = _unpack_scales(px, int(s.shape[-1]))
     red = jnp.sum(dequantize_blocks(qx, sx,
                                     out_dim=int(x.shape[-1])), axis=0)
     return red.astype(x.dtype)

@@ -26,6 +26,9 @@ HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
 BATCH_AXES = ("dp", "sharding")
 # the tensor-parallel axis (model weights / kv heads)
 MP_AXIS = "mp"
+# the context-parallel axis (paged KV pools shard by PAGE along it;
+# FLAGS_serving_cp — only the serving mesh uses it today)
+CP_AXIS = "cp"
 
 
 def divisible_prefix(mesh, dim: int, names) -> tuple:
@@ -71,23 +74,32 @@ def build_mesh(
     return Mesh(dev_array, axis_names)
 
 
-def serving_mesh(mp: int, devices: Optional[Sequence] = None) \
-        -> Optional[Mesh]:
-    """Single-axis `mp` mesh over the first `mp` local devices — the
-    tensor-parallel serving topology (FLAGS_serving_mp). Kept separate
-    from the global hybrid training mesh: the serving engine owns its
-    own mesh so a co-resident trainer's dp/pp axes never leak into the
-    paged programs' shard_map specs. Returns None at mp == 1 (the
-    single-chip path takes no mesh at all)."""
-    mp = int(mp)
-    if mp <= 1:
+def serving_mesh(mp: int, devices: Optional[Sequence] = None,
+                 cp: int = 1) -> Optional[Mesh]:
+    """Serving topology mesh over the first cp*mp local devices — 1-D
+    `mp` (tensor parallel, FLAGS_serving_mp) when cp == 1, 2-D
+    `cp x mp` (context x tensor parallel, FLAGS_serving_cp) otherwise.
+    Kept separate from the global hybrid training mesh: the serving
+    engine owns its own mesh so a co-resident trainer's dp/pp axes
+    never leak into the paged programs' shard_map specs. Returns None
+    at cp == mp == 1 (the single-chip path takes no mesh at all); the
+    cp == 1 result is byte-identical to the pre-cp 1-D mesh."""
+    mp, cp = int(mp), int(cp)
+    if mp <= 1 and cp <= 1:
         return None
     if devices is None:
         devices = jax.devices()
-    if mp > len(devices):
+    need = cp * mp
+    if need > len(devices):
         raise ValueError(
-            f"serving_mp={mp} needs {mp} devices, found {len(devices)}")
-    return build_mesh({MP_AXIS: mp}, devices=list(devices)[:mp])
+            f"serving_cp={cp} x serving_mp={mp} needs {need} devices, "
+            f"found {len(devices)}")
+    if cp <= 1:
+        return build_mesh({MP_AXIS: mp}, devices=list(devices)[:mp])
+    # size-1 axes are kept (build_mesh contract), so a cp-only mesh
+    # still names `mp` and every sharding spec can reference both axes
+    return build_mesh({CP_AXIS: cp, MP_AXIS: mp},
+                      devices=list(devices)[:need])
 
 
 def set_global_mesh(mesh: Mesh) -> None:

@@ -106,9 +106,9 @@ from ..models.llama import (PagedKVManager, _make_chunk_prefill,
                             hash_prefix_blocks, make_paged_kv_helpers,
                             make_paged_kv_q8_helpers, make_serving_tp,
                             resolve_decode_megakernel,
-                            resolve_kv_cache_dtype, resolve_serving_mp,
-                            resolve_unified_step, serving_param_specs,
-                            shard_serving_params)
+                            resolve_kv_cache_dtype, resolve_serving_cp,
+                            resolve_serving_mp, resolve_unified_step,
+                            serving_param_specs, shard_serving_params)
 from ..observability import metrics as obs_metrics
 from ..observability import trace as obs_trace
 from ..resilience import chaos
@@ -216,6 +216,7 @@ class ContinuousBatchingEngine:
                  kv_pool_bytes: Optional[int] = None,
                  decode_megakernel: Optional[bool] = None,
                  serving_mp: Optional[int] = None,
+                 serving_cp: Optional[int] = None,
                  quantized_collectives: Optional[bool] = None,
                  disaggregated: bool = False,
                  unified_step=None, token_budget: Optional[int] = None,
@@ -255,6 +256,21 @@ class ContinuousBatchingEngine:
         accuracy (the token-match gate is the int8-KV bar, not
         identity). OFF (default) keeps every wire byte-identical; at
         mp=1 the flag is key-only (no collectives exist).
+
+        `serving_cp` (ISSUE 18; default from FLAGS_serving_cp /
+        PADDLE_TPU_SERVING_CP, resolved HERE at build time like every
+        serving flag — it joins every program key and `warm()` covers
+        it) shards the paged pools along the PAGE axis across a `cp`
+        mesh axis, composable with `serving_mp` as a 2-D `cp x mp`
+        serving mesh: global page id g lives on cp shard
+        g // (max_pages / cp), block tables stay replicated (global
+        ids), each shard streams only its LOCAL pages as
+        online-softmax partials, and the per-layer cross-chip merge
+        ships only (m, l, weighted acc) stats — never the KV — via
+        `ServingTP.merge_attn_partials`. A `kv_pool_bytes` budget
+        stays PER-CHIP, so cp shards hold cp x the fleet pages: the
+        per-request context ceiling grows cp x. cp=1 is byte-
+        identical to a build without the flag.
 
         `unified_step` (ISSUE 14; default from FLAGS_unified_step /
         PADDLE_TPU_UNIFIED_STEP, 'auto' = ON off-TPU, resolved HERE at
@@ -330,12 +346,14 @@ class ContinuousBatchingEngine:
                 kv_cache_dtype=kv_cache_dtype,
                 decode_megakernel=decode_megakernel,
                 unified_step=unified_step, serving_mp=serving_mp,
+                serving_cp=serving_cp,
                 quantized_collectives=quantized_collectives,
                 token_budget=token_budget, block_size=block_size))
             kv_cache_dtype = merged["kv_cache_dtype"]
             decode_megakernel = merged["decode_megakernel"]
             unified_step = merged["unified_step"]
             serving_mp = merged["serving_mp"]
+            serving_cp = merged.get("serving_cp", serving_cp)
             quantized_collectives = merged["quantized_collectives"]
             token_budget = merged["token_budget"]
             block_size = merged["block_size"]
@@ -398,6 +416,10 @@ class ContinuousBatchingEngine:
         # time like the flags above; mp=1 builds exactly the single-chip
         # programs (no mesh, no shard_map — byte-identical)
         self.mp = resolve_serving_mp(serving_mp)
+        # context-parallel degree (FLAGS_serving_cp, ISSUE 18),
+        # resolved at build time like mp; cp=1 builds exactly the
+        # page-replicated programs (byte-identical)
+        self.cp = resolve_serving_cp(serving_cp)
         # quantized collectives (ISSUE 15), resolved at build time like
         # the flags above — resolved even at mp=1 so the flag rides the
         # program keys uniformly (it is a no-op there: no collectives)
@@ -407,12 +429,13 @@ class ContinuousBatchingEngine:
             quantized_collectives)
         self._tp = make_serving_tp(
             cfg, self.mp,
-            quantized_collectives=self.quantized_collectives)
+            quantized_collectives=self.quantized_collectives,
+            serving_cp=self.cp)
         self.mp_mesh = None
         if self._tp is not None:
             from ..parallel.mesh import serving_mesh
 
-            self.mp_mesh = serving_mesh(self.mp)
+            self.mp_mesh = serving_mesh(self.mp, cp=self.cp)
         # kv-head shard count of the POOLS: mp when they shard, 1 when
         # replicated (single-chip or the MQA fallback) — the geometry
         # byte accounting and budget sizing run on
@@ -444,20 +467,27 @@ class ContinuousBatchingEngine:
                     "pass max_pages OR kv_pool_bytes, not both")
             # PER-CHIP budget: under kv-head sharding each chip holds
             # only nkv/mp heads of every page, so the same per-chip
-            # bytes buy ~mp x the aggregate cacheable pages
+            # bytes buy ~mp x the aggregate cacheable pages; under
+            # page sharding (cp, ISSUE 18) each chip holds 1/cp of the
+            # fleet's pages, so the same bytes buy cp x the FLEET page
+            # count — the context-ceiling lift
             max_pages = PagedKVManager.pages_for_bytes(
                 kv_pool_bytes, block_size,
                 n_layers=cfg.num_hidden_layers, num_kv_heads=nkv,
                 head_dim=dh, kv_cache_dtype=self.kv_dtype,
-                mp=self.kv_shards)
+                mp=self.kv_shards, cp=self.cp)
             if max_pages < cap + 2:
                 raise ValueError(
                     f"kv_pool_bytes {kv_pool_bytes} holds only "
                     f"{max_pages} pages at kv_cache_dtype="
-                    f"{self.kv_dtype}; need at least {cap + 2} "
+                    f"{self.kv_dtype} (cp={self.cp}); need at least "
+                    f"{cap + 2} "
                     "(one full request + scratch + one cacheable page)")
         if max_pages is None:
-            max_pages = slots * cap + 1
+            # round the default up to a whole number of cp shards —
+            # set_pool_geometry rejects a fleet count with ownerless
+            # remainder pages (PageShardingError)
+            max_pages = -(-(slots * cap + 1) // self.cp) * self.cp
         # the operator's explicit PER-CHIP pool byte budget (None when
         # sized by max_pages) — audit_memory() derives its default
         # TPU702 HBM budget from it
@@ -469,7 +499,7 @@ class ContinuousBatchingEngine:
         self.mgr.set_pool_geometry(n_layers=cfg.num_hidden_layers,
                                    num_kv_heads=nkv, head_dim=dh,
                                    kv_cache_dtype=self.kv_dtype,
-                                   mp=self.kv_shards)
+                                   mp=self.kv_shards, cp=self.cp)
         self.scratch_page = self.mgr.alloc_pages(1)[0]  # retired rows' sink
         if self.kv_dtype == "int8":
             # (int8 pool, per-(page, kv head) f32 absmax scale) pairs —
@@ -598,18 +628,26 @@ class ContinuousBatchingEngine:
     def _pool_entry_spec(self):
         """PartitionSpec(s) of one per-layer K or V pool entry on the
         serving mesh: [max_pages, nkv, block, dh] sharded on the
-        kv-head axis (scale sidecars [max_pages, nkv] likewise), or
-        fully replicated under the MQA fallback."""
+        kv-head axis over `mp` (scale sidecars [max_pages, nkv]
+        likewise) and/or on the PAGE axis over `cp` (ISSUE 18 — each
+        chip holds a contiguous 1/cp of the fleet's pages, matching
+        `cp_local_view`'s owner arithmetic); fully replicated under
+        the MQA fallback at cp=1."""
         from jax.sharding import PartitionSpec as P
 
-        shard = self._tp is not None and self._tp.kv_sharded
+        mp_shard = self._tp is not None and self._tp.kv_sharded \
+            and self._tp.mp > 1
+        cp_shard = self._tp is not None and self._tp.cp > 1
         # NOTE: trailing-None-free form — jit normalizes output specs
         # (P(None, 'mp', None, None) comes back as P(None, 'mp')) and
         # treats the two spellings as DIFFERENT shardings; matching the
         # normalized form keeps warm()'s compile serving the steady
         # state instead of donating into a one-entry-stale cache
-        pool = P(None, self._tp.axis) if shard else P()
-        sc = P(None, self._tp.axis) if shard else P()
+        if cp_shard:
+            pool = sc = P(self._tp.cp_axis, self._tp.axis) if mp_shard \
+                else P(self._tp.cp_axis)
+        else:
+            pool = sc = P(None, self._tp.axis) if mp_shard else P()
         return (pool, sc) if self.kv_dtype == "int8" else pool
 
     def _shard_program(self, fn, n_repl: int, n_out_repl: int):
@@ -706,6 +744,10 @@ class ContinuousBatchingEngine:
             # quantized collectives (ISSUE 15): int8 wire on the mp
             # o-proj gather / megakernel psum when True
             "quantized_collectives": self.quantized_collectives,
+            # serving parallelism degrees: kv-head (mp) and page-axis
+            # context (cp, ISSUE 18) shard counts
+            "serving_mp": self.mp,
+            "serving_cp": self.cp,
             # pool occupancy: pages not reclaimable right now / bytes
             "kv_cache_dtype": self.kv_dtype,
             "kv_pool_bytes": mgr.kv_pool_bytes(),
@@ -951,14 +993,46 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         nkv, dh = self._nkv_eff, cfg.head_dim
         bs = self.block_size
+        tp = self._tp
+        cp_drop = tp is not None and tp.cp > 1
         to_pages, _ = make_paged_kv_helpers(bsz, n_pre, nkv, dh, bs, None)
+
+        def _local(pages, pps):
+            # cp page-axis translation (ISSUE 18): this shard owns
+            # global ids [idx*pps, (idx+1)*pps); non-owned writes
+            # translate OUT OF RANGE (pps) and mode='drop' discards
+            # them — never a redirect onto a real local page
+            idx = jax.lax.axis_index(tp.cp_axis)
+            return jnp.where((pages // pps) == idx, pages % pps, pps)
+
         if self.kv_dtype != "int8":
+            if cp_drop:
+                def scatter(kc, vc, k, v, pages):
+                    loc = _local(pages, kc.shape[0])
+                    return (kc.at[loc].set(to_pages(k).astype(kc.dtype),
+                                           mode="drop"),
+                            vc.at[loc].set(to_pages(v).astype(vc.dtype),
+                                           mode="drop"))
+                return scatter
+
             def scatter(kc, vc, k, v, pages):
                 return (kc.at[pages].set(to_pages(k).astype(kc.dtype)),
                         vc.at[pages].set(to_pages(v).astype(vc.dtype)))
             return scatter
         to_pages_q8, _ = make_paged_kv_q8_helpers(bsz, n_pre, nkv, dh,
                                                   bs, None)
+
+        if cp_drop:
+            def scatter_q8(kct, vct, k, v, pages):
+                (kc, ksc), (vc, vsc) = kct, vct
+                loc = _local(pages, kc.shape[0])
+                qk, sk = to_pages_q8(k)
+                qv, sv = to_pages_q8(v)
+                return ((kc.at[loc].set(qk, mode="drop"),
+                         ksc.at[loc].set(sk, mode="drop")),
+                        (vc.at[loc].set(qv, mode="drop"),
+                         vsc.at[loc].set(sv, mode="drop")))
+            return scatter_q8
 
         def scatter_q8(kct, vct, k, v, pages):
             (kc, ksc), (vc, vsc) = kct, vct
@@ -1014,29 +1088,92 @@ class ContinuousBatchingEngine:
         use_mega = self.use_megakernel
         nkv_eff = self._nkv_eff
         tp = self._tp
+        cp_parts = tp is not None and tp.cp > 1
 
         def make_step(tables, p, kcs, vcs):
             """Per-layer decode body for one chunk: the megakernel
             (FLAGS_decode_megakernel) when enabled and supported for
             these operand shapes, else the multi-kernel oracle path.
             Under serving_mp this runs inside the shard_map body — the
-            kv helpers and the attention see the LOCAL kv heads."""
+            kv helpers and the attention see the LOCAL kv heads. Under
+            serving_cp (ISSUE 18) the pools arrive PAGE-sharded: the
+            kv commit translates global page ids to local rows (non-
+            owned writes drop out of range), the attend streams only
+            the owned pages as online-softmax partials, and
+            `merge_attn_partials` folds the per-shard stats — never
+            the KV — into the global context."""
+            if cp_parts:
+                from ..kernels.partial_attention import (
+                    cp_local_view, decode_paged_partials,
+                    finalize_partials)
+
+                def _cp_attend(q1, kc, vc, lens_, ksc=None, vsc=None):
+                    loc, owned = cp_local_view(tables, kc.shape[0],
+                                               tp.cp_axis)
+                    part = decode_paged_partials(
+                        q1, kc, vc, loc, lens_, owned, k_scale=ksc,
+                        v_scale=vsc)
+                    m, l, acc = tp.merge_attn_partials(*part)
+                    return finalize_partials(m, l, acc).astype(q1.dtype)
+
             if quant:
-                _, kv_write = make_paged_kv_q8_helpers(
-                    b, 0, nkv_eff, cfg.head_dim, bs, tables)
+                if cp_parts:
+                    # the q8 commit gathers the page's running absmax,
+                    # rescales, and writes back — feeding it the
+                    # TRANSLATED table (non-owned ids pushed out of
+                    # range) makes its reads clamp to a don't-care row
+                    # and its writes drop, so only the owning shard
+                    # mutates a page (jax scatters drop out-of-bounds
+                    # by default; the gathered garbage never lands)
+                    def _q8_local_tables(kct):
+                        pps = kct[0].shape[0]
+                        idx = jax.lax.axis_index(tp.cp_axis)
+                        return jnp.where((tables // pps) == idx,
+                                         tables % pps, pps)
 
-                def kv_attend(q1, kct, vct, lens_):
-                    (kc, ksc), (vc, vsc) = kct, vct
-                    return paged_decode_attention(q1, kc, vc, tables,
-                                                  lens_, k_scale=ksc,
-                                                  v_scale=vsc)
+                    def kv_write(kct, vct, k, v, lens_):
+                        _, w = make_paged_kv_q8_helpers(
+                            b, 0, nkv_eff, cfg.head_dim, bs,
+                            _q8_local_tables(kct))
+                        return w(kct, vct, k, v, lens_)
+
+                    def kv_attend(q1, kct, vct, lens_):
+                        (kc, ksc), (vc, vsc) = kct, vct
+                        return _cp_attend(q1, kc, vc, lens_, ksc, vsc)
+                else:
+                    _, kv_write = make_paged_kv_q8_helpers(
+                        b, 0, nkv_eff, cfg.head_dim, bs, tables)
+
+                    def kv_attend(q1, kct, vct, lens_):
+                        (kc, ksc), (vc, vsc) = kct, vct
+                        return paged_decode_attention(q1, kc, vc,
+                                                      tables, lens_,
+                                                      k_scale=ksc,
+                                                      v_scale=vsc)
             else:
-                _, kv_write = make_paged_kv_helpers(
-                    b, 0, nkv_eff, cfg.head_dim, bs, tables)
+                if cp_parts:
+                    def kv_write(kc, vc, k, v, lens_):
+                        page = tables[jnp.arange(b), lens_ // bs]
+                        slot = lens_ % bs
+                        pps = kc.shape[0]
+                        idx = jax.lax.axis_index(tp.cp_axis)
+                        loc = jnp.where((page // pps) == idx,
+                                        page % pps, pps)
+                        return (kc.at[loc, :, slot, :].set(
+                                    k[:, 0].astype(kc.dtype),
+                                    mode="drop"),
+                                vc.at[loc, :, slot, :].set(
+                                    v[:, 0].astype(vc.dtype),
+                                    mode="drop"))
 
-                def kv_attend(q1, kc, vc, lens_):
-                    return paged_decode_attention(q1, kc, vc, tables,
-                                                  lens_)
+                    kv_attend = _cp_attend
+                else:
+                    _, kv_write = make_paged_kv_helpers(
+                        b, 0, nkv_eff, cfg.head_dim, bs, tables)
+
+                    def kv_attend(q1, kc, vc, lens_):
+                        return paged_decode_attention(q1, kc, vc,
+                                                      tables, lens_)
 
             base = _make_decode_step(cfg, b, kv_write=kv_write,
                                      kv_attend=kv_attend, tp=tp)
@@ -1150,7 +1287,7 @@ class ContinuousBatchingEngine:
         dtype rides every key: an engine only ever builds programs at
         its own kv_cache_dtype, and the key makes that self-evident in
         compile_stats()."""
-        key = ("cold", sb, bsz, self.kv_dtype,
+        key = ("cold", sb, bsz, self.kv_dtype, self.cp,
                int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
@@ -1159,7 +1296,7 @@ class ContinuousBatchingEngine:
         return self._prefill_cache[key]
 
     def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
-        key = ("prefix", sb, bsz, w_pre, self.kv_dtype,
+        key = ("prefix", sb, bsz, w_pre, self.kv_dtype, self.cp,
                int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
@@ -1522,6 +1659,7 @@ class ContinuousBatchingEngine:
             "fleet_peak_hbm_bytes": fleet_peak,
             "per_chip": True,
             "mp": self.mp,
+            "cp": self.cp,
             "kv_pool_bytes": self.mgr.kv_pool_bytes(),
             "hbm_budget_bytes": hbm_budget_bytes,
             "donation_clean": all(p["donation_misses"] == 0
@@ -1612,6 +1750,7 @@ class ContinuousBatchingEngine:
             "programs_audited": len(out),
             "per_chip": True,
             "mp": self.mp,
+            "cp": self.cp,
             "total_bytes_on_wire": sum(p["bytes_on_wire"]
                                        for p in out.values()),
             "predicted_bytes_on_wire_per_token": per_token,
@@ -1721,6 +1860,7 @@ class ContinuousBatchingEngine:
             "device": spec.name,
             "per_chip": True,
             "mp": self.mp,
+            "cp": self.cp,
             "predicted_step_ms": step_ms,
             "predicted_mfu": mfu,
             "predicted_ms_per_token": per_token_ms,

@@ -15,12 +15,13 @@ Contracts under test:
   churn; the flag joins every program key and zero-recompile-after-
   warm holds; flag OFF stays byte-identical (guarded by the existing
   mp identity suite);
-- analysis: the comms pass recognizes the (int8 payload + f32
-  sidecar) pair and prices BOTH tensors; the quantized decode gather
-  is ~0.5-0.65x the bf16 wire (exact 0.5x plus the sidecar, which is
-  proportionally wider at tiny head dims); TPU803 fires on the bf16
-  gather at a tightened threshold and is SILENT on the quantized one
-  at the DEFAULT threshold;
+- analysis: the comms pass recognizes the packed int8 buffer (the f32
+  sidecar rides bitcast-int8 inside the payload — ONE collective per
+  hop since the ISSUE 18 packing) and prices payload + sidecar; the
+  quantized decode gather is ~0.5-0.65x the bf16 wire (exact 0.5x
+  plus the sidecar, which is proportionally wider at tiny head dims);
+  TPU803 fires on the bf16 gather at a tightened threshold and is
+  SILENT on the quantized one at the DEFAULT threshold;
 - training: dp-trained tiny-llama loss curve with the quantized sync
   matches the eager unquantized run within the PR 5 quantization
   tolerance, and fit(audit_comms=) prices the quantized step;
@@ -376,7 +377,9 @@ class TestCommsAuditQuantized(unittest.TestCase):
         """The quantized decode gather is priced payload + sidecar:
         ~0.5x the bf16 wire at serving head dims (0.625x at the tiny
         dh=16: int8 1 B/elt + f32/16-elt sidecar vs bf16 2 B/elt), and
-        the pass marks the int8+scale pair."""
+        the pass marks the packed int8 buffer — ONE collective per hop
+        since the sidecar packing, so every quantized event is int8
+        and the hop count matches the unquantized program's."""
         from paddle_tpu.analysis import comms as comms_mod
 
         e_b, g_b = self._decode_graphs(False)
@@ -393,11 +396,19 @@ class TestCommsAuditQuantized(unittest.TestCase):
         self.assertGreaterEqual(dec_q["n_quantized_sites"], 1)
         self.assertEqual(dec_q["quantized_wire_bytes"],
                          dec_q["bytes_on_wire"])
-        # the raw report marks both halves of each pair
+        # packed form: EVERY quantized event is the single int8
+        # buffer (no float sidecar twin rides the wire anymore), and
+        # the quantized program issues no more collectives than the
+        # bf16 one — the launch-bound-decode risk is closed
         crep = comms_mod.audit_graph(g_q[0][1])
+        self.assertTrue(crep.quantized_events)
         kinds = {e.dtype.startswith("int8") for e in
                  crep.quantized_events}
-        self.assertEqual(kinds, {True, False})
+        self.assertEqual(kinds, {True})
+        brep = comms_mod.audit_graph(g_b[0][1])
+        self.assertLessEqual(crep.n_collective_sites,
+                             brep.n_collective_sites)
+        self.assertLessEqual(crep.n_collectives, brep.n_collectives)
         dec_b = rep_b["programs"]["decode"]
         self.assertEqual(dec_b["n_quantized_sites"], 0)
 

@@ -45,10 +45,12 @@ def _demo_runs():
     test)."""
     cfg, params = _tiny_setup()
     space = tuner.default_space(cfg, _KW)
-    # conftest forces 8 host devices, which would add serving_mp=2 to
-    # the space and double the engine-build work; mp behavior has its
-    # own suite (test_serving_mp) — pin the sweep to mp=1 here
+    # conftest forces 8 host devices, which would add serving_mp=2
+    # and serving_cp=2/4/8 to the space and multiply the engine-build
+    # work; mesh behavior has its own suites (test_serving_mp,
+    # test_serving_cp) — pin both sweeps to 1 here
     space["serving_mp"] = [1]
+    space["serving_cp"] = [1]
     geo = tuner._engine_geometry(dict(_KW))
     budget = max(tuner.static_candidate_bound(cfg, params, c, _KW)
                  for c in tuner.enumerate_candidates(space, geo)) - 1
@@ -154,6 +156,72 @@ class TestAutotuneRanking(unittest.TestCase):
         d = rep.to_dict()
         self.assertLessEqual(d["n_candidates"], 3)  # 2 + baseline
         self.assertIsNotNone(d["baseline"])
+
+
+class TestServingCPKnob(unittest.TestCase):
+    """ISSUE 18: serving_cp joins the config space — divisibility-
+    filtered against a pinned pool, per-chip stage-A bound, and
+    unbuildable cp*mp meshes pruned by name (never an engine crash)."""
+
+    def test_space_filters_and_static_bound_shrinks(self):
+        cfg, params = _tiny_setup()
+        space = tuner.default_space(cfg, _KW)
+        self.assertIn("serving_cp", space)
+        self.assertIn(2, space["serving_cp"])  # conftest: 8 devices
+        # a pinned max_pages filters degrees that don't divide it
+        s2 = tuner.default_space(cfg, dict(_KW, max_pages=6))
+        self.assertEqual(s2["serving_cp"], [1, 2])
+        # stage-A bound carries fleet/cp LOCAL pages: the pool term
+        # must strictly shrink as cp grows (params are replicated)
+        base = tuner.baseline_config(cfg, _KW)
+        bounds = [tuner.static_candidate_bound(
+            cfg, params, dict(base, serving_cp=c), _KW)
+            for c in (1, 2, 4)]
+        self.assertGreater(bounds[0], bounds[1])
+        self.assertGreater(bounds[1], bounds[2])
+        # a per-chip kv_pool_bytes budget is cp-invariant by contract
+        # (pages_for_bytes buys budget*cp fleet pages)
+        kwb = dict(_KW, kv_pool_bytes=1 << 20)
+        self.assertEqual(
+            tuner.static_candidate_bound(
+                cfg, params, dict(base, serving_cp=1), kwb),
+            tuner.static_candidate_bound(
+                cfg, params, dict(base, serving_cp=4), kwb))
+
+    def test_qcoll_survives_collapse_under_cp(self):
+        """quantized_collectives only collapses when BOTH mesh axes
+        are 1 — the cp merge ships quantized acc partials at mp=1."""
+        geo = tuner._engine_geometry(dict(_KW))
+        base = tuner.baseline_config(cfg=LlamaConfig.tiny(),
+                                     engine_kwargs=_KW)
+        c = tuner.canonical_config(
+            dict(base, serving_cp=2, quantized_collectives=True), geo)
+        self.assertTrue(c["quantized_collectives"])
+        c = tuner.canonical_config(
+            dict(base, serving_cp=1, serving_mp=1,
+                 quantized_collectives=True), geo)
+        self.assertFalse(c["quantized_collectives"])
+
+    def test_unbuildable_mesh_pruned_by_name(self):
+        """cp*mp products past the host's device count are pruned
+        with a named reason, distinct from both HBM prune stages."""
+        cfg, params = _tiny_setup()
+        base = tuner.baseline_config(cfg, _KW)
+        space = {k: [v] for k, v in base.items()}
+        space["serving_cp"] = [8]
+        space["serving_mp"] = [2]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # budget of 1 B statically prunes every buildable
+            # candidate, so the test never builds an engine
+            rep = analysis.autotune(cfg, params,
+                                    engine_kwargs=dict(_KW),
+                                    hbm_budget_bytes=1, space=space)
+        reasons = [p.pruned_reason for p in rep.pruned]
+        self.assertTrue(any(
+            "serving_cp*serving_mp = 16" in r and "host has" in r
+            for r in reasons), reasons)
+        self.assertFalse(rep.ranking)
 
 
 class TestTunedConfigArtifact(unittest.TestCase):
