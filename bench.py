@@ -748,6 +748,69 @@ def _op_bench(only=None):
         }
         del ueng, urun
 
+    if want("verify_chunk"):
+        # speculative verify window (ISSUE 19): ONE ragged pass scoring
+        # 8 slots x (k=4 drafts + the pending token) at the 1B serving
+        # shape — the program a speculative step dispatches instead of
+        # k+1 sequential decode steps. The slope prices one window; the
+        # auditor twins (predicted_step_ms / wire bytes / peak HBM)
+        # land beside it like the ragged_step row so the next TPU run
+        # gets estimate/actual ratios.
+        from bench_util import paired_slope_ms
+        from paddle_tpu.analysis import roofline as _roof
+        from paddle_tpu.models import (LlamaConfig,
+                                       init_quant_serving_params)
+        from paddle_tpu.serving import ContinuousBatchingEngine
+
+        vcfg = LlamaConfig.llama_1b(dtype="bfloat16")
+        vp = init_quant_serving_params(vcfg, "weight_only_int8", seed=0)
+        np.asarray(jax.tree.leaves(vp)[-1])
+        veng = ContinuousBatchingEngine(
+            vcfg, vp, slots=8, prompt_bucket=128, max_prompt_len=128,
+            max_new_tokens=64, block_size=64, steps_per_sync=16,
+            prefill_batch=1, prefix_cache=False,
+            speculative="ngram", spec_k=4)
+        w = veng.spec_k + 1
+        vtables = jnp.full((veng.slots, veng.table_width),
+                           veng.scratch_page, jnp.int32)
+        vids = jnp.ones((veng.slots, w), jnp.int32)
+        vcached = jnp.full((veng.slots,), 96, jnp.int32)
+        vnew = jnp.full((veng.slots,), w, jnp.int32)
+
+        def vrun(n):
+            # chained donated invocations, synced once — the slope
+            # cancels the tunnel RTT like the decode-chunk rig
+            acc = None
+            for _ in range(int(n)):
+                preds, veng.kcs, veng.vcs = veng._verify(
+                    veng.p, veng.kcs, veng.vcs, vids, vtables, vcached,
+                    vnew)
+                acc = preds
+            return float(jnp.sum(acc))
+
+        vrun(1)  # compile once
+        ops["verify_chunk"] = round(paired_slope_ms(vrun, 1, 13,
+                                                    pairs=6), 4)
+        vgraphs = veng._traced_inventory(programs=("verify",))
+        vroof = veng.audit_roofline(
+            programs=("verify",), graphs=vgraphs)["programs"]["verify"]
+        OP_INFO["verify_chunk"] = {
+            "spec_k": veng.spec_k,
+            "window_rows": veng.slots * w,
+            "kernels_per_step": _roof.count_kernel_launches(
+                vgraphs[0][1].jaxpr),
+            "predicted_step_ms": round(vroof["predicted_step_ms"], 4),
+            "predicted_mfu": vroof["predicted_mfu"],
+            "predicted_bound": vroof["bound"],
+            "predicted_bytes_on_wire_per_token": int(
+                veng.audit_comms(programs=("verify",), graphs=vgraphs)
+                ["predicted_bytes_on_wire_per_token"]),
+            "predicted_peak_hbm_bytes": veng.audit_memory(
+                programs=("verify",),
+                graphs=vgraphs)["fleet_peak_hbm_bytes"],
+        }
+        del veng, vrun
+
     # eager dispatch overhead: one tiny op, eager, host-timed — tracks the
     # per-op cost of the eager tape + device round-trip over rounds
     # (reference: test/cpp/eager/performance_tests/benchmark_eager_cuda.cc).

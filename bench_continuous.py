@@ -90,6 +90,16 @@ untraced useful_tok_s overhead (< 2% is the bar) and whether the
 exported spans cover admit / prefill / decode / sync-wait / retire
 for every request.
 
+- speculative (ISSUE 19): an extractive/repetitive trace (requests
+  share a long repeated phrase, so generated tokens keep re-entering
+  n-gram context) with a cold-suffix control mixed in, served with
+  speculation off / ngram k=4 / ngram k=8 at greedy bf16. Rows carry
+  spec_drafted / spec_accepted / acceptance_rate straight from
+  engine.metrics(); the summary reports accepted_tok_s per policy,
+  the speedup vs the off row (> 1.2x on the repetitive trace is the
+  acceptance bar) and spec_token_match_rate, which MUST be 1.0 —
+  greedy speculation changes throughput, never output.
+
 Usage: python bench_continuous.py [n_requests] [seed] [--trace out.json]
 """
 from __future__ import annotations
@@ -141,6 +151,29 @@ def make_trace(n, seed, rate_req_s, variance="uniform"):
                              MAX_NEW).tolist()
     else:
         targets = rng.integers(8, MAX_NEW + 1, n).tolist()
+    return arrivals, prompts, targets
+
+
+def make_spec_trace(n, seed, rate_req_s=1e9):
+    """Repetitive/extractive requests (a shared 24-token phrase repeated
+    through every prompt — summarisation/code-edit-shaped traffic the
+    n-gram drafter feasts on) with every 4th request a COLD control: a
+    unique random prompt the drafter never matches, which must degrade
+    to plain one-token-per-window decode, not slow down or diverge.
+    Saturating arrivals so accepted_tok_s is throughput-bound."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_req_s, n))
+    phrase = rng.integers(1, 32000, (24,)).tolist()
+    prompts, targets = [], []
+    for i in range(n):
+        if i % 4 == 3:
+            prompts.append(rng.integers(
+                1, 32000, (int(rng.integers(24, 96)),)).tolist())
+        else:
+            head = rng.integers(1, 32000,
+                                (int(rng.integers(2, 8)),)).tolist()
+            prompts.append(head + phrase * 4)
+        targets.append(MAX_NEW)
     return arrivals, prompts, targets
 
 
@@ -200,6 +233,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
                megakernel=False, serving_mp=1, disaggregated=False,
                quantized_collectives=None,
                unified=False, token_budget=None,
+               speculative=None, spec_k=None,
                tracer=None, with_metrics=True):
     import paddle_tpu as paddle
 
@@ -231,6 +265,7 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
             # SPLIT scheduler they were written against; the `mixed`
             # trace runs both and compares (ISSUE 14)
             unified_step=unified, token_budget=token_budget,
+            speculative=speculative, spec_k=spec_k,
             tracer=tracer if tracer is not None else False,
             metrics=mt if mt is not None else False)
         # compile every (bucket, prefill-batch) program + the decode
@@ -311,6 +346,13 @@ def run_engine(cfg, p, arrivals, prompts, targets, *, policy="continuous",
         "mp": serving_mp,
         "useful_tok_s_per_chip": round(useful / wall / serving_mp, 1),
         "prefill_handoffs": em["prefill_handoffs"],
+        # speculative decoding (ISSUE 19): read off the ONE metrics
+        # dict, never by poking engine attributes
+        "speculative": em["speculative"],
+        "spec_k": em["spec_k"],
+        "spec_drafted": em["spec_drafted"],
+        "spec_accepted": em["spec_accepted"],
+        "acceptance_rate": round(em["acceptance_rate"], 4),
         # observability snapshot (ISSUE 8): latency-histogram
         # percentiles from the engine's metrics registry
         "metrics": None if mt is None else {
@@ -658,6 +700,42 @@ def main():
         "prefill_chunks": muni["prefill_chunks"],
         "unified_token_match_rate": _token_match_rate(toks_mixed[0],
                                                       toks_mixed[2]),
+    }), flush=True)
+
+    # speculative trace (ISSUE 19): repetitive/extractive traffic +
+    # cold-suffix controls, speculation off vs ngram at k=4 and k=8.
+    # Greedy bf16 — the off row is the token oracle, and the summary's
+    # spec_token_match_rate MUST be 1.0 (acceptance only ever keeps
+    # drafts the target's own argmax agrees with). accepted_tok_s is
+    # useful_tok_s on this saturating trace; > 1.2x the off row on the
+    # repetitive mix is the acceptance bar.
+    arrivals, prompts, targets = make_spec_trace(n, seed)
+    spec_rows = []
+    for pol, policy_spec, k in (("speculative off", None, None),
+                                ("speculative ngram k=4", "ngram", 4),
+                                ("speculative ngram k=8", "ngram", 8)):
+        spec_rows.append(run_engine(
+            cfg, p, arrivals, prompts, targets, policy=pol,
+            prefix_cache=True, speculative=policy_spec, spec_k=k))
+    spec_toks = [row.pop("_tokens", None) for row in spec_rows]
+    for row in spec_rows:
+        row["trace"] = "speculative"
+        print(json.dumps(row), flush=True)
+    off_row = spec_rows[0]
+    print(json.dumps({
+        "trace": "speculative", "summary": True,
+        "accepted_tok_s": {r["policy"]: r["useful_tok_s"]
+                           for r in spec_rows},
+        "accepted_tok_s_gain_vs_off": {
+            r["policy"]: round(r["useful_tok_s"]
+                               / max(off_row["useful_tok_s"], 1e-9), 3)
+            for r in spec_rows[1:]},
+        "acceptance_rate": {r["policy"]: r["acceptance_rate"]
+                            for r in spec_rows[1:]},
+        # the correctness bar: greedy speculation is output-invariant
+        "spec_token_match_rate": {
+            r["policy"]: _token_match_rate(spec_toks[0], t)
+            for r, t in zip(spec_rows[1:], spec_toks[1:])},
     }), flush=True)
 
     # sharded trace (ISSUE 7): the shared_prefix traffic across a

@@ -79,7 +79,7 @@ __all__ = [
 # TunedConfig.apply() a plain dict merge
 KNOBS = ("block_size", "decode_megakernel", "kv_cache_dtype",
          "quantized_collectives", "serving_cp", "serving_mp",
-         "token_budget", "unified_step")
+         "spec_k", "speculative", "token_budget", "unified_step")
 
 SCHEMA_VERSION = 1
 # the artifact the engine loads; lives next to the persistent compile
@@ -141,9 +141,11 @@ def baseline_config(cfg, engine_kwargs: Optional[dict] = None) -> dict:
                                 resolve_serving_cp, resolve_serving_mp,
                                 resolve_unified_step)
     from ..parallel.collectives import resolve_quantized_collectives
+    from ..serving.speculative import resolve_spec_k, resolve_speculative
 
     kw = dict(engine_kwargs or {})
     geo = _engine_geometry(kw)
+    speculative = resolve_speculative(kw.get("speculative"))
     config = {
         "block_size": geo["block_size"],
         "decode_megakernel": resolve_decode_megakernel(
@@ -154,6 +156,9 @@ def baseline_config(cfg, engine_kwargs: Optional[dict] = None) -> dict:
             kw.get("quantized_collectives")),
         "serving_cp": resolve_serving_cp(kw.get("serving_cp")),
         "serving_mp": resolve_serving_mp(kw.get("serving_mp")),
+        "spec_k": (resolve_spec_k(kw.get("spec_k"))
+                   if speculative != "off" else 0),
+        "speculative": speculative,
         "token_budget": int(kw.get("token_budget")
                             or geo["prompt_bucket"]),
         "unified_step": resolve_unified_step(kw.get("unified_step")),
@@ -168,12 +173,20 @@ def canonical_config(config: dict, geo: dict) -> dict:
     (no collectives exist; with cp>1 the partial merge ships
     quantized acc partials even head-unsharded) and `token_budget` is
     meaningless on the split path (no unified window program is
-    built)."""
+    built), and `spec_k` is meaningless with speculation off (no
+    verify program is built — the window width collapses to 0)."""
     out = dict(config)
     if out["serving_mp"] == 1 and out.get("serving_cp", 1) == 1:
         out["quantized_collectives"] = False
     if not out["unified_step"]:
         out["token_budget"] = geo["prompt_bucket"]
+    if out.get("serving_cp", 1) > 1:
+        # speculative verify windows don't compose with page-sharded
+        # pools yet (ROADMAP follow-up) — the engine refuses the build,
+        # so the candidate collapses to its non-speculative twin
+        out["speculative"] = "off"
+    if out.get("speculative", "off") == "off":
+        out["spec_k"] = 0
     return out
 
 
@@ -209,6 +222,9 @@ def default_space(cfg, engine_kwargs: Optional[dict] = None) -> dict:
            if c <= n_dev and (geo["max_pages"] is None
                               or int(geo["max_pages"]) % c == 0)]
     tb = geo["prompt_bucket"]
+    # speculative sweeps only the model-free ngram policy (the draft
+    # policy needs a drafter instance the tuner cannot conjure) at two
+    # draft depths; "off" collapses spec_k, so the product stays tight
     return {
         "block_size": blocks,
         "decode_megakernel": [False, True],
@@ -216,6 +232,8 @@ def default_space(cfg, engine_kwargs: Optional[dict] = None) -> dict:
         "quantized_collectives": [False, True],
         "serving_cp": cps,
         "serving_mp": mps,
+        "spec_k": [4, 8],
+        "speculative": ["off", "ngram"],
         "token_budget": sorted({tb, 2 * tb}),
         "unified_step": [False, True],
     }

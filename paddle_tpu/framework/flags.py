@@ -209,6 +209,30 @@ define_flag("quantized_collectives", False,
             "(also: PADDLE_TPU_QUANTIZED_COLLECTIVES)",
             env_aliases=("PADDLE_TPU_QUANTIZED_COLLECTIVES",))
 
+define_flag("speculative", "off",
+            "speculative decoding policy of the serving engine "
+            "(serving/speculative.py): 'ngram' drafts k tokens per "
+            "slot host-side by prompt-lookup (match the last n "
+            "generated tokens against the request's own prompt + "
+            "history and propose the continuation — no draft model), "
+            "'draft' runs a small draft llama on its own tiny paged "
+            "pools; either way the target model verifies all k "
+            "drafts + the pending token as ONE ragged window "
+            "(new_len=k+1) through the same paged attention kernel, "
+            "greedy acceptance keeps the longest matching prefix "
+            "plus one corrected token, and rejection is pure length "
+            "bookkeeping. 'off' (default) = today's one-token-per-"
+            "step path, byte-identical. Read when a paged program / "
+            "engine is BUILT (spec_k joins every program key; "
+            "warm() covers it), so flip it before constructing (or "
+            "warming) an engine (also: PADDLE_TPU_SPECULATIVE)",
+            env_aliases=("PADDLE_TPU_SPECULATIVE",))
+define_flag("spec_k", 4,
+            "tokens drafted per slot per speculative step (the "
+            "verify window is spec_k+1 rows). Read at engine BUILD "
+            "time alongside `speculative` (also: PADDLE_TPU_SPEC_K)",
+            env_aliases=("PADDLE_TPU_SPEC_K",))
+
 define_flag("compile_cache", "",
             "persistent XLA compile-cache directory for the serving "
             "engine (serving/compile_cache.py): non-empty enables "

@@ -1027,6 +1027,92 @@ def _make_chunk_prefill(cfg, tn, tp=None):
     return prefill
 
 
+def _make_verify_window(cfg, b, w, tp=None):
+    """Speculative-verify transformer body (ISSUE 19): the chunk lane of
+    `_make_chunk_prefill`, batched over `b` slots at a FIXED window of
+    `w = spec_k + 1` tokens — the slot's pending token plus its k
+    drafts — through the same `ragged_paged_attention` kernel the
+    unified step runs. The only new ask of the model is that logits
+    come back for ALL w rows instead of the last: row j scores the
+    token the target would emit AFTER window token j, which is exactly
+    what greedy acceptance compares draft j+1 against.
+
+    Per-slot state is traced so ONE compiled program serves every
+    (cached_len, new_len) mix: `tables` [b, tw] are the slots' block
+    tables, `cached_lens` [b] the committed counts (arbitrary, token
+    granular), `new_lens` [b] the true window lengths (1 = no drafts =
+    plain decode semantics; rows past new_len are pad — the kernel
+    zeroes them and the caller scatters their K/V at the scratch page).
+
+    With `tp` (ServingTP, inside a shard_map body): shard-local q/k/v
+    heads + pool shards, per-shard outputs all-gather before the
+    replicated o-proj — same one collective per layer as the decode
+    chunk. Context parallelism (tp.cp > 1) is a follow-up; the engine
+    gates it.
+
+    Returns verify(p, kcs, vcs, ids, tables, cached_lens, new_lens) ->
+    (h_final [b, w, hidden], [(k_i, v_i)]) with rotary-applied window
+    K/V [b, w, nkv_l, dh] per layer — the caller owns the per-column
+    page scatter and the head projection."""
+    nh, nkv, dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    nh_l = tp.nh_local if tp is not None else nh
+    nkv_l = tp.nkv_local if tp is not None else nkv
+    if tp is not None and tp.cp > 1:
+        raise NotImplementedError(
+            "speculative verify windows do not compose with serving_cp "
+            "yet (page-sharded partial-attention merge of a multi-row "
+            "window is a ROADMAP follow-up)")
+    n_layers = cfg.num_hidden_layers
+    eps = cfg.rms_norm_eps
+    scale = 1.0 / math.sqrt(dh)
+    from ..framework.flags import flag as _flag
+
+    use_kernel = bool(_flag("prefix_prefill_kernel"))
+
+    def verify(p, kcs, vcs, ids, tables, cached_lens, new_lens):
+        from ..kernels.ragged_attention import (
+            ragged_paged_attention, ragged_paged_attention_reference)
+
+        h = p["llama.embed_tokens.weight"][ids]          # [b, w, h]
+        pos_ids = cached_lens[:, None] + jnp.arange(w)[None, :]
+        kvs = []
+        for i in range(n_layers):
+            pre = f"llama.layers.{i}."
+            x = _k_rms(h, p[pre + "input_layernorm.weight"], eps)
+            q = _mm(x, p[pre + "self_attn.q_proj.weight"]).reshape(
+                b, w, nh_l, dh)
+            k = _mm(x, p[pre + "self_attn.k_proj.weight"]).reshape(
+                b, w, nkv_l, dh)
+            v = _mm(x, p[pre + "self_attn.v_proj.weight"]).reshape(
+                b, w, nkv_l, dh)
+            q, k = apply_rotary_emb(q, k, position_ids=pos_ids,
+                                    base=cfg.rope_theta)
+            kvs.append((k, v))
+            kc_i, ksc_i = kcs[i] if isinstance(kcs[i], tuple) \
+                else (kcs[i], None)
+            vc_i, vsc_i = vcs[i] if isinstance(vcs[i], tuple) \
+                else (vcs[i], None)
+            attn_fn = ragged_paged_attention if use_kernel \
+                else ragged_paged_attention_reference
+            attn = attn_fn(q, k, v, kc_i, vc_i, tables,
+                           cached_lens, new_lens, scale=scale,
+                           k_scale=ksc_i, v_scale=vsc_i).astype(h.dtype)
+            if tp is not None:
+                attn = tp.gather_heads(attn)
+            h = h + _mm(attn.reshape(b, w, nh * dh),
+                        p[pre + "self_attn.o_proj.weight"])
+            x2 = _k_rms(h, p[pre + "post_attention_layernorm.weight"], eps)
+            gate = _mm(x2, p[pre + "mlp.gate_proj.weight"])
+            up = _mm(x2, p[pre + "mlp.up_proj.weight"])
+            h = h + _mm(jax.nn.silu(gate) * up,
+                        p[pre + "mlp.down_proj.weight"])
+        h = _k_rms(h, p["llama.norm.weight"], eps)
+        return h, kvs
+
+    return verify
+
+
 def build_quant_generate(cfg, b, sb, max_new, max_seq=None,
                          eos_token_id=None, do_sample=False, top_k=0):
     """Model-free serving program over QUANTIZED weights only: prefill AND

@@ -8,9 +8,14 @@ from .compile_cache import (  # noqa: F401
 )
 from .fleet import Fleet, FleetWorker, SubprocessWorker  # noqa: F401
 from .router import Rejected, Request, Router  # noqa: F401
+from .speculative import (  # noqa: F401
+    DraftModelDrafter, NGramDrafter, resolve_spec_k, resolve_speculative,
+)
 
 __all__ = [
     "ContinuousBatchingEngine", "ServeRequest", "cache_dir",
     "enable_compile_cache", "Fleet", "FleetWorker",
     "SubprocessWorker", "Rejected", "Request", "Router",
+    "DraftModelDrafter", "NGramDrafter", "resolve_spec_k",
+    "resolve_speculative",
 ]

@@ -102,6 +102,7 @@ import numpy as np
 from ..models.llama import (PagedKVManager, _make_chunk_prefill,
                             _make_decode_step, _make_head_logits,
                             _make_prefill, _make_prefill_with_prefix,
+                            _make_verify_window,
                             _megakernel_or_fallback_step, _sample_next,
                             hash_prefix_blocks, make_paged_kv_helpers,
                             make_paged_kv_q8_helpers, make_serving_tp,
@@ -220,6 +221,8 @@ class ContinuousBatchingEngine:
                  quantized_collectives: Optional[bool] = None,
                  disaggregated: bool = False,
                  unified_step=None, token_budget: Optional[int] = None,
+                 speculative: Optional[str] = None,
+                 spec_k: Optional[int] = None, drafter=None,
                  config=None, tracer=None, metrics=None):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
@@ -348,7 +351,8 @@ class ContinuousBatchingEngine:
                 unified_step=unified_step, serving_mp=serving_mp,
                 serving_cp=serving_cp,
                 quantized_collectives=quantized_collectives,
-                token_budget=token_budget, block_size=block_size))
+                token_budget=token_budget, block_size=block_size,
+                speculative=speculative, spec_k=spec_k))
             kv_cache_dtype = merged["kv_cache_dtype"]
             decode_megakernel = merged["decode_megakernel"]
             unified_step = merged["unified_step"]
@@ -357,6 +361,8 @@ class ContinuousBatchingEngine:
             quantized_collectives = merged["quantized_collectives"]
             token_budget = merged["token_budget"]
             block_size = merged["block_size"]
+            speculative = merged.get("speculative", speculative)
+            spec_k = merged.get("spec_k", spec_k)
         if block_size is None:
             block_size = 64
         block_size = int(block_size)
@@ -427,6 +433,40 @@ class ContinuousBatchingEngine:
 
         self.quantized_collectives = resolve_quantized_collectives(
             quantized_collectives)
+        # speculative decoding (ISSUE 19), resolved at build time like
+        # every serving flag: the policy + draft depth bake into the
+        # verify program, spec_k joins every program key, and warm()
+        # covers it — "off" builds byte-identical to a build without
+        # the flag (no verify program, no drafter, today's step loop)
+        from .speculative import (NGramDrafter, resolve_spec_k,
+                                  resolve_speculative)
+
+        self.speculative = resolve_speculative(speculative)
+        self.spec_k = resolve_spec_k(spec_k or None) \
+            if self.speculative != "off" else 0
+        self._drafter = None
+        if self.speculative != "off":
+            if do_sample:
+                raise ValueError(
+                    "speculative decoding is greedy-only: acceptance "
+                    "compares drafts against the target's argmax "
+                    "(draft-aware sampling is a ROADMAP follow-up) — "
+                    "build with do_sample=False or speculative='off'")
+            if self.cp > 1:
+                raise ValueError(
+                    "speculative decoding does not compose with "
+                    "serving_cp yet (page-sharded partial-attention "
+                    "merge of a multi-row verify window is a ROADMAP "
+                    "follow-up)")
+            if self.speculative == "draft":
+                if drafter is None:
+                    raise ValueError(
+                        "speculative='draft' needs a DraftModelDrafter "
+                        "(its config + params) via drafter=")
+                self._drafter = drafter
+            else:
+                self._drafter = drafter if drafter is not None \
+                    else NGramDrafter()
         self._tp = make_serving_tp(
             cfg, self.mp,
             quantized_collectives=self.quantized_collectives,
@@ -556,6 +596,13 @@ class ContinuousBatchingEngine:
         self._unified = jax.jit(
             self._shard_program(self._build_unified_step(), 13, 4),
             donate_argnums=(1, 2)) if self.unified else None
+        # speculative verify: one ragged window of spec_k+1 rows per
+        # slot scores every draft + the pending token in a single pass
+        # (models/llama._make_verify_window); built only when the
+        # policy is on, so "off" stays byte-identical
+        self._verify = jax.jit(
+            self._shard_program(self._build_verify_chunk(), 4, 1),
+            donate_argnums=(1, 2)) if self.spec_k else None
         # the request currently streaming prefill windows through the
         # unified step: {"req": ServeRequest, "done": tokens committed}
         self._prefilling = None
@@ -563,6 +610,9 @@ class ContinuousBatchingEngine:
         self.chunk_tokens = 0    # prompt tokens prefilled via windows
         self.device_steps = 0    # decode-chunk dispatches (for metrics)
         self.prefill_calls = 0   # batched-admission device calls
+        self.spec_steps = 0      # speculative verify dispatches
+        self.spec_drafted = 0    # draft tokens offered for verification
+        self.spec_accepted = 0   # draft tokens the target agreed with
         self.hung_retired = 0    # slots retired by the watchdog
         self.hung_requeued = 0   # hung slots requeued (requeue_hung=)
         self._requeue_hung = False  # armed per run()
@@ -600,6 +650,10 @@ class ContinuousBatchingEngine:
         # commit before the bump or fully abort after it — never
         # interleave; a zombie thread must never dispatch against
         # donated pools the live loop still owns)
+        # attach last: a draft-model drafter sizes its own tiny pools
+        # off mgr/block_size/spec_k, which must all exist by now
+        if self._drafter is not None:
+            self._drafter.attach(self)
         self._commit_lock = threading.Lock()
 
     # ---- host-side accounting -------------------------------------------
@@ -704,6 +758,10 @@ class ContinuousBatchingEngine:
         stats = {"decode": self._jit_cache_size(self._decode)}
         if self._unified is not None:
             stats["unified"] = self._jit_cache_size(self._unified)
+        if self._verify is not None:
+            stats["verify"] = self._jit_cache_size(self._verify)
+            if self._drafter is not None:
+                stats.update(self._drafter.compile_stats())
         for key, fn in self._prefill_cache.items():
             stats["prefill:" + ":".join(str(k) for k in key)] = \
                 self._jit_cache_size(fn)
@@ -732,6 +790,17 @@ class ContinuousBatchingEngine:
             "chunk_tokens": self.chunk_tokens,
             "hung_retired": self.hung_retired,
             "hung_requeued": self.hung_requeued,
+            # speculative decoding (ISSUE 19): draft/accept counters —
+            # acceptance_rate is the fraction of OFFERED draft tokens
+            # the target's greedy argmax agreed with (the +1 corrected
+            # token per window is regular decode output, not counted)
+            "speculative": self.speculative,
+            "spec_k": self.spec_k,
+            "spec_steps": self.spec_steps,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "acceptance_rate": (self.spec_accepted / self.spec_drafted
+                                if self.spec_drafted else 0.0),
             # prefix cache
             "prefix_hit_rate": self.prefix_hit_rate,
             "prefix_hit_tokens": self.prefix_hit_tokens,
@@ -1279,6 +1348,77 @@ class ContinuousBatchingEngine:
 
         return run
 
+    def _verify_scatter(self, w: int):
+        """Token-granular K/V commit for the speculative verify window:
+        column j of every slot writes at position cached_len+j into the
+        slot's own pages; pad columns (j >= new_len) and dead slots
+        redirect to the scratch page. Columns commit SEQUENTIALLY —
+        adjacent window positions share a page, and the int8 read-
+        modify-write absmax chain needs each column to see the previous
+        one's page state (w <= spec_k+1, so the unrolled loop is
+        tiny)."""
+        b, bs, W = self.slots, self.block_size, self.table_width
+        nkv, dh = self._nkv_eff, self.cfg.head_dim
+        quant = self.kv_dtype == "int8"
+        scratch = self.scratch_page
+
+        def scatter(kc, vc, k, v, tables, cached_lens, new_lens):
+            for j in range(w):
+                pos = cached_lens + j
+                col = jnp.minimum(pos // bs, W - 1)
+                page = jnp.where(j < new_lens,
+                                 tables[jnp.arange(b), col], scratch)
+                if quant:
+                    # the q8 committer owns the absmax rescale; feeding
+                    # it a width-1 table of the REDIRECTED page makes
+                    # its internal tables[arange, pos//bs] lookup clamp
+                    # onto exactly that page
+                    _, w8 = make_paged_kv_q8_helpers(
+                        b, 0, nkv, dh, bs, page[:, None])
+                    kc, vc = w8(kc, vc, k[:, j:j + 1], v[:, j:j + 1],
+                                pos)
+                else:
+                    slot = pos % bs
+                    kc = kc.at[page, :, slot, :].set(
+                        k[:, j].astype(kc.dtype))
+                    vc = vc.at[page, :, slot, :].set(
+                        v[:, j].astype(vc.dtype))
+            return kc, vc
+
+        return scatter
+
+    def _build_verify_chunk(self):
+        """The speculative verify program (ISSUE 19 tentpole): ONE
+        ragged window of spec_k+1 rows per slot — row j holds
+        [pending, d1..dk][j] at position cached_len+j — through the
+        chunk-prefill body batched over slots
+        (models/llama._make_verify_window). Row j's logits score the
+        token AFTER window token j, so the host's acceptance walk
+        (longest matching draft prefix + one corrected token) reads
+        straight off the returned argmax. Every window row's K/V
+        scatters into the slot's own pages; rejected rows' K/V past
+        the committed length is masked garbage the ragged kernels
+        never attend to, overwritten at the same positions by a later
+        commit (bf16 bitwise; int8's monotone per-page absmax makes a
+        re-write quantization noise — the PR 5 contract)."""
+        b, w = self.slots, self.spec_k + 1
+        body = _make_verify_window(self.cfg, b, w, tp=self._tp)
+        head_logits = _make_head_logits(self.cfg)
+        scatter = self._verify_scatter(w)
+
+        def run(p, kcs, vcs, ids, tables, cached_lens, new_lens):
+            h, kvs = body(p, kcs, vcs, ids, tables, cached_lens,
+                          new_lens)
+            for i, (k, v) in enumerate(kvs):
+                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v, tables,
+                                         cached_lens, new_lens)
+            logits = head_logits(h, p)  # [b, w, vocab]
+            preds = jnp.argmax(logits.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)
+            return preds, kcs, vcs
+
+        return run
+
     # ---- scheduling loop ------------------------------------------------
 
     def _get_prefill(self, sb: int, bsz: int):
@@ -1287,7 +1427,7 @@ class ContinuousBatchingEngine:
         dtype rides every key: an engine only ever builds programs at
         its own kv_cache_dtype, and the key makes that self-evident in
         compile_stats()."""
-        key = ("cold", sb, bsz, self.kv_dtype, self.cp,
+        key = ("cold", sb, bsz, self.kv_dtype, self.spec_k, self.cp,
                int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
@@ -1296,8 +1436,8 @@ class ContinuousBatchingEngine:
         return self._prefill_cache[key]
 
     def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
-        key = ("prefix", sb, bsz, w_pre, self.kv_dtype, self.cp,
-               int(self.quantized_collectives), self.mp)
+        key = ("prefix", sb, bsz, w_pre, self.kv_dtype, self.spec_k,
+               self.cp, int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._shard_program(
@@ -1472,6 +1612,18 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.temperature, jnp.float32),
             jnp.asarray(self.top_p, jnp.float32))
         _, _, _, self.kcs, self.vcs = out
+        if self._verify is not None:
+            # the speculative verify window: every slot all-scratch
+            # with new_len=1 (the pending-token row only — pad columns
+            # and the scatter both land on the scratch page)
+            vout = self._verify(
+                self.p, self.kcs, self.vcs,
+                jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
+                scratch_tables, jnp.zeros((self.slots,), jnp.int32),
+                jnp.ones((self.slots,), jnp.int32))
+            _, self.kcs, self.vcs = vout
+        if self._drafter is not None:
+            self._drafter.warm()
         np.asarray(jax.tree.leaves(self.kcs)[0])  # sync
         self.warm_compile_stats = _compile_cache.stats_since(cc_snap)
         from ..analysis.comms import resolve_audit_comms
@@ -1538,16 +1690,27 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self.temperature, jnp.float32),
                 jnp.asarray(self.top_p, jnp.float32))
 
+    def _verify_example_args(self):
+        b, W = self.slots, self.table_width
+        return (self.p, self.kcs, self.vcs,
+                jnp.zeros((b, self.spec_k + 1), jnp.int32),
+                jnp.zeros((b, W), jnp.int32),
+                jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.int32))
+
     def _program_inventory(self):
         """(name, jitted_fn, example_args) for every program this
         engine can dispatch: the decode chunk, the unified mixed
-        program (ISSUE 14, when enabled), plus every compiled prefill
+        program (ISSUE 14, when enabled), the speculative verify
+        window (ISSUE 19, when enabled), plus every compiled prefill
         variant — the enumeration the fleet audit (and any future
         whole-cache tooling) walks."""
         progs = [("decode", self._decode, self._decode_example_args())]
         if self._unified is not None:
             progs.append(("unified", self._unified,
                           self._unified_example_args()))
+        if self._verify is not None:
+            progs.append(("verify", self._verify,
+                          self._verify_example_args()))
         for key, fn in sorted(self._prefill_cache.items(),
                               key=lambda kv: str(kv[0])):
             name = "prefill:" + ":".join(str(k) for k in key)
@@ -2440,6 +2603,12 @@ class ContinuousBatchingEngine:
                 if wd is not None:
                     wd.phase = "admit"
             return n + self._dispatch_commit_unified(token)
+        if self.spec_k:
+            # pure-decode phase: speculative verify replaces the plain
+            # chunk (prefill phases above keep the unified mixed
+            # program — drafting against a half-prefilled prompt has
+            # nothing to verify against)
+            return self._drain_inflight(token) + self._step_spec(token)
         rec = self._dispatch_chunk(token, chain=pipeline)
         if pipeline:
             with self._commit_lock:
@@ -2491,6 +2660,8 @@ class ContinuousBatchingEngine:
         self._tokens[slot_id] = 0
         self._budgets[slot_id] = 0
         self._override[slot_id] = True
+        if self._drafter is not None:
+            self._drafter.release(slot_id)
 
     def _dispatch_chunk(self, token: Optional[int] = None,
                         chain: bool = False):
@@ -2617,6 +2788,161 @@ class ContinuousBatchingEngine:
                 mt.counter("output_tokens").inc(produced)
             return produced
 
+    def _step_spec(self, token: Optional[int] = None) -> int:
+        """One speculative iteration (ISSUE 19): draft up to spec_k
+        tokens per live slot (host-side n-gram lookup, or the draft
+        model on its own tiny pools), verify every draft plus the
+        slot's pending token as ONE ragged window of spec_k+1 rows
+        through the target, then commit the longest matching draft
+        prefix + the target's one corrected token. Greedy-only by
+        construction, so accepted output is EXACTLY what sequential
+        decode would have produced. A slot whose drafter comes up
+        empty rides the window at new_len=1 — one verified token, the
+        plain decode step's math. Synchronous: the committed length is
+        a host-side acceptance decision, so no device-side chain can
+        span a speculative step (the double-buffer chain is
+        invalidated at dispatch)."""
+        live = np.asarray([s.req is not None for s in self._slots])
+        if not live.any():
+            return 0
+        wd = self._watchdog
+        if wd is not None:
+            wd.phase = "decode"
+        # chaos hang seam BEFORE the device call and BEFORE the lock,
+        # exactly like _dispatch_chunk: an abandoned speculative step
+        # must unwind without ever dispatching against donated pools
+        chaos.maybe_hang("decode")
+        tr, mt = self._tracer, self._metrics
+        b, k = self.slots, self.spec_k
+        t_disp0 = time.perf_counter()
+        with self._commit_lock:
+            self._check_owner(token)
+            ids = np.zeros((b, k + 1), np.int32)
+            new_lens = np.ones((b,), np.int32)
+            lens = np.asarray([s.length for s in self._slots], np.int32)
+            drafts = [None] * b
+            reqs = [s.req for s in self._slots]
+            for slot_id, slot in enumerate(self._slots):
+                req = slot.req
+                if req is None:
+                    continue  # dead rows ride along on the scratch page
+                ids[slot_id, 0] = self._tokens[slot_id]
+                # never draft past the row budget: window position L+j
+                # writes K/V there, and the corrected token needs its
+                # own headroom too
+                want = min(k,
+                           int(self._budgets[slot_id]) - slot.length - 1,
+                           req.max_new - slot.emitted - 1)
+                d = []
+                if want > 0 and self._drafter is not None:
+                    d = list(self._drafter.draft(
+                        slot_id, req.req_id, req.prompt + req.tokens,
+                        want, table_row=self._tables[slot_id],
+                        budget=int(self._budgets[slot_id])))[:want]
+                drafts[slot_id] = d
+                ids[slot_id, 1:1 + len(d)] = d
+                new_lens[slot_id] = 1 + len(d)
+            res = self._verify(
+                self.p, self.kcs, self.vcs, jnp.asarray(ids),
+                jnp.asarray(self._tables), jnp.asarray(lens),
+                jnp.asarray(new_lens))
+            preds_dev, self.kcs, self.vcs = res
+            self.device_steps += 1
+            self.spec_steps += 1
+            # acceptance rewrites host tokens/lengths per slot — a
+            # later pipelined dispatch must not chain stale device state
+            self._chain_tok = None
+            self._chain_lens = None
+            self._override[:] = True
+            if tr is not None:
+                tr.complete("spec.verify", int(t_disp0 * 1e9),
+                            time.perf_counter_ns(),
+                            chunk=self.device_steps,
+                            live=int(live.sum()),
+                            drafted=int(sum(len(d) for d in drafts
+                                            if d)))
+            if mt is not None:
+                mt.gauge("live_slots", "slots decoding").set(
+                    int(live.sum()))
+        # the blocking readback stays OUTSIDE the lock — sync-wait
+        # telemetry identical to _commit_chunk's
+        t0 = time.perf_counter()
+        preds = np.asarray(preds_dev)
+        t1 = time.perf_counter()
+        wait = t1 - t0
+        stalled = wait > self.stall_threshold_s
+        if tr is not None:
+            tr.complete("decode.sync_wait", int(t0 * 1e9), int(t1 * 1e9),
+                        stalled=stalled)
+        if mt is not None:
+            mt.histogram("sync_wait_s",
+                         "host blocked on decode readback").observe(wait)
+            mt.histogram("decode_chunk_s",
+                         "decode-chunk dispatch to readback").observe(
+                             t1 - t_disp0)
+            if stalled:
+                mt.counter("blocked_syncs").inc()
+        if wd is not None:
+            wd.phase = "commit"
+        with self._commit_lock:
+            self._check_owner(token)  # abandoned mid-wait: discard
+            self.sync_wait_s += wait
+            if stalled:
+                self.blocked_syncs += 1
+            produced = 0
+            for slot_id, slot in enumerate(self._slots):
+                req = reqs[slot_id]
+                if req is None or slot.req is not req or req.done:
+                    continue
+                d = drafts[slot_id]
+                row = preds[slot_id]
+                n_acc = 0
+                while n_acc < len(d) and d[n_acc] == int(row[n_acc]):
+                    n_acc += 1
+                # accepted drafts + the target's corrected token; clip
+                # to the request's remaining output budget, then to EOS
+                toks = d[:n_acc] + [int(row[n_acc])]
+                toks = toks[:max(req.max_new - slot.emitted, 0)]
+                if self.eos is not None and self.eos in toks:
+                    toks = toks[:toks.index(self.eos) + 1]
+                self.spec_drafted += len(d)
+                self.spec_accepted += min(n_acc, len(toks))
+                if d and mt is not None:
+                    mt.histogram(
+                        "spec_acceptance",
+                        "accepted draft fraction per window").observe(
+                            n_acc / len(d))
+                req.tokens.extend(toks)
+                produced += len(toks)
+                slot.emitted += len(toks)
+                # the window WROTE positions L..L+new_len-1; everything
+                # before the new pending token (toks[-1]) is committed
+                # cache, the rest is garbage a later commit overwrites
+                slot.length += len(toks)
+                self._tokens[slot_id] = toks[-1] if toks else 0
+                if self._drafter is not None:
+                    self._drafter.note_commit(slot_id, slot.length)
+                if (self.eos is not None and toks
+                        and toks[-1] == self.eos) \
+                        or slot.emitted >= req.max_new:
+                    self._retire(slot_id)
+            if mt is not None:
+                mt.counter("output_tokens").inc(produced)
+            return produced
+
+    def _drain_inflight(self, token: Optional[int] = None) -> int:
+        """Commit (and clear) any pipelined chunk in flight — the
+        speculative step rewrites host lengths, so a chained chunk
+        from before it must land first."""
+        with self._commit_lock:
+            self._check_owner(token)
+            prev, self._inflight = self._inflight, None
+        if prev is None:
+            return 0
+        if self._watchdog is not None:
+            self._watchdog.phase = "commit"
+        return self._commit_chunk(prev, token)
+
     def step(self) -> int:
         """One synchronous scheduling iteration: admit -> decode chunk
         -> wait -> retire. Returns the number of live tokens produced."""
@@ -2632,6 +2958,8 @@ class ContinuousBatchingEngine:
         self._admit(token)
         if self.disaggregated:
             self._install_handoffs(token)
+        if self.spec_k:
+            return self._step_spec(token)
         rec = self._dispatch_chunk(token, chain=False)
         if rec is None:
             return 0
@@ -2654,6 +2982,10 @@ class ContinuousBatchingEngine:
         self._admit(token)
         if self.disaggregated:
             self._install_handoffs(token)
+        if self.spec_k:
+            # speculative steps are synchronous (acceptance is a host
+            # decision) — drain any chained chunk, then verify
+            return self._drain_inflight(token) + self._step_spec(token)
         rec = self._dispatch_chunk(token, chain=True)
         with self._commit_lock:
             self._check_owner(token)
@@ -2880,5 +3212,7 @@ class ContinuousBatchingEngine:
         self._tokens[slot_id] = 0
         self._budgets[slot_id] = 0
         self._override[slot_id] = True
+        if self._drafter is not None:
+            self._drafter.release(slot_id)
         self.waiting.insert(0, req)
         self._emit_hung_requeue(slot_id, req)

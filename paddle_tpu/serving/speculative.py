@@ -1,0 +1,412 @@
+"""Speculative decoding for the serving engine (ISSUE 19).
+
+Decode throughput is bounded at one token per slot per step because
+each step's input token depends on the previous step's output. A
+drafter breaks the dependency by GUESSING the next `k` tokens; the
+target model then scores all k drafts (plus the slot's pending token)
+in ONE ragged window of `new_len = k + 1` through the same
+`ragged_paged_attention` kernel the unified step runs — one pass over
+the weights verifies k+1 positions instead of one. Greedy acceptance
+keeps the longest prefix of drafts that match the target's own
+argmax, plus the target's one corrected token; rejection is pure
+bookkeeping (lengths rewind, every mask already ignores positions
+past `cached_len`, and later commits overwrite the rejected slots).
+Accepted tokens are EXACTLY what sequential greedy decode would have
+produced — speculation changes throughput, never output.
+
+Two drafters:
+
+- `NGramDrafter` (policy "ngram"): host-side prompt lookup, no draft
+  model. The last n tokens of the request's own prompt + generated
+  history are matched against an earlier occurrence in that same
+  history; the tokens that followed it are the drafts. Extractive /
+  repetitive workloads (summarisation, code edits, templated output)
+  accept heavily; a cold workload simply never matches and degrades
+  to k=0 — today's path, step for step.
+
+- `DraftModelDrafter` (policy "draft"): a small llama proposes the k
+  tokens by free-running the existing paged decode step over its OWN
+  (tiny, always-bf16) paged pools. The draft pools mirror the target
+  engine's page geometry and reuse its block tables, so draft-side
+  bookkeeping is the same page arithmetic; after each verify the
+  draft cache rewinds by the same length bookkeeping as the target
+  (`note_commit` clamps the draft watermark to the committed length —
+  every cached draft position at or below it holds a token the target
+  actually committed).
+
+Both policies resolve at engine BUILD time from FLAGS_speculative /
+PADDLE_TPU_SPECULATIVE (and FLAGS_spec_k / PADDLE_TPU_SPEC_K) like
+every serving flag: `spec_k` joins every program key, `warm()` covers
+the verify program, and "off" builds byte-identical to an engine
+without the flag.
+
+`python -m paddle_tpu.serving.speculative` is the CI smoke gate: a
+tiny model serves a repetitive trace with the ngram drafter next to a
+spec-off oracle and must exit 0 with a JSON row reporting
+`acceptance_rate` and `token_match == 1.0`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+SPECULATIVE_POLICIES = ("off", "ngram", "draft")
+
+
+def resolve_speculative(speculative: Optional[str] = None) -> str:
+    """'off' | 'ngram' | 'draft', from the argument or
+    FLAGS_speculative / PADDLE_TPU_SPECULATIVE. Read at engine-BUILD
+    time like every serving flag (the verify program and `spec_k` join
+    the program keys): flip it before constructing or warming an
+    engine."""
+    if speculative is None:
+        from ..framework.flags import flag as _flag
+
+        speculative = str(_flag("speculative"))
+    speculative = speculative.strip().lower() or "off"
+    if speculative not in SPECULATIVE_POLICIES:
+        raise ValueError(
+            f"speculative must be one of {SPECULATIVE_POLICIES}, got "
+            f"{speculative!r}")
+    return speculative
+
+
+def resolve_spec_k(spec_k: Optional[int] = None) -> int:
+    """Draft depth (tokens proposed per slot per speculative step; the
+    verify window is spec_k+1 rows), from the argument or FLAGS_spec_k
+    / PADDLE_TPU_SPEC_K. Read at engine-BUILD time alongside
+    `speculative` — it is the verify program's window width."""
+    if spec_k is None:
+        from ..framework.flags import flag as _flag
+
+        spec_k = int(_flag("spec_k"))
+    spec_k = int(spec_k)
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    return spec_k
+
+
+class Drafter:
+    """Drafter contract the engine's speculative step drives. Every
+    hook but `draft` is optional bookkeeping: `attach`/`warm` let a
+    device-backed drafter build and pre-compile its programs against
+    the engine's page geometry, `note_commit` tells it how many tokens
+    the target actually committed (its cache watermark can never
+    exceed that), `release` frees per-slot state on retire/requeue."""
+
+    def attach(self, engine):
+        pass
+
+    def warm(self):
+        pass
+
+    def draft(self, slot_id: int, req_id: int, history: list, k: int,
+              table_row=None, budget: Optional[int] = None) -> list:
+        """Up to `k` proposed continuations of `history` (the request's
+        prompt + every emitted token, pending token last). Fewer — or
+        none — is always legal: unproposed depth just verifies as a
+        narrower window."""
+        raise NotImplementedError
+
+    def note_commit(self, slot_id: int, committed_len: int):
+        pass
+
+    def release(self, slot_id: int):
+        pass
+
+    def compile_stats(self) -> dict:
+        """jit cache sizes of any device programs the drafter owns —
+        merged into the engine's zero-recompile-after-warm guard."""
+        return {}
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup drafting: propose the continuation of the most
+    recent earlier occurrence of the history's own tail n-gram.
+    Host-side and stateless — no draft model, no device work, no
+    per-slot state. Tries the widest n-gram first (`max_ngram` down to
+    `min_ngram`); a miss at every width returns no drafts (k=0 — the
+    verify window degenerates to a plain decode step)."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}..{max_ngram}")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def draft(self, slot_id, req_id, history, k, table_row=None,
+              budget=None):
+        if k <= 0 or len(history) < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, len(history) - 1),
+                       self.min_ngram - 1, -1):
+            pat = history[-n:]
+            # most recent occurrence strictly before the tail itself,
+            # so a continuation token always exists
+            for s in range(len(history) - n - 1, -1, -1):
+                if history[s:s + n] == pat:
+                    return list(history[s + n:s + n + k])
+        return []
+
+
+class DraftModelDrafter(Drafter):
+    """Draft-model drafting over the drafter's OWN paged pools. The
+    draft llama (`cfg`/`dec_params` — a model small enough that k
+    sequential steps cost less than the one target step they save)
+    free-runs the existing paged decode step k times per slot per
+    speculative step, writing its K/V into draft pools that mirror the
+    target engine's page geometry (same max_pages / block_size / block
+    tables — page ids mean the same thing on both sides, so slot
+    bookkeeping is shared arithmetic and a prefix page two slots share
+    is re-prefilled with bitwise the same draft K/V).
+
+    ONE fixed-shape program serves bind-time prompt prefill, post-
+    accept catch-up and the free-run: a scan of `m` decode steps whose
+    per-step input is the forced `feed` token while `j < n_feed` and
+    the step's own argmax after — teacher-forcing and free-running are
+    the same program at different `n_feed`. Rows past their `n_tot`
+    step count freeze their length (their writes land on a not-yet-
+    committed position and are overwritten by the next real token —
+    the decode chunk's own frozen-row contract). The draft pools stay
+    bf16 regardless of the target's kv_cache_dtype: they are tiny, and
+    int8 buys nothing at this size."""
+
+    def __init__(self, cfg, dec_params, *, dtype=None):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.p = dec_params
+        self.dtype = jnp.bfloat16 if dtype is None else dtype
+        self._eng = None
+        self._run = None
+
+    # ---- engine-geometry plumbing --------------------------------------
+
+    def attach(self, engine):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        self._eng = engine
+        b = engine.slots
+        self._b, self._bs = b, engine.block_size
+        self._max_pages = engine.mgr.max_pages
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        self.kcs = [jnp.zeros((self._max_pages, nkv, self._bs, dh),
+                              self.dtype)
+                    for _ in range(cfg.num_hidden_layers)]
+        self.vcs = [jnp.zeros((self._max_pages, nkv, self._bs, dh),
+                              self.dtype)
+                    for _ in range(cfg.num_hidden_layers)]
+        # window: k free-run steps plus at least the pending token of
+        # catch-up; wider so bind-time prompt prefill needs fewer calls
+        self.m = max(engine.spec_k + 1, 16)
+        self._run = jax.jit(self._build_run(b, self.m),
+                            donate_argnums=(1, 2))
+        self._len = np.zeros((b,), np.int64)
+        self._bound = [None] * b
+
+    def _build_run(self, b, m):
+        import jax
+        import jax.numpy as jnp
+
+        from ..kernels.decode_attention import paged_decode_attention
+        from ..models.llama import _make_decode_step, make_paged_kv_helpers
+
+        cfg = self.cfg
+        bs = self._bs
+
+        def run(p, kcs, vcs, feed, n_feed, n_tot, lens, budgets, tables,
+                live):
+            _, kv_write = make_paged_kv_helpers(
+                b, 0, cfg.num_key_value_heads, cfg.head_dim, bs, tables)
+
+            def kv_attend(q1, kc, vc, lens_):
+                return paged_decode_attention(q1, kc, vc, tables, lens_)
+
+            step = _make_decode_step(cfg, b, kv_write=kv_write,
+                                     kv_attend=kv_attend)
+
+            def body(carry, j):
+                tok, lens_, kcs_, vcs_ = carry
+                fj = jax.lax.dynamic_index_in_dim(feed, j, axis=1,
+                                                  keepdims=False)
+                inp = jnp.where(j < n_feed, fj, tok)
+                logits, kcs_, vcs_ = step(p, kcs_, vcs_, inp[:, None],
+                                          lens_)
+                nxt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                frozen = ~live | (j >= n_tot) | (lens_ >= budgets)
+                lens_ = jnp.where(frozen, lens_, lens_ + 1)
+                return (nxt, lens_, kcs_, vcs_), nxt
+
+            init = (feed[:, 0], lens, kcs, vcs)
+            (_, lens, kcs, vcs), outs = jax.lax.scan(
+                body, init, jnp.arange(m, dtype=jnp.int32))
+            return jnp.swapaxes(outs, 0, 1), lens, kcs, vcs
+
+        return run
+
+    def warm(self):
+        import jax.numpy as jnp
+
+        eng = self._eng
+        scratch = jnp.full((self._b, eng.table_width), eng.scratch_page,
+                           jnp.int32)
+        outs, _, self.kcs, self.vcs = self._run(
+            self.p, self.kcs, self.vcs,
+            jnp.zeros((self._b, self.m), jnp.int32),
+            jnp.zeros((self._b,), jnp.int32),
+            jnp.zeros((self._b,), jnp.int32),
+            jnp.zeros((self._b,), jnp.int32),
+            jnp.zeros((self._b,), jnp.int32), scratch,
+            jnp.zeros((self._b,), bool))
+        np.asarray(outs)  # sync
+
+    def compile_stats(self) -> dict:
+        try:
+            return {"draft": int(self._run._cache_size())}
+        except Exception:
+            return {"draft": -1}
+
+    # ---- drafting ------------------------------------------------------
+
+    def _dispatch(self, slot_id, feed, k, budget, tables):
+        import jax.numpy as jnp
+
+        b = self._b
+        feed_a = np.zeros((b, self.m), np.int32)
+        feed_a[slot_id, :len(feed)] = feed
+        n_feed_a = np.zeros((b,), np.int32)
+        n_feed_a[slot_id] = len(feed)
+        n_tot_a = np.zeros((b,), np.int32)
+        n_tot_a[slot_id] = len(feed) + max(k - 1, 0) if k else len(feed)
+        lens_a = np.asarray(self._len, np.int32)
+        budgets_a = np.zeros((b,), np.int32)
+        budgets_a[slot_id] = budget
+        live = np.zeros((b,), bool)
+        live[slot_id] = True
+        outs, lens_o, self.kcs, self.vcs = self._run(
+            self.p, self.kcs, self.vcs, jnp.asarray(feed_a),
+            jnp.asarray(n_feed_a), jnp.asarray(n_tot_a),
+            jnp.asarray(lens_a), jnp.asarray(budgets_a),
+            jnp.asarray(np.asarray(tables, np.int32)),
+            jnp.asarray(live))
+        self._len[slot_id] = int(np.asarray(lens_o)[slot_id])
+        return np.asarray(outs)[slot_id]
+
+    def draft(self, slot_id, req_id, history, k, table_row=None,
+              budget=None):
+        if self._run is None:
+            raise RuntimeError(
+                "DraftModelDrafter.draft before attach(engine) — pass "
+                "the drafter to ContinuousBatchingEngine(drafter=...)")
+        if k <= 0:
+            return []
+        if self._bound[slot_id] != req_id:
+            # lazy (re)bind: a new or requeued occupant starts from an
+            # empty draft cache and teacher-forces its whole prompt
+            self._bound[slot_id] = req_id
+            self._len[slot_id] = 0
+        tables = self._eng._tables
+        if budget is None:
+            budget = int(self._eng._budgets[slot_id])
+        # teacher-force whatever the draft cache is missing (whole
+        # prompt on bind, nothing but the pending token steady-state),
+        # in m-wide windows; the last window appends the k free-run
+        while True:
+            start = int(self._len[slot_id])
+            rem = history[start:]
+            if len(rem) + k - 1 <= self.m:
+                outs = self._dispatch(slot_id, rem, k, budget, tables)
+                return outs[len(rem) - 1:len(rem) - 1 + k].tolist()
+            self._dispatch(slot_id, rem[:self.m], 0, budget, tables)
+
+    def note_commit(self, slot_id, committed_len):
+        # positions above the committed length hold rejected drafts —
+        # rewind is pure bookkeeping, the tokens at or below it are
+        # exactly what the draft model fed/produced for them
+        self._len[slot_id] = min(int(self._len[slot_id]),
+                                 int(committed_len))
+
+    def release(self, slot_id):
+        self._bound[slot_id] = None
+        self._len[slot_id] = 0
+
+
+# ---------------------------------------------------------------------------
+# CI smoke gate: `python -m paddle_tpu.serving.speculative`
+# ---------------------------------------------------------------------------
+
+def _smoke_prompts(cfg, n, rng):
+    """Repetitive/extractive prompts the ngram drafter feasts on: a
+    shared template body whose phrases repeat, so generated tokens
+    keep re-entering n-gram context that exists earlier in history."""
+    phrase = rng.integers(1, cfg.vocab_size, (6,)).tolist()
+    out = []
+    for i in range(n):
+        head = rng.integers(1, cfg.vocab_size, (2 + i % 3,)).tolist()
+        out.append(head + phrase * 3)
+    return out
+
+
+def _smoke(argv=None):
+    import argparse
+    import dataclasses
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="speculative-decoding smoke: tiny model, ngram "
+                    "drafter, spec-on vs spec-off token match")
+    parser.add_argument("--requests", type=int, default=6)
+    parser.add_argument("--max-new", type=int, default=12)
+    parser.add_argument("--spec-k", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from ..models import LlamaConfig, LlamaForCausalLM
+    from .engine import ContinuousBatchingEngine
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), num_key_value_heads=2)
+    paddle.seed(11)
+    model = LlamaForCausalLM(cfg)
+    params = dict(model.raw_state())
+    rng = np.random.default_rng(7)
+    prompts = _smoke_prompts(cfg, args.requests, rng)
+
+    def serve(speculative):
+        eng = ContinuousBatchingEngine(
+            cfg, dict(params), slots=2, prompt_bucket=8,
+            max_prompt_len=64, max_new_tokens=args.max_new,
+            block_size=8, steps_per_sync=3,
+            speculative=speculative,
+            spec_k=args.spec_k if speculative != "off" else None)
+        for pr in prompts:
+            eng.add_request(pr, max_new=args.max_new)
+        eng.run(max_iters=2000)
+        toks = {r.req_id: list(r.tokens) for r in eng.finished}
+        return toks, eng.metrics()
+
+    base, _ = serve("off")
+    spec, em = serve("ngram")
+    matched = sum(1 for rid in base if spec.get(rid) == base[rid])
+    row = {
+        "bench": "speculative_smoke",
+        "requests": args.requests,
+        "spec_k": args.spec_k,
+        "spec_drafted": em["spec_drafted"],
+        "spec_accepted": em["spec_accepted"],
+        "acceptance_rate": em["acceptance_rate"],
+        "token_match": matched / max(len(base), 1),
+        "ok": (matched == len(base) and em["spec_drafted"] > 0
+               and em["spec_accepted"] > 0),
+    }
+    print(json.dumps(row))
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised in tests
+    raise SystemExit(_smoke())

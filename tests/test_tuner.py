@@ -51,6 +51,11 @@ def _demo_runs():
     # test_serving_cp) — pin both sweeps to 1 here
     space["serving_mp"] = [1]
     space["serving_cp"] = [1]
+    # same rationale for the ISSUE 19 sweep: speculative=ngram triples
+    # the candidate count (off + k=4/8) and builds a verify program
+    # per candidate; speculation has its own suite (test_speculative)
+    space["speculative"] = ["off"]
+    space["spec_k"] = [0]
     geo = tuner._engine_geometry(dict(_KW))
     budget = max(tuner.static_candidate_bound(cfg, params, c, _KW)
                  for c in tuner.enumerate_candidates(space, geo)) - 1
