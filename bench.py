@@ -309,22 +309,30 @@ def _op_bench(only=None):
             paired_slope_ms(drun, 2, 194, pairs=8), 4)
         del dp, dkcs, dvcs
 
-    if want("decode_step_1b_megakernel", "decode_step_1b_paged_ref"):
-        # the decode megakernel under the gate (ISSUE 6): one full 1B
-        # int8-weight decode step over PAGED bf16 pools with the fused
-        # per-layer megakernel (kernels/decode_megakernel.py), next to
-        # the informational `decode_step_1b_paged_ref` row — the
-        # IDENTICAL paged program through the multi-kernel path — so the
+    if want("decode_step_1b_megakernel", "decode_step_1b_paged_ref",
+            "decode_step_1b_megakernel_full", "decode_step_1b_layerscan"):
+        # the decode megakernel ladder under the gate (ISSUES 6 + 20):
+        # one full 1B int8-weight decode step over PAGED bf16 pools at
+        # each fusion rung — 'attn' (decode_step_1b_megakernel, one
+        # Pallas call per layer's attention block), 'full'
+        # (decode_step_1b_megakernel_full, the MLP half fuses in too),
+        # 'scan' (decode_step_1b_layerscan, ONE call walks every layer
+        # over stacked weights + a layer-major stacked pool) — next to
+        # the informational `decode_step_1b_paged_ref` row, the
+        # IDENTICAL paged program through the multi-kernel path, so the
         # per-phase split (kernel time vs inter-kernel dispatch + HBM
-        # round-trips) is attributable: both rows record their
+        # round-trips) is attributable. Every row records its
         # kernels_per_step (pallas_call + dot_general launches per
-        # decode step) in OPBENCH's `info`. Target (ROADMAP): the fused
-        # row at <= 0.5x the decode_step_1b_int8 best.
+        # decode step) in OPBENCH's `info`, and the two new rungs land
+        # their static-auditor twins (predicted_step_ms /
+        # predicted_peak_hbm_bytes) so the next TPU run scores the
+        # rooflines that justified the fusion. Target (ROADMAP): the
+        # fused rows at <= 0.5x the decode_step_1b_int8 best.
         from paddle_tpu.models import (LlamaConfig,
                                        init_quant_serving_params)
         from paddle_tpu.models.llama import (
             _make_decode_step, _make_decode_step_megakernel,
-            make_paged_kv_helpers)
+            make_paged_kv_helpers, stack_decode_layer_params)
         from paddle_tpu.kernels.decode_attention import (
             paged_decode_attention)
         from bench_util import paired_slope_ms
@@ -332,19 +340,27 @@ def _op_bench(only=None):
         gcfg = LlamaConfig.llama_1b(dtype="bfloat16")
         gp = init_quant_serving_params(gcfg, "weight_only_int8", seed=0)
         np.asarray(jax.tree.leaves(gp)[-1])
+        gl = gcfg.num_hidden_layers
+        gp_stacked = stack_decode_layer_params(dict(gp), gl)
         MB, MBS, MW = 4, 64, 8              # 4 rows x 8 pages (512 ctx)
         mnkv, mdh = gcfg.num_key_value_heads, gcfg.head_dim
         m_pages = MB * MW + 1
         mtables = jnp.asarray(
             np.arange(MB * MW).reshape(MB, MW) + 1, jnp.int32)
 
-        def paged_pools():
+        def paged_pools(mode=None):
+            if mode == "scan":
+                # layer-major stacked pool: layer i owns page rows
+                # [i*m_pages, (i+1)*m_pages); tables keep per-layer ids
+                return [jnp.zeros((m_pages * gl, mnkv, MBS, mdh),
+                                  jnp.bfloat16)]
             return [jnp.zeros((m_pages, mnkv, MBS, mdh), jnp.bfloat16)
-                    for _ in range(gcfg.num_hidden_layers)]
+                    for _ in range(gl)]
 
-        def make_step(use_mega):
-            if use_mega:
-                return _make_decode_step_megakernel(gcfg, MB, mtables)
+        def make_step(mode):
+            if mode is not None:
+                return _make_decode_step_megakernel(gcfg, MB, mtables,
+                                                    mode=mode)
             _, kv_write = make_paged_kv_helpers(MB, 0, mnkv, mdh, MBS,
                                                 mtables)
 
@@ -371,27 +387,50 @@ def _op_bench(only=None):
 
         mtok = jnp.ones((MB,), jnp.int32)
         mlens = jnp.full((MB,), 128, jnp.int32)
-        for name, use_mega in (("decode_step_1b_megakernel", True),
-                               ("decode_step_1b_paged_ref", False)):
+        for name, mode in (("decode_step_1b_megakernel", "attn"),
+                           ("decode_step_1b_paged_ref", None),
+                           ("decode_step_1b_megakernel_full", "full"),
+                           ("decode_step_1b_layerscan", "scan")):
             if not want(name):
                 continue
-            step = make_step(use_mega)
+            params = gp_stacked if mode == "scan" else gp
+            step = make_step(mode)
             loop = make_loop(step)
-            kcs, vcs = paged_pools(), paged_pools()
+            kcs, vcs = paged_pools(mode), paged_pools(mode)
 
-            def mrun(n, loop=loop, kcs=kcs, vcs=vcs):
-                return float(loop(gp, kcs, vcs, mtok, mlens,
+            def mrun(n, loop=loop, kcs=kcs, vcs=vcs, params=params):
+                return float(loop(params, kcs, vcs, mtok, mlens,
                                   jnp.asarray(n, jnp.int32)))
 
             mrun(2); mrun(194)  # warm (trip count traced: one compile)
             ops[name] = round(paired_slope_ms(mrun, 2, 194, pairs=8), 4)
             OP_INFO[name] = {
                 "kernels_per_step": _count_step_kernels(
-                    step, gp, paged_pools(), paged_pools(),
+                    step, params, paged_pools(mode), paged_pools(mode),
                     mtok[:, None], mlens),
                 "pages_per_seq": MW,
             }
-        del gp
+            if mode in ("full", "scan"):
+                # static-auditor twins (ISSUES 10 + 13) for the new
+                # rungs: predicted step roofline + per-chip liveness
+                # peak of the SAME step the slope times
+                from paddle_tpu.analysis.memory import audit_memory
+                from paddle_tpu.analysis.roofline import audit_roofline
+
+                roof = audit_roofline(
+                    step, params, paged_pools(mode), paged_pools(mode),
+                    mtok[:, None], mlens)
+                OP_INFO[name].update({
+                    "predicted_step_ms": round(roof.predicted_step_ms,
+                                               4),
+                    "predicted_mfu": roof.predicted_mfu,
+                    "predicted_bound": roof.bound,
+                    "predicted_peak_hbm_bytes": int(audit_memory(
+                        step, params, paged_pools(mode),
+                        paged_pools(mode), mtok[:, None],
+                        mlens).peak_bytes),
+                })
+        del gp, gp_stacked
 
     def _serving_chunk_harness(serving_mp=1, quantized_collectives=False,
                                compile_run=True):

@@ -613,11 +613,24 @@ def main():
         warm_buckets=[PROMPT_BUCKET, cold_bucket],
         warm_prefix_widths=[hit_width], prefill_batch=1,
         megakernel=True))
+    # layer-scanned megakernel (ISSUE 20): the deepest fusion rung on
+    # the same trace — ONE Pallas call walks every decoder layer over
+    # stacked weights and a layer-major stacked pool, so a decode step
+    # is the scan call + final rms + lm head regardless of depth. The
+    # summary's gain vs the attn-rung row is the inter-layer dispatch
+    # the scan removes; token_match_rate guards numerics end-to-end.
+    rows.append(run_engine(
+        cfg, p, arrivals, prompts, targets,
+        policy="continuous+prefix+kernel+layerscan", prefix_cache=True,
+        prefix_kernel=True, max_prompt_len=mpl,
+        warm_buckets=[PROMPT_BUCKET, cold_bucket],
+        warm_prefix_widths=[hit_width], prefill_batch=1,
+        megakernel="scan"))
     toks = [row.pop("_tokens", None) for row in rows]
     for row in rows:
         row["trace"] = "deep_prefix"
         print(json.dumps(row), flush=True)
-    cold, jnp_row, kern, int8kv, mega = rows
+    cold, jnp_row, kern, int8kv, mega, lscan = rows
     print(json.dumps({
         "trace": "deep_prefix", "summary": True,
         "prefix_hit_rate": kern["prefix_hit_rate"],
@@ -645,6 +658,12 @@ def main():
             mega["useful_tok_s"] / max(kern["useful_tok_s"], 1e-9), 3),
         "megakernel_token_match_rate": _token_match_rate(toks[2],
                                                          toks[4]),
+        # layer-scanned rung vs the attn rung (ISSUE 20): what
+        # collapsing per-layer launches into one scan call buys
+        "layerscan_useful_tok_s_gain_vs_attn": round(
+            lscan["useful_tok_s"] / max(mega["useful_tok_s"], 1e-9), 3),
+        "layerscan_token_match_rate": _token_match_rate(toks[2],
+                                                        toks[5]),
     }), flush=True)
 
     # mixed trace (ISSUE 14): interleaved long prefills + steady

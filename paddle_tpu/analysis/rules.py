@@ -365,8 +365,9 @@ class FusionMissRule(Rule):
                 "dispatch and HBM round-trips between tiny ops dominate",
                 where=first.path,
                 hint="fuse the step (serving decode: "
-                     "FLAGS_decode_megakernel serves the whole per-layer "
-                     "attention block as one Pallas call)")
+                     "FLAGS_decode_megakernel=attn|full|scan serves the "
+                     "per-layer attention block, the whole layer, or "
+                     "every layer as one Pallas call)")
 
 
 # ---------------------------------------------------------------------------

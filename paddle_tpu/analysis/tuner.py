@@ -174,8 +174,28 @@ def canonical_config(config: dict, geo: dict) -> dict:
     quantized acc partials even head-unsharded) and `token_budget` is
     meaningless on the split path (no unified window program is
     built), and `spec_k` is meaningless with speculation off (no
-    verify program is built — the window width collapses to 0)."""
+    verify program is built — the window width collapses to 0). The
+    `decode_megakernel` rungs collapse to what the engine would
+    actually SERVE: full/scan fuse the MLP past the per-layer o-proj
+    psum seam, so at cp>1 (and a future int4 pool) they fall back —
+    enumerating them separately would score the same fallen-back
+    program under several names."""
+    from ..models.llama import resolve_decode_megakernel
+
     out = dict(config)
+    out["decode_megakernel"] = resolve_decode_megakernel(
+        out.get("decode_megakernel", "off"))
+    if (out.get("serving_cp", 1) > 1 or out["serving_mp"] > 1) \
+            and out["decode_megakernel"] in ("full", "scan"):
+        # page-sharded attention needs the online-softmax partial
+        # merge, and tensor parallelism the o-proj psum, OUTSIDE any
+        # fused MLP half — the engine serves at most the attn rung on
+        # either axis, so deeper requests collapse to it
+        out["decode_megakernel"] = "attn"
+    if out.get("kv_cache_dtype") == "int4":
+        # no in-kernel nibble unpack yet (ROADMAP rung) — every
+        # megakernel rung refuses int4 pools
+        out["decode_megakernel"] = "off"
     if out["serving_mp"] == 1 and out.get("serving_cp", 1) == 1:
         out["quantized_collectives"] = False
     if not out["unified_step"]:
@@ -227,7 +247,7 @@ def default_space(cfg, engine_kwargs: Optional[dict] = None) -> dict:
     # draft depths; "off" collapses spec_k, so the product stays tight
     return {
         "block_size": blocks,
-        "decode_megakernel": [False, True],
+        "decode_megakernel": ["off", "attn", "full", "scan"],
         "kv_cache_dtype": ["bf16", "int8"],
         "quantized_collectives": [False, True],
         "serving_cp": cps,

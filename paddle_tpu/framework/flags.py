@@ -136,14 +136,22 @@ define_flag("kv_cache_dtype", "bf16",
             "(also: PADDLE_TPU_KV_CACHE_DTYPE)",
             env_aliases=("PADDLE_TPU_KV_CACHE_DTYPE",))
 
-define_flag("decode_megakernel", False,
-            "serve paged decode steps through the fused per-layer "
-            "megakernel (kernels/decode_megakernel.py: rms + QKV + "
+define_flag("decode_megakernel", "off",
+            "fusion rung of the paged decode step "
+            "(kernels/decode_megakernel.py), a ladder: 'off' (default) "
+            "= the multi-kernel oracle path; 'attn' = rms + QKV + "
             "rotary + paged attention + in-kernel KV commit + o-proj "
-            "in ONE Pallas call per layer); off (default) = the "
-            "multi-kernel oracle path. Read when a paged program / "
-            "engine is BUILT, so flip it before constructing (or "
-            "warming) an engine "
+            "in ONE Pallas call per layer; 'full' = 'attn' plus the "
+            "MLP half (post-attention rms + gate/up + silu*mul + down "
+            "+ residual) fused into the same per-layer call; 'scan' = "
+            "the whole decode step as ONE Pallas call whose outermost "
+            "grid axis walks every layer over stacked weights and "
+            "stacked K/V pools. Legacy booleans map onto the ladder "
+            "(False/'0' -> off, True/'1' -> attn). Unsupported shapes "
+            "fall back one rung at a time with a build-time warning. "
+            "Read when a paged program / engine is BUILT (the rung "
+            "joins every program key), so flip it before constructing "
+            "(or warming) an engine "
             "(also: PADDLE_TPU_DECODE_MEGAKERNEL)",
             env_aliases=("PADDLE_TPU_DECODE_MEGAKERNEL",))
 
@@ -232,6 +240,19 @@ define_flag("spec_k", 4,
             "verify window is spec_k+1 rows). Read at engine BUILD "
             "time alongside `speculative` (also: PADDLE_TPU_SPEC_K)",
             env_aliases=("PADDLE_TPU_SPEC_K",))
+define_flag("spec_adaptive", False,
+            "acceptance-adaptive speculative draft depth: a pure HOST "
+            "policy (serving/speculative.py AdaptiveSpecPolicy) that "
+            "shrinks the active draft window when the measured "
+            "acceptance_rate says drafts are being wasted and grows "
+            "it back when acceptance recovers. The verify program is "
+            "ragged over new_lens, so every effective k <= spec_k "
+            "rides the ONE already-warmed window program — no new "
+            "compiles ever (spec_k_effective in engine.metrics() "
+            "reports the live depth). Off (default) = fixed spec_k. "
+            "Read at engine BUILD time "
+            "(also: PADDLE_TPU_SPEC_ADAPTIVE)",
+            env_aliases=("PADDLE_TPU_SPEC_ADAPTIVE",))
 
 define_flag("compile_cache", "",
             "persistent XLA compile-cache directory for the serving "

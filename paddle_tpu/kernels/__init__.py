@@ -22,5 +22,9 @@ from . import decode_attention as _decode_attention  # noqa: F401
 from . import int4_matmul as _int4_matmul  # noqa: F401
 from .prefix_prefill import prefix_prefill_attention  # noqa: F401
 from .ragged_attention import ragged_paged_attention  # noqa: F401
-from .decode_megakernel import decode_layer_megakernel  # noqa: F401
+from .decode_megakernel import (  # noqa: F401
+    decode_layer_megakernel, decode_layer_megakernel_full,
+    decode_layers_megakernel, megakernel_full_supported,
+    megakernel_scan_supported, megakernel_supported,
+)
 from . import swiglu as _swiglu  # noqa: F401

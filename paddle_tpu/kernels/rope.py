@@ -47,7 +47,9 @@ def rope_freqs(seq_len: int, head_dim: int, base: float = 10000.0,
                           / head_dim))
     pos = (jnp.arange(seq_len, dtype=jnp.float32)
            if position_ids is None else position_ids.astype(jnp.float32))
-    freqs = jnp.einsum("...s,d->...sd", pos, inv)
+    # broadcast multiply, NOT einsum: the outer product would lower to
+    # a dot_general and ride the decode step's kernels_per_step count
+    freqs = pos[..., None] * inv
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 

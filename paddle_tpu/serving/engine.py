@@ -99,17 +99,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.llama import (PagedKVManager, _make_chunk_prefill,
-                            _make_decode_step, _make_head_logits,
-                            _make_prefill, _make_prefill_with_prefix,
-                            _make_verify_window,
+from ..models.llama import (STACKED_LAYER_NAMES, STACKED_PREFIX,
+                            PagedKVManager, _make_chunk_prefill,
+                            _make_decode_step, _make_decode_step_megakernel,
+                            _make_head_logits, _make_prefill,
+                            _make_prefill_with_prefix, _make_verify_window,
                             _megakernel_or_fallback_step, _sample_next,
                             hash_prefix_blocks, make_paged_kv_helpers,
                             make_paged_kv_q8_helpers, make_serving_tp,
+                            plan_megakernel_rung,
                             resolve_decode_megakernel,
                             resolve_kv_cache_dtype, resolve_serving_cp,
                             resolve_serving_mp, resolve_unified_step,
-                            serving_param_specs, shard_serving_params)
+                            serving_param_specs, shard_serving_params,
+                            stack_decode_layer_params)
 from ..observability import metrics as obs_metrics
 from ..observability import trace as obs_trace
 from ..resilience import chaos
@@ -215,7 +218,7 @@ class ContinuousBatchingEngine:
                  prefix_cache: bool = True, double_buffer: bool = False,
                  kv_cache_dtype: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
-                 decode_megakernel: Optional[bool] = None,
+                 decode_megakernel=None,
                  serving_mp: Optional[int] = None,
                  serving_cp: Optional[int] = None,
                  quantized_collectives: Optional[bool] = None,
@@ -223,6 +226,7 @@ class ContinuousBatchingEngine:
                  unified_step=None, token_budget: Optional[int] = None,
                  speculative: Optional[str] = None,
                  spec_k: Optional[int] = None, drafter=None,
+                 spec_adaptive: Optional[bool] = None,
                  config=None, tracer=None, metrics=None):
         """`kv_cache_dtype` ('bf16' | 'int8'; default from
         FLAGS_kv_cache_dtype / PADDLE_TPU_KV_CACHE_DTYPE) picks the
@@ -363,6 +367,7 @@ class ContinuousBatchingEngine:
             block_size = merged["block_size"]
             speculative = merged.get("speculative", speculative)
             spec_k = merged.get("spec_k", spec_k)
+            spec_adaptive = merged.get("spec_adaptive", spec_adaptive)
         if block_size is None:
             block_size = 64
         block_size = int(block_size)
@@ -438,12 +443,20 @@ class ContinuousBatchingEngine:
         # verify program, spec_k joins every program key, and warm()
         # covers it — "off" builds byte-identical to a build without
         # the flag (no verify program, no drafter, today's step loop)
-        from .speculative import (NGramDrafter, resolve_spec_k,
+        from .speculative import (AdaptiveSpecPolicy, NGramDrafter,
+                                  resolve_spec_adaptive, resolve_spec_k,
                                   resolve_speculative)
 
         self.speculative = resolve_speculative(speculative)
         self.spec_k = resolve_spec_k(spec_k or None) \
             if self.speculative != "off" else 0
+        # acceptance-adaptive draft depth (pure host policy: the verify
+        # window stays spec_k+1 rows, only the per-step `want` cap
+        # moves — no program key change, no new compiles)
+        self.spec_adaptive = resolve_spec_adaptive(spec_adaptive) \
+            if self.spec_k else False
+        self._spec_policy = AdaptiveSpecPolicy(self.spec_k) \
+            if self.spec_adaptive else None
         self._drafter = None
         if self.speculative != "off":
             if do_sample:
@@ -541,17 +554,32 @@ class ContinuousBatchingEngine:
                                    kv_cache_dtype=self.kv_dtype,
                                    mp=self.kv_shards, cp=self.cp)
         self.scratch_page = self.mgr.alloc_pages(1)[0]  # retired rows' sink
+        # megakernel rung plan (ISSUE 20): walk the requested fusion
+        # ladder ONCE here at build over spec views of this engine's
+        # exact decode operands, warning once per refused rung — every
+        # later program trace serves the planned rung silently. The
+        # scan rung re-lays the engine out: per-layer weights stack
+        # along a leading layer axis and the n_layers pools collapse to
+        # ONE layer-major pool (layer i owns rows [i*max_pages,
+        # (i+1)*max_pages); tables keep per-layer page ids and the
+        # programs add the layer offset).
+        self.megakernel_rung = self._plan_megakernel(max_pages)
+        scan = self.megakernel_rung == "scan"
+        self._page_stride = max_pages if scan else 0
+        pool_rows = max_pages * cfg.num_hidden_layers if scan \
+            else max_pages
+        n_pools = 1 if scan else cfg.num_hidden_layers
         if self.kv_dtype == "int8":
             # (int8 pool, per-(page, kv head) f32 absmax scale) pairs —
             # every program threads the pair, so donation keeps scales
             # in place exactly like the pools
             def _pool():
-                return (jnp.zeros((max_pages, nkv, block_size, dh),
+                return (jnp.zeros((pool_rows, nkv, block_size, dh),
                                   jnp.int8),
-                        jnp.zeros((max_pages, nkv), jnp.float32))
+                        jnp.zeros((pool_rows, nkv), jnp.float32))
         else:
             def _pool():
-                return jnp.zeros((max_pages, nkv, block_size, dh), dtype)
+                return jnp.zeros((pool_rows, nkv, block_size, dh), dtype)
         if self._tp is not None:
             # pools are BORN on the serving mesh (kv-head sharded, or
             # replicated under the MQA fallback): max_pages was sized
@@ -570,8 +598,15 @@ class ContinuousBatchingEngine:
                 if self.kv_dtype == "int8" \
                 else NamedSharding(self.mp_mesh, sp)
             _pool = jax.jit(_pool, out_shardings=out)
-        self.kcs = [_pool() for _ in range(cfg.num_hidden_layers)]
-        self.vcs = [_pool() for _ in range(cfg.num_hidden_layers)]
+        self.kcs = [_pool() for _ in range(n_pools)]
+        self.vcs = [_pool() for _ in range(n_pools)]
+        if scan:
+            # build-time re-layout behind the flag: the scan kernel
+            # streams per-layer weights from ONE stacked tensor per
+            # projection (leading layer axis) — `_lw` serves every
+            # other program the same slices, so tokens stay identical
+            self.p = stack_decode_layer_params(
+                self.p, cfg.num_hidden_layers)
         if self._tp is not None:
             # params per `serving_param_specs` (q/k/v columns sharded,
             # the rest — o-proj included — replicated). Logical shapes
@@ -669,6 +704,66 @@ class ContinuousBatchingEngine:
         # constructed yet when __init__ sizes the pool from this)
         return -(-(sb + max_new) // self.block_size)
 
+    def _plan_megakernel(self, max_pages: int) -> str:
+        """Resolve the SERVED megakernel rung once per engine BUILD
+        (ISSUE 20): walk the requested fusion ladder
+        (`plan_megakernel_rung`) over ShapeDtypeStruct views of this
+        engine's exact decode operands — slots-wide hidden batch, the
+        full-width block tables, the would-be stacked weights and
+        layer-major pool — and warn ONCE naming each refused rung and
+        its reason. The per-program traces then serve the plan
+        silently (`warn=False` at the fallback seam), so an engine
+        build emits each downgrade exactly once instead of once per
+        compiled program."""
+        if self.use_megakernel == "off":
+            return "off"
+        import warnings
+
+        cfg = self.cfg
+        L = cfg.num_hidden_layers
+        nkv, dh = self._nkv_eff, cfg.head_dim
+        sds = jax.ShapeDtypeStruct
+
+        def _spec(w):
+            if isinstance(w, tuple):
+                return tuple(sds(a.shape, a.dtype) for a in w)
+            return sds(w.shape, w.dtype)
+
+        pview = {name: _spec(w) for name, w in self.p.items()}
+        for name in STACKED_LAYER_NAMES:
+            # the stacked layout does not exist yet (it is built only
+            # if this plan lands on scan) — synthesize its specs from
+            # layer 0 so the scan rung's check sees what WOULD exist
+            w0 = self.p[f"llama.layers.0.{name}"]
+            if isinstance(w0, tuple):
+                pview[STACKED_PREFIX + name] = tuple(
+                    sds((L,) + a.shape, a.dtype) for a in w0)
+            else:
+                pview[STACKED_PREFIX + name] = sds((L,) + w0.shape,
+                                                   w0.dtype)
+        rows = max_pages * L  # layer-major stacked rows; the attn and
+        # full rung checks never read the row count
+        bs = self.block_size
+        if self.kv_dtype == "int8":
+            pool = (sds((rows, nkv, bs, dh), jnp.int8),
+                    sds((rows, nkv), jnp.float32))
+        else:
+            pool = sds((rows, nkv, bs, dh),
+                       self.p["llama.embed_tokens.weight"].dtype)
+        tables = sds((self.slots, self.table_width), jnp.int32)
+        rung, refusals = plan_megakernel_rung(
+            self.use_megakernel, cfg, self.slots, pview, [pool],
+            [pool], tables, tp=self._tp, localize_tp=True)
+        if refusals:
+            down = "the multi-kernel path" if rung == "off" \
+                else f"the '{rung}' rung"
+            for refused, reason in refusals:
+                warnings.warn(
+                    f"decode_megakernel rung '{refused}' unsupported "
+                    f"on this engine build ({reason}); serving {down}",
+                    stacklevel=3)
+        return rung
+
     # ---- tensor-parallel plumbing (FLAGS_serving_mp) --------------------
 
     @property
@@ -718,7 +813,7 @@ class ContinuousBatchingEngine:
 
         from ..parallel.shard_map_compat import shard_map
 
-        pools = [self._pool_entry_spec()] * self.cfg.num_hidden_layers
+        pools = [self._pool_entry_spec()] * len(self.kcs)
         in_specs = (self._param_specs, pools, pools) + (P(),) * n_repl
         out_specs = (P(),) * n_out_repl + (pools, pools)
         return shard_map(fn, mesh=self.mp_mesh, in_specs=in_specs,
@@ -796,6 +891,10 @@ class ContinuousBatchingEngine:
             # token per window is regular decode output, not counted)
             "speculative": self.speculative,
             "spec_k": self.spec_k,
+            "spec_adaptive": self.spec_adaptive,
+            "spec_k_effective": (
+                self._spec_policy.spec_k_effective
+                if self._spec_policy is not None else self.spec_k),
             "spec_steps": self.spec_steps,
             "spec_drafted": self.spec_drafted,
             "spec_accepted": self.spec_accepted,
@@ -810,6 +909,11 @@ class ContinuousBatchingEngine:
             # sync-wait telemetry (what double buffering hides)
             "sync_wait_s": self.sync_wait_s,
             "blocked_syncs": self.blocked_syncs,
+            # decode megakernel (ISSUE 20): the REQUESTED fusion rung
+            # and the rung the build plan actually serves (the plan
+            # steps down one rung per unsupported-shape refusal)
+            "decode_megakernel": self.use_megakernel,
+            "megakernel_rung": self.megakernel_rung,
             # quantized collectives (ISSUE 15): int8 wire on the mp
             # o-proj gather / megakernel psum when True
             "quantized_collectives": self.quantized_collectives,
@@ -1039,11 +1143,16 @@ class ContinuousBatchingEngine:
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
         scatter = self._page_scatter(bsz, n_pre)
+        stride = self._page_stride
 
         def run(p, kcs, vcs, ids, s0_vec, pages, key, temperature, top_p):
             h, kvs = base(p, ids)
             for i, (k, v) in enumerate(kvs):
-                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v, pages)
+                # stacked pool (scan rung): layer i's writes land in
+                # pool 0 at its page ids + i*max_pages
+                j = 0 if stride else i
+                pg = pages + i * stride if stride else pages
+                kcs[j], vcs[j] = scatter(kcs[j], vcs[j], k, v, pg)
             h_last = h[jnp.arange(bsz), s0_vec - 1][:, None, :]
             logits = head_logits(h_last, p)[:, -1]
             first = _sample_next(logits.astype(jnp.float32), key,
@@ -1132,12 +1241,17 @@ class ContinuousBatchingEngine:
         head_logits = _make_head_logits(cfg)
         do_sample, top_k = self.do_sample, self.top_k
         scatter = self._page_scatter(bsz, n_pre)
+        stride = self._page_stride
 
         def run(p, kcs, vcs, ids, s0_vec, pages, ptables, plens, key,
                 temperature, top_p):
             h, kvs = base(p, kcs, vcs, ids, ptables, plens, s0_vec)
             for i, (k, v) in enumerate(kvs):
-                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v, pages)
+                # stacked pool (scan rung): layer i's writes land in
+                # pool 0 at its page ids + i*max_pages
+                j = 0 if stride else i
+                pg = pages + i * stride if stride else pages
+                kcs[j], vcs[j] = scatter(kcs[j], vcs[j], k, v, pg)
             h_last = h[jnp.arange(bsz), s0_vec - 1][:, None, :]
             logits = head_logits(h_last, p)[:, -1]
             first = _sample_next(logits.astype(jnp.float32), key,
@@ -1154,7 +1268,10 @@ class ContinuousBatchingEngine:
 
         cfg, b, bs = self.cfg, self.slots, self.block_size
         quant = self.kv_dtype == "int8"
-        use_mega = self.use_megakernel
+        # the rung PLANNED at engine build (_plan_megakernel) — the
+        # build already warned for every refused rung, so the traces
+        # below stay silent (warn=False at the fallback seam)
+        use_mega = self.megakernel_rung
         nkv_eff = self._nkv_eff
         tp = self._tp
         cp_parts = tp is not None and tp.cp > 1
@@ -1244,12 +1361,20 @@ class ContinuousBatchingEngine:
                         return paged_decode_attention(q1, kc, vc,
                                                       tables, lens_)
 
+            if use_mega == "scan":
+                # the stacked-pool layout has no multi-kernel twin —
+                # the plan guaranteed support, so build the scanned
+                # step directly (one Pallas call walks every layer)
+                return _make_decode_step_megakernel(cfg, b, tables,
+                                                    tp=tp, mode="scan")
             base = _make_decode_step(cfg, b, kv_write=kv_write,
                                      kv_attend=kv_attend, tp=tp)
-            if not use_mega:
+            if use_mega == "off":
                 return base
             return _megakernel_or_fallback_step(cfg, b, tables, p, kcs,
-                                                vcs, base, tp=tp)
+                                                vcs, base, tp=tp,
+                                                mode=use_mega,
+                                                warn=False)
 
         return make_step
 
@@ -1320,6 +1445,7 @@ class ContinuousBatchingEngine:
         chunk_body = _make_chunk_prefill(cfg, tn, tp=self._tp)
         head_logits = _make_head_logits(cfg)
         scatter = self._page_scatter(1, n_win)
+        stride = self._page_stride
 
         def run(p, kcs, vcs, toks, lens, budgets, tables, live,
                 chunk_ids, chunk_table, chunk_cached, chunk_len,
@@ -1333,8 +1459,9 @@ class ContinuousBatchingEngine:
             h, kvs = chunk_body(p, kcs, vcs, chunk_ids, chunk_table,
                                 chunk_cached, chunk_len)
             for i, (k, v) in enumerate(kvs):
-                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v,
-                                         chunk_pages)
+                j = 0 if stride else i
+                pg = chunk_pages + i * stride if stride else chunk_pages
+                kcs[j], vcs[j] = scatter(kcs[j], vcs[j], k, v, pg)
             # first-token logits at the chunk's true last position —
             # meaningful only when this window completes the prompt
             # (the host ignores it otherwise)
@@ -1405,12 +1532,19 @@ class ContinuousBatchingEngine:
         body = _make_verify_window(self.cfg, b, w, tp=self._tp)
         head_logits = _make_head_logits(self.cfg)
         scatter = self._verify_scatter(w)
+        stride = self._page_stride
 
         def run(p, kcs, vcs, ids, tables, cached_lens, new_lens):
             h, kvs = body(p, kcs, vcs, ids, tables, cached_lens,
                           new_lens)
             for i, (k, v) in enumerate(kvs):
-                kcs[i], vcs[i] = scatter(kcs[i], vcs[i], k, v, tables,
+                # stacked pool (scan rung): offset the TABLE per layer
+                # — the scatter's scratch redirect stays at the layer-0
+                # scratch row, a shared don't-care sink no program
+                # attends to
+                j = 0 if stride else i
+                tbl = tables + i * stride if stride else tables
+                kcs[j], vcs[j] = scatter(kcs[j], vcs[j], k, v, tbl,
                                          cached_lens, new_lens)
             logits = head_logits(h, p)  # [b, w, vocab]
             preds = jnp.argmax(logits.astype(jnp.float32),
@@ -1427,8 +1561,9 @@ class ContinuousBatchingEngine:
         dtype rides every key: an engine only ever builds programs at
         its own kv_cache_dtype, and the key makes that self-evident in
         compile_stats()."""
-        key = ("cold", sb, bsz, self.kv_dtype, self.spec_k, self.cp,
-               int(self.quantized_collectives), self.mp)
+        key = ("cold", sb, bsz, self.kv_dtype, self.use_megakernel,
+               self.spec_k, self.cp, int(self.quantized_collectives),
+               self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._shard_program(self._build_prefill(sb, bsz), 6, 1),
@@ -1436,8 +1571,9 @@ class ContinuousBatchingEngine:
         return self._prefill_cache[key]
 
     def _get_prefix_prefill(self, sb: int, bsz: int, w_pre: int):
-        key = ("prefix", sb, bsz, w_pre, self.kv_dtype, self.spec_k,
-               self.cp, int(self.quantized_collectives), self.mp)
+        key = ("prefix", sb, bsz, w_pre, self.kv_dtype,
+               self.use_megakernel, self.spec_k, self.cp,
+               int(self.quantized_collectives), self.mp)
         if key not in self._prefill_cache:
             self._prefill_cache[key] = jax.jit(
                 self._shard_program(
@@ -2814,6 +2950,11 @@ class ContinuousBatchingEngine:
         chaos.maybe_hang("decode")
         tr, mt = self._tracer, self._metrics
         b, k = self.slots, self.spec_k
+        # adaptive depth caps how much we ASK the drafter for — the
+        # verify window stays k+1 rows, unproposed depth just verifies
+        # as a narrower ragged window (same program, no recompile)
+        k_eff = self._spec_policy.spec_k_effective \
+            if self._spec_policy is not None else k
         t_disp0 = time.perf_counter()
         with self._commit_lock:
             self._check_owner(token)
@@ -2830,7 +2971,7 @@ class ContinuousBatchingEngine:
                 # never draft past the row budget: window position L+j
                 # writes K/V there, and the corrected token needs its
                 # own headroom too
-                want = min(k,
+                want = min(k_eff,
                            int(self._budgets[slot_id]) - slot.length - 1,
                            req.max_new - slot.emitted - 1)
                 d = []
@@ -2907,6 +3048,8 @@ class ContinuousBatchingEngine:
                     toks = toks[:toks.index(self.eos) + 1]
                 self.spec_drafted += len(d)
                 self.spec_accepted += min(n_acc, len(toks))
+                if d and self._spec_policy is not None:
+                    self._spec_policy.observe(len(d), n_acc)
                 if d and mt is not None:
                     mt.histogram(
                         "spec_acceptance",

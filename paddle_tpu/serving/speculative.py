@@ -87,6 +87,83 @@ def resolve_spec_k(spec_k: Optional[int] = None) -> int:
     return spec_k
 
 
+def resolve_spec_adaptive(spec_adaptive: Optional[bool] = None) -> bool:
+    """Whether the engine adapts its effective draft depth to the
+    observed acceptance rate (`AdaptiveSpecPolicy`), from the argument
+    or FLAGS_spec_adaptive / PADDLE_TPU_SPEC_ADAPTIVE. A pure host-side
+    policy: the verify program keeps its spec_k+1 window, only the
+    number of tokens the drafter is *asked* for changes — so flipping
+    it never adds a compile."""
+    if spec_adaptive is None:
+        from ..framework.flags import flag as _flag
+
+        spec_adaptive = _flag("spec_adaptive")
+    if isinstance(spec_adaptive, str):
+        spec_adaptive = spec_adaptive.strip().lower() in (
+            "1", "true", "yes", "on")
+    return bool(spec_adaptive)
+
+
+class AdaptiveSpecPolicy:
+    """Acceptance-adaptive draft depth (host-side, zero new compiles).
+
+    Tracks an EWMA of the per-window acceptance fraction
+    (accepted / offered). When drafts stop landing the policy walks the
+    effective depth down one token at a time (floor 1: speculation
+    degrades to draft-one-verify-one, never below), so dead drafting
+    stops paying the drafter + wide-window tax; after `patience`
+    consecutive high-acceptance windows at the shrunken depth it grows
+    back one token at a time toward the built `spec_k` ceiling.
+
+    Only the `want` cap in the engine's speculative step reads
+    `spec_k_effective` — the verify window stays spec_k+1 rows and the
+    drafter contract already allows fewer-than-k proposals, so the
+    policy rides entirely on the existing ragged-window path."""
+
+    def __init__(self, spec_k: int, *, alpha: float = 0.2,
+                 shrink_below: float = 0.4, grow_above: float = 0.8,
+                 patience: int = 3):
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.spec_k = int(spec_k)
+        self.alpha = float(alpha)
+        self.shrink_below = float(shrink_below)
+        self.grow_above = float(grow_above)
+        self.patience = int(patience)
+        self._k_eff = int(spec_k)
+        self._ewma: Optional[float] = None
+        self._high_streak = 0
+
+    @property
+    def spec_k_effective(self) -> int:
+        return self._k_eff
+
+    @property
+    def acceptance_ewma(self) -> Optional[float]:
+        return self._ewma
+
+    def observe(self, offered: int, accepted: int) -> None:
+        """Feed one slot-window outcome: `offered` draft tokens went
+        into the verify window, `accepted` matched the target."""
+        if offered <= 0:
+            return
+        frac = min(max(accepted / offered, 0.0), 1.0)
+        self._ewma = frac if self._ewma is None else (
+            self.alpha * frac + (1.0 - self.alpha) * self._ewma)
+        if self._ewma < self.shrink_below:
+            self._high_streak = 0
+            if self._k_eff > 1:
+                self._k_eff -= 1
+        elif self._ewma > self.grow_above:
+            self._high_streak += 1
+            if (self._high_streak >= self.patience
+                    and self._k_eff < self.spec_k):
+                self._k_eff += 1
+                self._high_streak = 0
+        else:
+            self._high_streak = 0
+
+
 class Drafter:
     """Drafter contract the engine's speculative step drives. Every
     hook but `draft` is optional bookkeeping: `attach`/`warm` let a

@@ -296,8 +296,14 @@ class TestFusionMiss:
             _make_decode_step, _make_decode_step_megakernel,
             make_paged_kv_helpers)
 
+        # intermediate != vocab so the gate/up dot shape stays DISTINCT
+        # from the lm-head dot: TPU105 counts by (primitive, shapes),
+        # and since rope builds its tables with a broadcast multiply
+        # (no dot_general) the tiny() default would land exactly on the
+        # 6-launch budget instead of over it
         cfg = dataclasses.replace(LlamaConfig.tiny(),
-                                  num_key_value_heads=2)
+                                  num_key_value_heads=2,
+                                  intermediate_size=96)
         paddle.seed(3)
         params = dict(LlamaForCausalLM(cfg).raw_state())
         b, bs, W = 2, 8, 2

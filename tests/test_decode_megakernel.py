@@ -1,10 +1,20 @@
-"""Decode megakernel (ISSUE 6): interpret-mode parity of the fused
-per-layer serving decode step against the multi-kernel oracle it
-replaces, the in-kernel paged-KV commit epilogue's exactness (bf16
-byte-identical, int8 identical to the q8 helpers' monotone-scale
+"""Decode megakernel (ISSUE 6 + ISSUE 20): interpret-mode parity of
+the fused per-layer serving decode step against the multi-kernel
+oracle it replaces, the in-kernel paged-KV commit epilogue's exactness
+(bf16 byte-identical, int8 identical to the q8 helpers' monotone-scale
 read-modify-write), engine token identity megakernel-on-vs-off through
 recycling churn, the zero-recompile-after-warm guard under the new
-flag, and the unsupported-shape fallback."""
+flag, and the unsupported-shape fallback.
+
+ISSUE 20 deepens the ladder: the 'full' rung (attention + MLP half in
+one call per layer) matches the oracle, the 'scan' rung (every layer
+in ONE layer-walked call over stacked weights and a stacked pool) is
+BITWISE the per-layer full chain, both serve token-identical engines
+with the scanned int8 pool committing byte-identically per layer, the
+scan decode step traces to <= 3 kernel launches regardless of depth,
+and the in-kernel o-proj quantize epilogue emits exactly the
+quantize_blocks wire so quantized_psum_prequant is bit-identical to
+the f32-partial quantized_psum."""
 import dataclasses
 import unittest
 
@@ -18,6 +28,7 @@ import paddle_tpu as paddle
 from paddle_tpu.kernels.decode_attention import paged_decode_attention
 from paddle_tpu.kernels.decode_megakernel import (
     CONSTRAINT, PAGES_PER_STEP, decode_layer_megakernel,
+    decode_layer_megakernel_full, decode_layers_megakernel,
     megakernel_supported)
 from paddle_tpu.kernels.rms_norm import rms_norm
 from paddle_tpu.kernels.rope import apply_rotary_emb
@@ -248,36 +259,46 @@ class TestSupportGate(unittest.TestCase):
                             for w in caught))
 
 
+def _engine_run(megakernel, kv_dtype):
+    """Build + warm + churn one tiny engine; returns (tokens, engine,
+    warm-time compile stats) so rung tests can inspect pools/plan."""
+    cfg = dataclasses.replace(LlamaConfig.tiny(),
+                              num_key_value_heads=2)
+    paddle.seed(21)
+    model = LlamaForCausalLM(cfg)
+    params = dict(model.raw_state())
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, cfg.vocab_size, (8,)).tolist()
+    prompts = ([shared + rng.integers(1, cfg.vocab_size,
+                                      (n,)).tolist()
+                for n in (3, 5)]
+               + [rng.integers(1, cfg.vocab_size, (n,)).tolist()
+                  for n in (2, 9, 14, 4, 11)])
+    eng = ContinuousBatchingEngine(
+        cfg, params, slots=2, prompt_bucket=8, max_prompt_len=16,
+        max_new_tokens=6, block_size=8, steps_per_sync=3,
+        prefill_batch=1, prefix_cache=True, kv_cache_dtype=kv_dtype,
+        decode_megakernel=megakernel)
+    eng.warm(buckets=[8, 16])
+    before = eng.compile_stats()
+    for i, pr in enumerate(prompts):
+        eng.add_request(pr, max_new=2 + i % 4)
+    eng.run(max_iters=300)
+    assert len(eng.finished) == len(prompts)
+    return ({r.req_id: list(r.tokens) for r in eng.finished}, eng,
+            before)
+
+
 class TestGenerateAndEngine(unittest.TestCase):
     def _engine_tokens(self, megakernel, kv_dtype):
-        cfg = dataclasses.replace(LlamaConfig.tiny(),
-                                  num_key_value_heads=2)
-        paddle.seed(21)
-        model = LlamaForCausalLM(cfg)
-        params = dict(model.raw_state())
-        rng = np.random.default_rng(7)
-        shared = rng.integers(1, cfg.vocab_size, (8,)).tolist()
-        prompts = ([shared + rng.integers(1, cfg.vocab_size,
-                                          (n,)).tolist()
-                    for n in (3, 5)]
-                   + [rng.integers(1, cfg.vocab_size, (n,)).tolist()
-                      for n in (2, 9, 14, 4, 11)])
-        eng = ContinuousBatchingEngine(
-            cfg, params, slots=2, prompt_bucket=8, max_prompt_len=16,
-            max_new_tokens=6, block_size=8, steps_per_sync=3,
-            prefill_batch=1, prefix_cache=True, kv_cache_dtype=kv_dtype,
-            decode_megakernel=megakernel)
-        self.assertEqual(eng.use_megakernel, megakernel)
-        eng.warm(buckets=[8, 16])
-        before = eng.compile_stats()
+        toks, eng, before = _engine_run(megakernel, kv_dtype)
+        from paddle_tpu.models.llama import resolve_decode_megakernel
+        self.assertEqual(eng.use_megakernel,
+                         resolve_decode_megakernel(megakernel))
         self.assertNotIn(-1, before.values())
-        for i, pr in enumerate(prompts):
-            eng.add_request(pr, max_new=2 + i % 4)
-        eng.run(max_iters=300)
-        self.assertEqual(len(eng.finished), len(prompts))
         # zero-recompile-after-warm guard, extended to the new flag
         self.assertEqual(eng.compile_stats(), before)
-        return {r.req_id: list(r.tokens) for r in eng.finished}
+        return toks
 
     def test_engine_token_identity_bf16_through_churn(self):
         """Megakernel-on tokens == megakernel-off tokens through prefix
@@ -292,6 +313,23 @@ class TestGenerateAndEngine(unittest.TestCase):
     def test_engine_token_identity_int8_through_churn(self):
         self.assertEqual(self._engine_tokens(False, "int8"),
                          self._engine_tokens(True, "int8"))
+
+    def test_engine_token_identity_scan_bf16(self):
+        """ISSUE 20 acceptance (tier-1): the deepest rung — 'scan',
+        one layer-walked call over the stacked pool — serves
+        token-identical to the multi-kernel oracle through the same
+        churn, with zero compiles after warm and the served rung
+        reported in metrics."""
+        self.assertEqual(self._engine_tokens("off", "bf16"),
+                         self._engine_tokens("scan", "bf16"))
+
+    @pytest.mark.slow  # tier-1 budget: scan above covers the ladder's
+    # deep end, and scan == per-layer-full bitwise is tier-1 at the
+    # kernel level (TestFullAndScanKernels); this leg only re-serves
+    # the middle rung through the same engine wiring
+    def test_engine_token_identity_full_bf16(self):
+        self.assertEqual(self._engine_tokens("off", "bf16"),
+                         self._engine_tokens("full", "bf16"))
 
     def test_jit_generate_paged_identity_and_flag_in_key(self):
         paddle.seed(7)
@@ -399,6 +437,284 @@ class TestConstraintAndBenchHelpers(unittest.TestCase):
                          bench.INFORMATIONAL_OPS)
         self.assertIn("decode_step_1b_paged_ref",
                       bench.INFORMATIONAL_OPS)
+
+
+class TestFullAndScanKernels(unittest.TestCase):
+    """ISSUE 20 tentpole, kernel level: the FULL rung matches the attn
+    oracle + jnp MLP half; the scan rung is BITWISE the per-layer full
+    chain (same math in the same order — only the launch count and the
+    stacked-operand layout change)."""
+
+    def _full_case(self, dtype, quant_w=False, seed=0):
+        h, lens, tables, w_in, wq, wk, wv, wo, kc, vc = _case(
+            dtype, 4, 2, 16, 32, quant_w=quant_w, seed=seed)
+        rng = np.random.default_rng(seed + 100)
+        H, F = 32, 64
+        w_post = jnp.asarray(rng.normal(size=(H,)) * 0.1 + 1.0, dtype)
+        ms = [rng.normal(size=s) * 0.05
+              for s in ((H, F), (H, F), (F, H))]
+        if quant_w:
+            wg, wu, wd = (_quantize_w(w) for w in ms)
+        else:
+            wg, wu, wd = (jnp.asarray(w, dtype) for w in ms)
+        return (h, lens, tables, w_in, w_post, wq, wk, wv, wo,
+                wg, wu, wd, kc, vc)
+
+    @staticmethod
+    def _ref_full(h, lens, tables, w_in, w_post, wq, wk, wv, wo,
+                  wg, wu, wd, kc, vc):
+        ha, kcr, vcr = _ref_layer(h, lens, tables, w_in, wq, wk, wv,
+                                  wo, kc, vc)
+        x2 = rms_norm(ha, w_post, EPS)
+        hm = ha + _mm(jax.nn.silu(_mm(x2, wg)) * _mm(x2, wu), wd)
+        return hm, kcr, vcr
+
+    def _check_full(self, dtype, tol, quant_w=False):
+        ops = self._full_case(dtype, quant_w=quant_w)
+        hm, kcm, vcm = jax.jit(lambda a: decode_layer_megakernel_full(
+            a, *ops[1:], rope_base=BASE, eps=EPS))(ops[0])
+        hr, kcr, vcr = jax.jit(lambda a: self._ref_full(
+            a, *ops[1:]))(ops[0])
+        err = float(jnp.max(jnp.abs(hm.astype(jnp.float32)
+                                    - hr.astype(jnp.float32))))
+        self.assertLess(err, tol)
+        np.testing.assert_array_equal(np.asarray(kcm), np.asarray(kcr))
+        np.testing.assert_array_equal(np.asarray(vcm), np.asarray(vcr))
+
+    def test_full_layer_parity_f32(self):
+        self._check_full(jnp.float32, 1e-5)
+
+    def test_full_layer_parity_bf16(self):
+        self._check_full(jnp.bfloat16, 5e-2)
+
+    def test_full_layer_parity_quant_weights(self):
+        self._check_full(jnp.bfloat16, 5e-2, quant_w=True)
+
+    def test_scan_bitwise_equals_per_layer_full_chain(self):
+        L = 2
+        cases = [self._full_case(jnp.bfloat16, seed=i)
+                 for i in range(L)]
+        h, lens, tables = cases[0][0], cases[0][1], cases[0][2]
+        # per-layer full chain, residual carried between calls
+        hc, kcs, vcs = h, [], []
+        for i in range(L):
+            hc, kc2, vc2 = jax.jit(
+                lambda a, c=cases[i]: decode_layer_megakernel_full(
+                    a, lens, tables, *c[3:12], c[12], c[13],
+                    rope_base=BASE, eps=EPS))(hc)
+            kcs.append(kc2)
+            vcs.append(vc2)
+        # one layer-walked call over stacked weights + stacked pool
+        stacked = [jnp.stack([cases[i][j] for i in range(L)])
+                   for j in range(3, 12)]
+        kc_st = jnp.concatenate([c[12] for c in cases], axis=0)
+        vc_st = jnp.concatenate([c[13] for c in cases], axis=0)
+        hs, kcn, vcn = jax.jit(
+            lambda a: decode_layers_megakernel(
+                a, lens, tables, *stacked, kc_st, vc_st, n_layers=L,
+                rope_base=BASE, eps=EPS))(h)
+        np.testing.assert_array_equal(np.asarray(hs), np.asarray(hc))
+        stride = cases[0][12].shape[0]
+        for i in range(L):
+            sl = slice(i * stride, (i + 1) * stride)
+            np.testing.assert_array_equal(np.asarray(kcn[sl]),
+                                          np.asarray(kcs[i]))
+            np.testing.assert_array_equal(np.asarray(vcn[sl]),
+                                          np.asarray(vcs[i]))
+
+    def test_scan_bitwise_equals_full_chain_int8_pools(self):
+        """int8 pools through the scan: per-layer commit slices (int
+        values AND f32 scales) bitwise the per-layer full chain's —
+        the monotone absmax chain is preserved per layer step."""
+        L = 2
+        cases = [self._full_case(jnp.bfloat16, seed=i)
+                 for i in range(L)]
+        h, lens, tables = cases[0][0], cases[0][1], cases[0][2]
+        qs = [(quantize_kv_pages(c[12]), quantize_kv_pages(c[13]))
+              for c in cases]
+        hc, kcs, vcs = h, [], []
+        for i in range(L):
+            (kq, ks), (vq, vsc) = qs[i]
+            hc, kct, vct = jax.jit(
+                lambda a, c=cases[i], kq=kq, ks=ks, vq=vq, vsc=vsc:
+                decode_layer_megakernel_full(
+                    a, lens, tables, *c[3:12], kq, vq,
+                    rope_base=BASE, eps=EPS, k_scale=ks,
+                    v_scale=vsc))(hc)
+            kcs.append(kct)
+            vcs.append(vct)
+        stacked = [jnp.stack([cases[i][j] for i in range(L)])
+                   for j in range(3, 12)]
+        kq_st = jnp.concatenate([k[0] for k, _ in qs], axis=0)
+        ks_st = jnp.concatenate([k[1] for k, _ in qs], axis=0)
+        vq_st = jnp.concatenate([v[0] for _, v in qs], axis=0)
+        vs_st = jnp.concatenate([v[1] for _, v in qs], axis=0)
+        hs, kcn, vcn = jax.jit(
+            lambda a: decode_layers_megakernel(
+                a, lens, tables, *stacked, kq_st, vq_st, n_layers=L,
+                rope_base=BASE, eps=EPS, k_scale=ks_st,
+                v_scale=vs_st))(h)
+        np.testing.assert_array_equal(np.asarray(hs), np.asarray(hc))
+        stride = cases[0][12].shape[0]
+        for i in range(L):
+            sl = slice(i * stride, (i + 1) * stride)
+            for got, want in ((kcn, kcs[i]), (vcn, vcs[i])):
+                np.testing.assert_array_equal(
+                    np.asarray(got[0][sl]), np.asarray(want[0]))
+                np.testing.assert_array_equal(
+                    np.asarray(got[1][sl]), np.asarray(want[1]))
+
+
+class TestScanServing(unittest.TestCase):
+    @pytest.mark.slow  # tier-1 budget: three full engine builds; the
+    # int8 per-layer-step byte contract stays tier-1 at the kernel
+    # level via test_scan_bitwise_equals_full_chain_int8_pools
+    def test_scan_int8_pool_commits_byte_identical_per_layer(self):
+        """ISSUE 20 acceptance: int8 pool commits byte-identical per
+        layer STEP — after identical churn the scanned engine's single
+        stacked pool holds, per layer slice, exactly the bytes (int
+        values AND f32 scales) the per-layer 'full' engine's pools
+        hold; both emit the multi-kernel oracle's tokens. (The oracle's
+        pools are NOT the byte reference: its unfused MLP rounds the
+        next layer's input differently, which is the attn-rung
+        TestLayerParityInt8 contract, not the scan one.)"""
+        off_toks, _, _ = _engine_run("off", "int8")
+        full_toks, full_eng, _ = _engine_run("full", "int8")
+        scan_toks, scan_eng, _ = _engine_run("scan", "int8")
+        self.assertEqual(scan_eng.megakernel_rung, "scan")
+        self.assertEqual(scan_eng.metrics()["megakernel_rung"], "scan")
+        self.assertEqual(full_eng.megakernel_rung, "full")
+        self.assertEqual(off_toks, scan_toks)
+        self.assertEqual(full_toks, scan_toks)
+        self.assertEqual(len(scan_eng.kcs), 1)
+        (kq, ks), (vq, vs) = scan_eng.kcs[0], scan_eng.vcs[0]
+        n_layers = len(full_eng.kcs)
+        stride = kq.shape[0] // n_layers
+        for i in range(n_layers):
+            (okq, oks), (ovq, ovs) = full_eng.kcs[i], full_eng.vcs[i]
+            sl = slice(i * stride, (i + 1) * stride)
+            np.testing.assert_array_equal(np.asarray(kq[sl]),
+                                          np.asarray(okq))
+            np.testing.assert_array_equal(np.asarray(vq[sl]),
+                                          np.asarray(ovq))
+            np.testing.assert_array_equal(np.asarray(ks[sl]),
+                                          np.asarray(oks))
+            np.testing.assert_array_equal(np.asarray(vs[sl]),
+                                          np.asarray(ovs))
+
+    def test_scan_kernels_per_step_flat_in_depth(self):
+        """ISSUE 20 acceptance: the scanned decode step of a 4-layer
+        tiny llama traces to <= 3 kernel launches (the megakernel, the
+        final rms_norm, the lm head) — launch count flat in depth,
+        strictly below the multi-kernel step's."""
+        from paddle_tpu.analysis.roofline import count_step_kernels
+        from paddle_tpu.models.llama import (
+            _make_decode_step_megakernel, stack_decode_layer_params)
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  num_hidden_layers=4,
+                                  num_key_value_heads=2)
+        paddle.seed(3)
+        model = LlamaForCausalLM(cfg)
+        params = stack_decode_layer_params(dict(model.raw_state()),
+                                           cfg.num_hidden_layers)
+        b, bs, W = 2, 8, 2
+        max_pages = b * W + 1
+        nkv, dh = cfg.num_key_value_heads, cfg.head_dim
+        tables = jnp.asarray(np.arange(b * W).reshape(b, W) + 1,
+                             jnp.int32)
+        pool = lambda: [jnp.zeros(
+            (max_pages * cfg.num_hidden_layers, nkv, bs, dh),
+            jnp.float32)]
+        step = _make_decode_step_megakernel(cfg, b, tables,
+                                            mode="scan")
+        tok = jnp.ones((b, 1), jnp.int32)
+        lens = jnp.full((b,), 3, jnp.int32)
+        n = count_step_kernels(step, params, pool(), pool(), tok, lens)
+        self.assertLessEqual(n, 3)
+
+
+class TestQuantizeOutEpilogue(unittest.TestCase):
+    """ISSUE 20 satellite: the in-kernel o-proj quantize epilogue emits
+    exactly the quantize_blocks wire layout of the f32 partial, and
+    quantized_psum_prequant over that wire is bit-identical to
+    quantized_psum of the f32 partial — the TP seam never round-trips
+    an f32 partial through HBM."""
+
+    def test_bitwise_matches_quantize_blocks_of_f32_partial(self):
+        from paddle_tpu.parallel.collectives import quantize_blocks
+
+        # lane-aligned H=128 (nh=4, dh=32): the serving gate's shape
+        h, lens, tables, w_in, wq, wk, wv, wo, kc, vc = _case(
+            jnp.bfloat16, 4, 2, 32, 128)
+        part, kc1, vc1 = jax.jit(lambda a: decode_layer_megakernel(
+            a, lens, tables, w_in, wq, wk, wv, wo, kc, vc,
+            rope_base=BASE, eps=EPS, residual=False))(h)
+        (q8, sc), kc2, vc2 = jax.jit(lambda a: decode_layer_megakernel(
+            a, lens, tables, w_in, wq, wk, wv, wo, kc, vc,
+            rope_base=BASE, eps=EPS, residual=False,
+            quantize_out=True))(h)
+        self.assertEqual(q8.dtype, jnp.int8)
+        self.assertEqual(part.dtype, jnp.float32)
+        qr, sr = quantize_blocks(part.reshape(4, 128))
+        np.testing.assert_array_equal(np.asarray(q8), np.asarray(qr))
+        np.testing.assert_array_equal(np.asarray(sc), np.asarray(sr))
+        # the quantize epilogue leaves the pool commit untouched
+        np.testing.assert_array_equal(np.asarray(kc1), np.asarray(kc2))
+        np.testing.assert_array_equal(np.asarray(vc1), np.asarray(vc2))
+
+    def test_quantize_out_requires_residual_off_and_aligned_h(self):
+        h, lens, tables, w_in, wq, wk, wv, wo, kc, vc = _case(
+            jnp.bfloat16, 4, 2, 32, 128)
+        with self.assertRaisesRegex(ValueError, "residual"):
+            decode_layer_megakernel(
+                h, lens, tables, w_in, wq, wk, wv, wo, kc, vc,
+                quantize_out=True)
+        ops = _case(jnp.bfloat16, 4, 2, 16, 32)
+        with self.assertRaisesRegex(ValueError, "lane-aligned"):
+            decode_layer_megakernel(*ops[:10], residual=False,
+                                    quantize_out=True)
+
+    def test_prequant_psum_bit_identical_to_f32_partial_psum(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.parallel import collectives as qc
+        from paddle_tpu.parallel.shard_map_compat import shard_map
+
+        rng = np.random.default_rng(11)
+        for n in (2, 4):
+            x = jnp.asarray(
+                rng.normal(size=(n, 4, 256)).astype(np.float32))
+            mesh = Mesh(np.asarray(jax.devices()[:n]), ("mp",))
+
+            def smap(fn):
+                return jax.jit(shard_map(
+                    fn, mesh=mesh, in_specs=P("mp"),
+                    out_specs=P("mp"), check_vma=False))
+
+            ref = smap(lambda v: qc.quantized_psum(v[0], "mp")[None])(x)
+            pre = smap(lambda v: qc.quantized_psum_prequant(
+                *qc.quantize_blocks(v[0]), "mp", shape=v[0].shape,
+                dtype=v[0].dtype)[None])(x)
+            np.testing.assert_array_equal(np.asarray(ref),
+                                          np.asarray(pre))
+
+    def test_prequant_psum_rejects_misaligned_payload(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.parallel import collectives as qc
+        from paddle_tpu.parallel.shard_map_compat import shard_map
+
+        # 3 * 128 = 384 flat elements do not split into 2 * 128 blocks
+        x = jnp.ones((2, 3, 128), jnp.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("mp",))
+        with self.assertRaisesRegex(ValueError, "split"):
+            jax.jit(shard_map(
+                lambda v: qc.quantized_psum_prequant(
+                    *qc.quantize_blocks(v[0]), "mp",
+                    shape=v[0].shape, dtype=v[0].dtype)[None],
+                mesh=mesh, in_specs=P("mp"), out_specs=P("mp"),
+                check_vma=False))(x)
 
 
 if __name__ == "__main__":

@@ -354,6 +354,89 @@ class TestCompileGuard(unittest.TestCase):
         self.assertIsNotNone(comms)
 
 
+class TestAdaptiveSpec(unittest.TestCase):
+    """ISSUE 20 satellite: acceptance-adaptive draft depth. The policy
+    is pure host state — the verify window stays spec_k+1 rows, only
+    the per-step `want` cap moves, so no program key changes and no
+    new compiles ever."""
+
+    def test_policy_shrinks_to_floor_on_dead_drafting(self):
+        from paddle_tpu.serving.speculative import AdaptiveSpecPolicy
+
+        pol = AdaptiveSpecPolicy(4)
+        self.assertEqual(pol.spec_k_effective, 4)
+        for _ in range(10):
+            pol.observe(4, 0)
+        self.assertEqual(pol.spec_k_effective, 1)  # floor, never 0
+        self.assertLess(pol.acceptance_ewma, 0.4)
+
+    def test_policy_grows_back_after_patience(self):
+        from paddle_tpu.serving.speculative import AdaptiveSpecPolicy
+
+        pol = AdaptiveSpecPolicy(4, patience=3)
+        for _ in range(10):
+            pol.observe(4, 0)          # walk to the floor
+        for _ in range(30):
+            pol.observe(4, 4)          # sustained full acceptance
+        self.assertEqual(pol.spec_k_effective, 4)  # capped at spec_k
+        pol.observe(4, 4)
+        self.assertEqual(pol.spec_k_effective, 4)  # never above the cap
+
+    def test_policy_ignores_empty_windows_and_validates(self):
+        from paddle_tpu.serving.speculative import AdaptiveSpecPolicy
+
+        pol = AdaptiveSpecPolicy(4)
+        pol.observe(0, 0)              # no drafts offered: no signal
+        self.assertIsNone(pol.acceptance_ewma)
+        self.assertEqual(pol.spec_k_effective, 4)
+        with self.assertRaisesRegex(ValueError, "spec_k"):
+            AdaptiveSpecPolicy(0)
+
+    def test_resolver_and_flag(self):
+        from paddle_tpu.serving.speculative import resolve_spec_adaptive
+
+        self.assertFalse(resolve_spec_adaptive(None))  # flag default
+        self.assertTrue(resolve_spec_adaptive(True))
+        self.assertTrue(resolve_spec_adaptive("on"))
+        self.assertFalse(resolve_spec_adaptive("0"))
+        prev = paddle.get_flags(["spec_adaptive"])
+        paddle.set_flags({"spec_adaptive": True})
+        try:
+            self.assertTrue(resolve_spec_adaptive(None))
+        finally:
+            paddle.set_flags({k.replace("FLAGS_", ""): v
+                              for k, v in prev.items()})
+
+    def test_engine_identity_metrics_and_zero_compiles(self):
+        """Adaptive-on serves token-identical to spec-off (acceptance
+        logic is unchanged — only draft depth adapts), reports the live
+        depth in metrics(), and adds zero compiles after warm."""
+        cfg, _, params = _tiny_setup(dtype=jnp.bfloat16)
+        rng = np.random.default_rng(5)
+        prompts = _churn_prompts(cfg, rng)
+        t_off = _serve(_engine(cfg, params), prompts, max_new=8)
+        eng = _engine(cfg, params, speculative="ngram", spec_k=4,
+                      spec_adaptive=True)
+        eng.warm(buckets=[8, 16, 24, 32])
+        before = eng.compile_stats()
+        t_on = _serve(eng, prompts, max_new=8)
+        self.assertEqual(t_off, t_on)
+        self.assertEqual(eng.compile_stats(), before)
+        self.assertGreater(eng.spec_drafted, 0)
+        em = eng.metrics()
+        self.assertTrue(em["spec_adaptive"])
+        self.assertTrue(1 <= em["spec_k_effective"] <= eng.spec_k)
+        # the policy actually saw the served windows
+        self.assertIsNotNone(eng._spec_policy.acceptance_ewma)
+
+    def test_off_engine_reports_static_depth(self):
+        cfg, _, params = _tiny_setup(dtype=jnp.bfloat16)
+        eng = _engine(cfg, params, speculative="ngram", spec_k=3)
+        self.assertFalse(eng.spec_adaptive)
+        self.assertIsNone(eng._spec_policy)
+        self.assertEqual(eng.metrics()["spec_k_effective"], 3)
+
+
 class TestWatchdogSpec(unittest.TestCase):
     def tearDown(self):
         from paddle_tpu.resilience import chaos

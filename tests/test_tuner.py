@@ -56,6 +56,11 @@ def _demo_runs():
     # per candidate; speculation has its own suite (test_speculative)
     space["speculative"] = ["off"]
     space["spec_k"] = [0]
+    # and for the ISSUE 20 ladder: full/scan double the sweep and
+    # each builds + traces a fused-step engine; the deep rungs have
+    # their own suites (test_decode_megakernel, TestMegakernelKnob)
+    # and the CLI schema gate tunes over all four
+    space["decode_megakernel"] = ["off", "attn"]
     geo = tuner._engine_geometry(dict(_KW))
     budget = max(tuner.static_candidate_bound(cfg, params, c, _KW)
                  for c in tuner.enumerate_candidates(space, geo)) - 1
@@ -227,6 +232,70 @@ class TestServingCPKnob(unittest.TestCase):
             "serving_cp*serving_mp = 16" in r and "host has" in r
             for r in reasons), reasons)
         self.assertFalse(rep.ranking)
+
+
+class TestMegakernelKnob(unittest.TestCase):
+    """ISSUE 20: decode_megakernel becomes the four-rung tri-state in
+    the space, with canonicalization collapsing rungs the engine would
+    refuse anyway (full/scan under a cp or mp mesh, any rung on a
+    future int4 pool) so the same fallen-back program is never scored
+    under several names."""
+
+    def test_space_sweeps_all_rungs(self):
+        cfg, _ = _tiny_setup()
+        space = tuner.default_space(cfg, _KW)
+        self.assertEqual(space["decode_megakernel"],
+                         ["off", "attn", "full", "scan"])
+        # the widened axis changes the space hash: an artifact tuned
+        # over the boolean space is stale against the tri-state one
+        legacy = dict(space, decode_megakernel=[False, True])
+        self.assertNotEqual(tuner.space_hash(space),
+                            tuner.space_hash(legacy))
+
+    def test_canonicalization_collapses_refused_rungs(self):
+        geo = tuner._engine_geometry(dict(_KW))
+        base = tuner.baseline_config(cfg=LlamaConfig.tiny(),
+                                     engine_kwargs=_KW)
+        for deep in ("full", "scan"):
+            c = tuner.canonical_config(
+                dict(base, serving_cp=2, decode_megakernel=deep), geo)
+            self.assertEqual(c["decode_megakernel"], "attn")
+            c = tuner.canonical_config(
+                dict(base, serving_mp=2, decode_megakernel=deep), geo)
+            self.assertEqual(c["decode_megakernel"], "attn")
+        # off stays off on every mesh; attn survives under cp (the
+        # engine warns + falls back at build, but the REQUEST is what
+        # the knob records)
+        c = tuner.canonical_config(
+            dict(base, serving_cp=2, decode_megakernel="off"), geo)
+        self.assertEqual(c["decode_megakernel"], "off")
+        c = tuner.canonical_config(
+            dict(base, serving_cp=2, decode_megakernel="attn"), geo)
+        self.assertEqual(c["decode_megakernel"], "attn")
+        # a future int4 pool has no in-kernel nibble unpack: every
+        # rung collapses to off
+        c = tuner.canonical_config(
+            dict(base, kv_cache_dtype="int4",
+                 decode_megakernel="scan"), geo)
+        self.assertEqual(c["decode_megakernel"], "off")
+        # legacy booleans normalize to the tri-state
+        c = tuner.canonical_config(
+            dict(base, decode_megakernel=True), geo)
+        self.assertEqual(c["decode_megakernel"], "attn")
+        c = tuner.canonical_config(
+            dict(base, decode_megakernel=False), geo)
+        self.assertEqual(c["decode_megakernel"], "off")
+
+    def test_tuned_config_round_trips_rung(self):
+        tc = analysis.TunedConfig(
+            knobs={"decode_megakernel": "scan"}, device="tpu-v5e",
+            model="m", space_hash="x")
+        with tempfile.TemporaryDirectory() as d:
+            path = tc.save(d)
+            back = analysis.TunedConfig.load(path)
+        self.assertEqual(back.knobs["decode_megakernel"], "scan")
+        merged = back.apply({"decode_megakernel": None})
+        self.assertEqual(merged["decode_megakernel"], "scan")
 
 
 class TestTunedConfigArtifact(unittest.TestCase):
@@ -405,12 +474,7 @@ class TestCLITune(unittest.TestCase):
             capture_output=True, text=True, env=env,
             cwd=os.path.dirname(os.path.dirname(__file__)), timeout=520)
 
-    def test_cli_tune_json_schema(self):
-        """Tier-1 CI gate (ISSUE 16 satellite): `--tune --format json`
-        exits 0 and emits the documented TuningReport schema with a
-        feasible baseline, provable prunes from both gates, and a
-        winner no slower than the defaults."""
-        proc = self._run("--format", "json")
+    def _assert_schema(self, proc, *, want_static_prune):
         self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
         d = json.loads(proc.stdout)
         self.assertEqual(sorted(d),
@@ -423,13 +487,38 @@ class TestCLITune(unittest.TestCase):
                     "engine_geometry"):
             self.assertIn(key, t)
         self.assertGreater(t["n_pruned"], 0)
-        self.assertTrue(any("before tracing" in p["pruned_reason"]
-                            for p in t["pruned"]))
+        if want_static_prune:
+            self.assertTrue(any("before tracing" in p["pruned_reason"]
+                                for p in t["pruned"]))
         self.assertTrue(t["baseline"]["feasible"])
         self.assertLessEqual(t["best"]["predicted_step_ms"],
                              t["baseline"]["predicted_step_ms"])
         self.assertGreaterEqual(t["predicted_speedup_vs_default"], 1.0)
         self.assertEqual(d["counts"]["error"], 0)
+
+    def test_cli_tune_json_schema(self):
+        """Tier-1 CI gate (ISSUE 16 satellite): `--tune --format json`
+        exits 0 and emits the documented TuningReport schema with a
+        feasible baseline, provable prunes, and a winner no slower
+        than the defaults.
+
+        `--budget-candidates 24` keeps the subprocess tier-1-sized:
+        the four-rung megakernel axis (ISSUE 20) doubled the full
+        space, and every candidate in a prefix traces an engine. The
+        prefix still peak-prunes (bs8 unified candidates); the
+        before-tracing static prune sits in the block_size=16 class
+        past any affordable prefix, so that assertion lives in the
+        in-process both-stages gate (TestFeasibilityGate) and the
+        @slow full-sweep twin below."""
+        self._assert_schema(
+            self._run("--format", "json", "--budget-candidates", "24"),
+            want_static_prune=False)
+
+    @pytest.mark.slow  # the uncapped sweep traces every block_size=8
+    # candidate across all four megakernel rungs in a subprocess
+    def test_cli_tune_json_schema_full_sweep(self):
+        self._assert_schema(self._run("--format", "json"),
+                            want_static_prune=True)
 
     @pytest.mark.slow  # tier-1 keeps the rc-0 schema gate above; the
     # rc-1 leg re-runs the whole tune in a second subprocess
